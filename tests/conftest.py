@@ -1,0 +1,32 @@
+"""Test harness config.
+
+All tests run on the CPU backend with 8 virtual devices so multi-chip sharding
+logic (mesh assembly, make_array_from_process_local_data, ring attention
+collectives) is exercised without TPU hardware, per the build contract. The
+env vars must be set before jax initializes its backends, hence module scope
+here (conftest imports before any test module).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+from torchkafka_tpu.source.memory import InMemoryBroker  # noqa: E402
+
+
+@pytest.fixture
+def broker():
+    return InMemoryBroker()
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

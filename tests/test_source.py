@@ -1,0 +1,227 @@
+"""Source layer: broker semantics, consumer groups, rebalance, re-delivery.
+
+Encodes the commit-ordering invariants SURVEY.md §4 derives from the
+reference's structure: (ii) CommitFailedError is survivable, (iii)
+crash-before-commit re-delivers, plus group assignment disjointness (the
+reference's data-parallel sharding mechanism).
+"""
+
+import threading
+
+import pytest
+
+from torchkafka_tpu import (
+    CommitFailedError,
+    ConsumerClosedError,
+    InMemoryBroker,
+    MemoryConsumer,
+    TopicPartition,
+)
+from torchkafka_tpu.errors import NotAssignedError, UnknownTopicError
+from torchkafka_tpu.source import partitions_for_process
+
+
+def fill(broker, topic, n, partitions=None):
+    return [
+        broker.produce(topic, f"v{i}".encode(), partition=partitions)
+        for i in range(n)
+    ]
+
+
+class TestBroker:
+    def test_produce_round_robin_spreads_partitions(self, broker):
+        broker.create_topic("t", partitions=4)
+        recs = fill(broker, "t", 8)
+        assert sorted(r.partition for r in recs) == [0, 0, 1, 1, 2, 2, 3, 3]
+        # per-partition offsets are dense from 0
+        assert [r.offset for r in recs if r.partition == 0] == [0, 1]
+
+    def test_produce_key_hash_is_sticky(self, broker):
+        broker.create_topic("t", partitions=4)
+        parts = {broker.produce("t", b"x", key=b"user-42").partition for _ in range(5)}
+        assert len(parts) == 1
+
+    def test_unknown_topic(self, broker):
+        with pytest.raises(UnknownTopicError):
+            broker.produce("nope", b"x")
+
+    def test_fetch_bounds(self, broker):
+        broker.create_topic("t")
+        fill(broker, "t", 5)
+        tp = TopicPartition("t", 0)
+        assert [r.offset for r in broker.fetch(tp, 3, 10)] == [3, 4]
+        assert broker.fetch(tp, 99, 10) == []
+        assert broker.end_offset(tp) == 5
+
+
+class TestConsumerBasics:
+    def test_poll_returns_all_in_partition_order(self, broker):
+        broker.create_topic("t", partitions=2)
+        fill(broker, "t", 10)
+        c = MemoryConsumer(broker, "t", group_id="g")
+        recs = c.poll(max_records=100)
+        assert len(recs) == 10
+        for p in (0, 1):
+            offs = [r.offset for r in recs if r.partition == p]
+            assert offs == sorted(offs)
+
+    def test_iteration_matches_reference_hot_loop_shape(self, broker):
+        # for record in consumer — /root/reference/src/kafka_dataset.py:156
+        broker.create_topic("t")
+        fill(broker, "t", 6)
+        c = MemoryConsumer(broker, "t", group_id="g")
+        seen = []
+        for rec in c:
+            seen.append(rec.value)
+            if len(seen) == 6:
+                c.close()
+        assert seen == [f"v{i}".encode() for i in range(6)]
+
+    def test_commit_resume_cycle(self, broker):
+        """Committed offsets are the resume state (reference's checkpoint
+        story, SURVEY.md §5): same group -> resume at last commit."""
+        broker.create_topic("t")
+        fill(broker, "t", 10)
+        tp = TopicPartition("t", 0)
+
+        c1 = MemoryConsumer(broker, "t", group_id="g")
+        got = c1.poll(max_records=4)
+        c1.commit({tp: got[-1].offset + 1})
+        c1.close()
+
+        c2 = MemoryConsumer(broker, "t", group_id="g")
+        assert c2.poll(max_records=100)[0].offset == 4
+
+    def test_crash_before_commit_redelivers(self, broker):
+        """Invariant (iii): close() never commits
+        (/root/reference/src/kafka_dataset.py:89)."""
+        broker.create_topic("t")
+        fill(broker, "t", 5)
+        c1 = MemoryConsumer(broker, "t", group_id="g")
+        assert len(c1.poll(max_records=5)) == 5
+        c1.close()  # no commit -> everything re-delivered
+
+        c2 = MemoryConsumer(broker, "t", group_id="g")
+        assert [r.offset for r in c2.poll(max_records=5)] == [0, 1, 2, 3, 4]
+
+    def test_auto_offset_reset_latest(self, broker):
+        broker.create_topic("t")
+        fill(broker, "t", 3)
+        c = MemoryConsumer(broker, "t", group_id="g", auto_offset_reset="latest")
+        assert c.poll() == []
+        broker.produce("t", b"new")
+        assert [r.value for r in c.poll()] == [b"new"]
+
+    def test_closed_consumer_raises(self, broker):
+        broker.create_topic("t")
+        c = MemoryConsumer(broker, "t", group_id="g")
+        c.close()
+        with pytest.raises(ConsumerClosedError):
+            c.poll()
+        c.close()  # idempotent
+
+    def test_seek(self, broker):
+        broker.create_topic("t")
+        fill(broker, "t", 5)
+        c = MemoryConsumer(broker, "t", group_id="g")
+        c.poll(max_records=5)
+        c.seek(TopicPartition("t", 0), 2)
+        assert [r.offset for r in c.poll()] == [2, 3, 4]
+
+    def test_blocking_poll_wakes_on_produce(self, broker):
+        broker.create_topic("t")
+        c = MemoryConsumer(broker, "t", group_id="g")
+
+        def later():
+            broker.produce("t", b"x")
+
+        t = threading.Timer(0.05, later)
+        t.start()
+        recs = c.poll(timeout_ms=2000)
+        t.join()
+        assert [r.value for r in recs] == [b"x"]
+
+
+class TestGroups:
+    def test_two_members_get_disjoint_partitions(self, broker):
+        """The reference's data-parallel sharding: one consumer per worker,
+        disjoint partitions (/root/reference/src/kafka_dataset.py:208-233)."""
+        broker.create_topic("t", partitions=4)
+        a = MemoryConsumer(broker, "t", group_id="g")
+        b = MemoryConsumer(broker, "t", group_id="g")
+        pa, pb = set(a.assignment()), set(b.assignment())
+        assert pa.isdisjoint(pb)
+        assert len(pa | pb) == 4
+
+    def test_rebalance_invalidates_stale_commit(self, broker):
+        """Invariant (ii): commit after rebalance -> CommitFailedError, and it
+        is survivable (/root/reference/src/kafka_dataset.py:131-135)."""
+        broker.create_topic("t", partitions=2)
+        fill(broker, "t", 4)
+        a = MemoryConsumer(broker, "t", group_id="g")
+        a.poll(max_records=4)
+        # New member joins -> generation bump; a's cached generation is stale.
+        b = MemoryConsumer(broker, "t", group_id="g")
+        with pytest.raises(CommitFailedError):
+            a.commit({TopicPartition("t", 0): 2})
+        # Survivable: nothing was committed, records re-deliver to new owners.
+        assert broker.committed("g", TopicPartition("t", 0)) is None
+        got = a.poll(max_records=4) + b.poll(max_records=4)
+        assert len(got) == 4
+
+    def test_member_leave_reassigns_to_survivor(self, broker):
+        """Dead worker -> partitions rebalance to survivors, uncommitted
+        offsets re-delivered (SURVEY.md §5 failure-recovery row)."""
+        broker.create_topic("t", partitions=2)
+        fill(broker, "t", 6)
+        a = MemoryConsumer(broker, "t", group_id="g")
+        b = MemoryConsumer(broker, "t", group_id="g")
+        a.poll(max_records=10)
+        b.poll(max_records=10)
+        b.close()
+        # a picks up b's partitions. Eager rebalance revokes everything, and
+        # neither member ever committed, so ALL records re-deliver to a.
+        assert len(set(a.assignment())) == 2
+        assert len(a.poll(max_records=10)) == 6
+
+
+class TestManualAssignment:
+    def test_mesh_aligned_assignment_is_disjoint_and_complete(self):
+        tps = [
+            tp
+            for i in range(4)
+            for tp in partitions_for_process("t", 16, i, 4)
+        ]
+        assert len(tps) == 16
+        assert len(set(tps)) == 16
+        mine = partitions_for_process("t", 16, 1, 4)
+        assert [tp.partition for tp in mine] == [1, 5, 9, 13]
+
+    def test_manual_consumer_polls_only_assigned(self, broker):
+        broker.create_topic("t", partitions=4)
+        fill(broker, "t", 8)
+        c = MemoryConsumer(
+            broker, "t", group_id="g",
+            assignment=partitions_for_process("t", 4, 0, 2),
+        )
+        recs = c.poll(max_records=100)
+        assert {r.partition for r in recs} == {0, 2}
+
+    def test_manual_commit_unchecked_by_generation(self, broker):
+        broker.create_topic("t", partitions=2)
+        fill(broker, "t", 2)
+        c = MemoryConsumer(
+            broker, "t", group_id="g", assignment=[TopicPartition("t", 0)]
+        )
+        # Group churn elsewhere doesn't invalidate standalone commits.
+        MemoryConsumer(broker, "t", group_id="g")
+        c.commit({TopicPartition("t", 0): 1})
+        assert broker.committed("g", TopicPartition("t", 0)) == 1
+
+    def test_manual_commit_outside_assignment_rejected(self, broker):
+        broker.create_topic("t", partitions=2)
+        c = MemoryConsumer(
+            broker, "t", group_id="g", assignment=[TopicPartition("t", 0)]
+        )
+        with pytest.raises(NotAssignedError):
+            c.commit({TopicPartition("t", 1): 1})

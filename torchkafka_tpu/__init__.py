@@ -1,0 +1,44 @@
+"""torchkafka_tpu — TPU-native Kafka streaming-ingest framework.
+
+A ground-up JAX/XLA rebuild of the capabilities of Bendabir/torch-kafka
+(reference at /root/reference): stream records from Kafka into
+accelerator-ready global jax.Arrays with manual, commit-after-step offset
+semantics (at-least-once delivery), scaled from one process to a TPU pod.
+
+The reference exports exactly two names — ``KafkaDataset`` and ``auto_commit``
+(/root/reference/src/__init__.py:17-18). We export the TPU-native core
+(KafkaStream and friends) plus a drop-in compatibility surface for migrating
+reference users (torchkafka_tpu.compat).
+"""
+
+from torchkafka_tpu.errors import (
+    BarrierError,
+    CommitFailedError,
+    ConsumerClosedError,
+    TpuKafkaError,
+)
+from torchkafka_tpu.source import (
+    Consumer,
+    InMemoryBroker,
+    KafkaConsumer,
+    MemoryConsumer,
+    Record,
+    TopicPartition,
+    partitions_for_process,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "BarrierError",
+    "CommitFailedError",
+    "Consumer",
+    "ConsumerClosedError",
+    "InMemoryBroker",
+    "KafkaConsumer",
+    "MemoryConsumer",
+    "Record",
+    "TopicPartition",
+    "TpuKafkaError",
+    "partitions_for_process",
+]

@@ -1,0 +1,46 @@
+"""Exception hierarchy for torchkafka_tpu.
+
+The reference surfaces exactly one failure class to users:
+``kafka.errors.CommitFailedError``, which it catches and logs as non-fatal
+(/root/reference/src/kafka_dataset.py:131-135) because a failed commit simply
+means the records will be re-delivered (at-least-once delivery). We keep that
+contract but define our own transport-independent exceptions so the in-memory
+broker, the kafka-python adapter, and any future native client all raise the
+same types.
+"""
+
+from __future__ import annotations
+
+
+class TpuKafkaError(Exception):
+    """Base class for all torchkafka_tpu errors."""
+
+
+class CommitFailedError(TpuKafkaError):
+    """Offset commit was rejected (e.g. after a group rebalance).
+
+    Mirrors kafka-python's ``CommitFailedError`` as used by the reference
+    (/root/reference/src/kafka_dataset.py:22,131). Always survivable:
+    uncommitted records are re-delivered to whichever consumer now owns the
+    partitions, preserving at-least-once semantics.
+    """
+
+
+class ConsumerClosedError(TpuKafkaError):
+    """Operation attempted on a closed consumer."""
+
+
+class NotAssignedError(TpuKafkaError):
+    """Commit/seek referenced a partition this consumer does not own."""
+
+
+class UnknownTopicError(TpuKafkaError):
+    """Topic does not exist on the broker."""
+
+
+class BarrierError(TpuKafkaError):
+    """The pod-wide commit barrier failed.
+
+    The commit path fails *closed* on this: no offsets are committed, so Kafka
+    re-delivers the batch — zero uncommitted-batch loss on host preemption.
+    """

@@ -1,0 +1,79 @@
+"""The Consumer protocol — the only transport surface the framework uses.
+
+The reference touches exactly four points of kafka-python's ``KafkaConsumer``:
+iteration (/root/reference/src/kafka_dataset.py:156), ``commit()`` (:130),
+``close()`` (:89) and construction with ``enable_auto_commit=False`` forced
+(:201). This protocol is that surface, made explicit and offset-precise:
+``commit`` takes an explicit ``{TopicPartition: next_offset}`` map rather than
+"whatever was polled", which is what lets the commit layer commit *exactly*
+the records of one batch (SURVEY.md §7 hard part (b)).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Mapping, Protocol, Sequence, runtime_checkable
+
+from torchkafka_tpu.source.records import Record, TopicPartition
+
+
+@runtime_checkable
+class Consumer(Protocol):
+    """Minimal consumer surface. All transports implement this."""
+
+    def poll(self, max_records: int = 500, timeout_ms: int = 0) -> list[Record]:
+        """Fetch up to ``max_records`` across assigned partitions.
+
+        Records are returned in per-partition offset order (partitions may be
+        interleaved). Returns an empty list if nothing arrived within
+        ``timeout_ms``. Never auto-commits — the reference's core invariant
+        (/root/reference/src/kafka_dataset.py:201).
+        """
+        ...
+
+    def commit(self, offsets: Mapping[TopicPartition, int] | None = None) -> None:
+        """Commit explicit next-read offsets; ``None`` commits current positions.
+
+        Raises CommitFailedError if the group rebalanced underneath us; callers
+        treat that as non-fatal (records get re-delivered).
+        """
+        ...
+
+    def committed(self, tp: TopicPartition) -> int | None:
+        """Last committed next-read offset for ``tp`` in this group, if any."""
+        ...
+
+    def position(self, tp: TopicPartition) -> int:
+        """Next offset ``poll`` would fetch for ``tp``."""
+        ...
+
+    def seek(self, tp: TopicPartition, offset: int) -> None: ...
+
+    def assignment(self) -> Sequence[TopicPartition]:
+        """Partitions currently owned by this consumer."""
+        ...
+
+    def close(self) -> None:
+        """Release assignment. NEVER commits on close — uncommitted work must
+        be re-delivered (/root/reference/src/kafka_dataset.py:89)."""
+        ...
+
+    def __iter__(self) -> Iterator[Record]: ...
+
+
+class ConsumerIterMixin:
+    """Provides record-at-a-time iteration on top of ``poll`` (the reference's
+    ``for record in consumer`` hot-loop shape, /root/reference/src/kafka_dataset.py:156)."""
+
+    _ITER_TIMEOUT_MS = 100
+
+    def __iter__(self) -> Iterator[Record]:
+        buf: list[Record] = []
+        while True:
+            if not buf:
+                if getattr(self, "_closed", False):
+                    return
+                buf = list(self.poll(timeout_ms=self._ITER_TIMEOUT_MS))  # type: ignore[attr-defined]
+                if not buf:
+                    continue
+                buf.reverse()  # pop from the end, preserve order
+            yield buf.pop()

@@ -1,0 +1,123 @@
+"""kafka-python transport adapter (optional dependency, gated import).
+
+Wraps ``kafka.KafkaConsumer`` behind the framework's Consumer protocol. The
+reference constructs KafkaConsumer directly and force-disables auto-commit
+(/root/reference/src/kafka_dataset.py:188-206); we do the same here, and keep
+the reference's kwargs-passthrough config philosophy (SURVEY.md §5): every
+keyword argument flows verbatim to kafka-python except the forced override.
+
+This module imports cleanly without kafka-python installed; constructing
+``KafkaConsumer`` without it raises a clear error.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Mapping, Sequence
+
+from torchkafka_tpu import errors
+from torchkafka_tpu.source.consumer import ConsumerIterMixin
+from torchkafka_tpu.source.records import Record, TopicPartition
+
+try:  # pragma: no cover - exercised only where kafka-python is installed
+    import kafka as _kafka
+    import kafka.errors as _kafka_errors
+
+    HAVE_KAFKA_PYTHON = True
+except ImportError:  # pragma: no cover
+    _kafka = None
+    _kafka_errors = None
+    HAVE_KAFKA_PYTHON = False
+
+
+class KafkaConsumer(ConsumerIterMixin):
+    """Consumer-protocol adapter over kafka-python.
+
+    ``assignment=[TopicPartition(...), ...]`` selects manual (mesh-aligned)
+    assignment via ``consumer.assign``; otherwise topics are subscribed and
+    the broker's group protocol assigns partitions (the reference's mode).
+    """
+
+    def __init__(
+        self,
+        topics: str | Sequence[str],
+        *,
+        assignment: Sequence[TopicPartition] | None = None,
+        **kafka_kwargs,
+    ) -> None:
+        if not HAVE_KAFKA_PYTHON:  # pragma: no cover
+            raise ImportError(
+                "kafka-python is not installed; install it or use "
+                "torchkafka_tpu.source.memory.MemoryConsumer"
+            )
+        # The invariant the whole framework exists for
+        # (/root/reference/src/kafka_dataset.py:201): offsets are committed by
+        # the commit barrier, never by a background auto-commit timer.
+        kafka_kwargs["enable_auto_commit"] = False
+        topics = [topics] if isinstance(topics, str) else list(topics)
+        self._closed = False
+        if assignment is not None:
+            self._consumer = _kafka.KafkaConsumer(**kafka_kwargs)
+            self._consumer.assign(
+                [_kafka.TopicPartition(tp.topic, tp.partition) for tp in assignment]
+            )
+        else:
+            self._consumer = _kafka.KafkaConsumer(*topics, **kafka_kwargs)
+
+    @staticmethod
+    def _to_record(r) -> Record:
+        return Record(
+            topic=r.topic,
+            partition=r.partition,
+            offset=r.offset,
+            value=r.value,
+            key=r.key,
+            timestamp_ms=r.timestamp,
+            headers=tuple(r.headers or ()),
+        )
+
+    def poll(self, max_records: int = 500, timeout_ms: int = 0) -> list[Record]:
+        batches = self._consumer.poll(timeout_ms=timeout_ms, max_records=max_records)
+        out: list[Record] = []
+        for recs in batches.values():
+            out.extend(self._to_record(r) for r in recs)
+        return out
+
+    def commit(self, offsets: Mapping[TopicPartition, int] | None = None) -> None:
+        try:
+            if offsets is None:
+                self._consumer.commit()
+            else:
+                self._consumer.commit(
+                    {
+                        _kafka.TopicPartition(tp.topic, tp.partition):
+                            _kafka.OffsetAndMetadata(off, None, -1)
+                        for tp, off in offsets.items()
+                    }
+                )
+        except _kafka_errors.CommitFailedError as e:
+            # Re-raise as the framework's transport-independent type; callers
+            # treat it as non-fatal (/root/reference/src/kafka_dataset.py:131-135).
+            raise errors.CommitFailedError(str(e)) from e
+
+    def committed(self, tp: TopicPartition) -> int | None:
+        return self._consumer.committed(_kafka.TopicPartition(tp.topic, tp.partition))
+
+    def position(self, tp: TopicPartition) -> int:
+        return self._consumer.position(_kafka.TopicPartition(tp.topic, tp.partition))
+
+    def seek(self, tp: TopicPartition, offset: int) -> None:
+        self._consumer.seek(_kafka.TopicPartition(tp.topic, tp.partition), offset)
+
+    def assignment(self) -> list[TopicPartition]:
+        return [TopicPartition(tp.topic, tp.partition) for tp in self._consumer.assignment()]
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        # autocommit=False: never commit on teardown — uncommitted work must
+        # be re-delivered (/root/reference/src/kafka_dataset.py:89).
+        self._consumer.close(autocommit=False)
+
+    def __iter__(self) -> Iterator[Record]:
+        return super().__iter__()

@@ -1,0 +1,407 @@
+"""In-memory Kafka-semantics broker and consumer.
+
+The reference has no test double at all (SURVEY.md §4: no tests anywhere in
+the tree); every commit-ordering behavior it implements is only exercisable
+against a live broker. This module is the seam SURVEY.md §4 calls for: a
+faithful in-process implementation of the consumer surface the framework uses
+— partitioned logs, consumer groups with generation-checked commits,
+rebalance-on-membership-change, at-least-once re-delivery — so the entire
+commit path is testable and benchmarkable hermetically (the environment has
+no network egress and no broker).
+
+Semantics mirrored from the Kafka group protocol (behavior the reference
+depends on implicitly via kafka-python):
+
+- Partitions of subscribed topics are range-assigned across the group's
+  members; any join/leave bumps the group *generation* and reassigns.
+  This is the mechanism behind the reference's data-parallel sharding
+  (/root/reference/src/kafka_dataset.py:208-233 — one consumer per DataLoader
+  worker, disjoint partitions each).
+- A commit carrying a stale generation (i.e. issued after a rebalance took
+  the partitions away) raises CommitFailedError — exactly the error the
+  reference swallows as non-fatal (/root/reference/src/kafka_dataset.py:131-135).
+- Committed offsets are the group's durable resume state: a new consumer in
+  the same group starts at the committed offset (the reference's
+  checkpoint/resume story, SURVEY.md §5).
+
+``commit_log_path`` additionally appends every successful commit as a JSON
+line; this makes commits observable across forked processes, which is how the
+torch-DataLoader compat path is tested.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+import time
+import zlib
+from typing import Iterable, Mapping, Sequence
+
+from torchkafka_tpu.errors import (
+    CommitFailedError,
+    ConsumerClosedError,
+    NotAssignedError,
+    UnknownTopicError,
+)
+from torchkafka_tpu.source.consumer import ConsumerIterMixin
+from torchkafka_tpu.source.records import Record, TopicPartition
+
+_member_counter = itertools.count()
+
+
+class _Group:
+    """One consumer group: membership, generation, assignment, offsets."""
+
+    def __init__(self) -> None:
+        self.generation = 0
+        # member_id -> set of subscribed topics (group-managed members only)
+        self.members: dict[str, frozenset[str]] = {}
+        self.assignment: dict[str, list[TopicPartition]] = {}
+        self.committed: dict[TopicPartition, int] = {}
+
+
+class InMemoryBroker:
+    """Thread-safe partitioned log store with consumer-group semantics."""
+
+    def __init__(self, commit_log_path: str | None = None) -> None:
+        self._lock = threading.RLock()
+        self._data_arrived = threading.Condition(self._lock)
+        self._logs: dict[TopicPartition, list[Record]] = {}
+        self._topics: dict[str, int] = {}  # topic -> partition count
+        self._groups: dict[str, _Group] = {}
+        self._rr: dict[str, int] = {}  # per-topic round-robin produce cursor
+        self._commit_log_path = commit_log_path
+
+    # ------------------------------------------------------------- topics
+
+    def create_topic(self, topic: str, partitions: int = 1) -> None:
+        with self._lock:
+            if topic in self._topics:
+                raise ValueError(f"topic {topic!r} already exists")
+            self._topics[topic] = partitions
+            for p in range(partitions):
+                self._logs[TopicPartition(topic, p)] = []
+
+    def partitions_for(self, topic: str) -> int:
+        with self._lock:
+            if topic not in self._topics:
+                raise UnknownTopicError(topic)
+            return self._topics[topic]
+
+    def produce(
+        self,
+        topic: str,
+        value: bytes,
+        key: bytes | None = None,
+        partition: int | None = None,
+        timestamp_ms: int | None = None,
+    ) -> Record:
+        """Append one record; partition chosen by explicit arg, key hash, or
+        round-robin (Kafka's default partitioner behavior)."""
+        with self._lock:
+            n = self.partitions_for(topic)
+            if partition is None:
+                if key is not None:
+                    partition = zlib.crc32(key) % n
+                else:
+                    partition = self._rr.get(topic, 0) % n
+                    self._rr[topic] = partition + 1
+            if not 0 <= partition < n:
+                raise ValueError(f"partition {partition} out of range for {topic!r}")
+            tp = TopicPartition(topic, partition)
+            log = self._logs[tp]
+            rec = Record(
+                topic=topic,
+                partition=partition,
+                offset=len(log),
+                value=value,
+                key=key,
+                timestamp_ms=int(time.time() * 1000) if timestamp_ms is None else timestamp_ms,
+            )
+            log.append(rec)
+            self._data_arrived.notify_all()
+            return rec
+
+    def produce_many(self, topic: str, values: Iterable[bytes], **kw) -> list[Record]:
+        return [self.produce(topic, v, **kw) for v in values]
+
+    def end_offset(self, tp: TopicPartition) -> int:
+        with self._lock:
+            if tp not in self._logs:
+                raise UnknownTopicError(tp)
+            return len(self._logs[tp])
+
+    def fetch(self, tp: TopicPartition, offset: int, max_records: int) -> list[Record]:
+        with self._lock:
+            if tp not in self._logs:
+                raise UnknownTopicError(tp)
+            log = self._logs[tp]
+            return log[offset : offset + max_records]
+
+    # -------------------------------------------------------------- groups
+
+    def _group(self, group_id: str) -> _Group:
+        return self._groups.setdefault(group_id, _Group())
+
+    def join(self, group_id: str, member_id: str, topics: frozenset[str]) -> int:
+        """Add a member and rebalance; returns the new generation."""
+        with self._lock:
+            g = self._group(group_id)
+            g.members[member_id] = topics
+            self._rebalance(g)
+            return g.generation
+
+    def leave(self, group_id: str, member_id: str) -> None:
+        with self._lock:
+            g = self._group(group_id)
+            if member_id in g.members:
+                del g.members[member_id]
+                self._rebalance(g)
+
+    def _rebalance(self, g: _Group) -> None:
+        """Range-assign every subscribed partition across members, bump generation.
+
+        Deterministic: members sorted by id, partitions sorted by
+        (topic, partition). A member that held partitions before the
+        rebalance may lose them — its in-flight commit then fails with
+        CommitFailedError, which is the re-delivery trigger."""
+        g.generation += 1
+        g.assignment = {m: [] for m in g.members}
+        members = sorted(g.members)
+        if not members:
+            return
+        topics = sorted({t for ts in g.members.values() for t in ts})
+        all_tps = [
+            TopicPartition(t, p)
+            for t in topics
+            for p in range(self._topics.get(t, 0))
+        ]
+        # Only members subscribed to a topic are eligible for its partitions.
+        for t in topics:
+            eligible = [m for m in members if t in g.members[m]]
+            tps = [tp for tp in all_tps if tp.topic == t]
+            for i, tp in enumerate(tps):
+                g.assignment[eligible[i % len(eligible)]].append(tp)
+
+    def group_state(self, group_id: str, member_id: str) -> tuple[int, list[TopicPartition]]:
+        """Current (generation, assignment) for a member — polled by consumers
+        to detect rebalances."""
+        with self._lock:
+            g = self._group(group_id)
+            return g.generation, list(g.assignment.get(member_id, []))
+
+    def commit(
+        self,
+        group_id: str,
+        offsets: Mapping[TopicPartition, int],
+        member_id: str | None = None,
+        generation: int | None = None,
+    ) -> None:
+        """Durably record next-read offsets for a group.
+
+        Group-managed members must present the generation they last synced;
+        a stale generation or an unowned partition raises CommitFailedError
+        (what Kafka raises after a rebalance). Standalone (manually-assigned)
+        consumers pass member_id=None and skip the check, matching Kafka's
+        ``assign()`` mode."""
+        with self._lock:
+            g = self._group(group_id)
+            if member_id is not None:
+                if generation != g.generation:
+                    raise CommitFailedError(
+                        f"generation {generation} != current {g.generation} "
+                        f"(group rebalanced); offsets not committed"
+                    )
+                owned = set(g.assignment.get(member_id, []))
+                stray = set(offsets) - owned
+                if stray:
+                    raise CommitFailedError(f"partitions not owned: {sorted(stray)}")
+            g.committed.update(offsets)
+            if self._commit_log_path:
+                entry = {
+                    "group": group_id,
+                    "member": member_id,
+                    "offsets": {f"{tp.topic}:{tp.partition}": o for tp, o in offsets.items()},
+                    "ts": time.time(),
+                }
+                with open(self._commit_log_path, "a", encoding="utf-8") as f:
+                    f.write(json.dumps(entry) + "\n")
+
+    def committed(self, group_id: str, tp: TopicPartition) -> int | None:
+        with self._lock:
+            return self._group(group_id).committed.get(tp)
+
+    # ------------------------------------------------------------- waiting
+
+    def wait_for_data(self, timeout_s: float) -> None:
+        """Block until any produce happens (or timeout). Used by polling
+        consumers so empty polls don't spin."""
+        with self._data_arrived:
+            self._data_arrived.wait(timeout=timeout_s)
+
+
+class MemoryConsumer(ConsumerIterMixin):
+    """Consumer over an InMemoryBroker implementing the Consumer protocol.
+
+    Two assignment modes, matching kafka-python's subscribe()/assign() split:
+
+    - ``group-managed`` (default): join the group, receive a range assignment,
+      commits are generation-checked. This is what the reference's per-worker
+      consumers do (/root/reference/src/kafka_dataset.py:208-233).
+    - ``manual``: pass ``assignment=[...]``; no group membership, commits are
+      unchecked. This is the mesh-aligned mode used on TPU pods, where
+      partition → jax.process_index() mapping is static (SURVEY.md §2 TPU
+      equivalents table).
+
+    Never auto-commits, by construction: there is no code path that commits
+    except the explicit ``commit()`` — the invariant the reference enforces by
+    forcing ``enable_auto_commit=False`` (/root/reference/src/kafka_dataset.py:201).
+    """
+
+    def __init__(
+        self,
+        broker: InMemoryBroker,
+        topics: str | Sequence[str],
+        group_id: str,
+        *,
+        assignment: Sequence[TopicPartition] | None = None,
+        auto_offset_reset: str = "earliest",
+        member_id: str | None = None,
+    ) -> None:
+        if auto_offset_reset not in ("earliest", "latest"):
+            raise ValueError(f"auto_offset_reset must be earliest|latest, got {auto_offset_reset!r}")
+        self._broker = broker
+        self._topics = frozenset([topics] if isinstance(topics, str) else topics)
+        self._group_id = group_id
+        self._auto_offset_reset = auto_offset_reset
+        self._closed = False
+        self._positions: dict[TopicPartition, int] = {}
+        self._fetch_rr = 0  # round-robin cursor across assigned partitions
+
+        # Topics must exist either way; surfaces config errors eagerly.
+        for t in self._topics:
+            broker.partitions_for(t)
+
+        if assignment is not None:
+            self._manual = True
+            self._member_id = None
+            self._generation: int | None = None
+            self._assignment = list(assignment)
+        else:
+            self._manual = False
+            self._member_id = member_id or f"member-{next(_member_counter)}"
+            self._generation, self._assignment = 0, []
+            self._generation = broker.join(self._group_id, self._member_id, self._topics)
+            _, self._assignment = broker.group_state(self._group_id, self._member_id)
+
+    # ---------------------------------------------------------------- state
+
+    def _check_open(self) -> None:
+        if self._closed:
+            raise ConsumerClosedError("consumer is closed")
+
+    def _sync_group(self) -> None:
+        """Pick up a new assignment if the group rebalanced.
+
+        Models Kafka's eager rebalance: ALL partitions are revoked and
+        re-acquired, so every position re-resolves from the committed offset —
+        anything fetched but uncommitted is re-delivered (at-least-once)."""
+        if self._manual:
+            return
+        gen, assign = self._broker.group_state(self._group_id, self._member_id)
+        if gen != self._generation:
+            self._generation, self._assignment = gen, assign
+            self._positions.clear()
+
+    def _resolve_position(self, tp: TopicPartition) -> int:
+        if tp not in self._positions:
+            committed = self._broker.committed(self._group_id, tp)
+            if committed is not None:
+                self._positions[tp] = committed
+            elif self._auto_offset_reset == "earliest":
+                self._positions[tp] = 0
+            else:
+                self._positions[tp] = self._broker.end_offset(tp)
+        return self._positions[tp]
+
+    # ----------------------------------------------------------------- api
+
+    def poll(self, max_records: int = 500, timeout_ms: int = 0) -> list[Record]:
+        self._check_open()
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        while True:
+            self._sync_group()
+            out: list[Record] = []
+            tps = self._assignment
+            if tps:
+                # Round-robin across partitions for fairness, like the Kafka
+                # fetcher; per-partition order is always preserved.
+                start = self._fetch_rr % len(tps)
+                order = tps[start:] + tps[:start]
+                self._fetch_rr += 1
+                budget = max_records
+                for tp in order:
+                    if budget <= 0:
+                        break
+                    pos = self._resolve_position(tp)
+                    recs = self._broker.fetch(tp, pos, budget)
+                    if recs:
+                        self._positions[tp] = recs[-1].offset + 1
+                        out.extend(recs)
+                        budget -= len(recs)
+            if out or timeout_ms <= 0:
+                return out
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return []
+            self._broker.wait_for_data(min(remaining, 0.05))
+
+    def commit(self, offsets: Mapping[TopicPartition, int] | None = None) -> None:
+        self._check_open()
+        if offsets is None:
+            offsets = dict(self._positions)
+        if self._manual:
+            stray = set(offsets) - set(self._assignment)
+            if stray:
+                raise NotAssignedError(f"not assigned: {sorted(stray)}")
+            self._broker.commit(self._group_id, offsets)
+        else:
+            self._broker.commit(
+                self._group_id, offsets,
+                member_id=self._member_id, generation=self._generation,
+            )
+
+    def committed(self, tp: TopicPartition) -> int | None:
+        self._check_open()
+        return self._broker.committed(self._group_id, tp)
+
+    def position(self, tp: TopicPartition) -> int:
+        self._check_open()
+        return self._resolve_position(tp)
+
+    def seek(self, tp: TopicPartition, offset: int) -> None:
+        self._check_open()
+        if tp not in set(self._assignment):
+            raise NotAssignedError(str(tp))
+        self._positions[tp] = offset
+
+    def assignment(self) -> list[TopicPartition]:
+        self._check_open()
+        self._sync_group()
+        return list(self._assignment)
+
+    def close(self) -> None:
+        """Release assignment. Never commits (the reference's
+        close(autocommit=False), /root/reference/src/kafka_dataset.py:89)."""
+        if self._closed:
+            return
+        self._closed = True
+        if not self._manual:
+            self._broker.leave(self._group_id, self._member_id)
+
+    def __del__(self) -> None:
+        try:
+            self.close()
+        except Exception:  # pragma: no cover - interpreter teardown
+            pass

@@ -1,0 +1,43 @@
+"""Transport-independent record primitives.
+
+The reference iterates raw kafka-python ``ConsumerRecord`` objects straight out
+of the consumer (/root/reference/src/kafka_dataset.py:156) and hands them to
+the user's ``_process`` (:159,:173-186). We instead define our own small record
+type so that every transport (in-memory broker, kafka-python adapter, future
+native client) presents an identical surface to the transform layer, and so
+records can cross thread/process boundaries cheaply.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+
+class TopicPartition(NamedTuple):
+    """A (topic, partition) pair — the unit of assignment and offset commit."""
+
+    topic: str
+    partition: int
+
+
+@dataclasses.dataclass(frozen=True, slots=True)
+class Record:
+    """One immutable record fetched from a partition.
+
+    ``offset`` is the record's position in its partition log. Commits use
+    *next-offset* semantics: committing offset N means "records < N are done",
+    matching Kafka's OffsetAndMetadata convention.
+    """
+
+    topic: str
+    partition: int
+    offset: int
+    value: bytes
+    key: bytes | None = None
+    timestamp_ms: int = 0
+    headers: tuple[tuple[str, bytes], ...] = ()
+
+    @property
+    def tp(self) -> TopicPartition:
+        return TopicPartition(self.topic, self.partition)
