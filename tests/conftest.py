@@ -2,24 +2,27 @@
 
 All tests run on the CPU backend with 8 virtual devices so multi-chip sharding
 logic (mesh assembly, make_array_from_process_local_data, ring attention
-collectives) is exercised without TPU hardware, per the build contract. The
-env vars must be set before jax initializes its backends, hence module scope
-here (conftest imports before any test module).
+collectives) is exercised without TPU hardware, per the build contract.
+
+Note: this environment pre-imports jax at interpreter startup (the axon TPU
+tunnel's sitecustomize) with JAX_PLATFORMS=axon, so setting env vars here is
+too late. jax.config.update still works because backends only initialize on
+first device use — which conftest reaches before any test.
 """
 
-import os
+import jax
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 from torchkafka_tpu.source.memory import InMemoryBroker  # noqa: E402
+
+assert len(jax.devices()) == 8, (
+    f"tests need the 8-device virtual CPU mesh, got {jax.devices()}"
+)
 
 
 @pytest.fixture

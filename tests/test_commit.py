@@ -1,0 +1,250 @@
+"""Ledger, batcher, token: the commit-exactly-the-batch invariants.
+
+Encodes SURVEY.md §4's invariant (i): offsets are never committed before the
+step consuming that batch completes — sharpened here to "never cover a record
+the user was never handed" (the carry-over rule, SURVEY.md §7 hard part (b)).
+"""
+
+import numpy as np
+import pytest
+
+from torchkafka_tpu import CommitFailedError, InMemoryBroker, MemoryConsumer, TopicPartition
+from torchkafka_tpu.commit import CommitSequencer, CommitToken, LocalBarrier, OffsetLedger
+from torchkafka_tpu.errors import BarrierError
+from torchkafka_tpu.source.records import Record
+from torchkafka_tpu.transform import Batcher
+
+TP = TopicPartition("t", 0)
+
+
+def rec(offset, partition=0, value=b"x"):
+    return Record(topic="t", partition=partition, offset=offset, value=value)
+
+
+class TestLedger:
+    def test_snapshot_tracks_emitted_frontier(self):
+        led = OffsetLedger()
+        for i in range(3):
+            led.fetched(rec(i))
+        for i in range(3):
+            led.emitted(rec(i))
+        assert led.snapshot() == {TP: 3}
+
+    def test_carry_over_excluded_from_watermark(self):
+        """A fetched-but-unemitted record pins the watermark below it."""
+        led = OffsetLedger()
+        for i in range(5):
+            led.fetched(rec(i))
+        led.emitted(rec(0))
+        led.emitted(rec(1))
+        # 2,3,4 still pending (carry-over) -> committable stops at 2.
+        assert led.snapshot() == {TP: 2}
+
+    def test_drop_advances_watermark(self):
+        """Reference drop contract (/root/reference/src/kafka_dataset.py:161-162):
+        a None-processed record commits once its predecessors are done."""
+        led = OffsetLedger()
+        for i in range(4):
+            led.fetched(rec(i))
+        led.emitted(rec(0))
+        led.dropped(rec(1))
+        led.emitted(rec(2))
+        assert led.snapshot() == {TP: 3}  # drop at 1 does not hold anything back
+        led.dropped(rec(3))
+        assert led.snapshot() == {TP: 4}
+
+    def test_multi_partition_independent(self):
+        led = OffsetLedger()
+        led.fetched(rec(0, partition=0))
+        led.fetched(rec(0, partition=1))
+        led.emitted(rec(0, partition=0))
+        snap = led.snapshot()
+        assert snap[TopicPartition("t", 0)] == 1
+        assert snap[TopicPartition("t", 1)] == 0  # partition 1 still pending
+
+    def test_double_resolve_tolerated(self):
+        """Re-delivery after a rebalance can resolve the same offset twice;
+        that is legal at-least-once traffic, not a crash."""
+        led = OffsetLedger()
+        led.fetched(rec(0))
+        led.emitted(rec(0))
+        led.emitted(rec(0))  # duplicate copy resolving later: no-op
+        assert led.snapshot() == {TP: 1}
+
+    def test_redelivered_record_while_pending(self):
+        """Rebalance re-delivers a record whose first copy is still in the
+        batcher: fetched is idempotent, both copies resolve cleanly."""
+        led = OffsetLedger()
+        led.fetched(rec(0))
+        led.fetched(rec(0))  # re-delivery, first copy still pending
+        led.emitted(rec(0))
+        led.emitted(rec(0))
+        assert led.snapshot() == {TP: 1}
+
+    def test_resume_from_nonzero_offset(self):
+        led = OffsetLedger()
+        led.fetched(rec(100))
+        assert led.snapshot() == {TP: 100}  # pending pins at 100
+        led.emitted(rec(100))
+        assert led.snapshot() == {TP: 101}
+
+
+class TestBatcher:
+    def _mk(self, batch_size=4, **kw):
+        led = OffsetLedger()
+        return Batcher(batch_size, led, **kw), led
+
+    def test_emits_full_fixed_shape_batches(self):
+        b, led = self._mk()
+        out = []
+        for i in range(9):
+            r = rec(i)
+            led.fetched(r)
+            got = b.add(np.full(3, i, dtype=np.float32), r)
+            if got is not None:
+                out.append(got)
+        assert len(out) == 2
+        assert out[0].data.shape == (4, 3)
+        assert out[0].valid_count == 4
+        np.testing.assert_array_equal(out[1].data[:, 0], [4, 5, 6, 7])
+        # 9th record is carry-over: excluded from the second batch's offsets.
+        assert out[1].offsets == {TP: 8}
+        assert b.pending_in_batch == 1
+
+    def test_drops_do_not_occupy_rows(self):
+        b, led = self._mk(batch_size=2)
+        emitted = []
+        for i in range(6):
+            r = rec(i)
+            led.fetched(r)
+            element = None if i % 3 == 0 else np.int32(i)  # drop 0, 3
+            got = b.add(element, r)
+            if got:
+                emitted.append(got)
+        assert len(emitted) == 2
+        np.testing.assert_array_equal(emitted[0].data, [1, 2])
+        np.testing.assert_array_equal(emitted[1].data, [4, 5])
+        # All 6 records resolved -> watermark covers everything.
+        assert emitted[1].offsets == {TP: 6}
+
+    def test_pad_policy_flush(self):
+        b, led = self._mk(batch_size=4, pad_policy="pad")
+        for i in range(2):
+            r = rec(i)
+            led.fetched(r)
+            assert b.add(np.float32(i + 1), r) is None
+        tail = b.flush()
+        assert tail is not None
+        assert tail.valid_count == 2
+        np.testing.assert_array_equal(tail.valid_mask(), [True, True, False, False])
+        np.testing.assert_array_equal(tail.data, [1.0, 2.0, 0.0, 0.0])
+        assert tail.offsets == {TP: 2}
+
+    def test_block_policy_flush_returns_none_and_keeps_pending(self):
+        b, led = self._mk(batch_size=4, pad_policy="block")
+        r = rec(0)
+        led.fetched(r)
+        b.add(np.float32(1), r)
+        assert b.flush() is None
+        assert led.snapshot() == {TP: 0}  # tail uncommittable
+
+    def test_pytree_elements(self):
+        b, led = self._mk(batch_size=2)
+        for i in range(2):
+            r = rec(i)
+            led.fetched(r)
+            got = b.add({"x": np.ones(2, np.float32), "y": np.int32(i)}, r)
+        assert got is not None
+        assert got.data["x"].shape == (2, 2)
+        np.testing.assert_array_equal(got.data["y"], [0, 1])
+
+    def test_shape_mismatch_rejected(self):
+        b, led = self._mk(batch_size=2)
+        r0, r1 = rec(0), rec(1)
+        led.fetched(r0)
+        led.fetched(r1)
+        b.add(np.ones(3, np.float32), r0)
+        with pytest.raises(ValueError, match="fixed shapes"):
+            b.add(np.ones(4, np.float32), r1)
+
+    def test_emitted_batches_are_independent_buffers(self):
+        """Zero-copy handoff must not alias the next batch's buffer."""
+        b, led = self._mk(batch_size=1)
+        r0, r1 = rec(0), rec(1)
+        led.fetched(r0)
+        led.fetched(r1)
+        first = b.add(np.float32(1), r0)
+        second = b.add(np.float32(2), r1)
+        np.testing.assert_array_equal(first.data, [1.0])
+        np.testing.assert_array_equal(second.data, [2.0])
+
+
+class TestCommitToken:
+    def _stream_fixture(self):
+        broker = InMemoryBroker()
+        broker.create_topic("t", partitions=1)
+        for i in range(8):
+            broker.produce("t", f"v{i}".encode())
+        consumer = MemoryConsumer(broker, "t", group_id="g")
+        consumer.poll(max_records=8)
+        return broker, consumer
+
+    def test_commit_applies_exact_offsets(self):
+        broker, consumer = self._stream_fixture()
+        seq = CommitSequencer()
+        tok = CommitToken(consumer, {TP: 4}, seq, barrier=LocalBarrier())
+        assert tok.commit() is True
+        assert broker.committed("g", TP) == 4
+        assert tok.committed
+
+    def test_double_commit_idempotent(self):
+        broker, consumer = self._stream_fixture()
+        seq = CommitSequencer()
+        tok = CommitToken(consumer, {TP: 4}, seq)
+        assert tok.commit() and tok.commit()
+        assert broker.committed("g", TP) == 4
+
+    def test_out_of_order_commit_subsumed(self):
+        """Committing token k after k+1 must not move the watermark back."""
+        broker, consumer = self._stream_fixture()
+        seq = CommitSequencer()
+        t0 = CommitToken(consumer, {TP: 4}, seq)
+        t1 = CommitToken(consumer, {TP: 8}, seq)
+        assert t1.commit() is True
+        assert broker.committed("g", TP) == 8
+        assert t0.commit() is True  # no-op: subsumed
+        assert broker.committed("g", TP) == 8
+
+    def test_rebalance_commit_failure_is_nonfatal(self):
+        """Reference contract /root/reference/src/kafka_dataset.py:131-135."""
+        broker, consumer = self._stream_fixture()
+        seq = CommitSequencer()
+        tok = CommitToken(consumer, {TP: 4}, seq)
+        MemoryConsumer(broker, "t", group_id="g")  # join -> rebalance
+        assert tok.commit() is False
+        assert broker.committed("g", TP) is None  # fail closed: nothing committed
+        assert not tok.committed
+
+    def test_barrier_failure_fails_closed(self):
+        broker, consumer = self._stream_fixture()
+        seq = CommitSequencer()
+
+        class ExplodingBarrier(LocalBarrier):
+            def __call__(self, wait_for=None):
+                raise BarrierError("host 3 vanished")
+
+        tok = CommitToken(consumer, {TP: 4}, seq, barrier=ExplodingBarrier())
+        with pytest.raises(BarrierError):
+            tok.commit()
+        assert broker.committed("g", TP) is None
+
+    def test_wait_for_device_value(self):
+        """commit(wait_for=jax value) must block on it then commit."""
+        import jax.numpy as jnp
+
+        broker, consumer = self._stream_fixture()
+        seq = CommitSequencer()
+        tok = CommitToken(consumer, {TP: 8}, seq, barrier=LocalBarrier())
+        loss = jnp.sum(jnp.arange(1000.0))
+        assert tok.commit(wait_for=loss) is True
+        assert broker.committed("g", TP) == 8

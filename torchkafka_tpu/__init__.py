@@ -11,12 +11,20 @@ The reference exports exactly two names — ``KafkaDataset`` and ``auto_commit``
 reference users (torchkafka_tpu.compat).
 """
 
+from torchkafka_tpu.commit import (
+    CommitBarrier,
+    CommitToken,
+    LocalBarrier,
+    OffsetLedger,
+)
 from torchkafka_tpu.errors import (
     BarrierError,
     CommitFailedError,
     ConsumerClosedError,
     TpuKafkaError,
 )
+from torchkafka_tpu.parallel import batch_sharding, global_batch, make_mesh
+from torchkafka_tpu.pipeline import KafkaStream, stream
 from torchkafka_tpu.source import (
     Consumer,
     InMemoryBroker,
@@ -26,19 +34,34 @@ from torchkafka_tpu.source import (
     TopicPartition,
     partitions_for_process,
 )
+from torchkafka_tpu.transform import Batch, Batcher, compose, json_field, raw_bytes
 
 __version__ = "0.1.0"
 
 __all__ = [
     "BarrierError",
+    "Batch",
+    "Batcher",
+    "CommitBarrier",
     "CommitFailedError",
+    "CommitToken",
     "Consumer",
     "ConsumerClosedError",
     "InMemoryBroker",
     "KafkaConsumer",
+    "KafkaStream",
+    "LocalBarrier",
     "MemoryConsumer",
+    "OffsetLedger",
     "Record",
     "TopicPartition",
     "TpuKafkaError",
+    "batch_sharding",
+    "compose",
+    "global_batch",
+    "json_field",
+    "make_mesh",
     "partitions_for_process",
+    "raw_bytes",
+    "stream",
 ]

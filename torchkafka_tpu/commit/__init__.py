@@ -1,0 +1,13 @@
+"""Commit layer: ledger, barrier, tokens — the commit-after-step core."""
+
+from torchkafka_tpu.commit.barrier import CommitBarrier, LocalBarrier
+from torchkafka_tpu.commit.ledger import OffsetLedger
+from torchkafka_tpu.commit.token import CommitSequencer, CommitToken
+
+__all__ = [
+    "CommitBarrier",
+    "CommitSequencer",
+    "CommitToken",
+    "LocalBarrier",
+    "OffsetLedger",
+]

@@ -1,0 +1,70 @@
+"""Pod-wide commit barrier.
+
+Replaces the reference's POSIX-signal control plane (SIGUSR1 "commit now"
+from orchestrator to worker, /root/reference/src/kafka_dataset.py:47-55,235-239;
+/root/reference/src/auto_commit.py:59-72) with a first-class barrier:
+
+1. wait for the step's device work to retire locally (jax.block_until_ready),
+2. synchronize every process in the pod over ICI/DCN
+   (multihost_utils.sync_global_devices),
+3. only then is the commit allowed to proceed.
+
+Fail-closed: if any host dies, the barrier raises on the survivors instead of
+timing out silently; no host commits, Kafka re-delivers the batch — the zero
+uncommitted-batch-loss property (SURVEY.md §7 hard part (c)). The signal-race
+class the reference handles with its deferred-flag dance (SURVEY.md §5 race
+row) does not exist here: commits run synchronously on the host's own thread,
+never from an interrupt context.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+import jax
+
+from torchkafka_tpu.errors import BarrierError
+
+logger = logging.getLogger(__name__)
+
+
+class CommitBarrier:
+    """Callable barrier used by CommitToken before offsets are committed.
+
+    Single-process (the degenerate case, SURVEY.md §7 minimum slice): only
+    ``block_until_ready``. Multi-process: adds a pod-wide
+    ``sync_global_devices`` with a per-call unique name so distinct batches
+    can never alias each other's barrier.
+    """
+
+    def __init__(self, name: str = "tpukafka_commit") -> None:
+        self._name = name
+        self._calls = 0
+
+    def __call__(self, wait_for: Any = None) -> None:
+        try:
+            if wait_for is not None:
+                # Retire the step that consumed the batch: host-side proof the
+                # batch's results exist before its offsets become committable
+                # (the reference's yield-then-commit ordering,
+                # /root/reference/src/auto_commit.py:55-58, made device-aware).
+                jax.block_until_ready(wait_for)
+            self._calls += 1
+            if jax.process_count() > 1:  # pragma: no cover - needs real pod
+                from jax.experimental import multihost_utils
+
+                multihost_utils.sync_global_devices(f"{self._name}:{self._calls}")
+        except BarrierError:
+            raise
+        except Exception as e:
+            # Fail closed: a barrier failure means we cannot prove every host
+            # finished the step -> nobody commits -> Kafka re-delivers.
+            raise BarrierError(f"commit barrier failed (no offsets committed): {e}") from e
+
+
+#: Barrier that only waits for local device work — explicit single-host mode.
+class LocalBarrier(CommitBarrier):
+    def __call__(self, wait_for: Any = None) -> None:
+        if wait_for is not None:
+            jax.block_until_ready(wait_for)

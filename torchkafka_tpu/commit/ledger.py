@@ -1,0 +1,83 @@
+"""Offset ledger: commit-exactly-the-batch bookkeeping.
+
+The reference commits "whatever was polled" — in the multiprocessing path the
+committed offsets can even include records already fetched into the *next*
+in-flight batch (SURVEY.md §3, CS-3 coarseness note). The TPU-native design
+fixes this with explicit accounting (SURVEY.md §7, hard part (b)):
+
+- ``fetched(r)``  — record r was polled off the broker (enters *pending*).
+- ``dropped(r)``  — user transform returned None for r
+  (/root/reference/src/kafka_dataset.py:161-162); r is done, it just never
+  appears in a batch.
+- ``emitted(r)``  — r is part of a batch handed to the consumer of the stream.
+
+The committable watermark for a partition is the smallest offset still
+pending — i.e. fetched but sitting in the carry-over buffer or an
+un-emitted partial batch — or the fetch frontier if nothing is pending.
+Committing a snapshot therefore never covers a record the user hasn't been
+handed, no matter how records interleave with drops and batch boundaries.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from torchkafka_tpu.source.records import Record, TopicPartition
+
+
+class OffsetLedger:
+    """Tracks per-partition fetch frontiers and pending (un-emitted) offsets.
+
+    Thread-safe: the pipeline's fetch/transform thread mutates it while the
+    consuming thread snapshots it at batch-emit time.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._frontier: dict[TopicPartition, int] = {}
+        self._pending: dict[TopicPartition, set[int]] = {}
+
+    def fetched(self, record: Record) -> None:
+        with self._lock:
+            tp = record.tp
+            nxt = record.offset + 1
+            if nxt > self._frontier.get(tp, 0):
+                self._frontier[tp] = nxt
+            self._pending.setdefault(tp, set()).add(record.offset)
+
+    def dropped(self, record: Record) -> None:
+        self._done(record)
+
+    def emitted(self, record: Record) -> None:
+        self._done(record)
+
+    def _done(self, record: Record) -> None:
+        with self._lock:
+            pend = self._pending.get(record.tp)
+            if pend is None or record.offset not in pend:
+                # Tolerate: under at-least-once delivery a record can be
+                # re-delivered after a rebalance while its first copy is still
+                # in the batcher; both copies eventually resolve, the second
+                # against an already-cleared offset. Raising here would turn a
+                # legal re-delivery into a pipeline crash.
+                return
+            pend.remove(record.offset)
+
+    def snapshot(self) -> dict[TopicPartition, int]:
+        """Committable next-read offsets right now.
+
+        For each partition: min(pending) if any record is still in flight,
+        else the fetch frontier. Calling this immediately after marking a
+        batch ``emitted`` yields offsets covering exactly that batch plus any
+        earlier drops — and never a carried-over record.
+        """
+        with self._lock:
+            out: dict[TopicPartition, int] = {}
+            for tp, frontier in self._frontier.items():
+                pend = self._pending.get(tp)
+                out[tp] = min(pend) if pend else frontier
+            return out
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return sum(len(p) for p in self._pending.values())

@@ -1,0 +1,17 @@
+"""Parallelism layer: meshes, shardings, collectives."""
+
+from torchkafka_tpu.parallel.mesh import (
+    batch_sharding,
+    global_batch,
+    make_mesh,
+    process_count,
+    process_index,
+)
+
+__all__ = [
+    "batch_sharding",
+    "global_batch",
+    "make_mesh",
+    "process_count",
+    "process_index",
+]

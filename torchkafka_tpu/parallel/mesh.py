@@ -1,0 +1,82 @@
+"""Device mesh helpers: the TPU-native replacement for DataLoader workers.
+
+The reference's parallelism is N host processes each owning a consumer
+(/root/reference/src/kafka_dataset.py:208-233). On TPU the parallel axis is
+the *device mesh*: each host process feeds its local shard of a global
+jax.Array laid out over the mesh's data axis; model axes (tp/sp/...) subshard
+the rest. These helpers build meshes and assemble global arrays from
+host-local NumPy batches (`jax.make_array_from_process_local_data`) so
+ingest composes with any pjit-sharded step function.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    axes: Mapping[str, int] | None = None,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a Mesh from {axis_name: size}. Default: all devices on one
+    'data' axis (pure DP — the reference's only strategy, lifted to chips).
+
+    Sizes must multiply to the device count; a single -1 axis is inferred.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if axes is None:
+        axes = {"data": n}
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one axis size may be -1")
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        if n % known != 0:
+            raise ValueError(f"cannot infer -1 axis: {n} devices not divisible by {known}")
+        sizes[sizes.index(-1)] = n // known
+    if int(np.prod(sizes)) != n:
+        raise ValueError(f"mesh {dict(zip(names, sizes))} needs {np.prod(sizes)} devices, have {n}")
+    dev_array = np.array(devices).reshape(sizes)
+    return Mesh(dev_array, axis_names=names)
+
+
+def batch_sharding(mesh: Mesh, data_axis: str | Sequence[str] = "data") -> NamedSharding:
+    """Sharding for ingest batches: leading (batch) dim split over the data
+    axis (or axes, e.g. ('data','fsdp')), all other dims replicated."""
+    axes = (data_axis,) if isinstance(data_axis, str) else tuple(data_axis)
+    return NamedSharding(mesh, P(axes))
+
+
+def global_batch(
+    host_local: Any,
+    mesh: Mesh,
+    data_axis: str | Sequence[str] = "data",
+) -> Any:
+    """Assemble a global, mesh-sharded jax.Array pytree from each host's local
+    NumPy batch (the TPU equivalent of the DataLoader's worker->main queue
+    crossing, SURVEY.md §2 communication table).
+
+    Each process contributes its shard; the global leading dim is
+    local_batch * process_count. Single-process: local == global, data lands
+    sharded across local devices without an extra copy through one device.
+    """
+    sharding = batch_sharding(mesh, data_axis)
+    return jax.tree_util.tree_map(
+        lambda leaf: jax.make_array_from_process_local_data(sharding, np.asarray(leaf)),
+        host_local,
+    )
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()

@@ -1,0 +1,5 @@
+"""Pipeline layer: the user-facing streaming loop."""
+
+from torchkafka_tpu.pipeline.stream import KafkaStream, stream
+
+__all__ = ["KafkaStream", "stream"]

@@ -1,0 +1,11 @@
+"""Transform layer: per-record processors and fixed-shape batching."""
+
+from torchkafka_tpu.transform.batcher import Batch, Batcher
+from torchkafka_tpu.transform.processor import (
+    Processor,
+    compose,
+    json_field,
+    raw_bytes,
+)
+
+__all__ = ["Batch", "Batcher", "Processor", "compose", "json_field", "raw_bytes"]

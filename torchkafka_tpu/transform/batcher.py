@@ -1,0 +1,153 @@
+"""Fixed-shape batch assembly with carry-over accounting.
+
+Replaces the reference's L2 (torch DataLoader collation, SURVEY.md §1) with a
+batcher built for XLA's static-shape world. The reference never faced this
+problem — DataLoader happily emits ragged final batches; XLA recompiles on
+every new shape, so we never change shape. Policies:
+
+- ``block`` (default): only full batches are emitted; a partial tail waits
+  for more records. Its records stay *pending* in the ledger, so they are
+  excluded from every commit watermark until actually emitted — the
+  carry-over rule that makes the reference's round-robin worker↔batch
+  correspondence assumption (SURVEY.md §2 quirk 4) unnecessary.
+- ``pad``: ``flush()`` zero-pads the tail to the batch size and reports
+  ``valid_count``; downstream masks with ``batch.valid_mask()``.
+
+Elements are pytrees of fixed-shape NumPy arrays; leaves are stacked into
+preallocated ``[B, ...]`` buffers (one memcpy per element per leaf — the hot
+host path; see native/ for the C++ fast path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Iterator
+
+import numpy as np
+
+from torchkafka_tpu.commit.ledger import OffsetLedger
+from torchkafka_tpu.source.records import Record, TopicPartition
+
+try:
+    from jax import tree_util as _tree
+except ImportError:  # pragma: no cover - jax is a hard dep, but keep honest
+    _tree = None
+
+
+@dataclasses.dataclass
+class Batch:
+    """One host-local batch: stacked arrays + how many rows are real."""
+
+    data: Any  # pytree of np.ndarray with leading dim == batch_size
+    valid_count: int
+    offsets: dict[TopicPartition, int]  # committable snapshot for this batch
+
+    @property
+    def batch_size(self) -> int:
+        leaves = _tree.tree_leaves(self.data)
+        return int(leaves[0].shape[0]) if leaves else 0
+
+    def valid_mask(self) -> np.ndarray:
+        """Boolean [B] mask; rows past valid_count are padding."""
+        return np.arange(self.batch_size) < self.valid_count
+
+
+class Batcher:
+    """Accumulates processed elements into fixed-size batches.
+
+    Drives the ledger: ``add`` marks drops, ``_emit`` marks emissions and
+    snapshots the committable offsets at exactly that moment.
+    """
+
+    def __init__(
+        self,
+        batch_size: int,
+        ledger: OffsetLedger | None = None,
+        pad_policy: str = "block",
+    ) -> None:
+        if batch_size <= 0:
+            raise ValueError("batch_size must be positive")
+        if pad_policy not in ("block", "pad"):
+            raise ValueError(f"pad_policy must be 'block'|'pad', got {pad_policy!r}")
+        self.batch_size = batch_size
+        self.ledger = ledger if ledger is not None else OffsetLedger()
+        self.pad_policy = pad_policy
+        self._treedef = None
+        self._buffers: list[np.ndarray] | None = None
+        self._fill = 0
+        self._records: list[Record] = []
+
+    def _init_buffers(self, element: Any) -> None:
+        leaves, treedef = _tree.tree_flatten(element)
+        for i, leaf in enumerate(leaves):
+            if not isinstance(leaf, np.ndarray):
+                leaves[i] = np.asarray(leaf)
+        self._treedef = treedef
+        self._buffers = [
+            np.zeros((self.batch_size, *leaf.shape), dtype=leaf.dtype) for leaf in leaves
+        ]
+
+    def add(self, element: Any, record: Record) -> Batch | None:
+        """Add one processed element (None = drop). Returns a full Batch when
+        the element completes one, else None.
+
+        ``record`` must already be ``ledger.fetched``-registered by the caller
+        (the stream does this at poll time).
+        """
+        if element is None:
+            self.ledger.dropped(record)
+            return None
+        if self._buffers is None:
+            self._init_buffers(element)
+        leaves = _tree.tree_leaves(element)
+        if len(leaves) != len(self._buffers):
+            raise ValueError("element structure changed between records")
+        for buf, leaf in zip(self._buffers, leaves):
+            arr = np.asarray(leaf)
+            if arr.shape != buf.shape[1:] or arr.dtype != buf.dtype:
+                raise ValueError(
+                    f"element leaf shape/dtype {arr.shape}/{arr.dtype} does not "
+                    f"match batch buffer {buf.shape[1:]}/{buf.dtype}; processors "
+                    f"must emit fixed shapes (pad/truncate per record)"
+                )
+            buf[self._fill] = arr
+        self._records.append(record)
+        self._fill += 1
+        if self._fill == self.batch_size:
+            return self._emit()
+        return None
+
+    def flush(self) -> Batch | None:
+        """Emit the partial tail (pad policy) or nothing (block policy —
+        the tail stays pending and uncommitted)."""
+        if self._fill == 0 or self.pad_policy != "pad":
+            return None
+        return self._emit()
+
+    def _emit(self) -> Batch:
+        assert self._buffers is not None
+        for r in self._records:
+            self.ledger.emitted(r)
+        batch = Batch(
+            data=_tree.tree_unflatten(self._treedef, self._buffers),
+            valid_count=self._fill,
+            offsets=self.ledger.snapshot(),
+        )
+        # Fresh buffers: the emitted batch owns the old ones (zero-copy handoff).
+        leaves = _tree.tree_leaves(batch.data)
+        self._buffers = [np.zeros_like(leaf) for leaf in leaves]
+        self._fill = 0
+        self._records = []
+        return batch
+
+    @property
+    def pending_in_batch(self) -> int:
+        """Elements accumulated but not yet emitted (the carry-over)."""
+        return self._fill
+
+    def feed(self, processed: Iterator[tuple[Any, Record]]) -> Iterator[Batch]:
+        """Convenience: drain an iterator of (element, record) into batches."""
+        for element, record in processed:
+            out = self.add(element, record)
+            if out is not None:
+                yield out

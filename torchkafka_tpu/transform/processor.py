@@ -1,0 +1,93 @@
+"""The user-extension point: per-record transforms.
+
+Capability parity with the reference's single extension hook,
+``KafkaDataset._process(record) -> data | None``
+(/root/reference/src/kafka_dataset.py:173-186): a processor maps one record to
+a pytree of fixed-shape NumPy arrays, or None to drop the record
+(/root/reference/src/kafka_dataset.py:161-162, README.md:59 — the drop
+contract). The TPU-facing difference is explicit in the type: outputs must be
+*fixed-shape* arrays, because XLA compiles static shapes; ragged data must be
+padded/truncated here, at the record level, where the user knows the domain.
+
+Processors are plain callables — no subclassing required (though the compat
+layer's KafkaDataset._process maps straight onto this).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from torchkafka_tpu.source.records import Record
+
+# A processor maps a record to a pytree of np.ndarray (all leaves fixed-shape
+# across records) or None to drop the record.
+Processor = Callable[[Record], Optional[Any]]
+
+
+def raw_bytes(length: int, dtype=np.uint8, pad_value: int = 0) -> Processor:
+    """Record value -> fixed-length byte vector (truncate/zero-pad)."""
+
+    def process(record: Record):
+        buf = np.frombuffer(record.value[:length], dtype=np.uint8)
+        if buf.shape[0] < length:
+            buf = np.concatenate(
+                [buf, np.full(length - buf.shape[0], pad_value, dtype=np.uint8)]
+            )
+        return buf.astype(dtype, copy=False)
+
+    return process
+
+
+def json_field(
+    field: str,
+    seq_len: int,
+    tokenizer: Callable[[str], list[int]] | None = None,
+    pad_id: int = 0,
+    drop_invalid: bool = True,
+) -> Processor:
+    """JSON record -> int32 token ids of fixed ``seq_len`` (BASELINE config 2
+    shape: JSON records -> tokenized int32 batches).
+
+    Invalid JSON / missing field -> None (record dropped) when
+    ``drop_invalid``, else raises. Default tokenizer is bytes-of-utf8 — a
+    stand-in with the right shape; swap in a real tokenizer callable.
+    """
+    tok = tokenizer if tokenizer is not None else (lambda s: list(s.encode("utf-8")))
+
+    def process(record: Record):
+        try:
+            obj = json.loads(record.value)
+            text = obj[field]
+            if not isinstance(text, str):
+                raise TypeError(f"field {field!r} is {type(text).__name__}, not str")
+            ids = tok(text)
+        except (json.JSONDecodeError, KeyError, UnicodeDecodeError, TypeError,
+                AttributeError, IndexError):
+            # One malformed record (non-object root, wrong-typed field,
+            # tokenizer blowup) must drop, not kill the whole pipeline.
+            if drop_invalid:
+                return None
+            raise
+        ids = ids[:seq_len]
+        out = np.full(seq_len, pad_id, dtype=np.int32)
+        out[: len(ids)] = ids
+        return out
+
+    return process
+
+
+def compose(*fns: Callable) -> Processor:
+    """Chain callables left-to-right; None short-circuits (drop)."""
+
+    def process(record: Record):
+        x: Any = record
+        for f in fns:
+            x = f(x)
+            if x is None:
+                return None
+        return x
+
+    return process

@@ -1,0 +1,3 @@
+from torchkafka_tpu.utils.metrics import LatencyHistogram, RateMeter, StreamMetrics
+
+__all__ = ["LatencyHistogram", "RateMeter", "StreamMetrics"]

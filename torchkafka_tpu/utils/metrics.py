@@ -1,0 +1,100 @@
+"""Ingest observability: rate + latency metrics.
+
+The reference's only observability is a module logger (SURVEY.md §5 metrics
+row: debug/info on commit, error on failure). We keep equivalent log points
+(in commit/token.py) and add the counters BASELINE.md measures: records/sec
+sustained and offset-commit latency percentiles.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class RateMeter:
+    """Counts events; reports average rate over its lifetime and windows."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._count = 0
+            self._t0 = time.perf_counter()
+
+    def add(self, n: int = 1) -> None:
+        with self._lock:
+            self._count += n
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    def rate(self) -> float:
+        with self._lock:
+            dt = time.perf_counter() - self._t0
+            return self._count / dt if dt > 0 else 0.0
+
+
+class LatencyHistogram:
+    """Latency percentiles over a bounded window of recent samples.
+
+    Bounded (ring buffer) because streams may run forever
+    (idle_timeout_ms=None); recent-window percentiles are also what an
+    operator actually wants from a long-lived pipeline."""
+
+    def __init__(self, window: int = 8192) -> None:
+        from collections import deque
+
+        self._lock = threading.Lock()
+        self._samples: "deque[float]" = deque(maxlen=window)
+        self._total = 0
+
+    def observe(self, seconds: float) -> None:
+        with self._lock:
+            self._samples.append(seconds)
+            self._total += 1
+
+    def percentile(self, q: float) -> float:
+        with self._lock:
+            if not self._samples:
+                return 0.0
+            s = sorted(self._samples)
+            idx = min(len(s) - 1, int(round(q / 100.0 * (len(s) - 1))))
+            return s[idx]
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._total
+
+    def summary(self) -> dict[str, float]:
+        return {
+            "count": self.count,
+            "p50_ms": self.percentile(50) * 1e3,
+            "p99_ms": self.percentile(99) * 1e3,
+        }
+
+
+class StreamMetrics:
+    """The metric set one KafkaStream maintains."""
+
+    def __init__(self) -> None:
+        self.records = RateMeter()  # records fetched off the broker
+        self.batches = RateMeter()  # batches emitted to the consumer
+        self.dropped = RateMeter()  # records dropped by the processor
+        self.commit_latency = LatencyHistogram()
+        self.commit_failures = RateMeter()
+
+    def summary(self) -> dict:
+        return {
+            "records": self.records.count,
+            "records_per_s": self.records.rate(),
+            "batches": self.batches.count,
+            "dropped": self.dropped.count,
+            "commit": self.commit_latency.summary(),
+            "commit_failures": self.commit_failures.count,
+        }
