@@ -1,0 +1,13 @@
+"""Shim package: byte-identical imports for torch-kafka users.
+
+The reference installs as ``torchkafka`` (/root/reference/setup.py:25-30) and
+exports ``KafkaDataset`` and ``auto_commit``
+(/root/reference/src/__init__.py:17-18). Installing torchkafka-tpu provides
+this shim so existing code — ``from torchkafka import KafkaDataset,
+auto_commit`` — runs unchanged on the TPU-native core. Do not install both
+distributions in one environment: the module name collides by design.
+"""
+
+from torchkafka_tpu.compat import KafkaDataset, auto_commit
+
+__all__ = ["KafkaDataset", "auto_commit"]

@@ -1,0 +1,16 @@
+"""torch-kafka compatibility surface.
+
+The reference package exports exactly two names
+(/root/reference/src/__init__.py:17-18); so does this module. A torch-kafka
+user migrates with one import change:
+
+    from torchkafka_tpu.compat import KafkaDataset, auto_commit
+
+(or ``import torchkafka`` via the shim package, keeping their imports
+byte-identical). Requires torch; the TPU-native core does not.
+"""
+
+from torchkafka_tpu.compat.auto_commit import auto_commit
+from torchkafka_tpu.compat.dataset import KafkaDataset
+
+__all__ = ["KafkaDataset", "auto_commit"]

@@ -62,18 +62,46 @@ class Consumer(Protocol):
 
 class ConsumerIterMixin:
     """Provides record-at-a-time iteration on top of ``poll`` (the reference's
-    ``for record in consumer`` hot-loop shape, /root/reference/src/kafka_dataset.py:156)."""
+    ``for record in consumer`` hot-loop shape, /root/reference/src/kafka_dataset.py:156).
+
+    If the instance has a ``_consumer_timeout_ms`` attribute (kafka-python's
+    ``consumer_timeout_ms`` semantics), iteration ends after that long with no
+    records; otherwise it blocks until the consumer is closed.
+    """
 
     _ITER_TIMEOUT_MS = 100
 
     def __iter__(self) -> Iterator[Record]:
+        import time as _time
+
         buf: list[Record] = []
+        idle_limit_ms = getattr(self, "_consumer_timeout_ms", None)
+        # kafka-python semantics: the timeout clock measures time spent
+        # *waiting for the next record*, not wall time since the last fetch —
+        # time the caller spends processing buffered records must not count.
+        wait_start: float | None = None
         while True:
             if not buf:
                 if getattr(self, "_closed", False):
                     return
+                if wait_start is None:
+                    wait_start = _time.monotonic()
                 buf = list(self.poll(timeout_ms=self._ITER_TIMEOUT_MS))  # type: ignore[attr-defined]
                 if not buf:
+                    if (
+                        idle_limit_ms is not None
+                        and (_time.monotonic() - wait_start) * 1000 >= idle_limit_ms
+                    ):
+                        return
                     continue
+                wait_start = None
                 buf.reverse()  # pop from the end, preserve order
-            yield buf.pop()
+            rec = buf.pop()
+            # kafka-python iterator semantics: the consumed position advances
+            # per record *yielded to the user*, not per record fetched into
+            # the buffer — so commit(offsets=None) after iteration covers
+            # exactly what the user saw (transports keep _last_yielded).
+            ly = getattr(self, "_last_yielded", None)
+            if ly is not None:
+                ly[rec.tp] = rec.offset + 1
+            yield rec

@@ -55,6 +55,11 @@ class KafkaConsumer(ConsumerIterMixin):
         kafka_kwargs["enable_auto_commit"] = False
         topics = [topics] if isinstance(topics, str) else list(topics)
         self._closed = False
+        # Iteration is built on poll() via ConsumerIterMixin, so the
+        # iterator-ending timeout and the yielded-position tracking both live
+        # here, not in kafka-python's own (unused) iterator.
+        self._consumer_timeout_ms = kafka_kwargs.pop("consumer_timeout_ms", None)
+        self._last_yielded: dict[TopicPartition, int] = {}
         if assignment is not None:
             self._consumer = _kafka.KafkaConsumer(**kafka_kwargs)
             self._consumer.assign(
@@ -83,6 +88,12 @@ class KafkaConsumer(ConsumerIterMixin):
         return out
 
     def commit(self, offsets: Mapping[TopicPartition, int] | None = None) -> None:
+        if offsets is None and self._last_yielded:
+            # Iterator mode: commit the records handed to the user, NOT the
+            # whole fetched buffer (poll() advanced kafka-python's position
+            # past records still sitting in the mixin's buffer; committing
+            # positions here would lose them on crash).
+            offsets = dict(self._last_yielded)
         try:
             if offsets is None:
                 self._consumer.commit()

@@ -268,6 +268,7 @@ class MemoryConsumer(ConsumerIterMixin):
         assignment: Sequence[TopicPartition] | None = None,
         auto_offset_reset: str = "earliest",
         member_id: str | None = None,
+        consumer_timeout_ms: int | None = None,
     ) -> None:
         if auto_offset_reset not in ("earliest", "latest"):
             raise ValueError(f"auto_offset_reset must be earliest|latest, got {auto_offset_reset!r}")
@@ -278,6 +279,12 @@ class MemoryConsumer(ConsumerIterMixin):
         self._closed = False
         self._positions: dict[TopicPartition, int] = {}
         self._fetch_rr = 0  # round-robin cursor across assigned partitions
+        # kafka-python semantics: iteration (not poll) gives up after this
+        # long with no records; None = iterate forever.
+        self._consumer_timeout_ms = consumer_timeout_ms
+        # Positions of records handed out via the iterator (see
+        # ConsumerIterMixin): commit(None) prefers these over poll positions.
+        self._last_yielded: dict[TopicPartition, int] = {}
 
         # Topics must exist either way; surfaces config errors eagerly.
         for t in self._topics:
@@ -313,6 +320,7 @@ class MemoryConsumer(ConsumerIterMixin):
         if gen != self._generation:
             self._generation, self._assignment = gen, assign
             self._positions.clear()
+            self._last_yielded.clear()
 
     def _resolve_position(self, tp: TopicPartition) -> int:
         if tp not in self._positions:
@@ -360,7 +368,10 @@ class MemoryConsumer(ConsumerIterMixin):
     def commit(self, offsets: Mapping[TopicPartition, int] | None = None) -> None:
         self._check_open()
         if offsets is None:
-            offsets = dict(self._positions)
+            # Iterator mode: commit what the user was handed; poll mode:
+            # commit the poll positions (everything returned by poll), both
+            # matching kafka-python's notion of "consumed".
+            offsets = dict(self._last_yielded) if self._last_yielded else dict(self._positions)
         if self._manual:
             stray = set(offsets) - set(self._assignment)
             if stray:
