@@ -6,6 +6,8 @@ protocol, passthrough, and the multiprocessing signal path (run in a
 subprocess — forking a jax-initialized process is not safe).
 """
 
+import os
+import pathlib
 import subprocess
 import sys
 import textwrap
@@ -264,9 +266,12 @@ class TestMultiprocessing:
         commit_log = tmp_path / "commits.jsonl"
         script = tmp_path / "mp_flow.py"
         script.write_text(MULTIPROC_SCRIPT)
+        repo_root = str(pathlib.Path(__file__).resolve().parent.parent)
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
         proc = subprocess.run(
             [sys.executable, str(script), str(commit_log)],
-            capture_output=True, text=True, timeout=180,
+            capture_output=True, text=True, timeout=180, env=env,
         )
         assert proc.returncode == 0, f"stderr:\n{proc.stderr[-3000:]}"
         out = json.loads(proc.stdout.strip().splitlines()[-1])
