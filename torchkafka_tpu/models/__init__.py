@@ -1,0 +1,18 @@
+"""Model zoo: the on-chip consumers of ingested batches.
+
+The reference ships zero model code (SURVEY.md §2) — its batches flow into
+whatever the user's training loop does. Our BASELINE scenarios (configs 4-5,
+BASELINE.md) make the consumers concrete: a vision CNN for image-topic
+inference and a Llama-style decoder for prompt-topic generation/training.
+These models exist so the framework's end-to-end contract — ingest → global
+sharded batch → pjit step → barrier → commit — is demonstrated and benched
+against real MXU-shaped compute, not a stub.
+"""
+
+from torchkafka_tpu.models.transformer import (
+    Transformer,
+    TransformerConfig,
+    make_train_step,
+)
+
+__all__ = ["Transformer", "TransformerConfig", "make_train_step"]

@@ -1,0 +1,310 @@
+"""Llama-style decoder-only transformer, TPU-first.
+
+Net-new vs the reference (no model code in its tree — SURVEY.md §2); this is
+the flagship consumer of the ingest pipeline for BASELINE configs 3 and 5.
+
+Design choices, all for the TPU/XLA compilation model:
+
+- **Pure pytree params, stacked layers.** Parameters are a plain dict with
+  every per-layer tensor stacked on a leading [L, ...] axis, and the forward
+  pass runs ``lax.scan`` over that axis: one traced layer body, compile time
+  independent of depth, and a single PartitionSpec per tensor covers all
+  layers.
+- **bfloat16 compute, float32 params/accumulators.** Matmuls hit the MXU in
+  bf16 (``cfg.dtype``); master weights, optimizer moments, softmax and the
+  online-attention recurrence stay f32.
+- **Sharding by spec, collectives by XLA.** ``param_specs`` gives each tensor
+  a PartitionSpec over a {data, fsdp, tp, sp} mesh (2D "megatron" TP for
+  attention/MLP, fsdp sharding on the other matmul dim, replicated norms).
+  The train step is one ``jax.jit`` whose in/out shardings are those specs —
+  XLA inserts all_gather/reduce_scatter/psum where the math demands them.
+  No hand-written collectives outside ring attention's explicit ppermute.
+- **Sequence parallelism is real.** With an ``sp`` axis of size > 1 the
+  activations are sharded over sequence, and attention runs as ring
+  attention (torchkafka_tpu.ops.attention) so no device ever materialises
+  the full sequence. RoPE/norms/MLP are elementwise-in-sequence and need no
+  communication.
+- **Remat.** ``cfg.remat`` wraps the scanned layer body in
+  ``jax.checkpoint``, trading recompute for HBM — the standard long-context
+  lever.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Callable, Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from torchkafka_tpu.ops.attention import mha, ring_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32_000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 8  # < n_heads → grouped-query attention
+    d_ff: int = 1376
+    max_seq_len: int = 512
+    rope_theta: float = 10_000.0
+    dtype: Any = jnp.bfloat16  # compute dtype (MXU)
+    param_dtype: Any = jnp.float32  # master weights
+    remat: bool = False
+    attn_impl: str = "auto"  # 'dense' | 'ring' | 'auto' (ring iff sp>1)
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def __post_init__(self) -> None:
+        if self.d_model % self.n_heads:
+            raise ValueError("d_model must divide by n_heads")
+        if self.n_heads % self.n_kv_heads:
+            raise ValueError("n_heads must divide by n_kv_heads")
+
+
+# --------------------------------------------------------------------- params
+
+
+def param_specs(cfg: TransformerConfig) -> dict:
+    """PartitionSpecs per tensor, over mesh axes {data, fsdp, tp, sp}.
+
+    Megatron 2D layout: the "output features" dim of up-projections (wq/wk/wv,
+    w_gate/w_up) and the vocab dim shard over ``tp``; the opposing dim shards
+    over ``fsdp`` (ZeRO-3-style weight sharding that XLA turns into
+    all_gathers just-in-time). Mesh axes absent from the actual Mesh are
+    stripped by ``shardings_for_mesh``.
+    """
+    return {
+        "embed": P("tp", "fsdp"),  # [V, D]
+        "layers": {
+            "ln1": P(None, None),  # [L, D]
+            "ln2": P(None, None),
+            "wq": P(None, "fsdp", "tp", None),  # [L, D, H, Dh]
+            "wk": P(None, "fsdp", "tp", None),  # [L, D, K, Dh]
+            "wv": P(None, "fsdp", "tp", None),
+            "wo": P(None, "tp", None, "fsdp"),  # [L, H, Dh, D]
+            "w_gate": P(None, "fsdp", "tp"),  # [L, D, F]
+            "w_up": P(None, "fsdp", "tp"),
+            "w_down": P(None, "tp", "fsdp"),  # [L, F, D]
+        },
+        "ln_f": P(None),  # [D]
+        "lm_head": P("fsdp", "tp"),  # [D, V]
+    }
+
+
+def shardings_for_mesh(mesh: Mesh, specs: Any) -> Any:
+    """Convert specs → NamedShardings, dropping axis names the mesh lacks."""
+
+    def fix(spec: P) -> NamedSharding:
+        parts = []
+        for entry in spec:
+            if entry is None:
+                parts.append(None)
+            elif isinstance(entry, (tuple, list)):
+                kept = tuple(a for a in entry if a in mesh.shape)
+                parts.append(kept if kept else None)
+            else:
+                parts.append(entry if entry in mesh.shape else None)
+        return NamedSharding(mesh, P(*parts))
+
+    return jax.tree_util.tree_map(
+        fix, specs, is_leaf=lambda x: isinstance(x, P)
+    )
+
+
+def init_params(rng: jax.Array, cfg: TransformerConfig) -> dict:
+    """Scaled-normal init, stacked [L, ...] per layer tensor."""
+    keys = jax.random.split(rng, 8)
+    dm, dff, nl = cfg.d_model, cfg.d_ff, cfg.n_layers
+    h, k, dh, v = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.vocab_size
+    pd = cfg.param_dtype
+
+    def norm(key, shape, fan_in):
+        return (jax.random.normal(key, shape, pd) / math.sqrt(fan_in)).astype(pd)
+
+    return {
+        "embed": norm(keys[0], (v, dm), dm),
+        "layers": {
+            "ln1": jnp.ones((nl, dm), pd),
+            "ln2": jnp.ones((nl, dm), pd),
+            "wq": norm(keys[1], (nl, dm, h, dh), dm),
+            "wk": norm(keys[2], (nl, dm, k, dh), dm),
+            "wv": norm(keys[3], (nl, dm, k, dh), dm),
+            "wo": norm(keys[4], (nl, h, dh, dm), h * dh),
+            "w_gate": norm(keys[5], (nl, dm, dff), dm),
+            "w_up": norm(keys[6], (nl, dm, dff), dm),
+            "w_down": norm(keys[7], (nl, dff, dm), dff),
+        },
+        "ln_f": jnp.ones((dm,), pd),
+        "lm_head": norm(keys[0], (dm, v), dm),
+    }
+
+
+# -------------------------------------------------------------------- forward
+
+
+def _rms_norm(x: jax.Array, scale: jax.Array) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    rms = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + 1e-6)
+    return (xf * rms).astype(x.dtype) * scale.astype(x.dtype)
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding. x: [B, S, H, D]; positions: [S] global positions."""
+    dim = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, dim, 2, dtype=jnp.float32) / dim)
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [S, D/2]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+class Transformer:
+    """Functional model bound to a config (and optionally a mesh for SP)."""
+
+    def __init__(self, cfg: TransformerConfig, mesh: Mesh | None = None):
+        self.cfg = cfg
+        self.mesh = mesh
+        use_ring = (
+            cfg.attn_impl == "ring"
+            or (
+                cfg.attn_impl == "auto"
+                and mesh is not None
+                and mesh.shape.get("sp", 1) > 1
+            )
+        )
+        self._use_ring = use_ring and mesh is not None
+
+    def init(self, rng: jax.Array) -> dict:
+        return init_params(rng, self.cfg)
+
+    def _attention(self, q, k, v):
+        if self._use_ring:
+            return ring_attention(q, k, v, mesh=self.mesh, axis_name="sp", causal=True)
+        return mha(q, k, v, causal=True)
+
+    def _layer(self, x: jax.Array, layer: Mapping[str, jax.Array]) -> jax.Array:
+        cfg = self.cfg
+        positions = jnp.arange(x.shape[1])
+        h = _rms_norm(x, layer["ln1"])
+        q = jnp.einsum("bsd,dhe->bshe", h, layer["wq"].astype(cfg.dtype))
+        k = jnp.einsum("bsd,dke->bske", h, layer["wk"].astype(cfg.dtype))
+        v = jnp.einsum("bsd,dke->bske", h, layer["wv"].astype(cfg.dtype))
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        if cfg.n_kv_heads != cfg.n_heads:  # GQA: repeat kv heads
+            rep = cfg.n_heads // cfg.n_kv_heads
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        attn = self._attention(q, k, v)
+        x = x + jnp.einsum("bshe,hed->bsd", attn, layer["wo"].astype(cfg.dtype))
+        h = _rms_norm(x, layer["ln2"])
+        gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", h, layer["w_gate"].astype(cfg.dtype)))
+        up = jnp.einsum("bsd,df->bsf", h, layer["w_up"].astype(cfg.dtype))
+        x = x + jnp.einsum("bsf,fd->bsd", gate * up, layer["w_down"].astype(cfg.dtype))
+        return x
+
+    def __call__(self, params: dict, tokens: jax.Array) -> jax.Array:
+        """tokens [B, S] int32 → logits [B, S, V] float32."""
+        cfg = self.cfg
+        x = params["embed"].astype(cfg.dtype)[tokens]
+
+        def body(x, layer):
+            return self._layer(x, layer), None
+
+        if cfg.remat:
+            body = jax.checkpoint(body)
+        x, _ = lax.scan(body, x, params["layers"])
+        x = _rms_norm(x, params["ln_f"])
+        return jnp.einsum(
+            "bsd,dv->bsv", x, params["lm_head"].astype(cfg.dtype),
+            preferred_element_type=jnp.float32,
+        )
+
+    def loss(
+        self, params: dict, tokens: jax.Array, mask: jax.Array | None = None
+    ) -> jax.Array:
+        """Next-token cross-entropy. mask [B, S] 1=real row/token, 0=padding
+        (the ingest batcher's valid_mask — padded rows must not train).
+
+        The forward runs at full length S (so the sequence stays divisible by
+        the sp axis) and the shift happens on the logits.
+        """
+        logits = self(params, tokens)[:, :-1]
+        targets = tokens[:, 1:]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        if mask is None:
+            return nll.mean()
+        m = mask[:, 1:].astype(nll.dtype)
+        return (nll * m).sum() / jnp.maximum(m.sum(), 1.0)
+
+
+# ----------------------------------------------------------------- train step
+
+
+def batch_spec(mesh: Mesh) -> P:
+    """Tokens [B, S]: batch over data(+fsdp), sequence over sp."""
+    daxes = tuple(a for a in ("data", "fsdp") if a in mesh.shape)
+    return P(daxes if daxes else None, "sp" if "sp" in mesh.shape else None)
+
+
+def make_train_step(
+    cfg: TransformerConfig,
+    mesh: Mesh,
+    optimizer: Any,
+) -> tuple[Callable[[jax.Array], tuple], Callable[..., tuple]]:
+    """Build (init_fn, step_fn) jitted over the mesh.
+
+    init_fn(rng) → (params, opt_state) laid out per ``param_specs``.
+    step_fn(params, opt_state, tokens, mask) → (params, opt_state, loss);
+    donates params/opt_state, so the caller rebinds them every step.
+    """
+    model = Transformer(cfg, mesh)
+    p_shardings = shardings_for_mesh(mesh, param_specs(cfg))
+    tok_sharding = NamedSharding(mesh, batch_spec(mesh))
+    mask_sharding = tok_sharding
+    repl = NamedSharding(mesh, P())
+
+    @jax.jit
+    def _init(rng):
+        params = init_params(rng, cfg)
+        params = jax.lax.with_sharding_constraint(params, p_shardings)
+        opt_state = optimizer.init(params)
+        return params, opt_state
+
+    def init_fn(rng: jax.Array):
+        return _init(rng)
+
+    def _step(params, opt_state, tokens, mask):
+        # Constrain inside the jit (rather than via in_shardings) so callers
+        # may pass batches committed to any layout — e.g. the ingest path's
+        # data-axis-only sharding — and XLA inserts the reshard to add sp.
+        tokens = jax.lax.with_sharding_constraint(tokens, tok_sharding)
+        mask = jax.lax.with_sharding_constraint(mask, mask_sharding)
+        loss, grads = jax.value_and_grad(model.loss)(params, tokens, mask)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        params = jax.lax.with_sharding_constraint(params, p_shardings)
+        return params, opt_state, loss
+
+    step_fn = jax.jit(
+        _step,
+        donate_argnums=(0, 1),
+        out_shardings=(p_shardings, None, repl),
+    )
+    return init_fn, step_fn
+
+
+def count_params(params: dict) -> int:
+    return int(sum(np.prod(l.shape) for l in jax.tree_util.tree_leaves(params)))

@@ -1,0 +1,13 @@
+"""TPU compute ops: attention (dense / flash / ring) and friends.
+
+The reference has no tensor ops at all (SURVEY.md §2: TP/SP/ring-attention
+ABSENT — /root/reference has no model code). These ops are net-new capability
+required by the north star's model-consuming scenarios (BASELINE.md configs
+4-5) and by the long-context / sequence-parallel design contract: attention is
+the hot op of every downstream consumer of our ingested batches, so the
+framework ships MXU-shaped implementations of it.
+"""
+
+from torchkafka_tpu.ops.attention import mha, ring_attention
+
+__all__ = ["mha", "ring_attention"]

@@ -1,0 +1,150 @@
+"""Attention: dense (XLA) and ring (sequence-parallel) implementations.
+
+Net-new vs the reference (SURVEY.md §2: no attention anywhere in its tree);
+built TPU-first:
+
+- ``mha``: one fused einsum-softmax-einsum chain. XLA fuses the mask/softmax
+  elementwise work into the two MXU matmuls; for moderate sequence lengths
+  this is the fastest thing you can write without a custom kernel.
+- ``ring_attention``: blockwise attention with online softmax over a
+  sequence-parallel mesh axis. Each device holds a [B, S/n, H, D] shard of
+  q/k/v; k/v shards rotate around the ring via ``lax.ppermute`` (ICI
+  neighbour hops — the cheapest collective on a TPU torus) while every
+  device's q stays resident. Memory per device is O(S/n), enabling contexts
+  n× longer than a single chip's HBM would allow. Numerics follow the
+  flash-attention online-softmax recurrence (running max m, running
+  normalizer l) so the result is exact, not approximate.
+
+Both are differentiable (``ppermute`` and ``lax.scan`` have transpose rules),
+so ring attention composes with ``jax.value_and_grad`` in the training step.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.4.35 re-exports shard_map at the top level
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+_NEG_INF = -1e30  # finite sentinel: avoids -inf - -inf = nan in the recurrence
+
+
+def mha(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    q_offset: int | jax.Array = 0,
+    k_offset: int | jax.Array = 0,
+) -> jax.Array:
+    """Dense multi-head attention.
+
+    q: [B, Sq, H, D]; k, v: [B, Sk, H, D]  →  [B, Sq, H, D].
+
+    ``q_offset``/``k_offset`` are the global positions of the first row of
+    each block — this is what lets the same kernel serve both the single-chip
+    path (offsets 0) and one block step of ring attention (shard offsets).
+    """
+    dim = q.shape[-1]
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * (1.0 / math.sqrt(dim))
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[1])
+        k_pos = k_offset + jnp.arange(k.shape[1])
+        mask = q_pos[:, None] >= k_pos[None, :]
+        scores = jnp.where(mask[None, None], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum(
+        "bhqk,bkhd->bqhd", probs.astype(v.dtype), v, preferred_element_type=jnp.float32
+    ).astype(v.dtype)
+
+
+def _ring_attention_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    axis_size: int,
+    causal: bool,
+) -> jax.Array:
+    """Per-device body (runs under shard_map). q/k/v: local [B, Sl, H, D]."""
+    batch, s_local, heads, dim = q.shape
+    my_idx = lax.axis_index(axis_name)
+    scale = 1.0 / math.sqrt(dim)
+    q_pos = my_idx * s_local + jnp.arange(s_local)  # global positions, [Sl]
+
+    qf = q.astype(jnp.float32)
+
+    def block_step(carry, step):
+        out, m, l, k_cur, v_cur = carry
+        # Which shard k_cur holds now: it started at (my_idx + step) ... each
+        # hop moves shard j's data to device j+1, so after `step` hops device
+        # my_idx holds the shard originally on device (my_idx - step).
+        src = (my_idx - step) % axis_size
+        k_pos = src * s_local + jnp.arange(s_local)
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", qf, k_cur.astype(jnp.float32)
+        ) * scale
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]
+            scores = jnp.where(mask[None, None], scores, _NEG_INF)
+        m_new = jnp.maximum(m, scores.max(axis=-1))  # [B,H,Sq]
+        p = jnp.exp(scores - m_new[..., None])  # [B,H,Sq,Sk]
+        corr = jnp.exp(m - m_new)  # [B,H,Sq]
+        l_new = l * corr + p.sum(axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_cur.astype(jnp.float32))
+        out_new = out * corr.transpose(0, 2, 1)[..., None] + pv
+        perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (out_new, m_new, l_new, k_nxt, v_nxt), None
+
+    out0 = jnp.zeros((batch, s_local, heads, dim), jnp.float32)
+    m0 = jnp.full((batch, heads, s_local), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((batch, heads, s_local), jnp.float32)
+    (out, _, l, _, _), _ = lax.scan(
+        block_step, (out0, m0, l0, k, v), jnp.arange(axis_size)
+    )
+    denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return (out / denom).astype(v.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mesh: Mesh,
+    axis_name: str = "sp",
+    causal: bool = True,
+    batch_axes: tuple[str, ...] | str | None = None,
+) -> jax.Array:
+    """Exact sequence-parallel attention over ``mesh[axis_name]``.
+
+    q/k/v are *global* [B, S, H, D] arrays (inside jit, sharded along S over
+    ``axis_name`` and along B over ``batch_axes``); the shard_map body sees
+    the local shards and exchanges k/v around the ring.
+    """
+    axis_size = mesh.shape[axis_name]
+    if axis_size == 1:
+        return mha(q, k, v, causal=causal)
+    if batch_axes is None:
+        batch_axes = tuple(n for n in ("data", "fsdp") if n in mesh.shape)
+    spec = P(batch_axes if batch_axes else None, axis_name, None, None)
+    body = functools.partial(
+        _ring_attention_local, axis_name=axis_name, axis_size=axis_size, causal=causal
+    )
+    return shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
+    )(q, k, v)
