@@ -1,0 +1,175 @@
+"""Headline benchmark: sustained ingest throughput, records/sec.
+
+Measures the BASELINE.md metric (records/sec sustained ingest through the
+full transactional loop: poll → transform → batch → device → step → barrier →
+commit) for two implementations over the SAME in-memory Kafka-semantics
+broker and the SAME records:
+
+- **baseline**: the reference's architecture — our drop-in compat layer
+  running the reference's exact single-process pattern (KafkaDataset
+  subclass → torch DataLoader collation → auto_commit generator,
+  /root/reference/README.md:86-102). The reference publishes no numbers
+  (SURVEY.md §6), so its own design measured on the same hardware IS the
+  baseline.
+- **ours**: the TPU-native KafkaStream (threaded poll/transform pipeline,
+  fixed-shape batcher, async device transfer, commit tokens), with each
+  batch consumed by a real jitted reduction on the accelerator and offsets
+  committed per batch via the barrier.
+
+Prints ONE JSON line:
+  {"metric": "...", "value": N, "unit": "records/sec", "vs_baseline": N}
+
+Env knobs: BENCH_RECORDS (ours, default 1_000_000), BENCH_BASELINE_RECORDS
+(default 150_000), BENCH_BATCH (default 4096), BENCH_SEQ (tokens/record, 32).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+SEQ = int(os.environ.get("BENCH_SEQ", "32"))
+N_OURS = int(os.environ.get("BENCH_RECORDS", "1000000"))
+N_BASE = int(os.environ.get("BENCH_BASELINE_RECORDS", "150000"))
+BATCH = int(os.environ.get("BENCH_BATCH", "8192"))
+N_PARTS = 8
+
+
+def fill_broker(tk, n_records: int):
+    """One topic, N_PARTS partitions, fixed-width int32-token payloads."""
+    broker = tk.InMemoryBroker()
+    broker.create_topic("bench", partitions=N_PARTS)
+    rng = np.random.default_rng(0)
+    payload = rng.integers(0, 32000, size=(256, SEQ), dtype=np.int32)
+    # Round so the total divides evenly into BATCH-row batches: the stream
+    # then ends on a full batch and the timed region has no idle-flush tail.
+    step = max(BATCH // N_PARTS, 1) if BATCH % N_PARTS == 0 else 1
+    per_part = max(n_records // N_PARTS // step, 1) * step
+    for p in range(N_PARTS):
+        broker.produce_many(
+            "bench",
+            (payload[i % 256].tobytes() for i in range(per_part)),
+            partition=p,
+        )
+    return broker, per_part * N_PARTS
+
+
+def bench_ours(n_records: int) -> float:
+    import jax
+    import jax.numpy as jnp
+
+    import torchkafka_tpu as tk
+
+    broker, total = fill_broker(tk, n_records)
+    consumer = tk.MemoryConsumer(
+        broker,
+        "bench",
+        group_id="bench-tpu",
+        assignment=tk.partitions_for_process("bench", N_PARTS, 0, 1),
+    )
+
+    processor = tk.fixed_width(SEQ, dtype=np.int32)
+
+    @jax.jit
+    def step(tokens):
+        return jnp.sum(tokens, dtype=jnp.int32)
+
+    rows = 0
+    acc = None
+    with tk.KafkaStream(
+        consumer,
+        processor,
+        batch_size=BATCH,
+        mesh=None,
+        pad_policy="pad",
+        prefetch=4,
+        max_poll_records=16384,
+        idle_timeout_ms=2000,
+        transform_threads=0,
+        owns_consumer=True,
+    ) as stream:
+        # Warm the compile outside the timed region.
+        jax.block_until_ready(step(jnp.zeros((BATCH, SEQ), jnp.int32)))
+        t0 = time.perf_counter()
+        for batch, token in stream:
+            acc = step(batch.data)
+            token.commit(wait_for=acc)
+            rows += batch.valid_count
+            if rows >= total:  # deterministic end: no idle-timeout tail in the timing
+                break
+        elapsed = time.perf_counter() - t0
+    assert rows == total, f"consumed {rows} != produced {total}"
+    return rows / elapsed
+
+
+def bench_reference_pattern(n_records: int) -> float:
+    """The reference's single-process flow via the compat layer
+    (/root/reference/README.md:86-102): DataLoader batching + commit-per-batch."""
+    import torch
+    from torch.utils.data import DataLoader
+
+    import torchkafka_tpu as tk
+    from torchkafka_tpu.compat import KafkaDataset, auto_commit
+
+    broker, total = fill_broker(tk, n_records)
+
+    class BenchDataset(KafkaDataset):
+        def _process(self, record):
+            return torch.from_numpy(
+                np.frombuffer(record.value, dtype=np.int32).copy()
+            )
+
+        @classmethod
+        def new_consumer(cls, *args, **kwargs):
+            kwargs.pop("_is_placeholder", None)
+            return tk.MemoryConsumer(
+                broker,
+                *args,
+                assignment=tk.partitions_for_process("bench", N_PARTS, 0, 1),
+                consumer_timeout_ms=500,
+                **kwargs,
+            )
+
+    dataset = BenchDataset("bench", group_id="bench-ref")
+    loader = DataLoader(dataset, batch_size=BATCH)
+    rows = 0
+    t0 = time.perf_counter()
+    for batch in auto_commit(loader):
+        rows += int(batch.shape[0])
+        batch.sum()  # the user's "work" — same reduction as ours, on CPU torch
+        if rows >= total:  # symmetric deterministic end
+            break
+    elapsed = time.perf_counter() - t0
+    assert rows == total, f"consumed {rows} != produced {total}"
+    return rows / elapsed
+
+
+def main() -> None:
+    trials = int(os.environ.get("BENCH_TRIALS", "3"))
+    # Best-of-k: ingest is a sustained-throughput metric; transient scheduler
+    # noise (this box shares cores with the TPU tunnel) only ever subtracts.
+    ours = max(bench_ours(N_OURS) for _ in range(trials))
+    base = max(bench_reference_pattern(N_BASE) for _ in range(trials))
+    print(
+        json.dumps(
+            {
+                "metric": "sustained_ingest_throughput",
+                "value": round(ours, 1),
+                "unit": "records/sec",
+                "vs_baseline": round(ours / base, 3),
+            }
+        )
+    )
+    print(
+        f"ours={ours:,.0f} rec/s  reference-pattern={base:,.0f} rec/s  "
+        f"records={N_OURS:,}/{N_BASE:,} batch={BATCH} seq={SEQ}",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

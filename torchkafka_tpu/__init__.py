@@ -34,7 +34,16 @@ from torchkafka_tpu.source import (
     TopicPartition,
     partitions_for_process,
 )
-from torchkafka_tpu.transform import Batch, Batcher, compose, json_field, raw_bytes
+from torchkafka_tpu.transform import (
+    Batch,
+    Batcher,
+    chunk_of,
+    chunked,
+    compose,
+    fixed_width,
+    json_field,
+    raw_bytes,
+)
 
 __version__ = "0.1.0"
 
@@ -57,7 +66,10 @@ __all__ = [
     "TopicPartition",
     "TpuKafkaError",
     "batch_sharding",
+    "chunk_of",
+    "chunked",
     "compose",
+    "fixed_width",
     "global_batch",
     "json_field",
     "make_mesh",

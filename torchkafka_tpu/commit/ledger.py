@@ -16,6 +16,13 @@ pending — i.e. fetched but sitting in the carry-over buffer or an
 un-emitted partial batch — or the fetch frontier if nothing is pending.
 Committing a snapshot therefore never covers a record the user hasn't been
 handed, no matter how records interleave with drops and batch boundaries.
+
+Representation: per partition, pending is the interval [low, frontier) minus
+a (normally empty) set of out-of-order completions. Kafka partitions are
+ordered logs, so fetches arrive offset-ascending and completions almost
+always retire ``low`` itself — a couple of integer ops per record, no
+per-record set churn. The set only fills on genuinely out-of-order completion
+(e.g. interleaved re-delivery), and drains as ``low`` catches up.
 """
 
 from __future__ import annotations
@@ -23,6 +30,45 @@ from __future__ import annotations
 import threading
 
 from torchkafka_tpu.source.records import Record, TopicPartition
+
+
+class _Partition:
+    __slots__ = ("low", "frontier", "ooo")
+
+    def __init__(self, first_offset: int) -> None:
+        self.low = first_offset  # smallest possibly-pending offset
+        self.frontier = first_offset  # next-fetch position (exclusive)
+        self.ooo: set[int] = set()  # done out-of-order, all in (low, frontier)
+
+    def fetch(self, offset: int) -> None:
+        if offset < self.low:
+            # Re-delivery below the done watermark (consumer seeked back):
+            # that range is pending again.
+            self.low = offset
+        nxt = offset + 1
+        if nxt > self.frontier:
+            self.frontier = nxt
+
+    def done(self, offset: int) -> None:
+        if offset == self.low:
+            self.low += 1
+            ooo = self.ooo
+            while ooo and self.low in ooo:
+                ooo.remove(self.low)
+                self.low += 1
+        elif offset > self.low:
+            if offset < self.frontier:
+                self.ooo.add(offset)
+        # offset < low: already done (re-delivered duplicate) — tolerated,
+        # see the at-least-once note in done_many.
+
+    @property
+    def committable(self) -> int:
+        return self.low  # == frontier when nothing is pending
+
+    @property
+    def pending(self) -> int:
+        return (self.frontier - self.low) - len(self.ooo)
 
 
 class OffsetLedger:
@@ -34,16 +80,23 @@ class OffsetLedger:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._frontier: dict[TopicPartition, int] = {}
-        self._pending: dict[TopicPartition, set[int]] = {}
+        self._parts: dict[TopicPartition, _Partition] = {}
+
+    def _part(self, tp: TopicPartition, offset: int) -> _Partition:
+        part = self._parts.get(tp)
+        if part is None:
+            part = self._parts[tp] = _Partition(offset)
+        return part
 
     def fetched(self, record: Record) -> None:
         with self._lock:
-            tp = record.tp
-            nxt = record.offset + 1
-            if nxt > self._frontier.get(tp, 0):
-                self._frontier[tp] = nxt
-            self._pending.setdefault(tp, set()).add(record.offset)
+            self._part(record.tp, record.offset).fetch(record.offset)
+
+    def fetched_many(self, records: list[Record]) -> None:
+        """Bulk ``fetched``: one lock acquisition per poll chunk."""
+        with self._lock:
+            for record in records:
+                self._part(record.tp, record.offset).fetch(record.offset)
 
     def dropped(self, record: Record) -> None:
         self._done(record)
@@ -52,32 +105,35 @@ class OffsetLedger:
         self._done(record)
 
     def _done(self, record: Record) -> None:
+        # Unknown partitions are tolerated: under at-least-once delivery a
+        # record can be re-delivered after a rebalance while its first copy
+        # is still in the batcher; both copies eventually resolve, the second
+        # as a no-op. Raising would turn a legal re-delivery into a crash.
         with self._lock:
-            pend = self._pending.get(record.tp)
-            if pend is None or record.offset not in pend:
-                # Tolerate: under at-least-once delivery a record can be
-                # re-delivered after a rebalance while its first copy is still
-                # in the batcher; both copies eventually resolve, the second
-                # against an already-cleared offset. Raising here would turn a
-                # legal re-delivery into a pipeline crash.
-                return
-            pend.remove(record.offset)
+            part = self._parts.get(record.tp)
+            if part is not None:
+                part.done(record.offset)
+
+    def done_many(self, records: list[Record]) -> None:
+        """Bulk ``emitted``/``dropped`` (the same ledger transition)."""
+        with self._lock:
+            parts = self._parts
+            for record in records:
+                part = parts.get(record.tp)
+                if part is not None:
+                    part.done(record.offset)
 
     def snapshot(self) -> dict[TopicPartition, int]:
         """Committable next-read offsets right now.
 
-        For each partition: min(pending) if any record is still in flight,
-        else the fetch frontier. Calling this immediately after marking a
-        batch ``emitted`` yields offsets covering exactly that batch plus any
-        earlier drops — and never a carried-over record.
+        For each partition: the smallest still-pending offset if any record
+        is in flight, else the fetch frontier. Calling this immediately after
+        marking a batch ``emitted`` yields offsets covering exactly that
+        batch plus any earlier drops — and never a carried-over record.
         """
         with self._lock:
-            out: dict[TopicPartition, int] = {}
-            for tp, frontier in self._frontier.items():
-                pend = self._pending.get(tp)
-                out[tp] = min(pend) if pend else frontier
-            return out
+            return {tp: part.committable for tp, part in self._parts.items()}
 
     def pending_count(self) -> int:
         with self._lock:
-            return sum(len(p) for p in self._pending.values())
+            return sum(part.pending for part in self._parts.values())

@@ -90,6 +90,7 @@ class KafkaStream:
     ) -> None:
         self._consumer = consumer
         self._processor = processor
+        self._chunked = bool(getattr(processor, "chunked", False))
         self._mesh = mesh
         self._data_axis = data_axis
         self._to_device = to_device
@@ -158,8 +159,17 @@ class KafkaStream:
                     continue
                 last_data = monotonic()
                 self.metrics.records.add(len(records))
-                for r in records:
-                    self._ledger.fetched(r)
+                self._ledger.fetched_many(records)
+                if self._chunked:
+                    # Vectorized path: one processor call per poll chunk, one
+                    # slice-copy per emitted batch — the throughput hot path.
+                    stacked, keep = self._processor(records)
+                    if keep is not None:
+                        self.metrics.dropped.add(int(len(keep) - keep.sum()))
+                    if stacked is not None:
+                        for out in self._batcher.add_many(stacked, records, keep):
+                            self._ship(out)
+                    continue
                 if self._pool is not None:
                     # Lazy: results stream out in order as workers finish, so
                     # a batch ships as soon as it fills instead of waiting for

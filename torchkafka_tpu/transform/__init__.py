@@ -1,11 +1,26 @@
-"""Transform layer: per-record processors and fixed-shape batching."""
+"""Transform layer: per-record / per-chunk processors and fixed-shape batching."""
 
 from torchkafka_tpu.transform.batcher import Batch, Batcher
 from torchkafka_tpu.transform.processor import (
     Processor,
+    chunk_of,
+    chunked,
     compose,
+    fixed_width,
+    is_chunked,
     json_field,
     raw_bytes,
 )
 
-__all__ = ["Batch", "Batcher", "Processor", "compose", "json_field", "raw_bytes"]
+__all__ = [
+    "Batch",
+    "Batcher",
+    "Processor",
+    "chunk_of",
+    "chunked",
+    "compose",
+    "fixed_width",
+    "is_chunked",
+    "json_field",
+    "raw_bytes",
+]

@@ -117,6 +117,58 @@ class Batcher:
             return self._emit()
         return None
 
+    def add_many(
+        self,
+        stacked: Any,
+        records: list[Record],
+        keep: np.ndarray | None = None,
+    ) -> list[Batch]:
+        """Bulk add: the chunk-processor path. ``keep`` is an optional boolean
+        [len(records)] mask; False rows are drops, and ``stacked`` holds only
+        the kept rows (sum(keep) of them) in record order. With no mask,
+        ``stacked`` covers every record. Copies land as array slices, not
+        per-record memcpys. Returns every full Batch completed by this chunk
+        (possibly several).
+        """
+        if keep is not None:
+            kept_records = [r for r, k in zip(records, keep) if k]
+            dropped = [r for r, k in zip(records, keep) if not k]
+            if dropped:
+                self.ledger.done_many(dropped)
+            if not kept_records:
+                return []
+            records = kept_records
+        leaves, treedef = _tree.tree_flatten(stacked)
+        leaves = [np.asarray(leaf) for leaf in leaves]
+        if self._buffers is None:
+            self._treedef = treedef
+            self._buffers = [
+                np.zeros((self.batch_size, *leaf.shape[1:]), dtype=leaf.dtype)
+                for leaf in leaves
+            ]
+        if len(leaves) != len(self._buffers):
+            raise ValueError("element structure changed between chunks")
+        n = leaves[0].shape[0]
+        if n != len(records):
+            raise ValueError(f"chunk has {n} rows but {len(records)} records")
+        out: list[Batch] = []
+        i = 0
+        while i < n:
+            take = min(self.batch_size - self._fill, n - i)
+            for buf, leaf in zip(self._buffers, leaves):
+                if leaf.shape[1:] != buf.shape[1:] or leaf.dtype != buf.dtype:
+                    raise ValueError(
+                        f"chunk leaf shape/dtype {leaf.shape[1:]}/{leaf.dtype} does "
+                        f"not match batch buffer {buf.shape[1:]}/{buf.dtype}"
+                    )
+                buf[self._fill : self._fill + take] = leaf[i : i + take]
+            self._records.extend(records[i : i + take])
+            self._fill += take
+            i += take
+            if self._fill == self.batch_size:
+                out.append(self._emit())
+        return out
+
     def flush(self) -> Batch | None:
         """Emit the partial tail (pad policy) or nothing (block policy —
         the tail stays pending and uncommitted)."""
@@ -126,8 +178,7 @@ class Batcher:
 
     def _emit(self) -> Batch:
         assert self._buffers is not None
-        for r in self._records:
-            self.ledger.emitted(r)
+        self.ledger.done_many(self._records)
         batch = Batch(
             data=_tree.tree_unflatten(self._treedef, self._buffers),
             valid_count=self._fill,
