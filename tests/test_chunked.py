@@ -1,0 +1,158 @@
+"""Chunked (vectorized) transform path: the throughput hot path.
+
+Must be observationally identical to the per-record path: same batches, same
+drop semantics (keep-mask ≙ the reference's None-drop,
+/root/reference/src/kafka_dataset.py:161-162), same commit-exactly-the-batch
+offsets under carry-over.
+"""
+
+import numpy as np
+
+import torchkafka_tpu as tk
+from torchkafka_tpu.commit.ledger import OffsetLedger
+from torchkafka_tpu.source.records import Record, TopicPartition
+from torchkafka_tpu.transform.batcher import Batcher
+from torchkafka_tpu.transform.processor import chunk_of, chunked, fixed_width
+
+
+def _records(n, topic="t", partition=0, width=4, start=0):
+    return [
+        Record(topic, partition, start + i, np.full(width, i, np.int32).tobytes())
+        for i in range(n)
+    ]
+
+
+class TestFixedWidth:
+    def test_exact_width_decodes(self):
+        proc = fixed_width(4, dtype=np.int32)
+        recs = _records(10)
+        stacked, keep = proc(recs)
+        assert keep is None
+        assert stacked.shape == (10, 4)
+        np.testing.assert_array_equal(stacked[3], [3, 3, 3, 3])
+
+    def test_ragged_pads_and_truncates(self):
+        proc = fixed_width(4, dtype=np.int32, pad_value=-1)
+        recs = [
+            Record("t", 0, 0, np.array([1, 2], np.int32).tobytes()),  # short
+            Record("t", 0, 1, np.arange(6, dtype=np.int32).tobytes()),  # long
+            Record("t", 0, 2, b"\x01\x00\x00\x00\x02\x00"),  # partial item
+        ]
+        stacked, _ = proc(recs)
+        np.testing.assert_array_equal(stacked[0], [1, 2, -1, -1])
+        np.testing.assert_array_equal(stacked[1], [0, 1, 2, 3])
+        np.testing.assert_array_equal(stacked[2], [1, -1, -1, -1])
+
+
+class TestChunkOf:
+    def test_matches_per_record_and_drops(self):
+        per_record = lambda r: (
+            None if r.offset % 3 == 0 else np.frombuffer(r.value, np.int32)
+        )
+        proc = chunk_of(per_record)
+        recs = _records(9)
+        stacked, keep = proc(recs)
+        assert keep.tolist() == [False, True, True] * 3
+        assert stacked.shape == (6, 4)
+
+    def test_all_dropped(self):
+        proc = chunk_of(lambda r: None)
+        stacked, keep = proc(_records(4))
+        assert stacked is None
+        assert not keep.any()
+
+
+class TestAddMany:
+    def test_multi_batch_emit_and_offsets(self):
+        """One chunk spanning several batches: each emitted batch's offset
+        snapshot excludes records still in the carry-over."""
+        ledger = OffsetLedger()
+        b = Batcher(4, ledger)
+        recs = _records(10)
+        ledger.fetched_many(recs)
+        stacked = np.stack([np.frombuffer(r.value, np.int32) for r in recs])
+        batches = b.add_many(stacked, recs)
+        assert len(batches) == 2
+        tp = TopicPartition("t", 0)
+        assert batches[0].offsets[tp] == 4
+        assert batches[1].offsets[tp] == 8
+        assert b.pending_in_batch == 2  # carry-over stays uncommitted
+        assert ledger.snapshot()[tp] == 8
+
+    def test_keep_mask_drops_advance_watermark(self):
+        ledger = OffsetLedger()
+        b = Batcher(4, ledger)
+        recs = _records(8)
+        ledger.fetched_many(recs)
+        keep = np.array([True, False] * 4)
+        stacked = np.stack(
+            [np.frombuffer(r.value, np.int32) for r, k in zip(recs, keep) if k]
+        )
+        batches = b.add_many(stacked, recs, keep)
+        assert len(batches) == 1
+        # All 8 records resolved (4 emitted + 4 dropped): watermark = 8.
+        assert batches[0].offsets[TopicPartition("t", 0)] == 8
+
+    def test_row_record_mismatch_raises(self):
+        b = Batcher(4, OffsetLedger())
+        recs = _records(3)
+        try:
+            b.add_many(np.zeros((2, 4), np.int32), recs)
+        except ValueError as e:
+            assert "rows" in str(e)
+        else:
+            raise AssertionError("expected ValueError")
+
+
+class TestStreamChunked:
+    def test_stream_with_chunk_processor(self, broker):
+        broker.create_topic("t", partitions=2)
+        for i in range(64):
+            broker.produce("t", np.full(4, i, np.int32).tobytes(), partition=i % 2)
+        consumer = tk.MemoryConsumer(
+            broker, "t", group_id="g",
+            assignment=tk.partitions_for_process("t", 2, 0, 1),
+        )
+        rows = 0
+        with tk.KafkaStream(
+            consumer, fixed_width(4, np.int32), batch_size=16,
+            to_device=False, idle_timeout_ms=200, owns_consumer=True,
+        ) as s:
+            for batch, token in s:
+                rows += batch.valid_count
+                assert batch.data.shape == (16, 4)
+                assert token.commit()
+        assert rows == 64
+        for p in range(2):
+            assert broker.committed("g", tk.TopicPartition("t", p)) == 32
+
+    def test_chunked_drop_metrics(self, broker):
+        broker.create_topic("t", partitions=1)
+        for i in range(32):
+            broker.produce("t", np.full(4, i, np.int32).tobytes())
+
+        @chunked
+        def drop_odd(records):
+            keep = np.array([r.offset % 2 == 0 for r in records])
+            vals = [
+                np.frombuffer(r.value, np.int32) for r in records if r.offset % 2 == 0
+            ]
+            return (np.stack(vals) if vals else None), keep
+
+        consumer = tk.MemoryConsumer(
+            broker, "t", group_id="g",
+            assignment=[tk.TopicPartition("t", 0)],
+        )
+        rows = 0
+        with tk.KafkaStream(
+            consumer, drop_odd, batch_size=8, to_device=False,
+            idle_timeout_ms=200, owns_consumer=True,
+        ) as s:
+            for batch, token in s:
+                rows += batch.valid_count
+                token.commit()
+            assert s.metrics.dropped.count == 16
+        assert rows == 16
+        # Drops count toward the watermark: everything before the last
+        # emitted batch commits, including dropped odd offsets.
+        assert broker.committed("g", tk.TopicPartition("t", 0)) == 32
