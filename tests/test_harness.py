@@ -1,0 +1,33 @@
+"""Harness scenarios (tiny size) must run end-to-end on the CPU mesh and
+report sane metrics — these are the executable form of BASELINE.md's five
+configs, so each one doubles as an integration test of the full
+ingest→step→commit loop for its workload shape."""
+
+import pytest
+
+from torchkafka_tpu.harness import run_scenario
+
+
+@pytest.mark.parametrize("num", [1, 2, 3, 4, 5])
+def test_scenario_runs_and_reports(num):
+    out = run_scenario(num, "tiny")
+    assert out["records"] > 0
+    assert out["records_per_s"] > 0
+    assert out["commit_failures"] == 0
+    assert out["commit"]["count"] > 0
+    assert out["dropped"] == 0
+
+
+def test_scenario_3_trains():
+    out = run_scenario(3, "tiny")
+    assert out["last_loss"] < out["first_loss"]
+
+
+def test_scenario_5_token_accounting():
+    out = run_scenario(5, "tiny")
+    assert out["generated_tokens"] == out["records"] * 8
+
+
+def test_bad_size_rejected():
+    with pytest.raises(ValueError):
+        run_scenario(1, "huge")

@@ -1,0 +1,14 @@
+"""Benchmark harness: the five BASELINE.md scenarios.
+
+The reference publishes no benchmarks (SURVEY.md §6) — this harness defines
+the measured surface: records/sec sustained ingest and offset-commit latency
+percentiles for each BASELINE.json config, sized down to run anywhere
+(``size='tiny'`` on the CPU mesh) or at full scale on real hardware
+(``size='full'``).
+
+Run: ``python -m torchkafka_tpu.harness --scenario 1..5 [--size tiny|full]``.
+"""
+
+from torchkafka_tpu.harness.scenarios import SCENARIOS, run_scenario
+
+__all__ = ["SCENARIOS", "run_scenario"]

@@ -1,0 +1,23 @@
+"""CLI: python -m torchkafka_tpu.harness --scenario 3 --size tiny"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+from torchkafka_tpu.harness.scenarios import SCENARIOS, run_scenario
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description="torchkafka_tpu benchmark harness")
+    ap.add_argument("--scenario", type=int, choices=sorted(SCENARIOS), default=None,
+                    help="which BASELINE scenario; default: all")
+    ap.add_argument("--size", choices=("tiny", "full"), default="tiny")
+    args = ap.parse_args()
+    nums = [args.scenario] if args.scenario else sorted(SCENARIOS)
+    for n in nums:
+        print(json.dumps(run_scenario(n, args.size)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,268 @@
+"""The five BASELINE.json scenarios, each returning a metrics dict.
+
+| # | Scenario | Reference analog |
+|---|----------|------------------|
+| 1 | single-process float records, batch 4, 1 partition | README MyDataset flow (/root/reference/README.md:86-102) |
+| 2 | JSON → tokenized int32, 8 partitions, threaded transform | README multiproc flow (/root/reference/README.md:104-132) |
+| 3 | mesh-sharded global batch, transformer train, commit-after-step | none (new capability) |
+| 4 | image bytes → on-device decode/resize → ResNet-50 inference | none |
+| 5 | prompt topic → KV-cache generate → commit post-generation | none |
+
+Every scenario runs the full transactional loop (poll → transform → batch →
+device → step → barrier → commit) and reports ``records_per_s`` plus commit
+latency percentiles from the stream's own metrics.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Callable
+
+import numpy as np
+
+_SIZES = ("tiny", "full")
+
+
+def _result(name: str, rows: int, elapsed: float, stream, extra: dict | None = None) -> dict:
+    out = {
+        **stream.metrics.summary(),
+        "scenario": name,
+        "records": rows,
+        "elapsed_s": round(elapsed, 3),
+        "records_per_s": round(rows / elapsed, 1) if elapsed > 0 else None,
+    }
+    if extra:
+        out.update(extra)
+    return out
+
+
+def _drain(stream, step: Callable[[Any], Any] | None, total: int) -> tuple[int, float]:
+    """Run the transactional loop until ``total`` rows are consumed."""
+    rows = 0
+    t0 = time.perf_counter()
+    for batch, token in stream:
+        wait = step(batch) if step is not None else None
+        token.commit(wait_for=wait)
+        rows += batch.valid_count
+        if rows >= total:
+            break
+    return rows, time.perf_counter() - t0
+
+
+def scenario_1(size: str = "tiny") -> dict:
+    """Single-process, 1 partition, batch 4: the reference's README flow —
+    each record becomes a float32[8] row (torch.rand(8) analog,
+    /root/reference/README.md:40-44)."""
+    import torchkafka_tpu as tk
+
+    n = 512 if size == "tiny" else 200_000
+    broker = tk.InMemoryBroker()
+    broker.create_topic("t1", partitions=1)
+    rng = np.random.default_rng(0)
+    broker.produce_many("t1", (rng.random(8).astype(np.float32).tobytes() for _ in range(n)))
+    consumer = tk.MemoryConsumer(
+        broker, "t1", group_id="s1", assignment=[tk.TopicPartition("t1", 0)]
+    )
+    with tk.KafkaStream(
+        consumer, tk.fixed_width(8, np.float32), batch_size=4,
+        to_device=True, idle_timeout_ms=1000, owns_consumer=True,
+    ) as stream:
+        rows, elapsed = _drain(stream, None, n)
+    return _result("1:single-process", rows, elapsed, stream)
+
+
+def scenario_2(size: str = "tiny") -> dict:
+    """JSON records → tokenized int32[seq], 8 partitions, chunked transform
+    (the multiproc DataLoader analog — thread/chunk parallel instead of
+    process parallel)."""
+    import torchkafka_tpu as tk
+    from torchkafka_tpu.transform.processor import chunk_of, json_field
+
+    n, seq = (2048, 32) if size == "tiny" else (500_000, 128)
+    broker = tk.InMemoryBroker()
+    broker.create_topic("t2", partitions=8)
+    rng = np.random.default_rng(0)
+    words = ["stream", "kafka", "tpu", "offset", "commit", "batch", "mesh"]
+    broker.produce_many(
+        "t2",
+        (
+            json.dumps({"text": " ".join(rng.choice(words, 6))}).encode()
+            for _ in range(n)
+        ),
+    )
+    consumer = tk.MemoryConsumer(
+        broker, "t2", group_id="s2",
+        assignment=tk.partitions_for_process("t2", 8, 0, 1),
+    )
+    with tk.KafkaStream(
+        consumer, chunk_of(json_field("text", seq)), batch_size=256,
+        to_device=True, idle_timeout_ms=1000, owns_consumer=True,
+    ) as stream:
+        rows, elapsed = _drain(stream, None, n // 256 * 256)
+    return _result("2:json-tokenize", rows, elapsed, stream)
+
+
+def scenario_3(size: str = "tiny") -> dict:
+    """Mesh-sharded global batches training the flagship transformer with
+    commit-after-step — the heart of the TPU-native design (BASELINE
+    north star; no reference analog)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import torchkafka_tpu as tk
+    from torchkafka_tpu.models import TransformerConfig, make_train_step
+
+    n_dev = len(jax.devices())
+    mesh = tk.make_mesh({"data": n_dev})
+    seq = 64 if size == "tiny" else 512
+    cfg = (
+        TransformerConfig(vocab_size=512, d_model=64, n_layers=2, n_heads=4,
+                          n_kv_heads=2, d_ff=128, max_seq_len=seq, dtype=jnp.float32)
+        if size == "tiny"
+        else TransformerConfig(max_seq_len=seq)
+    )
+    steps = 8 if size == "tiny" else 50
+    local_batch = 2 * n_dev if size == "tiny" else 8 * n_dev
+    n = steps * local_batch
+
+    broker = tk.InMemoryBroker()
+    parts = max(n_dev, 4)
+    broker.create_topic("t3", partitions=parts)
+    rng = np.random.default_rng(0)
+    broker.produce_many(
+        "t3",
+        (rng.integers(0, cfg.vocab_size, seq, dtype=np.int32).tobytes() for _ in range(n)),
+    )
+    consumer = tk.MemoryConsumer(
+        broker, "t3", group_id="s3",
+        assignment=tk.partitions_for_process("t3", parts, 0, 1),
+    )
+    init_fn, step_fn = make_train_step(cfg, mesh, optax.adamw(1e-3))
+    params, opt_state = init_fn(jax.random.key(0))
+    state = {"params": params, "opt": opt_state, "losses": []}
+
+    def step(batch):
+        mask = (np.arange(batch.batch_size) < batch.valid_count).astype(np.int32)
+        mask = jnp.broadcast_to(jnp.asarray(mask)[:, None], batch.data.shape)
+        state["params"], state["opt"], loss = step_fn(
+            state["params"], state["opt"], batch.data, mask
+        )
+        state["losses"].append(loss)
+        return loss
+
+    with tk.KafkaStream(
+        consumer, tk.fixed_width(seq, np.int32), batch_size=local_batch,
+        mesh=mesh, idle_timeout_ms=2000, owns_consumer=True,
+    ) as stream:
+        rows, elapsed = _drain(stream, step, n)
+    losses = [float(x) for x in state["losses"]]
+    return _result(
+        "3:mesh-train", rows, elapsed, stream,
+        {"mesh": dict(mesh.shape), "first_loss": round(losses[0], 4),
+         "last_loss": round(losses[-1], 4)},
+    )
+
+
+def scenario_4(size: str = "tiny") -> dict:
+    """Image-bytes topic → on-device decode/resize → ResNet-50 inference,
+    commit per batch (BASELINE config 4; no reference analog)."""
+    import jax
+    import jax.numpy as jnp
+
+    import torchkafka_tpu as tk
+    from torchkafka_tpu.models import resnet
+
+    h = w = 64
+    out_size = 64 if size == "tiny" else 224
+    n, batch = (64, 8) if size == "tiny" else (8192, 64)
+    broker = tk.InMemoryBroker()
+    broker.create_topic("t4", partitions=4)
+    rng = np.random.default_rng(0)
+    broker.produce_many(
+        "t4",
+        (rng.integers(0, 255, h * w * 3, dtype=np.uint8).tobytes() for _ in range(n)),
+    )
+    consumer = tk.MemoryConsumer(
+        broker, "t4", group_id="s4",
+        assignment=tk.partitions_for_process("t4", 4, 0, 1),
+    )
+    params = resnet.init_params(jax.random.key(0))
+
+    @jax.jit
+    def infer(raw):
+        imgs = resnet.preprocess(raw.reshape(-1, h, w, 3), out_size)
+        return jnp.argmax(resnet.forward(params, imgs), axis=-1)
+
+    jax.block_until_ready(infer(jnp.zeros((batch, h * w * 3), jnp.uint8)))
+    with tk.KafkaStream(
+        consumer, tk.fixed_width(h * w * 3, np.uint8), batch_size=batch,
+        to_device=True, idle_timeout_ms=2000, owns_consumer=True,
+    ) as stream:
+        rows, elapsed = _drain(stream, lambda b: infer(b.data), n)
+    return _result("4:resnet-infer", rows, elapsed, stream, {"image": f"{h}x{w}->{out_size}"})
+
+
+def scenario_5(size: str = "tiny") -> dict:
+    """Prompt topic → KV-cache generation → commit offsets only after the
+    whole generation retires (BASELINE config 5; no reference analog)."""
+    import jax
+    import jax.numpy as jnp
+
+    import torchkafka_tpu as tk
+    from torchkafka_tpu.models import TransformerConfig
+    from torchkafka_tpu.models.generate import generate
+    from torchkafka_tpu.models.transformer import init_params
+
+    prompt_len, max_new = (16, 8) if size == "tiny" else (128, 64)
+    n, batch = (64, 8) if size == "tiny" else (1024, 32)
+    cfg = (
+        TransformerConfig(vocab_size=512, d_model=64, n_layers=2, n_heads=4,
+                          n_kv_heads=2, d_ff=128, max_seq_len=prompt_len + max_new,
+                          dtype=jnp.float32)
+        if size == "tiny"
+        else TransformerConfig(max_seq_len=prompt_len + max_new)
+    )
+    broker = tk.InMemoryBroker()
+    broker.create_topic("t5", partitions=2)
+    rng = np.random.default_rng(0)
+    broker.produce_many(
+        "t5",
+        (rng.integers(0, cfg.vocab_size, prompt_len, dtype=np.int32).tobytes()
+         for _ in range(n)),
+    )
+    consumer = tk.MemoryConsumer(
+        broker, "t5", group_id="s5",
+        assignment=tk.partitions_for_process("t5", 2, 0, 1),
+    )
+    params = init_params(jax.random.key(0), cfg)
+    gen = jax.jit(lambda p, t: generate(p, cfg, t, max_new))
+    jax.block_until_ready(gen(params, jnp.zeros((batch, prompt_len), jnp.int32)))
+    generated = []
+
+    def step(b):
+        out = gen(params, b.data)
+        generated.append(out)
+        return out
+
+    with tk.KafkaStream(
+        consumer, tk.fixed_width(prompt_len, np.int32), batch_size=batch,
+        to_device=True, idle_timeout_ms=2000, owns_consumer=True,
+    ) as stream:
+        rows, elapsed = _drain(stream, step, n)
+    toks = rows * max_new
+    return _result(
+        "5:generate", rows, elapsed, stream,
+        {"generated_tokens": toks,
+         "tokens_per_s": round(toks / elapsed, 1) if elapsed else None},
+    )
+
+
+SCENARIOS = {1: scenario_1, 2: scenario_2, 3: scenario_3, 4: scenario_4, 5: scenario_5}
+
+
+def run_scenario(num: int, size: str = "tiny") -> dict:
+    if size not in _SIZES:
+        raise ValueError(f"size must be one of {_SIZES}")
+    return SCENARIOS[num](size)

@@ -1,0 +1,162 @@
+"""KV-cache autoregressive decoding for the flagship transformer.
+
+BASELINE config 5's consumer: prompts stream in from a topic, the model
+generates continuations, and the prompts' offsets commit only after
+generation completes (commit-after-step, extended to a multi-step op).
+
+TPU/XLA shape discipline: the caches are preallocated to a static
+``max_len = prompt_len + max_new`` and written with
+``lax.dynamic_update_slice``; the decode loop is a ``lax.scan`` over
+``max_new`` steps (trace once, no per-step recompilation); attention masks by
+position against the static cache. Greedy (temperature=0) or categorical
+sampling.
+
+The prefill math intentionally reuses the exact layer code of
+``Transformer.__call__`` (one implementation, no drift); only the
+single-token decode step is specialised here.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from torchkafka_tpu.models.transformer import (
+    Transformer,
+    TransformerConfig,
+    _rms_norm,
+    _rope,
+)
+
+
+class KVCache(NamedTuple):
+    k: jax.Array  # [L, B, max_len, K, Dh]
+    v: jax.Array  # [L, B, max_len, K, Dh]
+
+
+def _layer_step(x, layer, cache_k, cache_v, pos, cfg):
+    """One token through one layer. x: [B, 1, D]; caches [B, max_len, K, Dh];
+    pos: scalar current position. Returns (x, new_k_row, new_v_row)."""
+    h = _rms_norm(x, layer["ln1"])
+    q = jnp.einsum("bsd,dhe->bshe", h, layer["wq"].astype(cfg.dtype))
+    k = jnp.einsum("bsd,dke->bske", h, layer["wk"].astype(cfg.dtype))
+    v = jnp.einsum("bsd,dke->bske", h, layer["wv"].astype(cfg.dtype))
+    positions = pos[None] if pos.ndim == 0 else pos
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    cache_k = lax.dynamic_update_slice(cache_k, k.astype(cache_k.dtype), (0, pos, 0, 0))
+    cache_v = lax.dynamic_update_slice(cache_v, v.astype(cache_v.dtype), (0, pos, 0, 0))
+    kk, vv = cache_k, cache_v
+    if cfg.n_kv_heads != cfg.n_heads:
+        rep = cfg.n_heads // cfg.n_kv_heads
+        kk = jnp.repeat(kk, rep, axis=2)
+        vv = jnp.repeat(vv, rep, axis=2)
+    scores = jnp.einsum(
+        "bshe,bmhe->bhsm", q, kk.astype(cfg.dtype), preferred_element_type=jnp.float32
+    ) / jnp.sqrt(jnp.float32(cfg.head_dim))
+    valid = jnp.arange(kk.shape[1]) <= pos  # attend to cache[0..pos]
+    scores = jnp.where(valid[None, None, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    attn = jnp.einsum(
+        "bhsm,bmhe->bshe", probs.astype(cfg.dtype), vv.astype(cfg.dtype),
+        preferred_element_type=jnp.float32,
+    ).astype(cfg.dtype)
+    x = x + jnp.einsum("bshe,hed->bsd", attn, layer["wo"].astype(cfg.dtype))
+    h = _rms_norm(x, layer["ln2"])
+    gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", h, layer["w_gate"].astype(cfg.dtype)))
+    up = jnp.einsum("bsd,df->bsf", h, layer["w_up"].astype(cfg.dtype))
+    x = x + jnp.einsum("bsf,fd->bsd", gate * up, layer["w_down"].astype(cfg.dtype))
+    return x, cache_k, cache_v
+
+
+def prefill(params, cfg: TransformerConfig, tokens: jax.Array, max_len: int):
+    """Full forward over the prompt, capturing k/v into static caches.
+
+    tokens: [B, S] → (last-position logits [B, V], KVCache with [0,S) filled).
+    Uses Transformer.__call__ for the logits (single source of truth) and an
+    auxiliary scan to capture per-layer k/v.
+    """
+    model = Transformer(cfg)
+    batch, seq = tokens.shape
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    positions = jnp.arange(seq)
+
+    def capture(x, layer):
+        # Same math as Transformer._layer, but returns k/v for the cache.
+        h = _rms_norm(x, layer["ln1"])
+        k = jnp.einsum("bsd,dke->bske", h, layer["wk"].astype(cfg.dtype))
+        v = jnp.einsum("bsd,dke->bske", h, layer["wv"].astype(cfg.dtype))
+        k = _rope(k, positions, cfg.rope_theta)
+        x = model._layer(x, layer)
+        return x, (k, v)
+
+    x, (ks, vs) = lax.scan(capture, x, params["layers"])
+    x = _rms_norm(x, params["ln_f"])
+    logits = jnp.einsum(
+        "bd,dv->bv", x[:, -1], params["lm_head"].astype(cfg.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    nl, kh, dh = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+    cache_k = jnp.zeros((nl, batch, max_len, kh, dh), cfg.dtype)
+    cache_v = jnp.zeros((nl, batch, max_len, kh, dh), cfg.dtype)
+    cache_k = lax.dynamic_update_slice(cache_k, ks.astype(cfg.dtype), (0, 0, 0, 0, 0))
+    cache_v = lax.dynamic_update_slice(cache_v, vs.astype(cfg.dtype), (0, 0, 0, 0, 0))
+    return logits, KVCache(cache_k, cache_v)
+
+
+def _decode_one(params, cfg, cache: KVCache, token: jax.Array, pos: jax.Array):
+    """token: [B] → logits [B, V], updated cache. pos: scalar position."""
+    x = params["embed"].astype(cfg.dtype)[token][:, None, :]  # [B,1,D]
+
+    def body(x, inputs):
+        layer, ck, cv = inputs
+        x, ck, cv = _layer_step(x, layer, ck, cv, pos, cfg)
+        return x, (ck, cv)
+
+    x, (ck, cv) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
+    x = _rms_norm(x, params["ln_f"])
+    logits = jnp.einsum(
+        "bd,dv->bv", x[:, 0], params["lm_head"].astype(cfg.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return logits, KVCache(ck, cv)
+
+
+def generate(
+    params,
+    cfg: TransformerConfig,
+    prompt: jax.Array,
+    max_new: int,
+    *,
+    temperature: float = 0.0,
+    rng: jax.Array | None = None,
+):
+    """prompt: [B, S] int32 → generated [B, max_new] int32 (greedy when
+    temperature == 0). Jit-friendly: static prompt length and max_new."""
+    batch, seq = prompt.shape
+    max_len = seq + max_new
+    logits, cache = prefill(params, cfg, prompt, max_len)
+    if rng is None:
+        rng = jax.random.key(0)
+
+    def pick(logits, key):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(key, logits / temperature, axis=-1).astype(jnp.int32)
+
+    first = pick(logits, rng)
+
+    def step(carry, i):
+        token, cache, key = carry
+        key, sub = jax.random.split(key)
+        logits, cache = _decode_one(params, cfg, cache, token, seq + i)
+        nxt = pick(logits, sub)
+        return (nxt, cache, key), token
+
+    (_, _, _), tokens = lax.scan(
+        step, (first, cache, rng), jnp.arange(max_new)
+    )
+    return jnp.transpose(tokens, (1, 0))  # [B, max_new]

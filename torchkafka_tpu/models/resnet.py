@@ -1,0 +1,126 @@
+"""ResNet-50, inference-first, pure JAX — BASELINE config 4's consumer.
+
+Net-new vs the reference (no model code in its tree, SURVEY.md §2). Written
+for the MXU: NHWC layout (the TPU-native conv layout), bfloat16 compute, and
+inference-mode batch norm folded into a single scale-and-shift per channel so
+XLA fuses it into the adjacent convolution.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+# Bottleneck stage layout for ResNet-50: (blocks, mid_channels, stride).
+_STAGES = ((3, 64, 1), (4, 128, 2), (6, 256, 2), (3, 512, 2))
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+
+def _conv_init(key, kh, kw, cin, cout, dtype):
+    fan_in = kh * kw * cin
+    w = jax.random.normal(key, (kh, kw, cin, cout), dtype) * math.sqrt(2.0 / fan_in)
+    return w
+
+
+def _bn_init(c, dtype):
+    # Inference-mode BN folded to scale/shift (identity at init).
+    return {"scale": jnp.ones((c,), dtype), "shift": jnp.zeros((c,), dtype)}
+
+
+def init_params(rng: jax.Array, cfg: ResNetConfig = ResNetConfig()) -> dict:
+    pd = cfg.param_dtype
+    keys = iter(jax.random.split(rng, 64))
+    params: dict = {
+        "stem": {"conv": _conv_init(next(keys), 7, 7, 3, cfg.width, pd), "bn": _bn_init(cfg.width, pd)}
+    }
+    cin = cfg.width
+    for s, (blocks, mid, stride) in enumerate(_STAGES):
+        stage = []
+        cout = mid * 4
+        for b in range(blocks):
+            blk = {
+                "conv1": _conv_init(next(keys), 1, 1, cin, mid, pd),
+                "bn1": _bn_init(mid, pd),
+                "conv2": _conv_init(next(keys), 3, 3, mid, mid, pd),
+                "bn2": _bn_init(mid, pd),
+                "conv3": _conv_init(next(keys), 1, 1, mid, cout, pd),
+                "bn3": _bn_init(cout, pd),
+            }
+            if b == 0:
+                blk["proj"] = _conv_init(next(keys), 1, 1, cin, cout, pd)
+                blk["bn_proj"] = _bn_init(cout, pd)
+            stage.append(blk)
+            cin = cout
+        params[f"stage{s}"] = stage
+    params["fc"] = {
+        "w": jax.random.normal(next(keys), (cin, cfg.num_classes), pd) / math.sqrt(cin),
+        "b": jnp.zeros((cfg.num_classes,), pd),
+    }
+    return params
+
+
+def _conv(x, w, stride=1, dtype=jnp.bfloat16):
+    return lax.conv_general_dilated(
+        x.astype(dtype),
+        w.astype(dtype),
+        window_strides=(stride, stride),
+        padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _bn(x, bn, dtype=jnp.bfloat16):
+    return (x * bn["scale"].astype(jnp.float32) + bn["shift"].astype(jnp.float32)).astype(dtype)
+
+
+def _bottleneck(x, blk, stride, dtype):
+    out = jax.nn.relu(_bn(_conv(x, blk["conv1"], 1, dtype), blk["bn1"], dtype))
+    out = jax.nn.relu(_bn(_conv(out, blk["conv2"], stride, dtype), blk["bn2"], dtype))
+    out = _bn(_conv(out, blk["conv3"], 1, dtype), blk["bn3"], dtype)
+    if "proj" in blk:
+        x = _bn(_conv(x, blk["proj"], stride, dtype), blk["bn_proj"], dtype)
+    return jax.nn.relu(out + x)
+
+
+def forward(params: dict, images: jax.Array, cfg: ResNetConfig = ResNetConfig()) -> jax.Array:
+    """images: [B, H, W, 3] float (already normalized) → logits [B, classes]."""
+    dt = cfg.dtype
+    x = jax.nn.relu(_bn(_conv(images, params["stem"]["conv"], 2, dt), params["stem"]["bn"], dt))
+    x = lax.reduce_window(
+        x, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME"
+    )
+    for s, (blocks, _mid, stride) in enumerate(_STAGES):
+        for b in range(blocks):
+            x = _bottleneck(x, params[f"stage{s}"][b], stride if b == 0 else 1, dt)
+    x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
+    return x @ params["fc"]["w"].astype(jnp.float32) + params["fc"]["b"].astype(jnp.float32)
+
+
+def preprocess(raw_uint8: jax.Array, out_size: int = 224) -> jax.Array:
+    """On-device decode tail for ingested [B, h, w, 3] uint8 frames: resize to
+    [B, out, out, 3] and normalize. Runs inside the consumer's jit step so the
+    host ships compact uint8 and the TPU does the pixel math."""
+    x = raw_uint8.astype(jnp.float32) / 255.0
+    b, h, w, c = x.shape
+    x = jax.image.resize(x, (b, out_size, out_size, c), method="bilinear")
+    mean = jnp.asarray([0.485, 0.456, 0.406])
+    std = jnp.asarray([0.229, 0.224, 0.225])
+    return (x - mean) / std
+
+
+def count_params(params: dict) -> int:
+    return int(sum(np.prod(l.shape) for l in jax.tree_util.tree_leaves(params)))
