@@ -11,6 +11,7 @@ The reference exports exactly two names — ``KafkaDataset`` and ``auto_commit``
 reference users (torchkafka_tpu.compat).
 """
 
+from torchkafka_tpu.checkpoint import StreamCheckpointer
 from torchkafka_tpu.commit import (
     CommitBarrier,
     CommitToken,
@@ -63,6 +64,7 @@ __all__ = [
     "MemoryConsumer",
     "OffsetLedger",
     "Record",
+    "StreamCheckpointer",
     "TopicPartition",
     "TpuKafkaError",
     "batch_sharding",

@@ -1,0 +1,5 @@
+"""Checkpoint/resume: train state and stream position, atomically paired."""
+
+from torchkafka_tpu.checkpoint.manager import StreamCheckpointer
+
+__all__ = ["StreamCheckpointer"]

@@ -1,0 +1,165 @@
+"""Atomic (train-state, stream-offset) checkpointing.
+
+The reference's resume story is "committed Kafka offsets ARE the state"
+(SURVEY.md §5 checkpoint row: restart with the same group_id ⇒ resume at the
+last commit, /root/reference/README.md:92-96) — sufficient when the consumer
+is stateless. A training consumer is not: its model/optimizer state must
+advance in lockstep with the stream position, or a restart replays records
+into a newer model (or skips records an older model never saw).
+
+``StreamCheckpointer`` fixes the pairing the way SURVEY.md §5 prescribes:
+every checkpoint atomically contains BOTH the train-state pytree (Orbax,
+which writes tmp-then-rename, so a torn save is invisible) AND the offset
+watermark of exactly the batches included in that state (the CommitToken's
+offsets). ``restore`` hands both back; ``resume`` additionally seeks the
+consumer so the stream continues from the checkpoint — even if the Kafka
+group's committed offsets ran ahead (a later commit happened, then the host
+died before saving) or behind (checkpoint saved, commit failed). Either way,
+state and stream agree afterwards; with commits also barrier-gated, the loss
+window is zero and the duplicate window is at most the batches between the
+checkpoint and the crash (at-least-once, same contract as the reference).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Any, Mapping
+
+import jax
+import numpy as np
+
+from torchkafka_tpu.source.consumer import Consumer
+from torchkafka_tpu.source.records import TopicPartition
+
+logger = logging.getLogger(__name__)
+
+_OFFSETS_FILE = "stream_offsets.json"
+
+
+def _encode_offsets(offsets: Mapping[TopicPartition, int]) -> dict[str, int]:
+    return {f"{tp.topic}\x00{tp.partition}": int(off) for tp, off in offsets.items()}
+
+
+def _decode_offsets(raw: Mapping[str, int]) -> dict[TopicPartition, int]:
+    out: dict[TopicPartition, int] = {}
+    for key, off in raw.items():
+        topic, _, part = key.rpartition("\x00")
+        out[TopicPartition(topic, int(part))] = int(off)
+    return out
+
+
+class StreamCheckpointer:
+    """Orbax-backed checkpoints of (state pytree, offset watermark).
+
+    Layout: ``<root>/<step>/state`` (Orbax PyTree) + ``<root>/<step>/stream_offsets.json``,
+    committed by a final atomic rename of the step directory — a crash
+    mid-save leaves only a ``.tmp`` directory that ``latest_step`` ignores.
+    """
+
+    def __init__(self, root: str | os.PathLike, *, keep: int = 3) -> None:
+        import orbax.checkpoint as ocp
+
+        self._root = os.path.abspath(os.fspath(root))
+        os.makedirs(self._root, exist_ok=True)
+        self._keep = keep
+        self._ckptr = ocp.StandardCheckpointer()
+
+    # ------------------------------------------------------------------ save
+
+    def save(
+        self,
+        step: int,
+        state: Any,
+        offsets: Mapping[TopicPartition, int],
+    ) -> str:
+        """Persist ``state`` + ``offsets`` as checkpoint ``step``.
+
+        ``offsets`` is normally ``token.offsets`` of the LAST batch folded
+        into ``state`` — i.e. commit watermark and weights describe the same
+        records.
+        """
+        final = os.path.join(self._root, str(step))
+        tmp = final + ".tmp"
+        if os.path.exists(tmp):
+            import shutil
+
+            shutil.rmtree(tmp)
+        state = jax.tree_util.tree_map(np.asarray, state)  # device → host
+        self._ckptr.save(os.path.join(tmp, "state"), state)
+        self._ckptr.wait_until_finished()
+        with open(os.path.join(tmp, _OFFSETS_FILE), "w") as f:
+            json.dump({"step": step, "offsets": _encode_offsets(offsets)}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        if os.path.exists(final):
+            import shutil
+
+            shutil.rmtree(final)
+        os.rename(tmp, final)  # the atomic commit point
+        self._gc()
+        logger.info("checkpoint %d saved (%d partitions)", step, len(offsets))
+        return final
+
+    def _gc(self) -> None:
+        steps = self.steps()
+        for old in steps[: -self._keep] if self._keep else []:
+            import shutil
+
+            shutil.rmtree(os.path.join(self._root, str(old)), ignore_errors=True)
+
+    # --------------------------------------------------------------- restore
+
+    def steps(self) -> list[int]:
+        out = []
+        for name in os.listdir(self._root):
+            if name.isdigit() and os.path.exists(
+                os.path.join(self._root, name, _OFFSETS_FILE)
+            ):
+                out.append(int(name))
+        return sorted(out)
+
+    def latest_step(self) -> int | None:
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    def restore(
+        self, step: int | None = None, *, template: Any | None = None
+    ) -> tuple[Any, dict[TopicPartition, int], int]:
+        """→ (state, offsets, step). ``template``: a pytree with the target
+        structure/dtypes (e.g. abstract arrays) for Orbax to restore into."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(f"no checkpoints under {self._root}")
+        path = os.path.join(self._root, str(step))
+        state = self._ckptr.restore(
+            os.path.join(path, "state"), template if template is not None else None
+        )
+        with open(os.path.join(path, _OFFSETS_FILE)) as f:
+            meta = json.load(f)
+        return state, _decode_offsets(meta["offsets"]), step
+
+    def resume(
+        self,
+        consumer: Consumer,
+        step: int | None = None,
+        *,
+        template: Any | None = None,
+    ) -> tuple[Any, int]:
+        """Restore AND align the consumer: seek every checkpointed partition
+        to its saved watermark, so the next poll continues exactly where the
+        restored state left off (regardless of the group's committed
+        offsets). → (state, step)."""
+        state, offsets, step = self.restore(step, template=template)
+        assigned = set(consumer.assignment())
+        for tp, off in offsets.items():
+            if tp in assigned:
+                consumer.seek(tp, off)
+            else:
+                logger.warning(
+                    "checkpointed partition %s not in current assignment; "
+                    "its owner must resume it", tp,
+                )
+        return state, step
