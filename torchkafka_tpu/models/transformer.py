@@ -57,7 +57,9 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16  # compute dtype (MXU)
     param_dtype: Any = jnp.float32  # master weights
     remat: bool = False
-    attn_impl: str = "auto"  # 'dense' | 'ring' | 'auto' (ring iff sp>1)
+    # 'dense' | 'flash' | 'ring' | 'auto': auto picks ring when the mesh has
+    # sp>1, else the Pallas flash kernel on TPU, else dense XLA.
+    attn_impl: str = "auto"
 
     @property
     def head_dim(self) -> int:
@@ -184,6 +186,10 @@ class Transformer:
             )
         )
         self._use_ring = use_ring and mesh is not None
+        self._use_flash = not self._use_ring and (
+            cfg.attn_impl == "flash"
+            or (cfg.attn_impl == "auto" and jax.default_backend() == "tpu")
+        )
 
     def init(self, rng: jax.Array) -> dict:
         return init_params(rng, self.cfg)
@@ -191,6 +197,10 @@ class Transformer:
     def _attention(self, q, k, v):
         if self._use_ring:
             return ring_attention(q, k, v, mesh=self.mesh, axis_name="sp", causal=True)
+        if self._use_flash:
+            from torchkafka_tpu.ops.flash import flash_attention
+
+            return flash_attention(q, k, v, True)
         return mha(q, k, v, causal=True)
 
     def _layer(self, x: jax.Array, layer: Mapping[str, jax.Array]) -> jax.Array:

@@ -9,5 +9,6 @@ framework ships MXU-shaped implementations of it.
 """
 
 from torchkafka_tpu.ops.attention import mha, ring_attention
+from torchkafka_tpu.ops.flash import flash_attention
 
-__all__ = ["mha", "ring_attention"]
+__all__ = ["flash_attention", "mha", "ring_attention"]

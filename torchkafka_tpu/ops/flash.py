@@ -1,0 +1,183 @@
+"""Flash attention: a Pallas TPU kernel for the ingest consumers' hot op.
+
+Net-new vs the reference (no tensor ops in its tree, SURVEY.md §2). The XLA
+``mha`` in attention.py materialises the [B,H,Sq,Sk] score tensor in HBM;
+this kernel never does — scores live in VMEM one (block_q × block_k) tile at
+a time, combined with the online-softmax recurrence (running max m, running
+normaliser l), so attention memory is O(S·D) instead of O(S²) and the two
+matmuls stay hot in the MXU.
+
+Layout: [B, S, H, D] api (matching ``mha``), computed as [B·H, S, D] with a
+(batch·head, q-block, k-block) grid; the k-block axis is innermost, i.e.
+sequential on TPU, and the f32 accumulators persist in VMEM scratch across
+its iterations. Causal blocks strictly above the diagonal are skipped via
+``pl.when`` (half the FLOPs of the naive mask for long sequences).
+
+Training: ``flash_attention`` carries a custom VJP whose backward recomputes
+attention with the XLA path — forward-pass memory wins (serving, prefill,
+frozen towers) are kept; long-context *training* should use ring attention
+(attention.py), whose scan is natively differentiable shard-by-shard.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU backend only; tests on CPU run the kernel in interpret mode
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+from torchkafka_tpu.ops.attention import mha
+
+_NEG_INF = -1e30
+
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+    *, scale: float, causal: bool, block_q: int, block_k: int,
+):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # Causal: a k-block strictly above the q-block's last row contributes
+    # nothing — skip its matmuls entirely.
+    run = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
+
+    @pl.when(run)
+    def _block():
+        q = q_ref[0]  # [block_q, D]
+        k = k_ref[0]  # [block_k, D]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [block_q, block_k]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_prev = m_ref[:, :1]  # [block_q, 1]
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_ref[:, :1] = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[...] = acc_ref[...] * corr + pv
+        m_ref[:, :1] = m_new
+
+    @pl.when(ki == pl.num_programs(2) - 1)
+    def _finish():
+        denom = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+
+
+def _flash_fwd_bhsd(q, k, v, *, causal: bool, block_q: int, block_k: int, interpret: bool):
+    """q,k,v: [BH, S, D] → [BH, S, D]."""
+    bh, s, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    grid = (bh, pl.cdiv(s, block_q), pl.cdiv(s, block_k))
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k
+    )
+    vmem = {} if _VMEM is None else {"memory_space": _VMEM}
+    scratch = (
+        [
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ]
+        if pltpu is not None
+        else [
+            jax.ShapeDtypeStruct((block_q, d), jnp.float32),
+            jax.ShapeDtypeStruct((block_q, 128), jnp.float32),
+            jax.ShapeDtypeStruct((block_q, 128), jnp.float32),
+        ]
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0), **vmem),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0), **vmem),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0), **vmem),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0), **vmem),
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _supported(s: int, block_q: int, block_k: int) -> bool:
+    return s % block_q == 0 and s % block_k == 0
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Fused attention. q,k,v: [B, S, H, D] → [B, S, H, D].
+
+    Falls back to the XLA path when the sequence does not tile (S not a
+    multiple of the block sizes after clamping to S).
+    """
+    return _flash_impl(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _flash_impl(q, k, v, causal, block_q, block_k, interpret):
+    b, s, h, d = q.shape
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    if not _supported(s, block_q, block_k):
+        return mha(q, k, v, causal=causal)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    def to_bhsd(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+    out = _flash_fwd_bhsd(
+        to_bhsd(q), to_bhsd(k), to_bhsd(v),
+        causal=causal, block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    return _flash_impl(q, k, v, causal, block_q, block_k, interpret), (q, k, v)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, g):
+    # Backward = recompute with the XLA path and differentiate it. Keeps the
+    # forward's memory/fusion wins where they matter (inference, prefill);
+    # memory-optimal training backward is ring attention's scan.
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: mha(q, k, v, causal=causal), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)
