@@ -1,0 +1,118 @@
+"""Native C++ decoders vs their pure-Python fallbacks: differential tests.
+
+The contract is that ``available()`` never changes observable behavior —
+only speed. Every property here runs against BOTH implementations on the
+same inputs and requires bit-identical outputs.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from torchkafka_tpu import native
+
+
+def _both(fn_name, *args, **kw):
+    """Run a native function and its forced-fallback twin."""
+    fast = getattr(native, fn_name)(*args, **kw)
+    saved = native._native
+    try:
+        native._native = None
+        slow = getattr(native, fn_name)(*args, **kw)
+    finally:
+        native._native = saved
+    return fast, slow
+
+
+needs_native = pytest.mark.skipif(
+    not native.available(), reason="native extension did not build"
+)
+
+
+class TestGatherRows:
+    @needs_native
+    def test_exact_rows_differential(self, rng):
+        vals = [rng.integers(0, 255, 16, dtype=np.uint8).tobytes() for _ in range(257)]
+        fast, slow = _both("gather_rows", vals, 16, np.uint8)
+        np.testing.assert_array_equal(fast, slow)
+
+    @needs_native
+    @pytest.mark.parametrize("dtype,pad", [(np.int32, -1), (np.float32, 0.5), (np.uint8, 7)])
+    def test_ragged_rows_differential(self, rng, dtype, pad):
+        item = np.dtype(dtype).itemsize
+        vals = [
+            rng.integers(0, 255, int(k), dtype=np.uint8).tobytes()
+            for k in rng.integers(0, 8 * item + 3, 64)  # includes partial items
+        ]
+        fast, slow = _both("gather_rows", vals, 8, dtype, pad)
+        np.testing.assert_array_equal(fast, slow)
+
+    @needs_native
+    def test_partial_trailing_item_truncated(self):
+        out = native.gather_rows([b"\x01\x00\x00\x00\x02\x00"], 4, np.int32, pad=-1)
+        assert out[0].tolist() == [1, -1, -1, -1]
+
+    def test_empty_list(self):
+        out = native.gather_rows([], 8, np.int32)
+        assert out.shape == (0, 8)
+
+
+class TestJsonTokens:
+    @needs_native
+    def test_differential_wellformed_and_malformed(self):
+        vals = [
+            json.dumps({"text": "hello world", "x": 1}).encode(),
+            json.dumps({"x": {"text": "nested counts too"}}).encode(),
+            b'{"text" : "spaced colon"}',
+            b'{"text": 42}',  # not a string -> drop
+            b'{"other": "field"}',  # missing -> drop
+            b'{"text": "unterminated',  # -> drop
+            b"not json at all",  # -> drop
+            json.dumps({"text": "x" * 100}).encode(),  # truncation
+        ]
+        fast, slow = (
+            r for r in _both("json_tokens_scan", vals, "text", 16, 0)
+        )
+        np.testing.assert_array_equal(fast[0], slow[0])
+        np.testing.assert_array_equal(fast[1], slow[1])
+        assert fast[1].tolist() == [1, 1, 1, 0, 0, 0, 0, 1]
+
+    @needs_native
+    def test_tokenization_is_utf8_bytes(self):
+        toks, keep = native.json_tokens_scan([b'{"t": "AB"}'], "t", 4, pad_id=-1)
+        assert keep[0] == 1
+        assert toks[0].tolist() == [65, 66, -1, -1]
+
+    @needs_native
+    def test_escaped_quote_does_not_terminate(self):
+        fast, slow = _both(
+            "json_tokens_scan", [br'{"t": "a\"b"}'], "t", 8, 0
+        )
+        np.testing.assert_array_equal(fast[0], slow[0])
+        assert fast[1][0] == 1
+
+
+class TestProcessorIntegration:
+    def test_fixed_width_uses_gather(self, rng):
+        from torchkafka_tpu.source.records import Record
+        from torchkafka_tpu.transform import fixed_width
+
+        recs = [
+            Record("t", 0, i, rng.integers(0, 9, 4).astype(np.int32).tobytes())
+            for i in range(7)
+        ]
+        stacked, keep = fixed_width(4, np.int32)(recs)
+        assert stacked.shape == (7, 4) and keep is None
+
+    def test_json_tokens_processor_drops(self):
+        from torchkafka_tpu.source.records import Record
+        from torchkafka_tpu.transform import json_tokens
+
+        recs = [
+            Record("t", 0, 0, b'{"text": "ok"}'),
+            Record("t", 0, 1, b'{"nope": 1}'),
+        ]
+        stacked, keep = json_tokens("text", 8)(recs)
+        assert keep.tolist() == [True, False]
+        assert stacked.shape == (1, 8)

@@ -43,6 +43,7 @@ from torchkafka_tpu.transform import (
     compose,
     fixed_width,
     json_field,
+    json_tokens,
     raw_bytes,
 )
 
@@ -74,6 +75,7 @@ __all__ = [
     "fixed_width",
     "global_batch",
     "json_field",
+    "json_tokens",
     "make_mesh",
     "partitions_for_process",
     "raw_bytes",

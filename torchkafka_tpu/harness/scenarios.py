@@ -77,7 +77,6 @@ def scenario_2(size: str = "tiny") -> dict:
     (the multiproc DataLoader analog — thread/chunk parallel instead of
     process parallel)."""
     import torchkafka_tpu as tk
-    from torchkafka_tpu.transform.processor import chunk_of, json_field
 
     n, seq = (2048, 32) if size == "tiny" else (500_000, 128)
     broker = tk.InMemoryBroker()
@@ -96,7 +95,7 @@ def scenario_2(size: str = "tiny") -> dict:
         assignment=tk.partitions_for_process("t2", 8, 0, 1),
     )
     with tk.KafkaStream(
-        consumer, chunk_of(json_field("text", seq)), batch_size=256,
+        consumer, tk.json_tokens("text", seq), batch_size=256,
         to_device=True, idle_timeout_ms=1000, owns_consumer=True,
     ) as stream:
         rows, elapsed = _drain(stream, None, n // 256 * 256)

@@ -1,0 +1,165 @@
+"""Native (C++) hot-path decoders, with pure-NumPy fallbacks.
+
+Build model: ``_decode.cpp`` compiles on demand (first import) with g++ into
+the package directory and loads as a CPython extension; no pip/pybind11
+involved. If no toolchain is available the pure-Python fallbacks below serve
+identical semantics (differential-tested), so the framework never *requires*
+the native path — it's a throughput lever, not a dependency.
+
+Public surface:
+- ``available()`` — True when the extension loaded.
+- ``gather_rows(values, width, dtype, pad)`` — list[bytes] → [n, width] array.
+- ``json_tokens_scan(values, field, seq_len, pad_id)`` — list[bytes] →
+  (int32 [n, seq_len], keep uint8 [n]); minimal flat-JSON string-field scan,
+  utf-8-byte tokenization (raw bytes — escape sequences are not decoded).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sysconfig
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "_decode.cpp")
+_SO = os.path.join(_HERE, "_tk_native" + (sysconfig.get_config_var("EXT_SUFFIX") or ".so"))
+
+_native = None
+
+
+def _build() -> bool:
+    include = sysconfig.get_paths()["include"]
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+        f"-I{include}", _SRC, "-o", _SO + ".tmp",
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(_SO + ".tmp", _SO)  # atomic: concurrent imports see whole file
+        return True
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired, OSError) as e:
+        detail = getattr(e, "stderr", b"")
+        logger.warning(
+            "native decoder build failed (falling back to NumPy): %s %s",
+            e, detail.decode() if isinstance(detail, bytes) else detail,
+        )
+        return False
+
+
+def _load() -> None:
+    global _native
+    if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+        if not _build():
+            return
+    try:
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location("_tk_native", _SO)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _native = mod
+    except Exception as e:  # pragma: no cover - loader failure is environmental
+        logger.warning("native decoder load failed (falling back to NumPy): %s", e)
+
+
+_load()
+
+
+def available() -> bool:
+    return _native is not None
+
+
+# ------------------------------------------------------------------- gather
+
+
+def gather_rows(
+    values: list[bytes], width: int, dtype=np.uint8, pad: int = 0
+) -> np.ndarray:
+    """Pack list[bytes] into a [n, width]-items array of ``dtype``
+    (truncate/pad each row). One C call for the whole chunk when native."""
+    dtype = np.dtype(dtype)
+    itemsize = dtype.itemsize
+    width_bytes = width * itemsize
+    n = len(values)
+    out = np.empty((n, width), dtype=dtype)
+    if n == 0:
+        return out
+    pad_pattern = np.asarray([pad]).astype(dtype).tobytes()
+    if _native is not None:
+        _native.gather_rows(
+            values, out.view(np.uint8).reshape(n, width_bytes), pad_pattern
+        )
+        return out
+    # Fallback: join-based bulk decode (still C-speed via bytes.join).
+    exact = all(len(v) == width_bytes for v in values)
+    if exact:
+        return np.frombuffer(b"".join(values), dtype=dtype).reshape(n, width)
+    out[:] = np.frombuffer(pad_pattern, dtype=dtype)[0]
+    for i, v in enumerate(values):
+        take = len(v) - len(v) % itemsize
+        row = np.frombuffer(v[: min(take, width_bytes)], dtype=dtype)
+        out[i, : row.shape[0]] = row
+    return out
+
+
+# ---------------------------------------------------------------- json scan
+
+
+def _py_find_string_field(buf: bytes, field: bytes) -> bytes | None:
+    """Python mirror of the C++ scanner (same raw-bytes semantics)."""
+    needle = b'"' + field + b'"'
+    i = buf.find(needle)
+    while i != -1:
+        j = i + len(needle)
+        while j < len(buf) and buf[j : j + 1] in b" \t\n":
+            j += 1
+        if j < len(buf) and buf[j : j + 1] == b":":
+            j += 1
+            while j < len(buf) and buf[j : j + 1] in b" \t\n":
+                j += 1
+            if j >= len(buf) or buf[j : j + 1] != b'"':
+                return None  # field exists but is not a string
+            j += 1
+            start = j
+            while j < len(buf):
+                if buf[j : j + 1] == b"\\":
+                    j += 2
+                    continue
+                if buf[j : j + 1] == b'"':
+                    return buf[start:j]
+                j += 1
+            return None
+        i = buf.find(needle, i + 1)
+    return None
+
+
+def json_tokens_scan(
+    values: list[bytes], field: str, seq_len: int, pad_id: int = 0
+) -> tuple[np.ndarray, np.ndarray]:
+    """→ (tokens int32 [n, seq_len], keep uint8 [n]). keep=0 rows are
+    pad_id-filled (missing / non-string / unterminated field)."""
+    n = len(values)
+    tokens = np.empty((n, seq_len), dtype=np.int32)
+    keep = np.empty((n,), dtype=np.uint8)
+    if n == 0:
+        return tokens, keep
+    fname = field.encode()
+    if _native is not None:
+        _native.json_tokens(values, fname, tokens, keep, pad_id)
+        return tokens, keep
+    for i, v in enumerate(values):
+        text = _py_find_string_field(v, fname)
+        if text is None:
+            keep[i] = 0
+            tokens[i] = pad_id
+            continue
+        keep[i] = 1
+        row = np.frombuffer(text[:seq_len], dtype=np.uint8)
+        tokens[i, : row.shape[0]] = row
+        tokens[i, row.shape[0] :] = pad_id
+    return tokens, keep

@@ -1,0 +1,192 @@
+// _tk_native: C++ hot-path record decoding for torchkafka_tpu.
+//
+// Net-new capability (the reference is pure Python with no native code —
+// SURVEY.md §2 "zero C++/Rust/CUDA components"); this is the host-side
+// throughput lever the TPU design calls for: the ingest pipeline's per-chunk
+// decode work (byte gathering, JSON field scan + tokenize) done as one C
+// call per poll chunk, writing straight into the batcher's NumPy buffers
+// with no intermediate joins or per-record Python objects.
+//
+// Interface contract (kept tiny on purpose):
+//   gather_rows(values: list[bytes], out: writable buffer [n, width_bytes],
+//               pad: int) -> None
+//       Row i = values[i] truncated/zero-padded to width_bytes.
+//   json_tokens(values: list[bytes], field: bytes, out: writable int32
+//               buffer [n, seq_len], keep: writable uint8 buffer [n],
+//               pad_id: int) -> None
+//       Minimal flat-JSON scan for "field": "...", tokenised as utf-8 byte
+//       values (the same stand-in tokenizer as transform.json_field's
+//       default); keep[i]=0 marks a drop (missing/invalid field).
+//
+// Python-side fallbacks with identical semantics live in
+// torchkafka_tpu/native/__init__.py; differential tests enforce equality.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+// ---------------------------------------------------------------- gather
+
+// Row i = values[i], truncated to whole items of `itemsize` bytes, padded
+// to the row width with the `pad_pattern` (one item's byte image) — item-
+// level semantics, so e.g. an int32 pad of -1 is a true -1, and a partial
+// trailing item in the input is replaced by pad, never half-copied.
+PyObject* gather_rows(PyObject*, PyObject* args) {
+  PyObject* values;
+  Py_buffer out;
+  Py_buffer pad;
+  if (!PyArg_ParseTuple(args, "O!w*y*", &PyList_Type, &values, &out, &pad)) {
+    return nullptr;
+  }
+  Py_ssize_t n = PyList_GET_SIZE(values);
+  Py_ssize_t itemsize = pad.len;
+  auto release = [&]() {
+    PyBuffer_Release(&out);
+    PyBuffer_Release(&pad);
+  };
+  if (n == 0) {
+    release();
+    Py_RETURN_NONE;
+  }
+  if (itemsize <= 0 || out.len % n != 0 || (out.len / n) % itemsize != 0) {
+    release();
+    PyErr_SetString(PyExc_ValueError, "out buffer / pad pattern shape mismatch");
+    return nullptr;
+  }
+  Py_ssize_t width = out.len / n;
+  auto* dst = static_cast<uint8_t*>(out.buf);
+  const auto* pad_bytes = static_cast<const uint8_t*>(pad.buf);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* item = PyList_GET_ITEM(values, i);
+    char* src;
+    Py_ssize_t len;
+    if (PyBytes_AsStringAndSize(item, &src, &len) != 0) {
+      release();
+      return nullptr;
+    }
+    Py_ssize_t take = len < width ? len : width;
+    take -= take % itemsize;  // whole items only
+    std::memcpy(dst, src, static_cast<size_t>(take));
+    for (Py_ssize_t off = take; off < width; off += itemsize) {
+      std::memcpy(dst + off, pad_bytes, static_cast<size_t>(itemsize));
+    }
+    dst += width;
+  }
+  release();
+  Py_RETURN_NONE;
+}
+
+// ------------------------------------------------------------ json scan
+
+// Find `"field"` (quoted) followed by optional spaces, ':', optional
+// spaces, '"', and return [start, end) of the raw string body (first
+// unescaped '"'). Returns false when absent or not a string value.
+bool find_string_field(const char* buf, Py_ssize_t len, const char* field,
+                       Py_ssize_t field_len, const char** out_start,
+                       Py_ssize_t* out_len) {
+  for (Py_ssize_t i = 0; i + field_len + 2 <= len; ++i) {
+    if (buf[i] != '"') continue;
+    if (std::memcmp(buf + i + 1, field, static_cast<size_t>(field_len)) != 0)
+      continue;
+    Py_ssize_t j = i + 1 + field_len;
+    if (j >= len || buf[j] != '"') continue;
+    ++j;
+    while (j < len && (buf[j] == ' ' || buf[j] == '\t' || buf[j] == '\n')) ++j;
+    if (j >= len || buf[j] != ':') continue;
+    ++j;
+    while (j < len && (buf[j] == ' ' || buf[j] == '\t' || buf[j] == '\n')) ++j;
+    if (j >= len || buf[j] != '"') return false;  // field exists, not a string
+    Py_ssize_t start = ++j;
+    while (j < len) {
+      if (buf[j] == '\\') {
+        j += 2;
+        continue;
+      }
+      if (buf[j] == '"') {
+        *out_start = buf + start;
+        *out_len = j - start;
+        return true;
+      }
+      ++j;
+    }
+    return false;  // unterminated
+  }
+  return false;
+}
+
+PyObject* json_tokens(PyObject*, PyObject* args) {
+  PyObject* values;
+  Py_buffer field;
+  Py_buffer out;
+  Py_buffer keep;
+  int pad_id;
+  if (!PyArg_ParseTuple(args, "O!y*w*w*i", &PyList_Type, &values, &field, &out,
+                        &keep, &pad_id)) {
+    return nullptr;
+  }
+  Py_ssize_t n = PyList_GET_SIZE(values);
+  auto release = [&]() {
+    PyBuffer_Release(&field);
+    PyBuffer_Release(&out);
+    PyBuffer_Release(&keep);
+  };
+  if (static_cast<Py_ssize_t>(keep.len) != n || n == 0 ||
+      out.len % (n * static_cast<Py_ssize_t>(sizeof(int32_t))) != 0) {
+    if (n == 0) {
+      release();
+      Py_RETURN_NONE;
+    }
+  }
+  Py_ssize_t seq_len = out.len / n / static_cast<Py_ssize_t>(sizeof(int32_t));
+  auto* tokens = static_cast<int32_t*>(out.buf);
+  auto* keep_flags = static_cast<uint8_t*>(keep.buf);
+  const char* fname = static_cast<const char*>(field.buf);
+  Py_ssize_t flen = field.len;
+
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* item = PyList_GET_ITEM(values, i);
+    char* src;
+    Py_ssize_t len;
+    int32_t* row = tokens + i * seq_len;
+    if (PyBytes_AsStringAndSize(item, &src, &len) != 0) {
+      release();
+      return nullptr;
+    }
+    const char* text;
+    Py_ssize_t text_len;
+    if (!find_string_field(src, len, fname, flen, &text, &text_len)) {
+      keep_flags[i] = 0;
+      for (Py_ssize_t t = 0; t < seq_len; ++t) row[t] = pad_id;
+      continue;
+    }
+    keep_flags[i] = 1;
+    Py_ssize_t take = text_len < seq_len ? text_len : seq_len;
+    for (Py_ssize_t t = 0; t < take; ++t) {
+      row[t] = static_cast<int32_t>(static_cast<uint8_t>(text[t]));
+    }
+    for (Py_ssize_t t = take; t < seq_len; ++t) row[t] = pad_id;
+  }
+  release();
+  Py_RETURN_NONE;
+}
+
+PyMethodDef methods[] = {
+    {"gather_rows", gather_rows, METH_VARARGS,
+     "gather_rows(values, out_buffer, pad): pack bytes rows fixed-width"},
+    {"json_tokens", json_tokens, METH_VARARGS,
+     "json_tokens(values, field, out_i32, keep_u8, pad_id): scan+tokenize"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef module = {
+    PyModuleDef_HEAD_INIT, "_tk_native",
+    "C++ hot-path decoders for torchkafka_tpu", -1, methods,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__tk_native() { return PyModule_Create(&module); }

@@ -9,6 +9,7 @@ from torchkafka_tpu.transform.processor import (
     fixed_width,
     is_chunked,
     json_field,
+    json_tokens,
     raw_bytes,
 )
 
@@ -22,5 +23,6 @@ __all__ = [
     "fixed_width",
     "is_chunked",
     "json_field",
+    "json_tokens",
     "raw_bytes",
 ]

@@ -51,23 +51,44 @@ def fixed_width(seq_len: int, dtype=np.int32, pad_value: int = 0) -> Callable:
     for the whole chunk); ragged stragglers fall back to a per-record
     pad/truncate. Uses the native C++ decoder when built (torchkafka_tpu.native).
     """
-    itemsize = np.dtype(dtype).itemsize
-    width = seq_len * itemsize
+    @chunked
+    def process(records: list[Record]):
+        from torchkafka_tpu import native
+
+        return native.gather_rows(
+            [r.value for r in records], seq_len, dtype, pad_value
+        ), None
+
+    return process
+
+
+def json_tokens(
+    field: str, seq_len: int, pad_id: int = 0
+) -> Callable:
+    """Chunk processor: flat-JSON records → int32[seq_len] token rows via the
+    native C++ field scanner (one C call per poll chunk; utf-8-byte
+    tokenization, the same stand-in tokenizer as ``json_field``'s default —
+    but raw bytes, escape sequences are not decoded). Records whose field is
+    missing/invalid are dropped (keep mask), the vectorized form of the
+    reference's None-drop (/root/reference/src/kafka_dataset.py:161-162).
+
+    Use ``chunk_of(json_field(...))`` instead when you need full JSON
+    semantics (escape decoding, nested objects, custom tokenizers).
+    """
 
     @chunked
     def process(records: list[Record]):
-        values = [r.value for r in records]
-        if all(len(v) == width for v in values):
-            arr = np.frombuffer(b"".join(values), dtype=dtype).reshape(
-                len(values), seq_len
-            )
-        else:
-            arr = np.full((len(values), seq_len), pad_value, dtype=dtype)
-            for i, v in enumerate(values):
-                v = v[:width]
-                row = np.frombuffer(v[: len(v) - len(v) % itemsize], dtype=dtype)
-                arr[i, : row.shape[0]] = row
-        return arr, None
+        from torchkafka_tpu import native
+
+        tokens, keep = native.json_tokens_scan(
+            [r.value for r in records], field, seq_len, pad_id
+        )
+        mask = keep.astype(bool)
+        if mask.all():
+            return tokens, None
+        if not mask.any():
+            return None, mask
+        return tokens[mask], mask
 
     return process
 
