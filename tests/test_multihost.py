@@ -1,0 +1,78 @@
+"""Multihost wiring: pod consumers, assignment disjointness, watchdog."""
+
+import functools
+import time
+
+import pytest
+
+import torchkafka_tpu as tk
+from torchkafka_tpu.errors import BarrierError
+from torchkafka_tpu.parallel import (
+    BarrierWatchdog,
+    initialize,
+    pod_consumer,
+    pod_partitions,
+)
+from torchkafka_tpu.source.assignment import partitions_for_process
+
+
+class TestInit:
+    def test_single_host_noop(self):
+        idx, count = initialize()
+        assert (idx, count) == (0, 1)
+
+
+class TestAssignment:
+    def test_pod_partitions_single_host_owns_all(self):
+        assert len(pod_partitions("t", 16)) == 16
+
+    @pytest.mark.parametrize("hosts,parts", [(4, 16), (4, 18), (8, 8), (3, 7)])
+    def test_disjoint_and_complete_across_hosts(self, hosts, parts):
+        """Every partition owned by exactly one host — the pod-level version
+        of the reference's consumer-group sharding
+        (/root/reference/src/kafka_dataset.py:208-233)."""
+        seen = {}
+        for h in range(hosts):
+            for tp in partitions_for_process("t", parts, h, hosts):
+                assert tp not in seen, f"{tp} owned by {seen[tp]} and {h}"
+                seen[tp] = h
+        assert len(seen) == parts
+
+    def test_pod_consumer_with_memory_transport(self, broker):
+        broker.create_topic("t", partitions=4)
+        consumer = pod_consumer(
+            "t", 4, "g", transport=functools.partial(tk.MemoryConsumer, broker)
+        )
+        assert len(consumer.assignment()) == 4
+        consumer.close()
+
+
+class TestWatchdog:
+    def test_normal_path_no_fire(self):
+        wd = BarrierWatchdog(tk.LocalBarrier(), timeout_s=5.0)
+        wd(None)
+        assert not wd.timed_out
+
+    def test_timeout_fires_callback(self):
+        fired = []
+
+        class SlowBarrier(tk.LocalBarrier):
+            def __call__(self, wait_for=None):
+                time.sleep(0.25)
+
+        wd = BarrierWatchdog(
+            SlowBarrier(), timeout_s=0.05, on_timeout=lambda: fired.append(1)
+        )
+        wd(None)
+        assert wd.timed_out and fired == [1]
+
+    def test_barrier_error_propagates_and_timer_cancelled(self):
+        class FailBarrier(tk.LocalBarrier):
+            def __call__(self, wait_for=None):
+                raise BarrierError("boom")
+
+        wd = BarrierWatchdog(FailBarrier(), timeout_s=0.05)
+        with pytest.raises(BarrierError):
+            wd(None)
+        time.sleep(0.1)
+        assert not wd.timed_out  # timer was cancelled on exit

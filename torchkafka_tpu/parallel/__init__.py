@@ -1,4 +1,4 @@
-"""Parallelism layer: meshes, shardings, collectives."""
+"""Parallelism layer: meshes, shardings, multihost wiring."""
 
 from torchkafka_tpu.parallel.mesh import (
     batch_sharding,
@@ -7,11 +7,21 @@ from torchkafka_tpu.parallel.mesh import (
     process_count,
     process_index,
 )
+from torchkafka_tpu.parallel.multihost import (
+    BarrierWatchdog,
+    initialize,
+    pod_consumer,
+    pod_partitions,
+)
 
 __all__ = [
+    "BarrierWatchdog",
     "batch_sharding",
     "global_batch",
+    "initialize",
     "make_mesh",
+    "pod_consumer",
+    "pod_partitions",
     "process_count",
     "process_index",
 ]

@@ -1,0 +1,138 @@
+"""Multi-host pod wiring: distributed init, pod-aligned consumers, watchdog.
+
+The reference scales with DataLoader worker *processes on one host*
+(/root/reference/src/kafka_dataset.py:208-233); a TPU pod scales with
+*host processes across machines*, one per chip group, coordinated over
+ICI/DCN. This module is the boot glue:
+
+- ``initialize()``: jax.distributed bring-up (idempotent, no-op single-host).
+- ``pod_consumer()``: this host's consumer with the mesh-aligned partition
+  slice — the TPU equivalent of the reference's one-consumer-per-worker
+  pattern, with Kafka's group protocol replaced by static assignment aligned
+  to ``jax.process_index()`` (elastic group mode remains available by
+  passing ``assignment=None``).
+- ``BarrierWatchdog``: failure detection for the commit barrier. The barrier
+  fails *closed* (nothing commits if a host is gone — records re-deliver),
+  but a collective over a dead host hangs rather than raises; the watchdog
+  turns "hung longer than timeout" into an explicit action (log + optional
+  process exit) so the orchestrator can restart the job instead of wedging.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Any, Callable, Sequence
+
+import jax
+
+from torchkafka_tpu.commit.barrier import CommitBarrier
+from torchkafka_tpu.source.assignment import partitions_for_process
+from torchkafka_tpu.source.records import TopicPartition
+
+logger = logging.getLogger(__name__)
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> tuple[int, int]:
+    """Bring up jax.distributed if needed. → (process_index, process_count).
+
+    Idempotent: safe to call when already initialized or on a single host
+    (where it is a no-op). Under TPU orchestrators (GKE/QR) all arguments are
+    auto-detected and may be omitted.
+    """
+    if num_processes is not None and num_processes > 1 and jax.process_count() == 1:
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+        except RuntimeError as e:  # already initialized
+            logger.debug("jax.distributed.initialize: %s", e)
+    return jax.process_index(), jax.process_count()
+
+
+def pod_partitions(topic: str, num_partitions: int) -> list[TopicPartition]:
+    """The partition slice this host owns under mesh-aligned assignment."""
+    return partitions_for_process(
+        topic, num_partitions, jax.process_index(), jax.process_count()
+    )
+
+
+def pod_consumer(
+    topic: str,
+    num_partitions: int,
+    group_id: str,
+    *,
+    transport: Callable[..., Any] | None = None,
+    assignment: Sequence[TopicPartition] | str = "mesh",
+    **consumer_kwargs: Any,
+):
+    """Build this host's consumer.
+
+    ``assignment='mesh'`` (default): static slice via ``pod_partitions`` —
+    deterministic, rebalance-free, the right choice when the host count is
+    fixed by the TPU topology. ``assignment=None``: join the consumer group
+    and let the broker assign (elastic, survives host replacement).
+    ``transport`` defaults to the kafka-python adapter; pass
+    ``functools.partial(MemoryConsumer, broker)`` for tests.
+    """
+    if transport is None:
+        from torchkafka_tpu.source.kafka import KafkaConsumer
+
+        transport = KafkaConsumer
+    if assignment == "mesh":
+        assignment = pod_partitions(topic, num_partitions)
+    return transport(topic, group_id=group_id, assignment=assignment, **consumer_kwargs)
+
+
+class BarrierWatchdog:
+    """Wraps a CommitBarrier; fires ``on_timeout`` if one barrier call hangs.
+
+    Default action logs CRITICAL and, when ``exit_on_timeout``, terminates
+    the process with ``exit_code`` — on a pod, a restart-from-last-commit is
+    strictly better than a wedged collective (nothing was committed, so no
+    data is lost; the Kafka group/checkpoint resume path takes over).
+    """
+
+    def __init__(
+        self,
+        barrier: CommitBarrier | None = None,
+        *,
+        timeout_s: float = 300.0,
+        on_timeout: Callable[[], None] | None = None,
+        exit_on_timeout: bool = False,
+        exit_code: int = 42,
+    ) -> None:
+        self._barrier = barrier if barrier is not None else CommitBarrier()
+        self._timeout_s = timeout_s
+        self._exit = exit_on_timeout
+        self._exit_code = exit_code
+        self._on_timeout = on_timeout
+        self.timed_out = False
+
+    def _fire(self) -> None:
+        self.timed_out = True
+        logger.critical(
+            "commit barrier exceeded %.0fs — a pod member is likely dead; "
+            "nothing was committed (fail-closed), records will re-deliver",
+            self._timeout_s,
+        )
+        if self._on_timeout is not None:
+            self._on_timeout()
+        if self._exit:  # pragma: no cover - kills the test process
+            os._exit(self._exit_code)
+
+    def __call__(self, wait_for: Any = None) -> None:
+        timer = threading.Timer(self._timeout_s, self._fire)
+        timer.daemon = True
+        timer.start()
+        try:
+            self._barrier(wait_for)
+        finally:
+            timer.cancel()
