@@ -31,7 +31,7 @@ from __future__ import annotations
 import queue
 import threading
 from concurrent.futures import ThreadPoolExecutor
-from time import monotonic
+from time import monotonic, time
 from typing import Any, Iterator, Sequence
 
 import jax
@@ -159,6 +159,9 @@ class KafkaStream:
                     continue
                 last_data = monotonic()
                 self.metrics.records.add(len(records))
+                newest = records[-1].timestamp_ms
+                if newest:
+                    self.metrics.ingest_lag_ms.set(max(0.0, time() * 1e3 - newest))
                 self._ledger.fetched_many(records)
                 if self._chunked:
                     # Vectorized path: one processor call per poll chunk, one

@@ -29,6 +29,16 @@ except ImportError:  # pragma: no cover
     HAVE_KAFKA_PYTHON = False
 
 
+def _offset_and_metadata(offset: int):
+    """kafka-python 2.0.2's OffsetAndMetadata is (offset, metadata); newer
+    releases added leader_epoch (/root/reference/setup.py:9 pins >=2.0.2, so
+    both shapes exist in the wild)."""
+    try:
+        return _kafka.OffsetAndMetadata(offset, None, -1)
+    except TypeError:
+        return _kafka.OffsetAndMetadata(offset, None)
+
+
 class KafkaConsumer(ConsumerIterMixin):
     """Consumer-protocol adapter over kafka-python.
 
@@ -101,7 +111,7 @@ class KafkaConsumer(ConsumerIterMixin):
                 self._consumer.commit(
                     {
                         _kafka.TopicPartition(tp.topic, tp.partition):
-                            _kafka.OffsetAndMetadata(off, None, -1)
+                            _offset_and_metadata(off)
                         for tp, off in offsets.items()
                     }
                 )

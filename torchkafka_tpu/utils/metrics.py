@@ -79,6 +79,23 @@ class LatencyHistogram:
         }
 
 
+class Gauge:
+    """Last-observed value (e.g. ingest lag)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = value
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
 class StreamMetrics:
     """The metric set one KafkaStream maintains."""
 
@@ -88,6 +105,7 @@ class StreamMetrics:
         self.dropped = RateMeter()  # records dropped by the processor
         self.commit_latency = LatencyHistogram()
         self.commit_failures = RateMeter()
+        self.ingest_lag_ms = Gauge()  # append-time -> poll-time of newest record
 
     def summary(self) -> dict:
         return {
@@ -97,4 +115,5 @@ class StreamMetrics:
             "dropped": self.dropped.count,
             "commit": self.commit_latency.summary(),
             "commit_failures": self.commit_failures.count,
+            "ingest_lag_ms": round(self.ingest_lag_ms.value, 3),
         }

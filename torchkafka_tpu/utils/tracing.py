@@ -1,0 +1,51 @@
+"""Profiler hooks: XLA traces and named spans around the ingest loop.
+
+The reference has no instrumentation at all (SURVEY.md §5 tracing row). On
+TPU the tool that matters is the XLA profiler — these helpers wire the
+ingest loop into it so a trace shows host poll/decode time, transfer, the
+step, and the commit barrier as separate named spans on the timeline.
+
+    with tracing.trace_session("/tmp/trace"):
+        for i, (batch, token) in enumerate(stream):
+            with tracing.step_span(i):
+                loss = train_step(batch.data)
+                token.commit(wait_for=loss)
+    # then: xprof / tensorboard --logdir /tmp/trace
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator
+
+import jax
+
+
+@contextlib.contextmanager
+def trace_session(logdir: str) -> Iterator[None]:
+    """Capture an XLA profiler trace for the enclosed block."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def step_span(step: int):
+    """Annotate one training/inference step on the trace timeline."""
+    return jax.profiler.StepTraceAnnotation("tk_step", step_num=step)
+
+
+def span(name: str):
+    """Annotate an arbitrary host-side region (e.g. 'decode', 'commit')."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def ingest_lag_ms(record_timestamp_ms: int, now_ms: float | None = None) -> float:
+    """End-to-end lag: record append time -> now. The streaming SLO metric
+    (how far behind the head of the topic the consumer is running)."""
+    import time
+
+    if now_ms is None:
+        now_ms = time.time() * 1e3
+    return max(0.0, now_ms - record_timestamp_ms) if record_timestamp_ms else 0.0
