@@ -44,6 +44,30 @@ class TestStreamBasics:
             tp = tk.TopicPartition("t", p)
             assert broker.committed("g", tp) == broker.end_offset(tp)
 
+    def test_sync_mode_matches_threaded(self, broker):
+        """prefetch=0 (no producer thread) must be observationally identical:
+        same rows, same commits, padded tail included."""
+        make_topic(broker, 60, partitions=2)  # 60 = 7 full batches of 8 + tail
+        results = {}
+        for prefetch in (0, 2):
+            consumer = tk.MemoryConsumer(broker, "t", group_id=f"g{prefetch}")
+            seen = []
+            with tk.KafkaStream(
+                consumer, int_processor, batch_size=8, prefetch=prefetch,
+                pad_policy="pad", idle_timeout_ms=200, to_device=False,
+                owns_consumer=True,
+            ) as s:
+                for batch, token in s:
+                    seen.extend(np.asarray(batch.data)[: batch.valid_count].tolist())
+                    assert token.commit()
+            results[prefetch] = (
+                sorted(seen),
+                {p: broker.committed(f"g{prefetch}", tk.TopicPartition("t", p))
+                 for p in range(2)},
+            )
+        assert results[0] == results[2]
+        assert results[0][0] == list(range(60))
+
     def test_commit_covers_exactly_emitted_batches(self, broker):
         """Stop mid-stream without committing the last batch -> its records
         re-deliver; committed ones don't. Invariant (i)+(iii) of SURVEY.md §4."""

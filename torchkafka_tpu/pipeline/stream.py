@@ -63,7 +63,12 @@ class KafkaStream:
     pad_policy: 'block' (only full batches) or 'pad' (flush emits a padded
         tail with valid_count).
     prefetch: max batches in flight ahead of the consumer (double buffering
-        at the default of 2).
+        at the default of 2). ``prefetch=0`` selects synchronous mode: no
+        producer thread at all — poll/decode run inline in ``__next__`` on
+        the caller's thread. Loses compute/ingest overlap, but also loses
+        all queue/GIL handoff cost; fastest when the step is cheap relative
+        to decode (pure-ingest workloads), and the mode to use when the
+        caller forks (threads don't survive fork).
     idle_timeout_ms: if set, the stream ends after this long with no new
         records (flushing the tail under 'pad'); if None, it streams forever.
     transform_threads: >0 runs the processor in a thread pool (order
@@ -103,6 +108,9 @@ class KafkaStream:
         self._ledger = OffsetLedger()
         self._batcher = Batcher(batch_size, self._ledger, pad_policy=pad_policy)
         self._sequencer = CommitSequencer()
+        self._sync = prefetch == 0
+        self._ready: list[Batch] = []  # sync mode: decoded-but-unyielded batches
+        self._idle_since: float | None = None
         self._queue: queue.Queue = queue.Queue(maxsize=max(1, prefetch))
         self._stop = threading.Event()
         self._error: BaseException | None = None
@@ -128,9 +136,8 @@ class KafkaStream:
             except queue.Full:
                 continue
 
-    def _ship(self, batch: Batch) -> None:
-        """Move a host batch toward the device and enqueue it. Runs on the
-        producer thread so transfers overlap the consumer's step."""
+    def _to_dev(self, batch: Batch) -> Batch:
+        """Move a host batch toward the device (async dispatch)."""
         if self._to_device:
             if self._mesh is not None:
                 data = global_batch(batch.data, self._mesh, self._data_axis)
@@ -138,7 +145,45 @@ class KafkaStream:
                 data = jax.tree_util.tree_map(jax.device_put, batch.data)
             batch = Batch(data=data, valid_count=batch.valid_count, offsets=batch.offsets)
         self.metrics.batches.add(1)
-        self._put(batch)
+        return batch
+
+    def _ship(self, batch: Batch) -> None:
+        """Device transfer + enqueue. Runs on the producer thread so
+        transfers overlap the consumer's step."""
+        self._put(self._to_dev(batch))
+
+    def _process_chunk(self, records) -> list[Batch]:
+        """One poll chunk through ledger + transform + batcher. Shared by the
+        threaded producer loop and the synchronous path."""
+        self.metrics.records.add(len(records))
+        newest = records[-1].timestamp_ms
+        if newest:
+            self.metrics.ingest_lag_ms.set(max(0.0, time() * 1e3 - newest))
+        self._ledger.fetched_many(records)
+        if self._chunked:
+            # Vectorized path: one processor call per poll chunk, one
+            # slice-copy per emitted batch — the throughput hot path.
+            stacked, keep = self._processor(records)
+            if keep is not None:
+                self.metrics.dropped.add(int(len(keep) - keep.sum()))
+            if stacked is None:
+                return []
+            return self._batcher.add_many(stacked, records, keep)
+        if self._pool is not None:
+            # Lazy: results stream out in order as workers finish, so a
+            # batch ships as soon as it fills instead of waiting for the
+            # whole poll chunk to transform.
+            elements = self._pool.map(self._processor, records)
+        else:
+            elements = (self._processor(r) for r in records)
+        outs = []
+        for r, el in zip(records, elements):
+            if el is None:
+                self.metrics.dropped.add(1)
+            out = self._batcher.add(el, r)
+            if out is not None:
+                outs.append(out)
+        return outs
 
     def _produce_loop(self) -> None:
         last_data = monotonic()
@@ -158,34 +203,8 @@ class KafkaStream:
                         break
                     continue
                 last_data = monotonic()
-                self.metrics.records.add(len(records))
-                newest = records[-1].timestamp_ms
-                if newest:
-                    self.metrics.ingest_lag_ms.set(max(0.0, time() * 1e3 - newest))
-                self._ledger.fetched_many(records)
-                if self._chunked:
-                    # Vectorized path: one processor call per poll chunk, one
-                    # slice-copy per emitted batch — the throughput hot path.
-                    stacked, keep = self._processor(records)
-                    if keep is not None:
-                        self.metrics.dropped.add(int(len(keep) - keep.sum()))
-                    if stacked is not None:
-                        for out in self._batcher.add_many(stacked, records, keep):
-                            self._ship(out)
-                    continue
-                if self._pool is not None:
-                    # Lazy: results stream out in order as workers finish, so
-                    # a batch ships as soon as it fills instead of waiting for
-                    # the whole poll chunk to transform.
-                    elements = self._pool.map(self._processor, records)
-                else:
-                    elements = (self._processor(r) for r in records)
-                for r, el in zip(records, elements):
-                    if el is None:
-                        self.metrics.dropped.add(1)
-                    out = self._batcher.add(el, r)
-                    if out is not None:
-                        self._ship(out)
+                for out in self._process_chunk(records):
+                    self._ship(out)
             tail = self._batcher.flush()
             if tail is not None:
                 self._ship(tail)
@@ -199,13 +218,45 @@ class KafkaStream:
     def __iter__(self) -> Iterator[tuple[Batch, CommitToken]]:
         return self
 
+    def _next_sync(self) -> tuple[Batch, CommitToken]:
+        """prefetch=0: poll/decode inline on the caller's thread."""
+        while not self._ready:
+            if self._stop.is_set():
+                raise StopIteration
+            try:
+                records = self._consumer.poll(
+                    max_records=self._max_poll, timeout_ms=self._poll_timeout_ms
+                )
+            except ConsumerClosedError:
+                records = []
+                self._stop.set()
+            if records:
+                self._idle_since = None
+                self._ready.extend(self._process_chunk(records))
+                continue
+            now = monotonic()
+            if self._idle_since is None:
+                self._idle_since = now
+            if self._stop.is_set() or (
+                self._idle_timeout_ms is not None
+                and (now - self._idle_since) * 1000 >= self._idle_timeout_ms
+            ):
+                tail = self._batcher.flush()
+                self._exhausted = True
+                if tail is None:
+                    raise StopIteration
+                self._ready.append(tail)
+        return self._mint(self._to_dev(self._ready.pop(0)))
+
     def __next__(self) -> tuple[Batch, CommitToken]:
-        if self._exhausted:
+        if self._exhausted and not self._ready:
             # Sticky: the _END sentinel is consumed only once; without this a
             # second iteration attempt would block forever on an empty queue.
             if self._error is not None:
                 raise self._error
             raise StopIteration
+        if self._sync:
+            return self._next_sync()
         if not self._started:
             self._started = True
             self._thread.start()
@@ -225,7 +276,9 @@ class KafkaStream:
             if self._error is not None:
                 raise self._error
             raise StopIteration
-        batch: Batch = item
+        return self._mint(item)
+
+    def _mint(self, batch: Batch) -> tuple[Batch, CommitToken]:
         token = CommitToken(
             self._consumer,
             batch.offsets,
