@@ -1,0 +1,108 @@
+"""Mixture-of-experts MLP: routing exactness, ep-sharded training, decode.
+
+The expert dimension shards over the mesh's ``ep`` axis (dense one-hot
+dispatch — every routing decision exact, no capacity drops); these tests pin
+the math against a per-token loop and prove training/decoding work under
+expert parallelism.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from torchkafka_tpu.models import Transformer, TransformerConfig, make_train_step
+from torchkafka_tpu.models.transformer import _moe_mlp
+from torchkafka_tpu.parallel import make_mesh
+
+MOE_CFG = TransformerConfig(
+    vocab_size=128, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+    d_ff=64, max_seq_len=16, dtype=jnp.float32, n_experts=4, expert_top_k=2,
+)
+
+
+class TestRouting:
+    def test_matches_per_token_loop(self, rng):
+        """Dense-dispatch einsum == naive loop over (token, top-k expert)."""
+        h = jnp.asarray(rng.normal(size=(2, 8, 32)), jnp.float32)
+        layer = {
+            "router": jnp.asarray(rng.normal(size=(32, 4)), jnp.float32),
+            "w_gate": jnp.asarray(rng.normal(size=(4, 32, 64)), jnp.float32) * 0.1,
+            "w_up": jnp.asarray(rng.normal(size=(4, 32, 64)), jnp.float32) * 0.1,
+            "w_down": jnp.asarray(rng.normal(size=(4, 64, 32)), jnp.float32) * 0.1,
+        }
+        out, aux = _moe_mlp(h, layer, MOE_CFG)
+        href = np.asarray(h)
+        logits = href @ np.asarray(layer["router"])
+        probs = np.exp(logits - logits.max(-1, keepdims=True))
+        probs /= probs.sum(-1, keepdims=True)
+        ref = np.zeros_like(href)
+        for b in range(2):
+            for s in range(8):
+                idx = np.argsort(-probs[b, s])[:2]
+                g = probs[b, s, idx] / probs[b, s, idx].sum()
+                for gi, e in zip(g, idx):
+                    x = href[b, s]
+                    sil = x @ np.asarray(layer["w_gate"][e])
+                    sil = sil / (1 + np.exp(-sil))
+                    up = x @ np.asarray(layer["w_up"][e])
+                    ref[b, s] += gi * ((sil * up) @ np.asarray(layer["w_down"][e]))
+        np.testing.assert_allclose(np.asarray(out), ref, atol=1e-4)
+        assert float(aux) >= 1.0 - 1e-5  # Switch aux loss is minimized at 1
+
+    def test_top1_routes_single_expert(self, rng):
+        cfg = dataclasses.replace(MOE_CFG, expert_top_k=1)
+        h = jnp.asarray(rng.normal(size=(1, 4, 32)), jnp.float32)
+        layer = {
+            "router": jnp.asarray(rng.normal(size=(32, 4)), jnp.float32),
+            "w_gate": jnp.asarray(rng.normal(size=(4, 32, 64)), jnp.float32) * 0.1,
+            "w_up": jnp.asarray(rng.normal(size=(4, 32, 64)), jnp.float32) * 0.1,
+            "w_down": jnp.asarray(rng.normal(size=(4, 64, 32)), jnp.float32) * 0.1,
+        }
+        out, _ = _moe_mlp(h, layer, cfg)
+        assert bool(jnp.isfinite(out).all())
+
+    def test_topk_exceeding_experts_rejected(self):
+        with pytest.raises(ValueError):
+            dataclasses.replace(MOE_CFG, n_experts=2, expert_top_k=3)
+
+
+class TestTrainingAndDecode:
+    @pytest.mark.parametrize(
+        "axes", [{"data": 8}, {"data": 2, "ep": 2, "tp": 2}, {"data": 2, "ep": 2, "sp": 2}]
+    )
+    def test_loss_decreases_on_ep_meshes(self, rng, axes):
+        mesh = make_mesh(axes)
+        init_fn, step_fn = make_train_step(MOE_CFG, mesh, optax.adamw(3e-3))
+        params, opt = init_fn(jax.random.key(0))
+        toks = jnp.asarray(rng.integers(0, 128, (8, 16)), jnp.int32)
+        mask = jnp.ones_like(toks)
+        first = None
+        for _ in range(6):
+            params, opt, loss = step_fn(params, opt, toks, mask)
+            first = float(loss) if first is None else first
+        assert float(loss) < first
+
+    def test_moe_generate_matches_full_forward(self, rng):
+        from torchkafka_tpu.models.generate import generate
+
+        model = Transformer(MOE_CFG)
+        params = model.init(jax.random.key(1))
+        prompt = jnp.asarray(rng.integers(0, 128, (2, 4)), jnp.int32)
+        out = generate(params, MOE_CFG, prompt, 4)
+        seq = prompt
+        for _ in range(4):
+            nxt = jnp.argmax(model(params, seq)[:, -1], -1).astype(jnp.int32)
+            seq = jnp.concatenate([seq, nxt[:, None]], 1)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(seq[:, 4:]))
+
+    def test_ep_sharded_loss_matches_unsharded(self, rng):
+        params = Transformer(MOE_CFG).init(jax.random.key(2))
+        toks = jnp.asarray(rng.integers(0, 128, (8, 16)), jnp.int32)
+        dense = Transformer(MOE_CFG).loss(params, toks)
+        mesh = make_mesh({"data": 2, "ep": 2, "tp": 2})
+        sharded = jax.jit(lambda p, t: Transformer(MOE_CFG, mesh).loss(p, t))(params, toks)
+        assert abs(float(dense) - float(sharded)) < 1e-4

@@ -27,6 +27,7 @@ from jax import lax
 from torchkafka_tpu.models.transformer import (
     Transformer,
     TransformerConfig,
+    _moe_mlp,
     _rms_norm,
     _rope,
 )
@@ -66,6 +67,9 @@ def _layer_step(x, layer, cache_k, cache_v, pos, cfg):
     ).astype(cfg.dtype)
     x = x + jnp.einsum("bshe,hed->bsd", attn, layer["wo"].astype(cfg.dtype))
     h = _rms_norm(x, layer["ln2"])
+    if cfg.is_moe:
+        mlp_out, _aux = _moe_mlp(h, layer, cfg)
+        return x + mlp_out, cache_k, cache_v
     gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", h, layer["w_gate"].astype(cfg.dtype)))
     up = jnp.einsum("bsd,df->bsf", h, layer["w_up"].astype(cfg.dtype))
     x = x + jnp.einsum("bsf,fd->bsd", gate * up, layer["w_down"].astype(cfg.dtype))
@@ -90,7 +94,7 @@ def prefill(params, cfg: TransformerConfig, tokens: jax.Array, max_len: int):
         k = jnp.einsum("bsd,dke->bske", h, layer["wk"].astype(cfg.dtype))
         v = jnp.einsum("bsd,dke->bske", h, layer["wv"].astype(cfg.dtype))
         k = _rope(k, positions, cfg.rope_theta)
-        x = model._layer(x, layer)
+        x, _aux = model._layer(x, layer)
         return x, (k, v)
 
     x, (ks, vs) = lax.scan(capture, x, params["layers"])

@@ -60,30 +60,55 @@ class TransformerConfig:
     # 'dense' | 'flash' | 'ring' | 'auto': auto picks ring when the mesh has
     # sp>1, else the Pallas flash kernel on TPU, else dense XLA.
     attn_impl: str = "auto"
+    # Mixture-of-experts MLP: 0 = dense SwiGLU; >0 = that many experts with
+    # top-k routing, expert weights sharded over the mesh's 'ep' axis.
+    n_experts: int = 0
+    expert_top_k: int = 2
+    router_aux_coef: float = 0.01  # load-balance loss weight (0 disables)
 
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
+
+    @property
+    def is_moe(self) -> bool:
+        return self.n_experts > 0
 
     def __post_init__(self) -> None:
         if self.d_model % self.n_heads:
             raise ValueError("d_model must divide by n_heads")
         if self.n_heads % self.n_kv_heads:
             raise ValueError("n_heads must divide by n_kv_heads")
+        if self.n_experts and self.expert_top_k > self.n_experts:
+            raise ValueError("expert_top_k cannot exceed n_experts")
 
 
 # --------------------------------------------------------------------- params
 
 
 def param_specs(cfg: TransformerConfig) -> dict:
-    """PartitionSpecs per tensor, over mesh axes {data, fsdp, tp, sp}.
+    """PartitionSpecs per tensor, over mesh axes {data, fsdp, tp, sp, ep}.
 
     Megatron 2D layout: the "output features" dim of up-projections (wq/wk/wv,
     w_gate/w_up) and the vocab dim shard over ``tp``; the opposing dim shards
     over ``fsdp`` (ZeRO-3-style weight sharding that XLA turns into
-    all_gathers just-in-time). Mesh axes absent from the actual Mesh are
-    stripped by ``shardings_for_mesh``.
+    all_gathers just-in-time). MoE expert weights add a leading expert dim
+    sharded over ``ep``. Mesh axes absent from the actual Mesh are stripped
+    by ``shardings_for_mesh``.
     """
+    if cfg.is_moe:
+        mlp = {
+            "router": P(None, "fsdp", None),  # [L, D, E] — replicated over ep
+            "w_gate": P(None, "ep", "fsdp", "tp"),  # [L, E, D, F]
+            "w_up": P(None, "ep", "fsdp", "tp"),
+            "w_down": P(None, "ep", "tp", "fsdp"),  # [L, E, F, D]
+        }
+    else:
+        mlp = {
+            "w_gate": P(None, "fsdp", "tp"),  # [L, D, F]
+            "w_up": P(None, "fsdp", "tp"),
+            "w_down": P(None, "tp", "fsdp"),  # [L, F, D]
+        }
     return {
         "embed": P("tp", "fsdp"),  # [V, D]
         "layers": {
@@ -93,9 +118,7 @@ def param_specs(cfg: TransformerConfig) -> dict:
             "wk": P(None, "fsdp", "tp", None),  # [L, D, K, Dh]
             "wv": P(None, "fsdp", "tp", None),
             "wo": P(None, "tp", None, "fsdp"),  # [L, H, Dh, D]
-            "w_gate": P(None, "fsdp", "tp"),  # [L, D, F]
-            "w_up": P(None, "fsdp", "tp"),
-            "w_down": P(None, "tp", "fsdp"),  # [L, F, D]
+            **mlp,
         },
         "ln_f": P(None),  # [D]
         "lm_head": P("fsdp", "tp"),  # [D, V]
@@ -132,6 +155,20 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> dict:
     def norm(key, shape, fan_in):
         return (jax.random.normal(key, shape, pd) / math.sqrt(fan_in)).astype(pd)
 
+    if cfg.is_moe:
+        ne = cfg.n_experts
+        mlp = {
+            "router": norm(keys[5], (nl, dm, ne), dm),
+            "w_gate": norm(keys[5], (nl, ne, dm, dff), dm),
+            "w_up": norm(keys[6], (nl, ne, dm, dff), dm),
+            "w_down": norm(keys[7], (nl, ne, dff, dm), dff),
+        }
+    else:
+        mlp = {
+            "w_gate": norm(keys[5], (nl, dm, dff), dm),
+            "w_up": norm(keys[6], (nl, dm, dff), dm),
+            "w_down": norm(keys[7], (nl, dff, dm), dff),
+        }
     return {
         "embed": norm(keys[0], (v, dm), dm),
         "layers": {
@@ -141,9 +178,7 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> dict:
             "wk": norm(keys[2], (nl, dm, k, dh), dm),
             "wv": norm(keys[3], (nl, dm, k, dh), dm),
             "wo": norm(keys[4], (nl, h, dh, dm), h * dh),
-            "w_gate": norm(keys[5], (nl, dm, dff), dm),
-            "w_up": norm(keys[6], (nl, dm, dff), dm),
-            "w_down": norm(keys[7], (nl, dff, dm), dff),
+            **mlp,
         },
         "ln_f": jnp.ones((dm,), pd),
         "lm_head": norm(keys[0], (dm, v), dm),
@@ -157,6 +192,44 @@ def _rms_norm(x: jax.Array, scale: jax.Array) -> jax.Array:
     xf = x.astype(jnp.float32)
     rms = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + 1e-6)
     return (xf * rms).astype(x.dtype) * scale.astype(x.dtype)
+
+
+def _moe_mlp(
+    h: jax.Array, layer: Mapping[str, jax.Array], cfg: "TransformerConfig"
+) -> tuple[jax.Array, jax.Array]:
+    """Top-k routed mixture of SwiGLU experts, expert dim sharded over the
+    mesh's ``ep`` axis. Dense (one-hot combine) dispatch: each ep shard
+    computes its local experts for all tokens and the gate-weighted combine
+    reduces across ``ep`` (a psum XLA inserts). Exact w.r.t. the routing —
+    no capacity-factor token dropping — at the cost of E/ep-fold local MLP
+    compute; an all_to_all token-routing dispatch is the scale-up path.
+    h: [B, S, D] → (output [B, S, D], load-balance aux loss scalar)."""
+    logits = jnp.einsum(
+        "bsd,de->bse", h.astype(jnp.float32), layer["router"].astype(jnp.float32)
+    )
+    probs = jax.nn.softmax(logits, axis=-1)  # [B,S,E]
+    top_vals, top_idx = lax.top_k(probs, cfg.expert_top_k)  # [B,S,K]
+    gates = top_vals / jnp.maximum(top_vals.sum(-1, keepdims=True), 1e-9)
+    combine = jnp.sum(
+        jax.nn.one_hot(top_idx, cfg.n_experts, dtype=probs.dtype) * gates[..., None],
+        axis=2,
+    )  # [B,S,E] — gate weight per (token, expert), 0 if not routed
+    gate_e = jax.nn.silu(
+        jnp.einsum("bsd,edf->ebsf", h, layer["w_gate"].astype(cfg.dtype))
+    )
+    up_e = jnp.einsum("bsd,edf->ebsf", h, layer["w_up"].astype(cfg.dtype))
+    out_e = jnp.einsum(
+        "ebsf,efd->ebsd", gate_e * up_e, layer["w_down"].astype(cfg.dtype)
+    )
+    out = jnp.einsum("ebsd,bse->bsd", out_e, combine.astype(cfg.dtype))
+    # Switch-style load balance: E * Σ_e (token fraction on e) * (mean prob e).
+    routed = jnp.sum(
+        jax.nn.one_hot(top_idx, cfg.n_experts, dtype=jnp.float32), axis=2
+    )
+    aux = cfg.n_experts * jnp.sum(
+        routed.mean(axis=(0, 1)) * probs.mean(axis=(0, 1))
+    )
+    return out, aux
 
 
 def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
@@ -203,7 +276,14 @@ class Transformer:
             return flash_attention(q, k, v, True)
         return mha(q, k, v, causal=True)
 
-    def _layer(self, x: jax.Array, layer: Mapping[str, jax.Array]) -> jax.Array:
+    def _moe_mlp(
+        self, h: jax.Array, layer: Mapping[str, jax.Array]
+    ) -> tuple[jax.Array, jax.Array]:
+        return _moe_mlp(h, layer, self.cfg)
+
+    def _layer(
+        self, x: jax.Array, layer: Mapping[str, jax.Array]
+    ) -> tuple[jax.Array, jax.Array]:
         cfg = self.cfg
         positions = jnp.arange(x.shape[1])
         h = _rms_norm(x, layer["ln1"])
@@ -219,27 +299,37 @@ class Transformer:
         attn = self._attention(q, k, v)
         x = x + jnp.einsum("bshe,hed->bsd", attn, layer["wo"].astype(cfg.dtype))
         h = _rms_norm(x, layer["ln2"])
+        if cfg.is_moe:
+            mlp_out, aux = self._moe_mlp(h, layer)
+            return x + mlp_out, aux
         gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", h, layer["w_gate"].astype(cfg.dtype)))
         up = jnp.einsum("bsd,df->bsf", h, layer["w_up"].astype(cfg.dtype))
         x = x + jnp.einsum("bsf,fd->bsd", gate * up, layer["w_down"].astype(cfg.dtype))
-        return x
+        return x, jnp.float32(0.0)
 
-    def __call__(self, params: dict, tokens: jax.Array) -> jax.Array:
-        """tokens [B, S] int32 → logits [B, S, V] float32."""
+    def __call__(
+        self, params: dict, tokens: jax.Array, *, return_aux: bool = False
+    ):
+        """tokens [B, S] int32 → logits [B, S, V] float32 (and, with
+        ``return_aux``, the mean per-layer router load-balance loss)."""
         cfg = self.cfg
         x = params["embed"].astype(cfg.dtype)[tokens]
 
         def body(x, layer):
-            return self._layer(x, layer), None
+            x, aux = self._layer(x, layer)
+            return x, aux
 
         if cfg.remat:
             body = jax.checkpoint(body)
-        x, _ = lax.scan(body, x, params["layers"])
+        x, auxes = lax.scan(body, x, params["layers"])
         x = _rms_norm(x, params["ln_f"])
-        return jnp.einsum(
+        logits = jnp.einsum(
             "bsd,dv->bsv", x, params["lm_head"].astype(cfg.dtype),
             preferred_element_type=jnp.float32,
         )
+        if return_aux:
+            return logits, jnp.mean(auxes)
+        return logits
 
     def loss(
         self, params: dict, tokens: jax.Array, mask: jax.Array | None = None
@@ -250,14 +340,20 @@ class Transformer:
         The forward runs at full length S (so the sequence stays divisible by
         the sp axis) and the shift happens on the logits.
         """
-        logits = self(params, tokens)[:, :-1]
+        cfg = self.cfg
+        aux = 0.0
+        if cfg.is_moe and cfg.router_aux_coef > 0:
+            logits, aux = self(params, tokens, return_aux=True)
+            logits = logits[:, :-1]
+        else:
+            logits = self(params, tokens)[:, :-1]
         targets = tokens[:, 1:]
         logp = jax.nn.log_softmax(logits, axis=-1)
         nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
         if mask is None:
-            return nll.mean()
+            return nll.mean() + cfg.router_aux_coef * aux
         m = mask[:, 1:].astype(nll.dtype)
-        return (nll * m).sum() / jnp.maximum(m.sum(), 1.0)
+        return (nll * m).sum() / jnp.maximum(m.sum(), 1.0) + cfg.router_aux_coef * aux
 
 
 # ----------------------------------------------------------------- train step
