@@ -65,6 +65,11 @@ class TransformerConfig:
     n_experts: int = 0
     expert_top_k: int = 2
     router_aux_coef: float = 0.01  # load-balance loss weight (0 disables)
+    # Pipeline parallelism: with a 'pp' mesh axis of size > 1 the layer
+    # stack runs as a GPipe schedule (ops/pipeline.py) with this many
+    # microbatches (None = pipeline depth). The router aux loss is not
+    # collected under pp (the router still trains through the main loss).
+    pp_microbatches: int | None = None
 
     @property
     def head_dim(self) -> int:
@@ -109,16 +114,24 @@ def param_specs(cfg: TransformerConfig) -> dict:
             "w_up": P(None, "fsdp", "tp"),
             "w_down": P(None, "tp", "fsdp"),  # [L, F, D]
         }
+    # The stacked layer dim shards over 'pp' (each pipeline stage owns a
+    # contiguous slice of layers); on meshes without pp it strips to None.
+    def with_pp(spec: P) -> P:
+        return P("pp", *tuple(spec)[1:])
+
     return {
         "embed": P("tp", "fsdp"),  # [V, D]
         "layers": {
-            "ln1": P(None, None),  # [L, D]
-            "ln2": P(None, None),
-            "wq": P(None, "fsdp", "tp", None),  # [L, D, H, Dh]
-            "wk": P(None, "fsdp", "tp", None),  # [L, D, K, Dh]
-            "wv": P(None, "fsdp", "tp", None),
-            "wo": P(None, "tp", None, "fsdp"),  # [L, H, Dh, D]
-            **mlp,
+            k: with_pp(v)
+            for k, v in {
+                "ln1": P(None, None),  # [L, D]
+                "ln2": P(None, None),
+                "wq": P(None, "fsdp", "tp", None),  # [L, D, H, Dh]
+                "wk": P(None, "fsdp", "tp", None),  # [L, D, K, Dh]
+                "wv": P(None, "fsdp", "tp", None),
+                "wo": P(None, "tp", None, "fsdp"),  # [L, H, Dh, D]
+                **mlp,
+            }.items()
         },
         "ln_f": P(None),  # [D]
         "lm_head": P("fsdp", "tp"),  # [D, V]
@@ -281,11 +294,22 @@ class Transformer:
     ) -> tuple[jax.Array, jax.Array]:
         return _moe_mlp(h, layer, self.cfg)
 
+    @staticmethod
+    def _seq_positions(local_len: int) -> jax.Array:
+        """Global RoPE positions. Inside a manual region over 'sp' (a
+        pipeline stage) the layer sees only its sequence shard, so offset by
+        the shard index; in the auto-sharded path jit sees the global view."""
+        from jax.sharding import get_abstract_mesh
+
+        if "sp" in getattr(get_abstract_mesh(), "manual_axes", ()):
+            return lax.axis_index("sp") * local_len + jnp.arange(local_len)
+        return jnp.arange(local_len)
+
     def _layer(
         self, x: jax.Array, layer: Mapping[str, jax.Array]
     ) -> tuple[jax.Array, jax.Array]:
         cfg = self.cfg
-        positions = jnp.arange(x.shape[1])
+        positions = self._seq_positions(x.shape[1])
         h = _rms_norm(x, layer["ln1"])
         q = jnp.einsum("bsd,dhe->bshe", h, layer["wq"].astype(cfg.dtype))
         k = jnp.einsum("bsd,dke->bske", h, layer["wk"].astype(cfg.dtype))
@@ -315,13 +339,39 @@ class Transformer:
         cfg = self.cfg
         x = params["embed"].astype(cfg.dtype)[tokens]
 
-        def body(x, layer):
-            x, aux = self._layer(x, layer)
-            return x, aux
+        if self.mesh is not None and self.mesh.shape.get("pp", 1) > 1:
+            # GPipe over the stacked layers; embed/head/norm stay outside the
+            # pipeline (replicated across pp). Aux losses are not collected.
+            # With sp>1 the stage also binds 'sp' manually so ring attention
+            # runs its collectives directly inside the stage body.
+            from jax.sharding import PartitionSpec as _P
 
-        if cfg.remat:
-            body = jax.checkpoint(body)
-        x, auxes = lax.scan(body, x, params["layers"])
+            from torchkafka_tpu.ops.pipeline import gpipe
+
+            sp_size = self.mesh.shape.get("sp", 1)
+            if sp_size > 1 and not self._use_ring:
+                raise ValueError(
+                    "a pp mesh with sp>1 requires ring attention "
+                    "(attn_impl='ring' or 'auto')"
+                )
+            layer_fn = lambda a, layer: self._layer(a, layer)[0]  # noqa: E731
+            if cfg.remat:
+                layer_fn = jax.checkpoint(layer_fn)
+            x = gpipe(
+                layer_fn, params["layers"], x,
+                mesh=self.mesh, axis="pp", microbatches=cfg.pp_microbatches,
+                extra_manual={"sp"} if sp_size > 1 else set(),
+                act_spec=_P(None, "sp", None) if sp_size > 1 else None,
+            )
+            auxes = jnp.zeros((cfg.n_layers,), jnp.float32)
+        else:
+            def body(x, layer):
+                x, aux = self._layer(x, layer)
+                return x, aux
+
+            if cfg.remat:
+                body = jax.checkpoint(body)
+            x, auxes = lax.scan(body, x, params["layers"])
         x = _rms_norm(x, params["ln_f"])
         logits = jnp.einsum(
             "bsd,dv->bsv", x, params["lm_head"].astype(cfg.dtype),

@@ -139,12 +139,29 @@ def ring_attention(
     axis_size = mesh.shape[axis_name]
     if axis_size == 1:
         return mha(q, k, v, causal=causal)
-    if batch_axes is None:
-        batch_axes = tuple(n for n in ("data", "fsdp") if n in mesh.shape)
-    spec = P(batch_axes if batch_axes else None, axis_name, None, None)
+    from jax.sharding import get_abstract_mesh
+
+    ctx = get_abstract_mesh()
+    if axis_name in getattr(ctx, "manual_axes", ()):
+        # Already inside a manual region over axis_name (e.g. a pipeline
+        # stage that bound 'sp' alongside 'pp'): q/k/v are local shards and
+        # the collectives can run directly — nesting a second shard_map on
+        # the same axis is illegal.
+        return _ring_attention_local(
+            q, k, v, axis_name=axis_name, axis_size=axis_size, causal=causal
+        )
+    # Partial-manual shard_map: only the sequence axis is manual here; batch
+    # (data/fsdp) sharding stays automatic, so the specs mention ONLY
+    # axis_name.
+    spec = P(None, axis_name, None, None)
     body = functools.partial(
         _ring_attention_local, axis_name=axis_name, axis_size=axis_size, causal=causal
     )
     return shard_map(
-        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
+        body,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        axis_names=frozenset({axis_name}),
+        check_vma=False,
     )(q, k, v)

@@ -1,0 +1,143 @@
+"""GPipe-style pipeline parallelism over a ``pp`` mesh axis.
+
+Net-new vs the reference (no model code, SURVEY.md §2 parallelism table).
+The stacked-layer representation ([L, ...] params + one scanned body, see
+models/transformer.py) pipelines naturally: shard the layer axis over
+``pp`` so each stage owns L/P consecutive layers, split the batch into
+microbatches, and run the classic GPipe schedule — M + P - 1 ticks, each
+stage applying its local layer stack and handing its activation to the next
+stage over ``lax.ppermute`` (one ICI hop on a TPU torus).
+
+Manual collectives are confined to the ``pp`` axis via partial-manual
+``shard_map`` (``axis_names={'pp'}``): tensor/data/fsdp sharding inside the
+stage body stays automatic, so the same layer code composes with tp/sp/ep
+exactly as in the non-pipelined path. The whole schedule is built from
+``lax.scan`` + ``ppermute`` + ``where``, all with transpose rules, so
+``jax.grad`` through the pipeline just works (backward replays the schedule
+in reverse).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+
+def gpipe(
+    layer_fn: Callable[[jax.Array, Any], jax.Array],
+    layer_params: Any,
+    x: jax.Array,
+    *,
+    mesh: Mesh,
+    axis: str = "pp",
+    microbatches: int | None = None,
+    extra_manual: frozenset[str] | set[str] = frozenset(),
+    act_spec: P | None = None,
+) -> jax.Array:
+    """Pipelined equivalent of ``lax.scan(layer_fn)`` over stacked layers.
+
+    layer_fn(act, one_layer) -> act; layer_params: pytree with leading layer
+    dim L (sharded over ``axis``: stage p owns layers [p·L/P, (p+1)·L/P));
+    x: [B, ...] activations. Returns the same value as the sequential scan,
+    bitwise up to reduction order.
+
+    ``microbatches`` (default = pipeline depth P) must divide B; deeper
+    M reduces the bubble fraction (P-1)/(M+P-1) at the cost of smaller
+    per-tick matmuls.
+
+    ``extra_manual``/``act_spec``: axes the layer body handles manually
+    itself (e.g. 'sp' when the body runs ring attention — a nested
+    shard_map over the same axis is illegal, so the stage binds it and the
+    body's collectives run directly). ``act_spec`` is the PartitionSpec of
+    one activation [B, ...] over those axes; its batch entry is ignored.
+    """
+    n_stages = mesh.shape[axis]
+    if n_stages == 1:
+        def seq_body(a, layer):
+            return layer_fn(a, layer), None
+
+        return lax.scan(seq_body, x, layer_params)[0]
+    m = microbatches if microbatches is not None else n_stages
+    batch = x.shape[0]
+    if batch % m != 0:
+        raise ValueError(f"batch {batch} not divisible by microbatches {m}")
+
+    orig_dtype = x.dtype
+
+    def stage_body(params_local: Any, x_mb_f32: jax.Array) -> jax.Array:
+        # The shard_map boundary is f32 (cast back immediately): x is
+        # replicated over pp, so its cotangent is an all-reduce across the
+        # stages — and XLA's CPU AllReducePromotion pass miscompiles bf16
+        # all-reduces. Stage-internal compute still runs in the caller's
+        # dtype; ppermute (the only steady-state collective) is unaffected.
+        x_mb = x_mb_f32.astype(orig_dtype)
+        stage = lax.axis_index(axis)
+
+        def apply_stage(act):
+            def body(a, layer):
+                return layer_fn(a, layer), None
+
+            return lax.scan(body, act, params_local)[0]
+
+        out_buf = jnp.zeros_like(x_mb)  # [M, mb, ...]
+        act = jnp.zeros_like(x_mb[0])
+
+        def tick(carry, t):
+            act, out_buf = carry
+            # Stage 0 ingests microbatch t (harmless clipped re-read after M).
+            incoming = lax.dynamic_index_in_dim(
+                x_mb, jnp.clip(t, 0, m - 1), keepdims=False
+            )
+            act = jnp.where(stage == 0, incoming, act)
+            act = apply_stage(act)
+            # Last stage retires microbatch t-(P-1).
+            idx = t - (n_stages - 1)
+            write = (stage == n_stages - 1) & (idx >= 0)
+            safe = jnp.clip(idx, 0, m - 1)
+            current = lax.dynamic_index_in_dim(out_buf, safe, keepdims=False)
+            out_buf = lax.dynamic_update_index_in_dim(
+                out_buf, jnp.where(write, act, current), safe, 0
+            )
+            # Hand activations downstream: stage p -> p+1.
+            act = lax.ppermute(
+                act, axis, [(i, (i + 1) % n_stages) for i in range(n_stages)]
+            )
+            return (act, out_buf), None
+
+        (act, out_buf), _ = lax.scan(
+            tick, (act, out_buf), jnp.arange(m + n_stages - 1)
+        )
+        # Replicate the last stage's result across the pp axis (f32 — see
+        # the boundary note above).
+        masked = jnp.where(
+            stage == n_stages - 1, out_buf, jnp.zeros_like(out_buf)
+        ).astype(jnp.float32)
+        return lax.psum(masked, axis)
+
+    # [B, ...] -> [M, B/M, ...]; the microbatch loop runs inside the stages.
+    x_mb = x.reshape(m, batch // m, *x.shape[1:]).astype(jnp.float32)
+    layer_specs = jax.tree_util.tree_map(lambda _: P(axis), layer_params)
+    if act_spec is not None:
+        # [B, d1, d2, ...] spec -> [M, mb, d1, d2, ...] spec.
+        x_spec = P(None, None, *tuple(act_spec)[1:])
+    else:
+        x_spec = P()
+    out = shard_map(
+        stage_body,
+        mesh=mesh,
+        in_specs=(layer_specs, x_spec),
+        out_specs=x_spec,
+        axis_names=frozenset({axis}) | frozenset(extra_manual),
+        check_vma=False,
+    )(layer_params, x_mb)
+    return out.reshape(batch, *x.shape[1:]).astype(orig_dtype)
