@@ -38,9 +38,30 @@ class CommitBarrier:
     can never alias each other's barrier.
     """
 
-    def __init__(self, name: str = "tpukafka_commit") -> None:
+    def __init__(self, name: str = "tpukafka_commit", strict: bool = True) -> None:
         self._name = name
         self._calls = 0
+        self._strict = strict
+
+    @staticmethod
+    def _retire(wait_for: Any) -> None:
+        """Prove the step's device work is complete.
+
+        ``block_until_ready`` plus — in strict mode — a one-scalar host
+        fetch from the first array leaf. The fetch exists because
+        experimental/tunneled backends (e.g. the axon TPU proxy) have been
+        observed returning from block_until_ready before the computation
+        retires; committing offsets on that lie would break the
+        at-least-once contract, so the barrier pessimistically demands a
+        value. Cost: one scalar D2H per batch.
+        """
+        jax.block_until_ready(wait_for)
+        leaves = [
+            leaf for leaf in jax.tree_util.tree_leaves(wait_for)
+            if isinstance(leaf, jax.Array) and leaf.size > 0
+        ]
+        if leaves:
+            jax.device_get(leaves[0].ravel()[0])
 
     def __call__(self, wait_for: Any = None) -> None:
         try:
@@ -49,7 +70,10 @@ class CommitBarrier:
                 # batch's results exist before its offsets become committable
                 # (the reference's yield-then-commit ordering,
                 # /root/reference/src/auto_commit.py:55-58, made device-aware).
-                jax.block_until_ready(wait_for)
+                if self._strict:
+                    self._retire(wait_for)
+                else:
+                    jax.block_until_ready(wait_for)
             self._calls += 1
             if jax.process_count() > 1:  # pragma: no cover - needs real pod
                 from jax.experimental import multihost_utils
@@ -67,4 +91,7 @@ class CommitBarrier:
 class LocalBarrier(CommitBarrier):
     def __call__(self, wait_for: Any = None) -> None:
         if wait_for is not None:
-            jax.block_until_ready(wait_for)
+            if self._strict:
+                self._retire(wait_for)
+            else:
+                jax.block_until_ready(wait_for)

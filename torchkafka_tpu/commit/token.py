@@ -19,7 +19,9 @@ matching the reference's non-fatal contract
 from __future__ import annotations
 
 import logging
+import threading
 import time
+from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Callable, Mapping
 
 from torchkafka_tpu.commit.barrier import CommitBarrier
@@ -31,22 +33,29 @@ logger = logging.getLogger(__name__)
 
 
 class CommitSequencer:
-    """Shared monotonic watermark across the tokens of one stream."""
+    """Shared monotonic watermark across the tokens of one stream.
+
+    Thread-safe: tokens are issued on the consuming thread while commits may
+    execute on the stream's async-commit thread."""
 
     def __init__(self) -> None:
+        self._lock = threading.Lock()
         self._next_seq = 0
         self._high_water = -1
 
     def issue(self) -> int:
-        seq = self._next_seq
-        self._next_seq += 1
-        return seq
+        with self._lock:
+            seq = self._next_seq
+            self._next_seq += 1
+            return seq
 
     def superseded(self, seq: int) -> bool:
-        return seq <= self._high_water
+        with self._lock:
+            return seq <= self._high_water
 
     def advance(self, seq: int) -> None:
-        self._high_water = max(self._high_water, seq)
+        with self._lock:
+            self._high_water = max(self._high_water, seq)
 
 
 class CommitToken:
@@ -59,6 +68,7 @@ class CommitToken:
         sequencer: CommitSequencer,
         barrier: CommitBarrier | None = None,
         on_commit: Callable[[float, bool], None] | None = None,
+        executor: Callable[[], ThreadPoolExecutor] | None = None,
     ) -> None:
         self._consumer = consumer
         self._offsets = dict(offsets)
@@ -66,6 +76,7 @@ class CommitToken:
         self._seq = sequencer.issue()
         self._barrier = barrier
         self._on_commit = on_commit
+        self._executor = executor
         self._committed = False
 
     @property
@@ -125,3 +136,23 @@ class CommitToken:
         if self._on_commit is not None:
             self._on_commit(time.perf_counter() - t0, True)
         return True
+
+    def commit_async(self, wait_for: Any = None) -> "Future[bool]":
+        """Pipelined ``commit``: same barrier-then-commit, on the stream's
+        single commit thread, so the training loop never stalls on the
+        step-retirement wait (which can be ~100 ms of pure latency on
+        remote/tunneled device transports). FIFO thread ⇒ commit order is
+        preserved; semantics are unchanged — offsets still only commit
+        after THIS batch's step provably retired. The returned Future
+        resolves to commit()'s bool (or raises BarrierError); the stream's
+        ``close()`` drains pending commits.
+        """
+        if self._executor is None:
+            # Standalone token (no stream): degrade to a synchronous commit.
+            fut: Future[bool] = Future()
+            try:
+                fut.set_result(self.commit(wait_for))
+            except BaseException as e:  # noqa: BLE001 - delivered via future
+                fut.set_exception(e)
+            return fut
+        return self._executor().submit(self.commit, wait_for)

@@ -38,15 +38,19 @@ def _result(name: str, rows: int, elapsed: float, stream, extra: dict | None = N
 
 
 def _drain(stream, step: Callable[[Any], Any] | None, total: int) -> tuple[int, float]:
-    """Run the transactional loop until ``total`` rows are consumed."""
+    """Run the transactional loop (pipelined commits) until ``total`` rows
+    are consumed; the last commit is awaited inside the timed region."""
     rows = 0
+    fut = None
     t0 = time.perf_counter()
     for batch, token in stream:
         wait = step(batch) if step is not None else None
-        token.commit(wait_for=wait)
+        fut = token.commit_async(wait_for=wait)
         rows += batch.valid_count
         if rows >= total:
             break
+    if fut is not None:
+        fut.result(timeout=600)
     return rows, time.perf_counter() - t0
 
 

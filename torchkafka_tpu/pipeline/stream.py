@@ -124,6 +124,15 @@ class KafkaStream:
         )
         self._started = False
         self._exhausted = False
+        self._commit_pool: ThreadPoolExecutor | None = None
+
+    def _commit_executor(self) -> ThreadPoolExecutor:
+        """Single FIFO thread for token.commit_async (order-preserving)."""
+        if self._commit_pool is None:
+            self._commit_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="tk-commit"
+            )
+        return self._commit_pool
 
     # ------------------------------------------------------------ producer
 
@@ -285,6 +294,7 @@ class KafkaStream:
             self._sequencer,
             barrier=self._barrier,
             on_commit=self._record_commit,
+            executor=self._commit_executor,
         )
         return batch, token
 
@@ -297,13 +307,17 @@ class KafkaStream:
     # ----------------------------------------------------------- lifecycle
 
     def close(self) -> None:
-        """Stop the stream. Never commits — in-flight batches re-deliver
-        (the reference's close contract, /root/reference/src/kafka_dataset.py:89)."""
+        """Stop the stream. Never commits on its own — in-flight batches
+        re-deliver (the reference's close contract,
+        /root/reference/src/kafka_dataset.py:89) — but commits the USER
+        already requested via commit_async are drained, not dropped."""
         self._stop.set()
         if self._started:
             self._thread.join(timeout=5.0)
         if self._pool is not None:
             self._pool.shutdown(wait=False, cancel_futures=True)
+        if self._commit_pool is not None:
+            self._commit_pool.shutdown(wait=True)
         if self._owns_consumer:
             self._consumer.close()
 
