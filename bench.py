@@ -163,7 +163,7 @@ def bench_reference_pattern(n_records: int) -> float:
 
 
 def main() -> None:
-    trials = int(os.environ.get("BENCH_TRIALS", "3"))
+    trials = int(os.environ.get("BENCH_TRIALS", "5"))
     # Best-of-k: ingest is a sustained-throughput metric; transient scheduler
     # noise (this box shares cores with the TPU tunnel) only ever subtracts.
     ours = max(bench_ours(N_OURS) for _ in range(trials))
