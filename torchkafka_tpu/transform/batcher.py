@@ -75,7 +75,13 @@ class Batcher:
         self._treedef = None
         self._buffers: list[np.ndarray] | None = None
         self._fill = 0
-        self._records: list[Record] = []
+        # Row identity, columnar: which (partition, offset) occupies each
+        # buffered row — the ledger accounting needs nothing more, and arrays
+        # keep the per-row cost at memcpy level (no Record objects held).
+        self._tp_table: list[TopicPartition] = []
+        self._tp_ids: dict[TopicPartition, int] = {}
+        self._row_tp = np.empty(batch_size, np.int32)
+        self._row_off = np.empty(batch_size, np.int64)
 
     def _init_buffers(self, element: Any) -> None:
         leaves, treedef = _tree.tree_flatten(element)
@@ -111,33 +117,57 @@ class Batcher:
                     f"must emit fixed shapes (pad/truncate per record)"
                 )
             buf[self._fill] = arr
-        self._records.append(record)
+        self._row_tp[self._fill] = self._tp_id(record.tp)
+        self._row_off[self._fill] = record.offset
         self._fill += 1
         if self._fill == self.batch_size:
             return self._emit()
         return None
 
+    def _tp_id(self, tp: TopicPartition) -> int:
+        i = self._tp_ids.get(tp)
+        if i is None:
+            i = self._tp_ids[tp] = len(self._tp_table)
+            self._tp_table.append(tp)
+        return i
+
     def add_many(
         self,
         stacked: Any,
-        records: list[Record],
+        records: "list[Record] | ChunkIndex",
         keep: np.ndarray | None = None,
     ) -> list[Batch]:
-        """Bulk add: the chunk-processor path. ``keep`` is an optional boolean
-        [len(records)] mask; False rows are drops, and ``stacked`` holds only
-        the kept rows (sum(keep) of them) in record order. With no mask,
-        ``stacked`` covers every record. Copies land as array slices, not
-        per-record memcpys. Returns every full Batch completed by this chunk
-        (possibly several).
+        """Bulk add: the chunk-processor path. ``records`` identifies the
+        chunk's rows — a list[Record] or (hot path) a ChunkIndex, which
+        carries the same identity as arrays with no per-row objects.
+        ``keep`` is an optional boolean [len(records)] mask; False rows are
+        drops, and ``stacked`` holds only the kept rows (sum(keep) of them)
+        in record order. With no mask, ``stacked`` covers every record.
+        Copies land as array slices, not per-record memcpys. Returns every
+        full Batch completed by this chunk (possibly several).
         """
+        index = (
+            records
+            if isinstance(records, ChunkIndex)
+            else ChunkIndex.from_records(records)
+        )
+        # Remap the chunk's partition-id space into the batcher's.
+        remap = np.fromiter(
+            (self._tp_id(tp) for tp in index.tps), np.int32, len(index.tps)
+        )
+        tp_idx = remap[index.tp_idx] if len(index.tps) else index.tp_idx
+        offsets = index.offsets
         if keep is not None:
-            kept_records = [r for r, k in zip(records, keep) if k]
-            dropped = [r for r, k in zip(records, keep) if not k]
-            if dropped:
-                self.ledger.done_many(dropped)
-            if not kept_records:
+            keep = np.asarray(keep, bool)
+            if keep.shape[0] != offsets.shape[0]:
+                raise ValueError(
+                    f"keep mask has {keep.shape[0]} rows, chunk has {offsets.shape[0]}"
+                )
+            self._retire(tp_idx[~keep], offsets[~keep])  # drops resolve now
+            tp_idx = tp_idx[keep]
+            offsets = offsets[keep]
+            if offsets.shape[0] == 0:
                 return []
-            records = kept_records
         leaves, treedef = _tree.tree_flatten(stacked)
         leaves = [np.asarray(leaf) for leaf in leaves]
         if self._buffers is None:
@@ -149,8 +179,8 @@ class Batcher:
         if len(leaves) != len(self._buffers):
             raise ValueError("element structure changed between chunks")
         n = leaves[0].shape[0]
-        if n != len(records):
-            raise ValueError(f"chunk has {n} rows but {len(records)} records")
+        if n != offsets.shape[0]:
+            raise ValueError(f"chunk has {n} rows but {offsets.shape[0]} records")
         out: list[Batch] = []
         i = 0
         while i < n:
@@ -162,12 +192,21 @@ class Batcher:
                         f"not match batch buffer {buf.shape[1:]}/{buf.dtype}"
                     )
                 buf[self._fill : self._fill + take] = leaf[i : i + take]
-            self._records.extend(records[i : i + take])
+            self._row_tp[self._fill : self._fill + take] = tp_idx[i : i + take]
+            self._row_off[self._fill : self._fill + take] = offsets[i : i + take]
             self._fill += take
             i += take
             if self._fill == self.batch_size:
                 out.append(self._emit())
         return out
+
+    def _retire(self, tp_idx: np.ndarray, offsets: np.ndarray) -> None:
+        """Mark rows done in the ledger, grouped per partition (each group's
+        offsets stay ascending, so the ledger's O(1) run path applies)."""
+        if offsets.shape[0] == 0:
+            return
+        for i in np.unique(tp_idx):
+            self.ledger.done_array(self._tp_table[int(i)], offsets[tp_idx == i])
 
     def flush(self) -> Batch | None:
         """Emit the partial tail (pad policy) or nothing (block policy —
