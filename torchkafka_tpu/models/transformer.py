@@ -160,7 +160,7 @@ def shardings_for_mesh(mesh: Mesh, specs: Any) -> Any:
 
 def init_params(rng: jax.Array, cfg: TransformerConfig) -> dict:
     """Scaled-normal init, stacked [L, ...] per layer tensor."""
-    keys = jax.random.split(rng, 8)
+    keys = jax.random.split(rng, 10)
     dm, dff, nl = cfg.d_model, cfg.d_ff, cfg.n_layers
     h, k, dh, v = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.vocab_size
     pd = cfg.param_dtype
@@ -171,7 +171,7 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> dict:
     if cfg.is_moe:
         ne = cfg.n_experts
         mlp = {
-            "router": norm(keys[5], (nl, dm, ne), dm),
+            "router": norm(keys[8], (nl, dm, ne), dm),
             "w_gate": norm(keys[5], (nl, ne, dm, dff), dm),
             "w_up": norm(keys[6], (nl, ne, dm, dff), dm),
             "w_down": norm(keys[7], (nl, ne, dff, dm), dff),
@@ -194,7 +194,7 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> dict:
             **mlp,
         },
         "ln_f": jnp.ones((dm,), pd),
-        "lm_head": norm(keys[0], (dm, v), dm),
+        "lm_head": norm(keys[9], (dm, v), dm),
     }
 
 

@@ -134,12 +134,15 @@ PyObject* json_tokens(PyObject*, PyObject* args) {
     PyBuffer_Release(&out);
     PyBuffer_Release(&keep);
   };
-  if (static_cast<Py_ssize_t>(keep.len) != n || n == 0 ||
+  if (n == 0) {
+    release();
+    Py_RETURN_NONE;
+  }
+  if (static_cast<Py_ssize_t>(keep.len) != n ||
       out.len % (n * static_cast<Py_ssize_t>(sizeof(int32_t))) != 0) {
-    if (n == 0) {
-      release();
-      Py_RETURN_NONE;
-    }
+    release();
+    PyErr_SetString(PyExc_ValueError, "out/keep buffer shape mismatch");
+    return nullptr;
   }
   Py_ssize_t seq_len = out.len / n / static_cast<Py_ssize_t>(sizeof(int32_t));
   auto* tokens = static_cast<int32_t*>(out.buf);

@@ -28,6 +28,7 @@ disappears.
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 from concurrent.futures import ThreadPoolExecutor
@@ -43,6 +44,8 @@ from torchkafka_tpu.source.consumer import Consumer
 from torchkafka_tpu.transform.batcher import Batch, Batcher
 from torchkafka_tpu.transform.processor import Processor
 from torchkafka_tpu.utils.metrics import StreamMetrics
+
+_logger = logging.getLogger(__name__)
 
 _END = object()
 
@@ -176,6 +179,12 @@ class KafkaStream:
             if keep is not None:
                 self.metrics.dropped.add(int(len(keep) - keep.sum()))
             if stacked is None:
+                # Whole chunk dropped: resolve its offsets now, else they
+                # stay pending forever and freeze the partition's commit
+                # watermark (every later commit would exclude them).
+                if keep is None:
+                    self.metrics.dropped.add(len(records))
+                self._ledger.done_many(records)
                 return []
             return self._batcher.add_many(stacked, records, keep)
         if self._pool is not None:
@@ -314,6 +323,12 @@ class KafkaStream:
         self._stop.set()
         if self._started:
             self._thread.join(timeout=5.0)
+            if self._thread.is_alive():
+                _logger.warning(
+                    "KafkaStream producer thread still alive after 5s join; "
+                    "a wedged consumer poll is leaking a daemon thread that "
+                    "holds the consumer"
+                )
         if self._pool is not None:
             self._pool.shutdown(wait=False, cancel_futures=True)
         if self._commit_pool is not None:

@@ -26,7 +26,7 @@ from typing import Any, Iterator
 import numpy as np
 
 from torchkafka_tpu.commit.ledger import OffsetLedger
-from torchkafka_tpu.source.records import Record, TopicPartition
+from torchkafka_tpu.source.records import ChunkIndex, Record, TopicPartition
 
 try:
     from jax import tree_util as _tree
@@ -217,7 +217,9 @@ class Batcher:
 
     def _emit(self) -> Batch:
         assert self._buffers is not None
-        self.ledger.done_many(self._records)
+        # Retire the buffered rows from the columnar identity arrays *before*
+        # snapshotting, so the snapshot's watermark covers exactly this batch.
+        self._retire(self._row_tp[: self._fill], self._row_off[: self._fill])
         batch = Batch(
             data=_tree.tree_unflatten(self._treedef, self._buffers),
             valid_count=self._fill,
@@ -227,7 +229,6 @@ class Batcher:
         leaves = _tree.tree_leaves(batch.data)
         self._buffers = [np.zeros_like(leaf) for leaf in leaves]
         self._fill = 0
-        self._records = []
         return batch
 
     @property
