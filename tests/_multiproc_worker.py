@@ -1,0 +1,157 @@
+"""Worker process for the real multi-process pod tests (test_pod.py).
+
+Runs as `python _multiproc_worker.py <pid> <nproc> <port> <outdir> <mode>`:
+one JAX process of an N-process CPU "pod" (2 local devices each), wired via
+jax.distributed to a localhost coordinator. Exercises the full TPU-native
+ingest loop the framework exists for — stream -> global batch assembly
+(make_array_from_process_local_data) -> pjit step -> CommitBarrier with
+sync_global_devices ACTUALLY firing (jax.process_count() > 1) -> commit —
+the cross-process commit coordination the reference does with POSIX signals
+(/root/reference/src/auto_commit.py:59-72).
+
+Modes:
+  happy — all processes stream 4 batches, commit each, write results, exit 0.
+  die   — process nproc-1 exits hard before committing batch 3; survivors'
+          barriers must fail CLOSED (nothing committed for batch 3): either
+          the BarrierWatchdog fires (exit 42) or the coordination service
+          notices the dead peer and the barrier raises BarrierError (exit 43).
+
+Each process uses its own InMemoryBroker primed with deterministic records —
+the per-host view of a disjoint partition slice, which is exactly what a real
+pod sees (one consumer per host, disjoint partitions). Committed offsets are
+persisted to <outdir>/committed_<pid>.json after each successful commit, so
+the parent test can replay the Kafka-durable state (broker content is
+deterministic; committed offsets survive the process in real Kafka) and
+assert re-delivery of exactly the uncommitted records.
+"""
+
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+
+PID = int(sys.argv[1])
+NPROC = int(sys.argv[2])
+PORT = sys.argv[3]
+OUTDIR = sys.argv[4]
+MODE = sys.argv[5]
+
+RECORDS_PER_PROCESS = 64
+BATCH = 16  # host-local rows; global batch = BATCH * NPROC
+
+
+def mark(name: str, payload=None) -> None:
+    path = os.path.join(OUTDIR, f"{name}_{PID}.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload if payload is not None else {}, f)
+    os.replace(tmp, path)
+
+
+def build_broker(tk):
+    """Deterministic per-process broker = this host's partition slice."""
+    broker = tk.InMemoryBroker()
+    broker.create_topic("t", partitions=2)
+    for i in range(RECORDS_PER_PROCESS):
+        value = PID.to_bytes(1, "little") + i.to_bytes(4, "little")
+        broker.produce("t", value, partition=i % 2)
+    return broker
+
+
+def main() -> int:
+    jax.distributed.initialize(
+        coordinator_address=f"localhost:{PORT}", num_processes=NPROC, process_id=PID
+    )
+    assert jax.process_count() == NPROC, jax.process_count()
+    assert len(jax.devices()) == 2 * NPROC, jax.devices()
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    import torchkafka_tpu as tk
+    from torchkafka_tpu.errors import BarrierError
+    from torchkafka_tpu.parallel import BarrierWatchdog
+    from torchkafka_tpu.parallel.mesh import make_mesh
+    from torchkafka_tpu.pipeline import KafkaStream
+
+    broker = build_broker(tk)
+    consumer = tk.MemoryConsumer(broker, "t", group_id="g")
+
+    def processor(record):
+        # PID-dependent values: a host that computed over only its LOCAL rows
+        # (i.e. global batch assembly regressed) would produce a sum the
+        # parent's expected-global-total assertion catches.
+        pid = record.value[0]
+        idx = int.from_bytes(record.value[1:5], "little")
+        return np.full((8,), float(pid * 1000 + idx), np.float32)
+
+    mesh = make_mesh({"data": 2 * NPROC})
+
+    @jax.jit
+    def step(x):
+        return jnp.sum(x)  # psum over the data axis: a true cross-host reduce
+
+    if MODE == "die" and PID == 0:
+        barrier = BarrierWatchdog(
+            tk.CommitBarrier(),
+            timeout_s=20.0,
+            on_timeout=lambda: mark("watchdog_fired", {"batch": "3"}),
+            exit_on_timeout=True,
+            exit_code=42,
+        )
+    else:
+        barrier = tk.CommitBarrier()
+
+    stream = KafkaStream(
+        consumer,
+        processor,
+        BATCH,
+        mesh=mesh,
+        idle_timeout_ms=2000,
+        barrier=barrier,
+    )
+
+    committed: list[dict] = []
+    losses: list[float] = []
+    n = 0
+    try:
+        for batch, token in stream:
+            n += 1
+            loss = step(batch.data)
+            if MODE == "die" and n == 3:
+                if PID == NPROC - 1:
+                    # Hard death mid-step, before the commit barrier: the
+                    # survivors must NOT commit batch 3.
+                    mark("died_before_commit", {"batch": n})
+                    os._exit(1)
+                mark("attempting", {"batch": n})
+            try:
+                ok = token.commit(wait_for=loss)
+            except BarrierError as e:
+                # Fail-closed path: peer death detected by the coordination
+                # service before the watchdog fired. Nothing was committed.
+                mark("barrier_error", {"batch": n, "error": str(e)})
+                os._exit(43)
+            assert ok, f"commit {n} failed"
+            losses.append(float(jax.device_get(loss)))
+            committed.append([[k.topic, k.partition, v] for k, v in token.offsets.items()])
+            mark("committed", {"batches": committed, "losses": losses})
+            if n == 4:
+                break
+    finally:
+        stream.close()
+        consumer.close()
+
+    # Global batch of BATCH*NPROC rows of 8 identical floats; the jit'd sum
+    # must agree bit-for-bit on every process (same global computation).
+    mark("done", {"batches": n, "losses": losses})
+    jax.distributed.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

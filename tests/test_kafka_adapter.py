@@ -1,0 +1,258 @@
+"""Contract tests for the kafka-python adapter (source/kafka.py), brokerless.
+
+kafka-python is not installed in this environment, so these tests install a
+STUB ``kafka`` module (a fake KafkaConsumer that records every call) into
+sys.modules and reload the adapter against it. What the reference validated
+only against a live broker (/root/reference/README.md:9) is pinned here as an
+executable contract:
+
+- auto-commit is forced off no matter what the caller passes
+  (/root/reference/src/kafka_dataset.py:201);
+- offset-map -> {kafka.TopicPartition: OffsetAndMetadata} translation, for
+  both the 2-arg (kafka-python 2.0.2) and 3-arg (leader_epoch) constructor
+  shapes (source/kafka.py:_offset_and_metadata);
+- manual-assign vs group-subscribe construction modes;
+- close() always passes autocommit=False and is idempotent
+  (/root/reference/src/kafka_dataset.py:89);
+- kafka.errors.CommitFailedError is re-raised as the framework's
+  transport-independent CommitFailedError
+  (/root/reference/src/kafka_dataset.py:131-135);
+- poll() flattens the per-partition dict into offset-ordered Records;
+- iterator-mode commit(None) covers exactly the records yielded to the
+  user, not the whole fetched buffer.
+"""
+
+import collections
+import importlib
+import sys
+import types
+
+import pytest
+
+from torchkafka_tpu import errors
+from torchkafka_tpu.source.records import TopicPartition
+
+FakeTopicPartition = collections.namedtuple("TopicPartition", ["topic", "partition"])
+OffsetAndMetadata3 = collections.namedtuple(
+    "OffsetAndMetadata", ["offset", "metadata", "leader_epoch"]
+)
+OffsetAndMetadata2 = collections.namedtuple("OffsetAndMetadata", ["offset", "metadata"])
+
+FakeRecord = collections.namedtuple(
+    "ConsumerRecord",
+    ["topic", "partition", "offset", "value", "key", "timestamp", "headers"],
+)
+
+
+def fake_record(topic, partition, offset, value=b"v"):
+    return FakeRecord(topic, partition, offset, value, None, 1234, [])
+
+
+class FakeCommitFailedError(Exception):
+    pass
+
+
+class FakeKafkaConsumer:
+    """Records every call the adapter makes; scripted poll results."""
+
+    def __init__(self, *topics, **kwargs):
+        self.init_topics = topics
+        self.init_kwargs = kwargs
+        self.assign_calls: list = []
+        self.commit_calls: list = []
+        self.seek_calls: list = []
+        self.close_calls: list = []
+        self.poll_queue: list = []
+        self.fail_next_commit = False
+        self._committed = {}
+        self._positions = {}
+
+    def assign(self, tps):
+        self.assign_calls.append(list(tps))
+
+    def poll(self, timeout_ms=0, max_records=None):
+        return self.poll_queue.pop(0) if self.poll_queue else {}
+
+    def commit(self, offsets=None):
+        if self.fail_next_commit:
+            self.fail_next_commit = False
+            raise FakeCommitFailedError("group rebalanced")
+        self.commit_calls.append(offsets)
+
+    def committed(self, tp):
+        return self._committed.get(tp)
+
+    def position(self, tp):
+        return self._positions.get(tp, 0)
+
+    def seek(self, tp, offset):
+        self.seek_calls.append((tp, offset))
+
+    def assignment(self):
+        return set(self.assign_calls[-1]) if self.assign_calls else set()
+
+    def close(self, autocommit=True):
+        self.close_calls.append(autocommit)
+
+
+def _install_stub(oam_cls):
+    kafka_mod = types.ModuleType("kafka")
+    kafka_mod.KafkaConsumer = FakeKafkaConsumer
+    kafka_mod.TopicPartition = FakeTopicPartition
+    kafka_mod.OffsetAndMetadata = oam_cls
+    errors_mod = types.ModuleType("kafka.errors")
+    errors_mod.CommitFailedError = FakeCommitFailedError
+    kafka_mod.errors = errors_mod
+    sys.modules["kafka"] = kafka_mod
+    sys.modules["kafka.errors"] = errors_mod
+    import torchkafka_tpu.source.kafka as adapter
+
+    return importlib.reload(adapter)
+
+
+def _remove_stub():
+    sys.modules.pop("kafka", None)
+    sys.modules.pop("kafka.errors", None)
+    import torchkafka_tpu.source.kafka as adapter
+
+    importlib.reload(adapter)
+
+
+@pytest.fixture
+def adapter():
+    """Adapter module reloaded against the 3-arg (modern) stub."""
+    mod = _install_stub(OffsetAndMetadata3)
+    yield mod
+    _remove_stub()
+
+
+@pytest.fixture
+def adapter_old_oam():
+    """Adapter module reloaded against the 2-arg (kafka-python 2.0.2) stub."""
+    mod = _install_stub(OffsetAndMetadata2)
+    yield mod
+    _remove_stub()
+
+
+class TestConstruction:
+    def test_auto_commit_forced_off(self, adapter):
+        c = adapter.KafkaConsumer("t", enable_auto_commit=True, group_id="g")
+        assert c._consumer.init_kwargs["enable_auto_commit"] is False
+        assert c._consumer.init_kwargs["group_id"] == "g"
+
+    def test_subscribe_mode_passes_topics_positionally(self, adapter):
+        c = adapter.KafkaConsumer(["a", "b"], bootstrap_servers=["x:9092"])
+        assert c._consumer.init_topics == ("a", "b")
+        assert c._consumer.assign_calls == []
+        assert c._consumer.init_kwargs["bootstrap_servers"] == ["x:9092"]
+
+    def test_manual_assignment_mode(self, adapter):
+        tps = [TopicPartition("t", 0), TopicPartition("t", 2)]
+        c = adapter.KafkaConsumer("t", assignment=tps)
+        assert c._consumer.init_topics == ()  # no subscribe
+        assert c._consumer.assign_calls == [
+            [FakeTopicPartition("t", 0), FakeTopicPartition("t", 2)]
+        ]
+        assert c.assignment() == [TopicPartition("t", 0), TopicPartition("t", 2)] or set(
+            c.assignment()
+        ) == {TopicPartition("t", 0), TopicPartition("t", 2)}
+
+    def test_consumer_timeout_ms_not_forwarded(self, adapter):
+        c = adapter.KafkaConsumer("t", consumer_timeout_ms=500)
+        assert "consumer_timeout_ms" not in c._consumer.init_kwargs
+        assert c._consumer_timeout_ms == 500
+
+
+class TestCommitTranslation:
+    def test_offset_map_to_offset_and_metadata_3arg(self, adapter):
+        c = adapter.KafkaConsumer("t")
+        c.commit({TopicPartition("t", 0): 5, TopicPartition("t", 1): 9})
+        (call,) = c._consumer.commit_calls
+        assert call == {
+            FakeTopicPartition("t", 0): OffsetAndMetadata3(5, None, -1),
+            FakeTopicPartition("t", 1): OffsetAndMetadata3(9, None, -1),
+        }
+
+    def test_offset_map_to_offset_and_metadata_2arg(self, adapter_old_oam):
+        c = adapter_old_oam.KafkaConsumer("t")
+        c.commit({TopicPartition("t", 0): 7})
+        (call,) = c._consumer.commit_calls
+        assert call == {FakeTopicPartition("t", 0): OffsetAndMetadata2(7, None)}
+
+    def test_commit_none_with_nothing_yielded_commits_positions(self, adapter):
+        c = adapter.KafkaConsumer("t")
+        c.commit(None)
+        assert c._consumer.commit_calls == [None]
+
+    def test_commit_failed_error_translated(self, adapter):
+        c = adapter.KafkaConsumer("t")
+        c._consumer.fail_next_commit = True
+        with pytest.raises(errors.CommitFailedError, match="rebalanced"):
+            c.commit({TopicPartition("t", 0): 1})
+        # Survivable by contract: the next commit goes through.
+        c.commit({TopicPartition("t", 0): 1})
+        assert len(c._consumer.commit_calls) == 1
+
+
+class TestPollTranslation:
+    def test_poll_flattens_and_maps_fields(self, adapter):
+        c = adapter.KafkaConsumer("t")
+        c._consumer.poll_queue = [
+            {
+                FakeTopicPartition("t", 0): [fake_record("t", 0, 3, b"a")],
+                FakeTopicPartition("t", 1): [
+                    fake_record("t", 1, 0, b"b"),
+                    fake_record("t", 1, 1, b"c"),
+                ],
+            }
+        ]
+        records = c.poll(max_records=10)
+        assert {(r.topic, r.partition, r.offset, r.value) for r in records} == {
+            ("t", 0, 3, b"a"),
+            ("t", 1, 0, b"b"),
+            ("t", 1, 1, b"c"),
+        }
+        assert all(r.timestamp_ms == 1234 and r.headers == () for r in records)
+
+    def test_committed_position_seek_translate_tp(self, adapter):
+        c = adapter.KafkaConsumer("t")
+        c._consumer._committed[FakeTopicPartition("t", 0)] = 11
+        c._consumer._positions[FakeTopicPartition("t", 0)] = 13
+        assert c.committed(TopicPartition("t", 0)) == 11
+        assert c.position(TopicPartition("t", 0)) == 13
+        c.seek(TopicPartition("t", 0), 4)
+        assert c._consumer.seek_calls == [(FakeTopicPartition("t", 0), 4)]
+
+
+class TestIteratorMode:
+    def test_iter_commit_covers_exactly_yielded(self, adapter):
+        """commit(None) after partial iteration must cover what the USER saw,
+        not kafka-python's position (which advanced past the whole fetch)."""
+        c = adapter.KafkaConsumer("t", consumer_timeout_ms=200)
+        c._consumer.poll_queue = [
+            {
+                FakeTopicPartition("t", 0): [
+                    fake_record("t", 0, 0),
+                    fake_record("t", 0, 1),
+                    fake_record("t", 0, 2),
+                ]
+            }
+        ]
+        it = iter(c)
+        assert next(it).offset == 0
+        assert next(it).offset == 1
+        c.commit(None)  # two records yielded -> next-read offset 2
+        (call,) = c._consumer.commit_calls
+        assert call == {FakeTopicPartition("t", 0): OffsetAndMetadata3(2, None, -1)}
+
+    def test_iter_ends_after_consumer_timeout(self, adapter):
+        c = adapter.KafkaConsumer("t", consumer_timeout_ms=50)
+        assert list(c) == []
+
+
+class TestClose:
+    def test_close_never_autocommits_and_is_idempotent(self, adapter):
+        c = adapter.KafkaConsumer("t")
+        c.close()
+        c.close()
+        assert c._consumer.close_calls == [False]

@@ -1,0 +1,185 @@
+"""Real multi-process pod tests: the cross-process commit coordination path.
+
+These spawn ACTUAL ``jax.distributed`` processes (localhost coordinator, CPU
+backend, 2 local devices each) running tests/_multiproc_worker.py — so
+``jax.process_count() > 1`` is true inside them and
+``CommitBarrier.__call__``'s ``sync_global_devices`` branch
+(torchkafka_tpu/commit/barrier.py) executes for real, not in simulation.
+
+This is the executed test of the framework's centerpiece claim: the TPU-native
+replacement for the reference's signal-based cross-process commit protocol
+(/root/reference/src/auto_commit.py:59-72,
+/root/reference/src/kafka_dataset.py:235-239) — all-hosts-or-nobody,
+fail-closed on member death, re-delivery of everything uncommitted.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+import torchkafka_tpu as tk
+from torchkafka_tpu.source.records import TopicPartition
+
+WORKER = os.path.join(os.path.dirname(__file__), "_multiproc_worker.py")
+RECORDS_PER_PROCESS = 64  # must match _multiproc_worker.py
+BATCH = 16
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_pod(nproc: int, outdir: str, mode: str) -> list[subprocess.Popen]:
+    port = _free_port()
+    env = dict(os.environ)
+    # The workers configure JAX themselves; scrub anything that could force
+    # the tunneled TPU platform into a subprocess.
+    env.pop("JAX_PLATFORMS", None)
+    procs = []
+    for pid in range(nproc):
+        # File-backed output: PIPE + wait() deadlocks once a worker writes
+        # more than the pipe buffer (a long XLA traceback easily does).
+        log = open(os.path.join(outdir, f"worker_{pid}.log"), "wb")
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, WORKER, str(pid), str(nproc), str(port), outdir, mode],
+                env=env,
+                stdout=log,
+                stderr=subprocess.STDOUT,
+            )
+        )
+        log.close()  # the child holds its own fd now
+    return procs
+
+
+def _wait_all(procs: list[subprocess.Popen], outdir: str, timeout_s: float) -> list[int]:
+    deadline = time.monotonic() + timeout_s
+    codes = []
+    try:
+        for p in procs:
+            codes.append(p.wait(timeout=max(1.0, deadline - time.monotonic())))
+    except subprocess.TimeoutExpired:
+        # Reap the WHOLE pod: a survivor blocked in sync_global_devices on a
+        # dead peer never exits on its own and would leak past the test.
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        pytest.fail(f"pod worker wedged (>{timeout_s}s):\n{_diagnose(procs, outdir)}")
+    return codes
+
+
+def _read(outdir: str, name: str, pid: int):
+    path = os.path.join(outdir, f"{name}_{pid}.json")
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def _diagnose(procs: list[subprocess.Popen], outdir: str) -> str:
+    parts = []
+    for i, p in enumerate(procs):
+        log_path = os.path.join(outdir, f"worker_{i}.log")
+        try:
+            with open(log_path, "rb") as f:
+                tail = f.read()[-3000:].decode(errors="replace")
+        except OSError:
+            tail = "<no log>"
+        parts.append(f"--- worker {i} (rc={p.returncode}) ---\n{tail}")
+    return "\n".join(parts)
+
+
+@pytest.mark.slow
+class TestPodCommit:
+    def test_two_process_stream_step_barrier_commit(self, tmp_path):
+        """Happy path: 2 jax.distributed processes, 4 global batches each
+        assembled via make_array_from_process_local_data, a jit'd cross-host
+        reduction, and a sync_global_devices-backed commit per batch."""
+        procs = _spawn_pod(2, str(tmp_path), "happy")
+        codes = _wait_all(procs, str(tmp_path), timeout_s=300)
+        assert codes == [0, 0], _diagnose(procs, str(tmp_path))
+
+        done0 = _read(str(tmp_path), "done", 0)
+        done1 = _read(str(tmp_path), "done", 1)
+        assert done0 and done1
+        assert done0["batches"] == 4 and done1["batches"] == 4
+        # The jit'd sum ran over the GLOBAL array: every process must see the
+        # identical losses (a cross-host psum agreed on), and their total must
+        # be the GLOBAL sum over both hosts' records (rows carry
+        # pid*1000 + idx, so a host summing only its local 16-row shard
+        # produces a number this equation rejects).
+        assert done0["losses"] == done1["losses"]
+        assert len(done0["losses"]) == 4
+        expected_total = 8.0 * sum(
+            pid * 1000 + i for pid in (0, 1) for i in range(RECORDS_PER_PROCESS)
+        )
+        assert sum(done0["losses"]) == expected_total
+
+        # Commits are durable and cover exactly the emitted batches.
+        for pid in (0, 1):
+            committed = _read(str(tmp_path), "committed", pid)["batches"]
+            assert len(committed) == 4
+            final = {TopicPartition(t, p): off for t, p, off in committed[-1]}
+            assert sum(final.values()) == 4 * BATCH  # 64 rows committed
+
+    def test_member_death_fails_closed_and_redelivers(self, tmp_path):
+        """Kill process 1 before it commits batch 3: process 0's barrier must
+        fail CLOSED (watchdog exit 42 or BarrierError exit 43 — in both cases
+        batch 3 is never committed), and replaying the durable Kafka state
+        (deterministic broker + persisted committed offsets) re-delivers
+        exactly the records batches 1-2 did not cover."""
+        procs = _spawn_pod(2, str(tmp_path), "die")
+        codes = _wait_all(procs, str(tmp_path), timeout_s=300)
+        assert codes[1] == 1, _diagnose(procs, str(tmp_path))  # the deliberate hard death
+        assert codes[0] in (42, 43), _diagnose(procs, str(tmp_path))  # fail-closed, not success
+
+        assert _read(str(tmp_path), "died_before_commit", 1) is not None
+        assert _read(str(tmp_path), "attempting", 0) is not None
+        fail_closed = (
+            _read(str(tmp_path), "watchdog_fired", 0) is not None
+            or _read(str(tmp_path), "barrier_error", 0) is not None
+        )
+        assert fail_closed
+
+        # Survivor committed batches 1-2 only — batch 3 must be absent.
+        committed = _read(str(tmp_path), "committed", 0)["batches"]
+        assert len(committed) == 2, committed
+
+        # Restart: rebuild the (deterministic) broker content, seek to the
+        # persisted committed offsets — the durable state real Kafka keeps —
+        # and everything NOT covered by batches 1-2 re-delivers.
+        broker = tk.InMemoryBroker()
+        broker.create_topic("t", partitions=2)
+        for i in range(RECORDS_PER_PROCESS):
+            value = (0).to_bytes(1, "little") + i.to_bytes(4, "little")
+            broker.produce("t", value, partition=i % 2)
+        consumer = tk.MemoryConsumer(broker, "t", group_id="g")
+        offsets = {TopicPartition(t, p): off for t, p, off in committed[-1]}
+        for tp, off in offsets.items():
+            consumer.seek(tp, off)
+        redelivered = []
+        while True:
+            records = consumer.poll(max_records=256, timeout_ms=50)
+            if not records:
+                break
+            redelivered.extend(records)
+        consumer.close()
+        got = sorted(int.from_bytes(r.value[1:5], "little") for r in redelivered)
+        committed_count = sum(offsets.values())
+        assert committed_count == 2 * BATCH
+        assert len(got) == RECORDS_PER_PROCESS - committed_count
+        # No committed record re-delivers; every uncommitted one does.
+        per_part: dict[int, list[int]] = {0: [], 1: []}
+        for r in redelivered:
+            per_part[r.partition].append(r.offset)
+        for tp, off in offsets.items():
+            lo = min(per_part[tp.partition], default=None)
+            assert lo is None or lo == off, (tp, off, lo)

@@ -75,7 +75,9 @@ class CommitBarrier:
                 else:
                     jax.block_until_ready(wait_for)
             self._calls += 1
-            if jax.process_count() > 1:  # pragma: no cover - needs real pod
+            # Executed for real in tests/test_pod.py (spawned jax.distributed
+            # processes) — the cross-process commit coordination path.
+            if jax.process_count() > 1:
                 from jax.experimental import multihost_utils
 
                 multihost_utils.sync_global_devices(f"{self._name}:{self._calls}")

@@ -178,14 +178,10 @@ class KafkaStream:
             stacked, keep = self._processor(records)
             if keep is not None:
                 self.metrics.dropped.add(int(len(keep) - keep.sum()))
-            if stacked is None:
-                # Whole chunk dropped: resolve its offsets now, else they
-                # stay pending forever and freeze the partition's commit
-                # watermark (every later commit would exclude them).
-                if keep is None:
-                    self.metrics.dropped.add(len(records))
-                self._ledger.done_many(records)
-                return []
+            elif stacked is None:
+                self.metrics.dropped.add(len(records))
+            # stacked=None (whole chunk dropped) is handled by the batcher:
+            # it retires every offset so the commit watermark can't freeze.
             return self._batcher.add_many(stacked, records, keep)
         if self._pool is not None:
             # Lazy: results stream out in order as workers finish, so a

@@ -143,6 +143,9 @@ class Batcher:
         ``keep`` is an optional boolean [len(records)] mask; False rows are
         drops, and ``stacked`` holds only the kept rows (sum(keep) of them)
         in record order. With no mask, ``stacked`` covers every record.
+        ``stacked=None`` means the whole chunk was dropped: every offset is
+        retired immediately (a pending-forever chunk would freeze the
+        partition's commit watermark).
         Copies land as array slices, not per-record memcpys. Returns every
         full Batch completed by this chunk (possibly several).
         """
@@ -157,6 +160,12 @@ class Batcher:
         )
         tp_idx = remap[index.tp_idx] if len(index.tps) else index.tp_idx
         offsets = index.offsets
+        if stacked is None:
+            # Whole chunk dropped: every offset resolves as a drop NOW, else
+            # the records stay pending forever and freeze the partition's
+            # commit watermark.
+            self._retire(tp_idx, offsets)
+            return []
         if keep is not None:
             keep = np.asarray(keep, bool)
             if keep.shape[0] != offsets.shape[0]:
