@@ -23,51 +23,49 @@ persisted to <outdir>/committed_<pid>.json after each successful commit, so
 the parent test can replay the Kafka-durable state (broker content is
 deterministic; committed offsets survive the process in real Kafka) and
 assert re-delivery of exactly the uncommitted records.
+
+Importable from test_pod.py: all argv parsing and jax.config mutation happen
+under the __main__ guard, so the parent test can reuse the constants,
+``encode_value`` and ``build_broker`` instead of duplicating them.
 """
 
 import json
 import os
 import sys
 
-import jax
-
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
-
-PID = int(sys.argv[1])
-NPROC = int(sys.argv[2])
-PORT = sys.argv[3]
-OUTDIR = sys.argv[4]
-MODE = sys.argv[5]
-
 RECORDS_PER_PROCESS = 64
 BATCH = 16  # host-local rows; global batch = BATCH * NPROC
 
 
-def mark(name: str, payload=None) -> None:
-    path = os.path.join(OUTDIR, f"{name}_{PID}.json")
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(payload if payload is not None else {}, f)
-    os.replace(tmp, path)
+def encode_value(pid: int, idx: int) -> bytes:
+    """The record payload: 1 byte of producer pid + 4 bytes of index."""
+    return pid.to_bytes(1, "little") + idx.to_bytes(4, "little")
 
 
-def build_broker(tk):
+def build_broker(tk, pid: int):
     """Deterministic per-process broker = this host's partition slice."""
     broker = tk.InMemoryBroker()
     broker.create_topic("t", partitions=2)
     for i in range(RECORDS_PER_PROCESS):
-        value = PID.to_bytes(1, "little") + i.to_bytes(4, "little")
-        broker.produce("t", value, partition=i % 2)
+        broker.produce("t", encode_value(pid, i), partition=i % 2)
     return broker
 
 
-def main() -> int:
+def main(pid: int, nproc: int, port: str, outdir: str, mode: str) -> int:
+    import jax
+
+    def mark(name: str, payload=None) -> None:
+        path = os.path.join(outdir, f"{name}_{pid}.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload if payload is not None else {}, f)
+        os.replace(tmp, path)
+
     jax.distributed.initialize(
-        coordinator_address=f"localhost:{PORT}", num_processes=NPROC, process_id=PID
+        coordinator_address=f"localhost:{port}", num_processes=nproc, process_id=pid
     )
-    assert jax.process_count() == NPROC, jax.process_count()
-    assert len(jax.devices()) == 2 * NPROC, jax.devices()
+    assert jax.process_count() == nproc, jax.process_count()
+    assert len(jax.devices()) == 2 * nproc, jax.devices()
 
     import jax.numpy as jnp
     import numpy as np
@@ -78,24 +76,24 @@ def main() -> int:
     from torchkafka_tpu.parallel.mesh import make_mesh
     from torchkafka_tpu.pipeline import KafkaStream
 
-    broker = build_broker(tk)
+    broker = build_broker(tk, pid)
     consumer = tk.MemoryConsumer(broker, "t", group_id="g")
 
     def processor(record):
         # PID-dependent values: a host that computed over only its LOCAL rows
         # (i.e. global batch assembly regressed) would produce a sum the
         # parent's expected-global-total assertion catches.
-        pid = record.value[0]
+        rpid = record.value[0]
         idx = int.from_bytes(record.value[1:5], "little")
-        return np.full((8,), float(pid * 1000 + idx), np.float32)
+        return np.full((8,), float(rpid * 1000 + idx), np.float32)
 
-    mesh = make_mesh({"data": 2 * NPROC})
+    mesh = make_mesh({"data": 2 * nproc})
 
     @jax.jit
     def step(x):
         return jnp.sum(x)  # psum over the data axis: a true cross-host reduce
 
-    if MODE == "die" and PID == 0:
+    if mode == "die" and pid == 0:
         barrier = BarrierWatchdog(
             tk.CommitBarrier(),
             timeout_s=20.0,
@@ -122,8 +120,8 @@ def main() -> int:
         for batch, token in stream:
             n += 1
             loss = step(batch.data)
-            if MODE == "die" and n == 3:
-                if PID == NPROC - 1:
+            if mode == "die" and n == 3:
+                if pid == nproc - 1:
                     # Hard death mid-step, before the commit barrier: the
                     # survivors must NOT commit batch 3.
                     mark("died_before_commit", {"batch": n})
@@ -154,4 +152,8 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+    sys.exit(main(int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4], sys.argv[5]))

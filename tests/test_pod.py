@@ -25,9 +25,9 @@ import pytest
 import torchkafka_tpu as tk
 from torchkafka_tpu.source.records import TopicPartition
 
+from tests._multiproc_worker import BATCH, RECORDS_PER_PROCESS, build_broker
+
 WORKER = os.path.join(os.path.dirname(__file__), "_multiproc_worker.py")
-RECORDS_PER_PROCESS = 64  # must match _multiproc_worker.py
-BATCH = 16
 
 
 def _free_port() -> int:
@@ -42,6 +42,10 @@ def _spawn_pod(nproc: int, outdir: str, mode: str) -> list[subprocess.Popen]:
     # The workers configure JAX themselves; scrub anything that could force
     # the tunneled TPU platform into a subprocess.
     env.pop("JAX_PLATFORMS", None)
+    # sys.path[0] in the child is tests/ (the script dir), not the repo root —
+    # the package is importable only if the root is on PYTHONPATH.
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
     procs = []
     for pid in range(nproc):
         # File-backed output: PIPE + wait() deadlocks once a worker writes
@@ -156,11 +160,7 @@ class TestPodCommit:
         # Restart: rebuild the (deterministic) broker content, seek to the
         # persisted committed offsets — the durable state real Kafka keeps —
         # and everything NOT covered by batches 1-2 re-delivers.
-        broker = tk.InMemoryBroker()
-        broker.create_topic("t", partitions=2)
-        for i in range(RECORDS_PER_PROCESS):
-            value = (0).to_bytes(1, "little") + i.to_bytes(4, "little")
-            broker.produce("t", value, partition=i % 2)
+        broker = build_broker(tk, pid=0)
         consumer = tk.MemoryConsumer(broker, "t", group_id="g")
         offsets = {TopicPartition(t, p): off for t, p, off in committed[-1]}
         for tp, off in offsets.items():

@@ -94,7 +94,7 @@ class _Partition:
             if offset < self.frontier:
                 self.ooo.add(offset)
         # offset < low: already done (re-delivered duplicate) — tolerated,
-        # see the at-least-once note in done_many.
+        # see the at-least-once note in OffsetLedger._done.
 
     @property
     def committable(self) -> int:
@@ -147,15 +147,6 @@ class OffsetLedger:
             part = self._parts.get(record.tp)
             if part is not None:
                 part.done(record.offset)
-
-    def done_many(self, records: list[Record]) -> None:
-        """Bulk ``emitted``/``dropped`` (the same ledger transition)."""
-        with self._lock:
-            parts = self._parts
-            for record in records:
-                part = parts.get(record.tp)
-                if part is not None:
-                    part.done(record.offset)
 
     # ------------------------------------------------------- vectorized path
 
