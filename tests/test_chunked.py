@@ -7,6 +7,7 @@ offsets under carry-over.
 """
 
 import numpy as np
+import pytest
 
 import torchkafka_tpu as tk
 from torchkafka_tpu.commit.ledger import OffsetLedger
@@ -30,6 +31,21 @@ class TestFixedWidth:
         assert keep is None
         assert stacked.shape == (10, 4)
         np.testing.assert_array_equal(stacked[3], [3, 3, 3, 3])
+
+    def test_wire_dtype_narrows(self):
+        """wire_dtype casts decoded rows before they leave the host (half the
+        host→device bytes for token ids < 65536)."""
+        proc = fixed_width(4, dtype=np.int32, wire_dtype=np.uint16)
+        stacked, keep = proc(_records(10))
+        assert stacked.dtype == np.uint16
+        assert keep is None
+        np.testing.assert_array_equal(stacked[3], [3, 3, 3, 3])
+
+    def test_wire_dtype_overflow_rejected(self):
+        proc = fixed_width(1, dtype=np.int32, wire_dtype=np.uint16)
+        rec = [Record("t", 0, 0, np.array([70_000], np.int32).tobytes())]
+        with pytest.raises(ValueError, match="uint16"):
+            proc(rec)
 
     def test_ragged_pads_and_truncates(self):
         proc = fixed_width(4, dtype=np.int32, pad_value=-1)

@@ -35,6 +35,22 @@ class TestFlash:
         ref = mha(q, k, v, causal=True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
+    def test_auto_block_covers_non_512_multiples(self, rng):
+        """Default (None) blocks pick the largest of (512, 256, 128) dividing
+        S, so S=384 still runs the flash path (128 blocks) instead of
+        silently going dense."""
+        from torchkafka_tpu.ops.flash import _auto_block
+
+        assert _auto_block(2048) == 512
+        assert _auto_block(768) == 256
+        assert _auto_block(384) == 128
+        assert _auto_block(100) == 0
+        q, k, v = _qkv(rng, s=384)
+        out = flash_attention(q, k, v, True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(mha(q, k, v, causal=True)), atol=2e-5
+        )
+
     def test_untileable_seq_falls_back(self, rng):
         q, k, v = _qkv(rng, s=100)  # 100 % 128 != 0 after clamping
         out = flash_attention(q, k, v, True)
@@ -55,3 +71,76 @@ class TestFlash:
         assert bool(jnp.isfinite(out).all())
         ref = mha(q * 30, k * 30, v, causal=True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+
+
+class TestFlashBackward:
+    """The Pallas flash backward (dq/dk/dv kernels) against dense-mha grads.
+
+    These run the REAL backward kernels (interpret mode on CPU): the residuals
+    are (q, k, v, o, lse), never an [S, S] tensor — the O(S·D) training-memory
+    claim in PERF.md rests on these kernels being the grad path."""
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_all_grads_match_dense(self, rng, causal):
+        q, k, v = _qkv(rng, s=256)
+
+        def loss_flash(q, k, v):
+            return (flash_attention(q, k, v, causal) ** 2).sum()
+
+        def loss_dense(q, k, v):
+            return (mha(q, k, v, causal=causal) ** 2).sum()
+
+        g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(g1, g2, "q k v".split()):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=5e-5, err_msg=f"d{name}"
+            )
+
+    def test_multiblock_grads(self, rng):
+        """64-row blocks over S=256: per-tile recompute from lse must agree
+        across block boundaries, including skipped above-diagonal tiles."""
+        q, k, v = _qkv(rng, s=256)
+        g1 = jax.grad(
+            lambda q, k, v: flash_attention(q, k, v, True, 64, 64).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        g2 = jax.grad(
+            lambda q, k, v: mha(q, k, v, causal=True).sum(), argnums=(0, 1, 2)
+        )(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
+
+    def test_bf16_grads(self, rng):
+        q, k, v = _qkv(rng, s=128, dtype=jnp.bfloat16)
+        g1 = jax.grad(lambda q: flash_attention(q, k, v, True).astype(jnp.float32).sum())(q)
+        g2 = jax.grad(lambda q: mha(q, k, v, causal=True).astype(jnp.float32).sum())(q)
+        np.testing.assert_allclose(
+            np.asarray(g1, np.float32), np.asarray(g2, np.float32), atol=0.15
+        )
+
+    def test_untileable_grads_fall_back(self, rng):
+        q, k, v = _qkv(rng, s=100)
+        g1 = jax.grad(lambda v: flash_attention(q, k, v, True).sum())(v)
+        g2 = jax.grad(lambda v: mha(q, k, v, causal=True).sum())(v)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=2e-5)
+
+    def test_no_quadratic_residual(self, rng):
+        """The saved residuals through jax.linearize stay O(S·D): no tensor
+        with an [S, S] trailing face may appear among them."""
+        q, k, v = _qkv(rng, s=256)
+        _, vjp = jax.vjp(lambda q, k, v: flash_attention(q, k, v, True), q, k, v)
+        s = q.shape[1]
+        leaves = jax.tree_util.tree_leaves(vjp)
+        for leaf in leaves:
+            if hasattr(leaf, "shape") and len(leaf.shape) >= 2:
+                assert not (
+                    leaf.shape[-1] == s and leaf.shape[-2] == s
+                ), f"O(S²) residual {leaf.shape}"
+
+    def test_grad_through_jit(self, rng):
+        q, k, v = _qkv(rng, s=128)
+        f = jax.jit(jax.grad(lambda q: flash_attention(q, k, v, True).sum()))
+        g1 = f(q)
+        g2 = jax.grad(lambda q: mha(q, k, v, causal=True).sum())(q)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=2e-5)

@@ -1,22 +1,36 @@
-"""Flash attention: a Pallas TPU kernel for the ingest consumers' hot op.
+"""Flash attention: Pallas TPU kernels for the ingest consumers' hot op.
 
 Net-new vs the reference (no tensor ops in its tree, SURVEY.md §2). The XLA
 ``mha`` in attention.py materialises the [B,H,Sq,Sk] score tensor in HBM;
-this kernel never does — scores live in VMEM one (block_q × block_k) tile at
+these kernels never do — scores live in VMEM one (block_q × block_k) tile at
 a time, combined with the online-softmax recurrence (running max m, running
-normaliser l), so attention memory is O(S·D) instead of O(S²) and the two
+normaliser l), so attention memory is O(S·D) instead of O(S²) and the
 matmuls stay hot in the MXU.
 
 Layout: [B, S, H, D] api (matching ``mha``), computed as [B·H, S, D] with a
-(batch·head, q-block, k-block) grid; the k-block axis is innermost, i.e.
-sequential on TPU, and the f32 accumulators persist in VMEM scratch across
-its iterations. Causal blocks strictly above the diagonal are skipped via
-``pl.when`` (half the FLOPs of the naive mask for long sequences).
+(batch·head, q-block, k-block) grid; the innermost grid axis is sequential on
+TPU, and the f32 accumulators persist in VMEM scratch across its iterations.
+Causal blocks strictly above the diagonal are skipped via ``pl.when`` (half
+the FLOPs of the naive mask for long sequences).
 
-Training: ``flash_attention`` carries a custom VJP whose backward recomputes
-attention with the XLA path — forward-pass memory wins (serving, prefill,
-frozen towers) are kept; long-context *training* should use ring attention
-(attention.py), whose scan is natively differentiable shard-by-shard.
+Training: the custom VJP is a real flash backward (the FlashAttention-2
+formulation). The forward saves only (q, k, v, o, lse) — lse is the per-row
+log-sum-exp ``m + log l`` emitted by the forward kernel — and the backward
+runs two Pallas kernels that recompute probabilities per tile from lse:
+
+  delta = rowsum(dO ∘ O)                       (XLA, O(S·D))
+  P  = exp(S·scale − lse)                      (per VMEM tile, never in HBM)
+  dV = Pᵀ dO      dS = P ∘ (dP − delta)·scale
+  dQ = dS K       dK = dSᵀ Q
+
+so ``jax.grad`` through ``flash_attention`` allocates O(S·D), never O(S²).
+
+Per-row vectors (lse, delta) are carried as [BH, S, 1] arrays with
+(1, block_q, 1) blocks: Mosaic accepts a minor block dim equal to the array
+dim, and the kernels get natural [block_q, 1] columns that broadcast against
+[block_q, block_k] score tiles with no sublane↔lane relayout. (jax's own TPU
+flash kernel instead replicates lse across a 128-lane minor dim — 128× the
+residual bytes for the same broadcast.)
 """
 
 from __future__ import annotations
@@ -41,8 +55,11 @@ from torchkafka_tpu.ops.attention import mha
 _NEG_INF = -1e30
 
 
+# ------------------------------------------------------------------ forward
+
+
 def _flash_kernel(
-    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
     *, scale: float, causal: bool, block_q: int, block_k: int,
 ):
     qi = pl.program_id(1)
@@ -85,12 +102,19 @@ def _flash_kernel(
 
     @pl.when(ki == pl.num_programs(2) - 1)
     def _finish():
-        denom = jnp.maximum(l_ref[:, :1], 1e-30)
-        o_ref[0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[:, :1] + jnp.log(l)  # [block_q, 1]
+
+
+def _scratch(shapes):
+    if pltpu is not None:
+        return [pltpu.VMEM(sh, jnp.float32) for sh in shapes]
+    return [jax.ShapeDtypeStruct(sh, jnp.float32) for sh in shapes]
 
 
 def _flash_fwd_bhsd(q, k, v, *, causal: bool, block_q: int, block_k: int, interpret: bool):
-    """q,k,v: [BH, S, D] → [BH, S, D]."""
+    """q,k,v: [BH, S, D] → ([BH, S, D], lse [BH, S, 1] f32)."""
     bh, s, d = q.shape
     scale = 1.0 / math.sqrt(d)
     grid = (bh, pl.cdiv(s, block_q), pl.cdiv(s, block_k))
@@ -98,36 +122,217 @@ def _flash_fwd_bhsd(q, k, v, *, causal: bool, block_q: int, block_k: int, interp
         _flash_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k
     )
     vmem = {} if _VMEM is None else {"memory_space": _VMEM}
-    scratch = (
-        [
-            pltpu.VMEM((block_q, d), jnp.float32),
-            pltpu.VMEM((block_q, 128), jnp.float32),
-            pltpu.VMEM((block_q, 128), jnp.float32),
-        ]
-        if pltpu is not None
-        else [
-            jax.ShapeDtypeStruct((block_q, d), jnp.float32),
-            jax.ShapeDtypeStruct((block_q, 128), jnp.float32),
-            jax.ShapeDtypeStruct((block_q, 128), jnp.float32),
-        ]
-    )
     return pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, s, 1), jnp.float32),
+        ],
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0), **vmem),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0), **vmem),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0), **vmem),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0), **vmem),
-        scratch_shapes=scratch,
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0), **vmem),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0), **vmem),
+        ],
+        scratch_shapes=_scratch([(block_q, d), (block_q, 128), (block_q, 128)]),
         interpret=interpret,
     )(q, k, v)
 
 
+# ----------------------------------------------------------------- backward
+
+
+def _dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
+    *, scale: float, causal: bool, block_q: int, block_k: int,
+):
+    """Grid (bh, qi, ki), ki innermost: accumulate dQ for one q block."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    run = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
+
+    @pl.when(run)
+    def _block():
+        q = q_ref[0]  # [block_q, D]
+        k = k_ref[0]  # [block_k, D]
+        v = v_ref[0]
+        do = do_ref[0]  # [block_q, D]
+        lse = lse_ref[0]  # [block_q, 1]
+        delta = delta_ref[0]  # [block_q, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [block_q, block_k]
+        p = jnp.exp(s - lse)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            p = jnp.where(q_pos >= k_pos, p, 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [block_q, block_k]
+        ds = p * (dp - delta) * scale
+        dq_acc[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [block_q, D]
+
+    @pl.when(ki == pl.num_programs(2) - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc,
+    *, scale: float, causal: bool, block_q: int, block_k: int,
+):
+    """Grid (bh, ki, qi), qi innermost: accumulate dK, dV for one k block."""
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    run = (qi * block_q + block_q - 1 >= ki * block_k) if causal else True
+
+    @pl.when(run)
+    def _block():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0]  # [block_q, 1]
+        delta = delta_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [block_q, block_k]
+        p = jnp.exp(s - lse)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            p = jnp.where(q_pos >= k_pos, p, 0.0)
+        # dV += Pᵀ dO: contract the q (sublane) dim.
+        dv_acc[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [block_k, D]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [block_q, block_k]
+        ds = p * (dp - delta) * scale
+        dk_acc[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [block_k, D]
+
+    @pl.when(qi == pl.num_programs(2) - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_bhsd(
+    q, k, v, o, lse, do, *, causal: bool, block_q: int, block_k: int, interpret: bool
+):
+    """q,k,v,o,do [BH, S, D], lse [BH, S, 1] → (dq, dk, dv) [BH, S, D]."""
+    bh, s, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    # delta = rowsum(dO ∘ O): O(S·D) elementwise — XLA fuses this fine.
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
+    )  # [BH, S, 1]
+
+    vmem = {} if _VMEM is None else {"memory_space": _VMEM}
+
+    def qd(idx):
+        return pl.BlockSpec((1, block_q, d), idx, **vmem)
+
+    def kd(idx):
+        return pl.BlockSpec((1, block_k, d), idx, **vmem)
+
+    def col(idx):
+        return pl.BlockSpec((1, block_q, 1), idx, **vmem)
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k
+        ),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        grid=(bh, pl.cdiv(s, block_q), pl.cdiv(s, block_k)),
+        in_specs=[
+            qd(lambda b, i, j: (b, i, 0)),  # q
+            kd(lambda b, i, j: (b, j, 0)),  # k
+            kd(lambda b, i, j: (b, j, 0)),  # v
+            qd(lambda b, i, j: (b, i, 0)),  # do
+            col(lambda b, i, j: (b, i, 0)),  # lse
+            col(lambda b, i, j: (b, i, 0)),  # delta
+        ],
+        out_specs=qd(lambda b, i, j: (b, i, 0)),
+        scratch_shapes=_scratch([(block_q, d)]),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, s, d), v.dtype),
+        ],
+        grid=(bh, pl.cdiv(s, block_k), pl.cdiv(s, block_q)),
+        in_specs=[
+            qd(lambda b, j, i: (b, i, 0)),  # q
+            kd(lambda b, j, i: (b, j, 0)),  # k
+            kd(lambda b, j, i: (b, j, 0)),  # v
+            qd(lambda b, j, i: (b, i, 0)),  # do
+            col(lambda b, j, i: (b, i, 0)),  # lse
+            col(lambda b, j, i: (b, i, 0)),  # delta
+        ],
+        out_specs=[
+            kd(lambda b, j, i: (b, j, 0)),
+            kd(lambda b, j, i: (b, j, 0)),
+        ],
+        scratch_shapes=_scratch([(block_k, d), (block_k, d)]),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ------------------------------------------------------------------ public
+
+
 def _supported(s: int, block_q: int, block_k: int) -> bool:
-    return s % block_q == 0 and s % block_k == 0
+    return block_q > 0 and block_k > 0 and s % block_q == 0 and s % block_k == 0
+
+
+def _auto_block(s: int) -> int:
+    """Largest of (512, 256, 128) dividing S — 512 benches ~5-25x faster
+    than 128 (fewer grid steps, better MXU occupancy), but any non-divisor
+    would silently lose the flash path for that S entirely."""
+    for b in (512, 256, 128):
+        if s % b == 0:
+            return b
+    return 0  # no tiling → dense fallback
+
+
+def _resolve(s: int, block_q: int | None, block_k: int | None, interpret):
+    block_q = _auto_block(s) if block_q is None else min(block_q, s)
+    block_k = _auto_block(s) if block_k is None else min(block_k, s)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return block_q, block_k, interpret
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -136,48 +341,67 @@ def flash_attention(
     k: jax.Array,
     v: jax.Array,
     causal: bool = True,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Fused attention. q,k,v: [B, S, H, D] → [B, S, H, D].
 
-    Falls back to the XLA path when the sequence does not tile (S not a
-    multiple of the block sizes after clamping to S).
+    Differentiable with O(S·D) memory (flash backward). Block sizes default
+    to the largest of (512, 256, 128) dividing S. Falls back to the XLA
+    path — forward and backward — when the sequence does not tile (no
+    candidate block divides S, e.g. S < 128 or odd sizes).
     """
     return _flash_impl(q, k, v, causal, block_q, block_k, interpret)
 
 
+def _to_bhsd(x):
+    b, s, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+
+def _from_bhsd(x, b, h):
+    bh, s, d = x.shape
+    return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
 def _flash_impl(q, k, v, causal, block_q, block_k, interpret):
     b, s, h, d = q.shape
-    block_q = min(block_q, s)
-    block_k = min(block_k, s)
+    block_q, block_k, interpret = _resolve(s, block_q, block_k, interpret)
     if not _supported(s, block_q, block_k):
         return mha(q, k, v, causal=causal)
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-
-    def to_bhsd(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-
-    out = _flash_fwd_bhsd(
-        to_bhsd(q), to_bhsd(k), to_bhsd(v),
+    out, _ = _flash_fwd_bhsd(
+        _to_bhsd(q), _to_bhsd(k), _to_bhsd(v),
         causal=causal, block_q=block_q, block_k=block_k, interpret=interpret,
     )
-    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    return _from_bhsd(out, b, h)
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    return _flash_impl(q, k, v, causal, block_q, block_k, interpret), (q, k, v)
+    b, s, h, d = q.shape
+    block_q, block_k, interpret = _resolve(s, block_q, block_k, interpret)
+    if not _supported(s, block_q, block_k):
+        # Residuals (o=None, lse=None) route the backward to the dense vjp.
+        return mha(q, k, v, causal=causal), (q, k, v, None, None)
+    out, lse = _flash_fwd_bhsd(
+        _to_bhsd(q), _to_bhsd(k), _to_bhsd(v),
+        causal=causal, block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    return _from_bhsd(out, b, h), (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, res, g):
-    # Backward = recompute with the XLA path and differentiate it. Keeps the
-    # forward's memory/fusion wins where they matter (inference, prefill);
-    # memory-optimal training backward is ring attention's scan.
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: mha(q, k, v, causal=causal), q, k, v)
-    return vjp(g)
+    q, k, v, o_bhsd, lse = res
+    if lse is None:  # untileable shape: dense fallback, matching the forward
+        _, vjp = jax.vjp(lambda q, k, v: mha(q, k, v, causal=causal), q, k, v)
+        return vjp(g)
+    b, s, h, d = q.shape
+    block_q, block_k, interpret = _resolve(s, block_q, block_k, interpret)
+    dq, dk, dv = _flash_bwd_bhsd(
+        _to_bhsd(q), _to_bhsd(k), _to_bhsd(v), o_bhsd, lse, _to_bhsd(g),
+        causal=causal, block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    return _from_bhsd(dq, b, h), _from_bhsd(dk, b, h), _from_bhsd(dv, b, h)
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)

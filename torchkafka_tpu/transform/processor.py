@@ -44,20 +44,36 @@ def is_chunked(fn: Callable) -> bool:
     return bool(getattr(fn, "chunked", False))
 
 
-def fixed_width(seq_len: int, dtype=np.int32, pad_value: int = 0) -> Callable:
+def fixed_width(
+    seq_len: int, dtype=np.int32, pad_value: int = 0, wire_dtype=None
+) -> Callable:
     """Chunk processor for fixed-width binary records: each record value is
     ``seq_len`` items of ``dtype`` (the BASELINE token-stream shape). Exact-
     width chunks decode with one join + one frombuffer (two memcpy-scale ops
     for the whole chunk); ragged stragglers fall back to a per-record
     pad/truncate. Uses the native C++ decoder when built (torchkafka_tpu.native).
+
+    ``wire_dtype``: optional narrower dtype the decoded rows are cast to
+    before leaving the host — the batch travels host→device in this dtype.
+    Host↔device bandwidth is the scarce resource on an ingest pipeline
+    (HBM/PCIe/ICI all beat it); token ids under 65536 in ``uint16`` halve
+    the wire bytes and gather into embeddings on-device without widening.
+    The cast asserts the values fit (overflow would corrupt ids silently).
     """
     @chunked
     def process(records: list[Record]):
         from torchkafka_tpu import native
 
-        return native.gather_rows(
-            [r.value for r in records], seq_len, dtype, pad_value
-        ), None
+        rows = native.gather_rows([r.value for r in records], seq_len, dtype, pad_value)
+        if wire_dtype is not None:
+            info = np.iinfo(wire_dtype)
+            if rows.size and (rows.min() < info.min or rows.max() > info.max):
+                raise ValueError(
+                    f"record values outside {np.dtype(wire_dtype).name} range "
+                    f"[{info.min}, {info.max}] — narrowing would corrupt them"
+                )
+            rows = rows.astype(wire_dtype)
+        return rows, None
 
     return process
 
