@@ -84,8 +84,6 @@ def _ring_attention_local(
     scale = 1.0 / math.sqrt(dim)
     q_pos = my_idx * s_local + jnp.arange(s_local)  # global positions, [Sl]
 
-    qf = q.astype(jnp.float32)
-
     def block_step(carry, step):
         out, m, l, k_cur, v_cur = carry
         # Which shard k_cur holds now: it started at (my_idx + step) ... each
@@ -93,8 +91,11 @@ def _ring_attention_local(
         # my_idx holds the shard originally on device (my_idx - step).
         src = (my_idx - step) % axis_size
         k_pos = src * s_local + jnp.arange(s_local)
+        # Inputs stay in their compute dtype (bf16 on the MXU); accumulation
+        # is f32 via preferred_element_type — flash-kernel numerics at
+        # native matmul speed (f32 inputs run the MXU in multi-pass mode).
         scores = jnp.einsum(
-            "bqhd,bkhd->bhqk", qf, k_cur.astype(jnp.float32)
+            "bqhd,bkhd->bhqk", q, k_cur, preferred_element_type=jnp.float32
         ) * scale
         if causal:
             mask = q_pos[:, None] >= k_pos[None, :]
@@ -103,7 +104,10 @@ def _ring_attention_local(
         p = jnp.exp(scores - m_new[..., None])  # [B,H,Sq,Sk]
         corr = jnp.exp(m - m_new)  # [B,H,Sq]
         l_new = l * corr + p.sum(axis=-1)
-        pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_cur.astype(jnp.float32))
+        pv = jnp.einsum(
+            "bhqk,bkhd->bqhd", p.astype(v_cur.dtype), v_cur,
+            preferred_element_type=jnp.float32,
+        )
         out_new = out * corr.transpose(0, 2, 1)[..., None] + pv
         perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
