@@ -177,18 +177,23 @@ def scenario_3(size: str = "tiny") -> dict:
     losses = [float(x) for x in state["losses"]]
     extra = {"mesh": dict(mesh.shape), "first_loss": round(losses[0], 4),
              "last_loss": round(losses[-1], 4)}
-    extra.update(_train_mfu(cfg, state, step_fn, local_batch, seq))
+    extra.update(_train_mfu(cfg, state, step_fn, local_batch, seq, n_dev))
     return _result("3:mesh-train", rows, elapsed, stream, extra)
 
 
-def _train_mfu(cfg, state, step_fn, batch: int, seq: int) -> dict:
+def _train_mfu(cfg, state, step_fn, batch: int, seq: int, n_dev: int) -> dict:
     """Pure train-step time (ingest excluded) and an MFU estimate.
 
     FLOPs/step ≈ 6·N_params·tokens (fwd+bwd matmul rule of thumb)
     + 6·L·d_model·B·S² (causal attention, fwd+bwd); peak = 197 TFLOP/s
-    bf16 for one v5e chip. Timed as K chained step_fn calls with a scalar
-    fetch at the end — an in-order device queue makes the chain honest
-    even on transports where block_until_ready returns early."""
+    bf16 per v5e chip × the mesh's device count. Timed as K chained
+    step_fn calls with a scalar fetch at the end — an in-order device
+    queue makes the chain honest even on transports where
+    block_until_ready returns early. The first (warmup) call is untimed:
+    these inputs' sharding differs from the training batches', so it may
+    trigger a fresh XLA compile that must not land in the timed region.
+    step_fn donates params/opt, so the chained values are rebound into
+    ``state`` to keep its buffers valid for later use."""
     import time as _time
 
     import jax
@@ -202,14 +207,18 @@ def _train_mfu(cfg, state, step_fn, batch: int, seq: int) -> dict:
     tokens = jnp.zeros((batch, seq), jnp.int32)
     mask = jnp.ones((batch, seq), jnp.int32)
     params, opt = state["params"], state["opt"]
+    params, opt, loss = step_fn(params, opt, tokens, mask)  # warmup/compile
+    float(loss)
     k = 4
     t0 = _time.perf_counter()
     for _ in range(k):
         params, opt, loss = step_fn(params, opt, tokens, mask)
     float(loss)
     step_s = (_time.perf_counter() - t0) / k
+    # Donated buffers were invalidated along the chain; rebind the live ones.
+    state["params"], state["opt"] = params, opt
     flops = 6 * n_params * batch * seq + 6 * cfg.n_layers * cfg.d_model * batch * seq**2
-    mfu = flops / step_s / 197e12
+    mfu = flops / step_s / (197e12 * n_dev)
     return {
         "params_m": round(n_params / 1e6, 1),
         "step_ms": round(step_s * 1e3, 1),

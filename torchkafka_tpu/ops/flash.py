@@ -59,11 +59,14 @@ _NEG_INF = -1e30
 
 
 def _flash_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+    q_ref, k_ref, v_ref, qoff_ref, koff_ref, o_ref, lse_ref,
+    acc_ref, m_ref, l_ref,
     *, scale: float, causal: bool, block_q: int, block_k: int,
 ):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
+    qoff = qoff_ref[0]  # global position of q row 0 (ring shard offset)
+    koff = koff_ref[0]
 
     @pl.when(ki == 0)
     def _init():
@@ -72,8 +75,13 @@ def _flash_kernel(
         l_ref[...] = jnp.zeros_like(l_ref)
 
     # Causal: a k-block strictly above the q-block's last row contributes
-    # nothing — skip its matmuls entirely.
-    run = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
+    # nothing — skip its matmuls entirely. With ring offsets this also
+    # skips every block of a kv shard that lies wholly in the future.
+    run = (
+        (koff + ki * block_k <= qoff + qi * block_q + block_q - 1)
+        if causal
+        else True
+    )
 
     @pl.when(run)
     def _block():
@@ -84,8 +92,8 @@ def _flash_kernel(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [block_q, block_k]
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            q_pos = qoff + qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = koff + ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
         m_prev = m_ref[:, :1]  # [block_q, 1]
         l_prev = l_ref[:, :1]
@@ -104,6 +112,9 @@ def _flash_kernel(
     def _finish():
         l = jnp.maximum(l_ref[:, :1], 1e-30)
         o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        # Rows that saw no allowed key (possible for a ring block wholly in
+        # the future): l == 0 → lse ≈ -1e30, o = 0; the partial-merge
+        # weight exp(lse - lse_new) underflows to exactly 0.
         lse_ref[0] = m_ref[:, :1] + jnp.log(l)  # [block_q, 1]
 
 
@@ -113,26 +124,51 @@ def _scratch(shapes):
     return [jax.ShapeDtypeStruct(sh, jnp.float32) for sh in shapes]
 
 
-def _flash_fwd_bhsd(q, k, v, *, causal: bool, block_q: int, block_k: int, interpret: bool):
-    """q,k,v: [BH, S, D] → ([BH, S, D], lse [BH, S, 1] f32)."""
-    bh, s, d = q.shape
+def _smem_spec():
+    kw = {} if pltpu is None else {"memory_space": pltpu.SMEM}
+    return pl.BlockSpec((1,), lambda b, i, j: (0,), **kw)
+
+
+def _offsets(q_offset, k_offset):
+    return (
+        jnp.asarray(q_offset, jnp.int32).reshape(1),
+        jnp.asarray(k_offset, jnp.int32).reshape(1),
+    )
+
+
+def _flash_fwd_bhsd(
+    q, k, v, *, causal: bool, block_q: int, block_k: int, interpret: bool,
+    q_offset=0, k_offset=0,
+):
+    """q: [BH, Sq, D]; k,v: [BH, Sk, D] → ([BH, Sq, D], lse [BH, Sq, 1] f32).
+
+    ``q_offset``/``k_offset`` are the global positions of row 0 (traced i32
+    scalars, SMEM) — this is what lets the same kernel serve the single-chip
+    path (offsets 0) and one block step of ring attention (shard offsets),
+    mirroring ``mha``'s offset contract (attention.py).
+    """
+    bh, sq, d = q.shape
+    sk = k.shape[1]
     scale = 1.0 / math.sqrt(d)
-    grid = (bh, pl.cdiv(s, block_q), pl.cdiv(s, block_k))
+    grid = (bh, pl.cdiv(sq, block_q), pl.cdiv(sk, block_k))
     kernel = functools.partial(
         _flash_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k
     )
     vmem = {} if _VMEM is None else {"memory_space": _VMEM}
+    qoff, koff = _offsets(q_offset, k_offset)
     return pl.pallas_call(
         kernel,
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, s, 1), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
         ],
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0), **vmem),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0), **vmem),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0), **vmem),
+            _smem_spec(),
+            _smem_spec(),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0), **vmem),
@@ -140,25 +176,32 @@ def _flash_fwd_bhsd(q, k, v, *, causal: bool, block_q: int, block_k: int, interp
         ],
         scratch_shapes=_scratch([(block_q, d), (block_q, 128), (block_q, 128)]),
         interpret=interpret,
-    )(q, k, v)
+    )(q, k, v, qoff, koff)
 
 
 # ----------------------------------------------------------------- backward
 
 
 def _dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qoff_ref, koff_ref,
+    dq_ref, dq_acc,
     *, scale: float, causal: bool, block_q: int, block_k: int,
 ):
     """Grid (bh, qi, ki), ki innermost: accumulate dQ for one q block."""
     qi = pl.program_id(1)
     ki = pl.program_id(2)
+    qoff = qoff_ref[0]
+    koff = koff_ref[0]
 
     @pl.when(ki == 0)
     def _init():
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
-    run = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
+    run = (
+        (koff + ki * block_k <= qoff + qi * block_q + block_q - 1)
+        if causal
+        else True
+    )
 
     @pl.when(run)
     def _block():
@@ -173,8 +216,8 @@ def _dq_kernel(
         ) * scale  # [block_q, block_k]
         p = jnp.exp(s - lse)
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            q_pos = qoff + qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = koff + ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             p = jnp.where(q_pos >= k_pos, p, 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -191,20 +234,26 @@ def _dq_kernel(
 
 
 def _dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    dk_acc, dv_acc,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qoff_ref, koff_ref,
+    dk_ref, dv_ref, dk_acc, dv_acc,
     *, scale: float, causal: bool, block_q: int, block_k: int,
 ):
     """Grid (bh, ki, qi), qi innermost: accumulate dK, dV for one k block."""
     ki = pl.program_id(1)
     qi = pl.program_id(2)
+    qoff = qoff_ref[0]
+    koff = koff_ref[0]
 
     @pl.when(qi == 0)
     def _init():
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    run = (qi * block_q + block_q - 1 >= ki * block_k) if causal else True
+    run = (
+        (qoff + qi * block_q + block_q - 1 >= koff + ki * block_k)
+        if causal
+        else True
+    )
 
     @pl.when(run)
     def _block():
@@ -219,8 +268,8 @@ def _dkv_kernel(
         ) * scale  # [block_q, block_k]
         p = jnp.exp(s - lse)
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            q_pos = qoff + qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = koff + ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             p = jnp.where(q_pos >= k_pos, p, 0.0)
         # dV += Pᵀ dO: contract the q (sublane) dim.
         dv_acc[...] += jax.lax.dot_general(
@@ -243,17 +292,21 @@ def _dkv_kernel(
 
 
 def _flash_bwd_bhsd(
-    q, k, v, o, lse, do, *, causal: bool, block_q: int, block_k: int, interpret: bool
+    q, k, v, o, lse, do, *, causal: bool, block_q: int, block_k: int,
+    interpret: bool, q_offset=0, k_offset=0,
 ):
-    """q,k,v,o,do [BH, S, D], lse [BH, S, 1] → (dq, dk, dv) [BH, S, D]."""
-    bh, s, d = q.shape
+    """q,o,do [BH, Sq, D]; k,v [BH, Sk, D]; lse [BH, Sq, 1] →
+    (dq [BH, Sq, D], dk, dv [BH, Sk, D])."""
+    bh, sq, d = q.shape
+    sk = k.shape[1]
     scale = 1.0 / math.sqrt(d)
     # delta = rowsum(dO ∘ O): O(S·D) elementwise — XLA fuses this fine.
     delta = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
-    )  # [BH, S, 1]
+    )  # [BH, Sq, 1]
 
     vmem = {} if _VMEM is None else {"memory_space": _VMEM}
+    qoff, koff = _offsets(q_offset, k_offset)
 
     def qd(idx):
         return pl.BlockSpec((1, block_q, d), idx, **vmem)
@@ -268,8 +321,8 @@ def _flash_bwd_bhsd(
         functools.partial(
             _dq_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k
         ),
-        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-        grid=(bh, pl.cdiv(s, block_q), pl.cdiv(s, block_k)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        grid=(bh, pl.cdiv(sq, block_q), pl.cdiv(sk, block_k)),
         in_specs=[
             qd(lambda b, i, j: (b, i, 0)),  # q
             kd(lambda b, i, j: (b, j, 0)),  # k
@@ -277,21 +330,23 @@ def _flash_bwd_bhsd(
             qd(lambda b, i, j: (b, i, 0)),  # do
             col(lambda b, i, j: (b, i, 0)),  # lse
             col(lambda b, i, j: (b, i, 0)),  # delta
+            _smem_spec(),
+            _smem_spec(),
         ],
         out_specs=qd(lambda b, i, j: (b, i, 0)),
         scratch_shapes=_scratch([(block_q, d)]),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse, delta, qoff, koff)
 
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k
         ),
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, s, d), v.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
         ],
-        grid=(bh, pl.cdiv(s, block_k), pl.cdiv(s, block_q)),
+        grid=(bh, pl.cdiv(sk, block_k), pl.cdiv(sq, block_q)),
         in_specs=[
             qd(lambda b, j, i: (b, i, 0)),  # q
             kd(lambda b, j, i: (b, j, 0)),  # k
@@ -299,6 +354,8 @@ def _flash_bwd_bhsd(
             qd(lambda b, j, i: (b, i, 0)),  # do
             col(lambda b, j, i: (b, i, 0)),  # lse
             col(lambda b, j, i: (b, i, 0)),  # delta
+            _smem_spec(),
+            _smem_spec(),
         ],
         out_specs=[
             kd(lambda b, j, i: (b, j, 0)),
@@ -306,7 +363,7 @@ def _flash_bwd_bhsd(
         ],
         scratch_shapes=_scratch([(block_k, d), (block_k, d)]),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse, delta, qoff, koff)
     return dq, dk, dv
 
 
@@ -327,11 +384,17 @@ def _auto_block(s: int) -> int:
     return 0  # no tiling → dense fallback
 
 
+def _default_interpret() -> bool:
+    """Interpret mode off-TPU: the kernels run under the Pallas interpreter
+    (tests on the CPU mesh); compiled Mosaic on the real chip."""
+    return jax.default_backend() != "tpu"
+
+
 def _resolve(s: int, block_q: int | None, block_k: int | None, interpret):
     block_q = _auto_block(s) if block_q is None else min(block_q, s)
     block_k = _auto_block(s) if block_k is None else min(block_k, s)
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        interpret = _default_interpret()
     return block_q, block_k, interpret
 
 
