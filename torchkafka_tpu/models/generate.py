@@ -38,18 +38,13 @@ class KVCache(NamedTuple):
     v: jax.Array  # [L, B, max_len, K, Dh]
 
 
-def _layer_step(x, layer, cache_k, cache_v, pos, cfg):
-    """One token through one layer. x: [B, 1, D]; caches [B, max_len, K, Dh];
-    pos: scalar current position. Returns (x, new_k_row, new_v_row)."""
-    h = _rms_norm(x, layer["ln1"])
-    q = jnp.einsum("bsd,dhe->bshe", h, layer["wq"].astype(cfg.dtype))
-    k = jnp.einsum("bsd,dke->bske", h, layer["wk"].astype(cfg.dtype))
-    v = jnp.einsum("bsd,dke->bske", h, layer["wv"].astype(cfg.dtype))
-    positions = pos[None] if pos.ndim == 0 else pos
-    q = _rope(q, positions, cfg.rope_theta)
-    k = _rope(k, positions, cfg.rope_theta)
-    cache_k = lax.dynamic_update_slice(cache_k, k.astype(cache_k.dtype), (0, pos, 0, 0))
-    cache_v = lax.dynamic_update_slice(cache_v, v.astype(cache_v.dtype), (0, pos, 0, 0))
+def _attend_cached(x, q, cache_k, cache_v, valid, layer, cfg):
+    """Shared decode tail: GQA repeat over the cache, masked softmax
+    attention, output projection and the MLP residual. x: [B, 1, D];
+    q: [B, 1, H, Dh]; caches [B, M, K, Dh]; valid: [B, M] or [M] bool mask
+    of readable cache positions. Single source of truth for both the
+    lockstep decode (scalar position, generate.py) and the continuous-
+    batching server's per-slot decode (serve.py)."""
     kk, vv = cache_k, cache_v
     if cfg.n_kv_heads != cfg.n_heads:
         rep = cfg.n_heads // cfg.n_kv_heads
@@ -58,8 +53,9 @@ def _layer_step(x, layer, cache_k, cache_v, pos, cfg):
     scores = jnp.einsum(
         "bshe,bmhe->bhsm", q, kk.astype(cfg.dtype), preferred_element_type=jnp.float32
     ) / jnp.sqrt(jnp.float32(cfg.head_dim))
-    valid = jnp.arange(kk.shape[1]) <= pos  # attend to cache[0..pos]
-    scores = jnp.where(valid[None, None, None, :], scores, -1e30)
+    if valid.ndim == 1:
+        valid = valid[None, :]
+    scores = jnp.where(valid[:, None, None, :], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
     attn = jnp.einsum(
         "bhsm,bmhe->bshe", probs.astype(cfg.dtype), vv.astype(cfg.dtype),
@@ -69,10 +65,32 @@ def _layer_step(x, layer, cache_k, cache_v, pos, cfg):
     h = _rms_norm(x, layer["ln2"])
     if cfg.is_moe:
         mlp_out, _aux = _moe_mlp(h, layer, cfg)
-        return x + mlp_out, cache_k, cache_v
+        return x + mlp_out
     gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", h, layer["w_gate"].astype(cfg.dtype)))
     up = jnp.einsum("bsd,df->bsf", h, layer["w_up"].astype(cfg.dtype))
-    x = x + jnp.einsum("bsf,fd->bsd", gate * up, layer["w_down"].astype(cfg.dtype))
+    return x + jnp.einsum("bsf,fd->bsd", gate * up, layer["w_down"].astype(cfg.dtype))
+
+
+def _project_qkv(x, layer, cfg):
+    """RMSNorm + q/k/v projections for one decode token. x: [B, 1, D]."""
+    h = _rms_norm(x, layer["ln1"])
+    q = jnp.einsum("bsd,dhe->bshe", h, layer["wq"].astype(cfg.dtype))
+    k = jnp.einsum("bsd,dke->bske", h, layer["wk"].astype(cfg.dtype))
+    v = jnp.einsum("bsd,dke->bske", h, layer["wv"].astype(cfg.dtype))
+    return q, k, v
+
+
+def _layer_step(x, layer, cache_k, cache_v, pos, cfg):
+    """One token through one layer. x: [B, 1, D]; caches [B, max_len, K, Dh];
+    pos: scalar current position. Returns (x, new_cache_k, new_cache_v)."""
+    q, k, v = _project_qkv(x, layer, cfg)
+    positions = pos[None] if pos.ndim == 0 else pos
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    cache_k = lax.dynamic_update_slice(cache_k, k.astype(cache_k.dtype), (0, pos, 0, 0))
+    cache_v = lax.dynamic_update_slice(cache_v, v.astype(cache_v.dtype), (0, pos, 0, 0))
+    valid = jnp.arange(cache_k.shape[1]) <= pos  # attend to cache[0..pos]
+    x = _attend_cached(x, q, cache_k, cache_v, valid, layer, cfg)
     return x, cache_k, cache_v
 
 

@@ -1,0 +1,286 @@
+"""Continuous-batching generation server: the streaming-native serving loop.
+
+Extends BASELINE config 5 (prompt topic → generate → commit-after-generation)
+from lockstep batches to CONTINUOUS batching: a fixed pool of decode slots,
+prompts admitted into free slots as earlier generations finish (EOS or
+max_new), offsets marked done per COMPLETION and committed through the same
+interval ledger the ingest pipeline uses — so a long generation never blocks
+the commit watermark behind it, and at-least-once delivery holds per prompt.
+No reference analog (the reference has no models, SURVEY.md §2); this is the
+TPU-idiomatic serving pattern (static shapes, slot masks) the way vLLM-style
+continuous batching is the GPU one.
+
+XLA shape discipline: everything is static — the slot pool is [B] with
+per-slot positions, the admission step always prefllls a full [B, P] batch
+(rows masked by an admit mask; wasted rows cost one prefill of padding),
+and the decode tick advances all B slots with inactive slots masked out.
+Slot kv-cache rows are recycled without clearing: a freed slot's stale tail
+is overwritten position-by-position before each position becomes readable
+(the decode step writes kv at ``pos`` before attending over ``[0, pos]``).
+
+Citations: commit-exactly-what-completed mirrors the reference's
+commit-after-batch contract (/root/reference/src/auto_commit.py:55-58)
+generalised to out-of-order completions via the OffsetLedger.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+_logger = logging.getLogger(__name__)
+
+from torchkafka_tpu.commit.ledger import OffsetLedger
+from torchkafka_tpu.models.generate import _attend_cached, _project_qkv, prefill
+from torchkafka_tpu.models.transformer import TransformerConfig, _rms_norm
+from torchkafka_tpu.source.records import Record
+
+
+def _rope_rows(x: jax.Array, pos_b: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding with a DIFFERENT position per batch row.
+    x: [B, 1, H, D]; pos_b: [B] int32."""
+    dim = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, dim, 2, dtype=jnp.float32) / dim)
+    angles = pos_b[:, None].astype(jnp.float32) * freqs[None, :]  # [B, D/2]
+    cos = jnp.cos(angles)[:, None, None, :]
+    sin = jnp.sin(angles)[:, None, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _slot_layer_step(x, layer, cache_k, cache_v, pos_b, cfg):
+    """One decode token through one layer with a DIFFERENT position per
+    slot. x: [B, 1, D]; caches [B, M, K, Dh]; pos_b: [B]. Only the rope and
+    the cache write differ from the lockstep ``generate._layer_step``; the
+    attention/MLP tail is the shared ``_attend_cached``."""
+    q, k, v = _project_qkv(x, layer, cfg)
+    q = _rope_rows(q, pos_b, cfg.rope_theta)
+    k = _rope_rows(k, pos_b, cfg.rope_theta)
+    rows = jnp.arange(x.shape[0])
+    cache_k = cache_k.at[rows, pos_b].set(k[:, 0].astype(cache_k.dtype))
+    cache_v = cache_v.at[rows, pos_b].set(v[:, 0].astype(cache_v.dtype))
+    valid = jnp.arange(cache_k.shape[1])[None, :] <= pos_b[:, None]  # [B, M]
+    x = _attend_cached(x, q, cache_k, cache_v, valid, layer, cfg)
+    return x, cache_k, cache_v
+
+
+def _default_decode_prompt(prompt_len: int) -> Callable[[Record], np.ndarray]:
+    def decode(record: Record) -> np.ndarray:
+        toks = np.frombuffer(record.value, dtype=np.int32)[:prompt_len]
+        if toks.shape[0] < prompt_len:
+            toks = np.pad(toks, (0, prompt_len - toks.shape[0]))
+        return toks
+
+    return decode
+
+
+class StreamingGenerator:
+    """Continuous-batching server over a Kafka-semantics consumer.
+
+    ``run()`` yields ``(record, tokens)`` in COMPLETION order (not offset
+    order); each completion retires its record in the ledger, and offsets
+    commit every ``commit_every`` completions plus once at the end — so a
+    crash re-delivers exactly the prompts whose generations never finished.
+    """
+
+    def __init__(
+        self,
+        consumer,
+        params,
+        cfg: TransformerConfig,
+        *,
+        slots: int = 8,
+        prompt_len: int,
+        max_new: int,
+        eos_id: int | None = None,
+        commit_every: int = 32,
+        decode_prompt: Callable[[Record], np.ndarray] | None = None,
+        max_poll_records: int = 512,
+    ) -> None:
+        if prompt_len + max_new > cfg.max_seq_len:
+            raise ValueError("prompt_len + max_new exceeds cfg.max_seq_len")
+        if max_new < 2:
+            raise ValueError("max_new must be >= 2 (prefill emits token 0)")
+        self._consumer = consumer
+        self._params = params
+        self._cfg = cfg
+        self._slots = slots
+        self._prompt_len = prompt_len
+        self._max_new = max_new
+        self._eos_id = eos_id
+        self._commit_every = commit_every
+        self._decode_prompt = decode_prompt or _default_decode_prompt(prompt_len)
+        self._max_poll = max_poll_records
+        self._ledger = OffsetLedger()
+        self._max_len = prompt_len + max_new
+        self._build()
+
+    def _build(self) -> None:
+        cfg, params = self._cfg, self._params
+        B, P, M = self._slots, self._prompt_len, self._max_len
+        nl, kh, dh = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+
+        def admit(caches, last_tok, pos, gen, prompts, admit_mask):
+            """Prefill the full [B, P] prompt batch; merge admitted rows in.
+            prompts: [B, P] int32; admit_mask: [B] bool."""
+            logits, fresh = prefill(params, cfg, prompts, M)
+            sel = admit_mask[None, :, None, None, None]  # over [L, B, M, K, Dh]
+            ck = jnp.where(sel, fresh.k, caches[0])
+            cv = jnp.where(sel, fresh.v, caches[1])
+            tok0 = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B]
+            last_tok = jnp.where(admit_mask, tok0, last_tok)
+            pos = jnp.where(admit_mask, P, pos)
+            gen = jnp.where(admit_mask[:, None], 0, gen)
+            gen = gen.at[:, 0].set(jnp.where(admit_mask, tok0, gen[:, 0]))
+            return (ck, cv), last_tok, pos, gen
+
+        def tick(caches, last_tok, pos, gen, active):
+            """One decode step for all slots; inactive rows are frozen."""
+            x = params["embed"].astype(cfg.dtype)[last_tok][:, None, :]
+
+            def body(x, inputs):
+                layer, ck, cv = inputs
+                x, ck, cv = _slot_layer_step(x, layer, ck, cv, pos, cfg)
+                return x, (ck, cv)
+
+            x, (ck, cv) = lax.scan(body, x, (params["layers"], caches[0], caches[1]))
+            x = _rms_norm(x, params["ln_f"])
+            logits = jnp.einsum(
+                "bd,dv->bv", x[:, 0], params["lm_head"].astype(cfg.dtype),
+                preferred_element_type=jnp.float32,
+            )
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            # Inactive slots write stale kv at their frozen position — safe:
+            # re-admission overwrites [0, P) via prefill and every later
+            # position is rewritten by the tick that reaches it BEFORE the
+            # attention that could read it. Freezing the caches with a
+            # jnp.where would copy the whole pool every token instead.
+            t = pos - P  # decode ticks completed before this one, per slot
+            idx = jnp.minimum(t + 1, self._max_new - 1)
+            gen = gen.at[jnp.arange(B), idx].set(
+                jnp.where(active, tok, gen[jnp.arange(B), idx])
+            )
+            hit_eos = (
+                (tok == self._eos_id) if self._eos_id is not None
+                else jnp.zeros_like(active)
+            )
+            # Tokens generated after this tick = t + 2 (prefill's token 0
+            # plus t+1 decode outputs); complete on EOS or a full buffer.
+            done = active & (hit_eos | (t + 2 >= self._max_new))
+            pos = jnp.where(active & ~done, pos + 1, pos)
+            last_tok = jnp.where(active, tok, last_tok)
+            n_out = jnp.where(done, jnp.minimum(t + 2, self._max_new), 0)
+            return (ck, cv), last_tok, pos, gen, done, n_out
+
+        self._admit_fn = jax.jit(admit)
+        self._tick_fn = jax.jit(tick)
+        self._caches = (
+            jnp.zeros((nl, B, M, kh, dh), cfg.dtype),
+            jnp.zeros((nl, B, M, kh, dh), cfg.dtype),
+        )
+        self._last_tok = jnp.zeros((B,), jnp.int32)
+        self._pos = jnp.zeros((B,), jnp.int32)
+        self._gen = jnp.zeros((B, self._max_new), jnp.int32)
+
+    def run(
+        self, max_records: int | None = None, idle_timeout_ms: int = 2000
+    ) -> Iterator[tuple[Record, np.ndarray]]:
+        import time
+
+        B = self._slots
+        slot_rec: list[Record | None] = [None] * B
+        pending: list[Record] = []
+        active = np.zeros((B,), bool)
+        caches, last_tok, pos, gen = (
+            self._caches, self._last_tok, self._pos, self._gen
+        )
+        served = 0
+        uncommitted = 0
+        exhausted_at: float | None = None
+        while True:
+            free = [i for i in range(B) if not active[i]]
+            in_flight = B - len(free)
+            # Admission budget: never take more work than max_records allows
+            # (completions already served + generations in flight).
+            budget = (
+                max(0, max_records - served - in_flight)
+                if max_records is not None
+                else B
+            )
+            if free and budget and len(pending) < min(len(free), budget):
+                # Never let an empty topic stall in-flight decode ticks:
+                # poll without blocking while anything is generating.
+                records = self._consumer.poll(
+                    max_records=self._max_poll,
+                    timeout_ms=0 if active.any() else 50,
+                )
+                if records:
+                    self._ledger.fetched_many(records)
+                    pending.extend(records)
+                    exhausted_at = None
+            if free and pending and budget:
+                prompts = np.zeros((B, self._prompt_len), np.int32)
+                admit_mask = np.zeros((B,), bool)
+                for i in free:
+                    if not pending or budget == 0:
+                        break
+                    rec = pending.pop(0)
+                    try:
+                        prompts[i] = self._decode_prompt(rec)
+                    except Exception:
+                        # Poison record: retire it (dropped, like the
+                        # reference's None-filter) or it would re-deliver
+                        # and crash the server forever on restart.
+                        _logger.exception(
+                            "dropping undecodable prompt %s@%s:%s",
+                            rec.topic, rec.partition, rec.offset,
+                        )
+                        self._ledger.dropped(rec)
+                        continue
+                    slot_rec[i] = rec
+                    admit_mask[i] = True
+                    active[i] = True
+                    budget -= 1
+                if admit_mask.any():
+                    caches, last_tok, pos, gen = self._admit_fn(
+                        caches, last_tok, pos, gen,
+                        jnp.asarray(prompts), jnp.asarray(admit_mask),
+                    )
+            if not active.any():
+                if max_records is not None and served >= max_records:
+                    break
+                if not pending:
+                    if exhausted_at is None:
+                        exhausted_at = time.monotonic()
+                    elif (time.monotonic() - exhausted_at) * 1000 >= idle_timeout_ms:
+                        break
+                continue
+            caches, last_tok, pos, gen, done, n_out = self._tick_fn(
+                caches, last_tok, pos, gen, jnp.asarray(active)
+            )
+            done_h = np.asarray(done)
+            if done_h.any():
+                n_out_h = np.asarray(n_out)
+                gen_h = np.asarray(gen)
+                for i in np.nonzero(done_h)[0]:
+                    rec = slot_rec[i]
+                    assert rec is not None
+                    self._ledger.emitted(rec)
+                    active[i] = False
+                    slot_rec[i] = None
+                    served += 1
+                    uncommitted += 1
+                    yield rec, gen_h[i, : n_out_h[i]].copy()
+                if uncommitted >= self._commit_every:
+                    self._consumer.commit(self._ledger.snapshot())
+                    uncommitted = 0
+                if max_records is not None and served >= max_records and not active.any():
+                    break
+        if uncommitted:
+            self._consumer.commit(self._ledger.snapshot())
