@@ -8,7 +8,7 @@ import pytest
 from torchkafka_tpu.harness import run_scenario
 
 
-@pytest.mark.parametrize("num", [1, 2, 3, 4, 5, 6])
+@pytest.mark.parametrize("num", [1, 2, 3, 4, 5, 6, 7])
 def test_scenario_runs_and_reports(num):
     out = run_scenario(num, "tiny")
     assert out["records"] > 0

@@ -62,9 +62,15 @@ def _slot_layer_step(x, layer, cache_k, cache_v, pos_b, cfg):
     q, k, v = _project_qkv(x, layer, cfg)
     q = _rope_rows(q, pos_b, cfg.rope_theta)
     k = _rope_rows(k, pos_b, cfg.rope_theta)
-    rows = jnp.arange(x.shape[0])
-    cache_k = cache_k.at[rows, pos_b].set(k[:, 0].astype(cache_k.dtype))
-    cache_v = cache_v.at[rows, pos_b].set(v[:, 0].astype(cache_v.dtype))
+    # Per-row cache write via vmapped dynamic_update_slice: XLA lowers this
+    # to a masked select, ~10x faster on TPU than the equivalent
+    # `.at[rows, pos_b].set` scatter (measured 1.9 ms vs noise-floor per
+    # [32, 192, 8, 64] update — 8 of these per tick).
+    upd = jax.vmap(
+        lambda c, row, p: lax.dynamic_update_slice(c, row[None], (p, 0, 0))
+    )
+    cache_k = upd(cache_k, k[:, 0].astype(cache_k.dtype), pos_b)
+    cache_v = upd(cache_v, v[:, 0].astype(cache_v.dtype), pos_b)
     valid = jnp.arange(cache_k.shape[1])[None, :] <= pos_b[:, None]  # [B, M]
     x = _attend_cached(x, q, cache_k, cache_v, valid, layer, cfg)
     return x, cache_k, cache_v
@@ -102,11 +108,18 @@ class StreamingGenerator:
         commit_every: int = 32,
         decode_prompt: Callable[[Record], np.ndarray] | None = None,
         max_poll_records: int = 512,
+        ticks_per_sync: int = 4,
     ) -> None:
+        """``ticks_per_sync``: decode ticks chained per device dispatch
+        (and per host sync of the done mask). Higher amortises dispatch
+        latency; the cost is completed slots idling up to K-1 ticks before
+        re-admission. 1 = immediate recycling (lowest latency hardware)."""
         if prompt_len + max_new > cfg.max_seq_len:
             raise ValueError("prompt_len + max_new exceeds cfg.max_seq_len")
         if max_new < 2:
             raise ValueError("max_new must be >= 2 (prefill emits token 0)")
+        if ticks_per_sync < 1:
+            raise ValueError("ticks_per_sync must be >= 1")
         self._consumer = consumer
         self._params = params
         self._cfg = cfg
@@ -117,6 +130,7 @@ class StreamingGenerator:
         self._commit_every = commit_every
         self._decode_prompt = decode_prompt or _default_decode_prompt(prompt_len)
         self._max_poll = max_poll_records
+        self._ticks_per_sync = ticks_per_sync
         self._ledger = OffsetLedger()
         self._max_len = prompt_len + max_new
         self._build()
@@ -140,46 +154,73 @@ class StreamingGenerator:
             gen = gen.at[:, 0].set(jnp.where(admit_mask, tok0, gen[:, 0]))
             return (ck, cv), last_tok, pos, gen
 
-        def tick(caches, last_tok, pos, gen, active):
-            """One decode step for all slots; inactive rows are frozen."""
-            x = params["embed"].astype(cfg.dtype)[last_tok][:, None, :]
+        K = self._ticks_per_sync
 
-            def body(x, inputs):
-                layer, ck, cv = inputs
-                x, ck, cv = _slot_layer_step(x, layer, ck, cv, pos, cfg)
-                return x, (ck, cv)
+        def tick_block(caches, last_tok, pos, gen, active_in):
+            """K chained decode ticks in ONE dispatch (static K), with a
+            LATCHED done mask: a slot that completes at inner tick j is
+            masked out of ticks j+1..K, so its output cannot be clobbered.
+            One host sync per K tokens — per-token syncing costs a full
+            host↔device round trip per generated token, which is the whole
+            serving budget on high-latency transports."""
 
-            x, (ck, cv) = lax.scan(body, x, (params["layers"], caches[0], caches[1]))
-            x = _rms_norm(x, params["ln_f"])
-            logits = jnp.einsum(
-                "bd,dv->bv", x[:, 0], params["lm_head"].astype(cfg.dtype),
-                preferred_element_type=jnp.float32,
-            )
-            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            # Inactive slots write stale kv at their frozen position — safe:
-            # re-admission overwrites [0, P) via prefill and every later
-            # position is rewritten by the tick that reaches it BEFORE the
-            # attention that could read it. Freezing the caches with a
-            # jnp.where would copy the whole pool every token instead.
-            t = pos - P  # decode ticks completed before this one, per slot
-            idx = jnp.minimum(t + 1, self._max_new - 1)
-            gen = gen.at[jnp.arange(B), idx].set(
-                jnp.where(active, tok, gen[jnp.arange(B), idx])
-            )
-            hit_eos = (
-                (tok == self._eos_id) if self._eos_id is not None
-                else jnp.zeros_like(active)
-            )
-            # Tokens generated after this tick = t + 2 (prefill's token 0
-            # plus t+1 decode outputs); complete on EOS or a full buffer.
-            done = active & (hit_eos | (t + 2 >= self._max_new))
-            pos = jnp.where(active & ~done, pos + 1, pos)
-            last_tok = jnp.where(active, tok, last_tok)
-            n_out = jnp.where(done, jnp.minimum(t + 2, self._max_new), 0)
-            return (ck, cv), last_tok, pos, gen, done, n_out
+            def one(carry, _):
+                caches, last_tok, pos, gen, done_latch, n_out = carry
+                act = active_in & ~done_latch
+                x = params["embed"].astype(cfg.dtype)[last_tok][:, None, :]
 
-        self._admit_fn = jax.jit(admit)
-        self._tick_fn = jax.jit(tick)
+                def body(x, inputs):
+                    layer, ck, cv = inputs
+                    x, ck, cv = _slot_layer_step(x, layer, ck, cv, pos, cfg)
+                    return x, (ck, cv)
+
+                x, (ck, cv) = lax.scan(
+                    body, x, (params["layers"], caches[0], caches[1])
+                )
+                caches = (ck, cv)
+                x = _rms_norm(x, params["ln_f"])
+                logits = jnp.einsum(
+                    "bd,dv->bv", x[:, 0], params["lm_head"].astype(cfg.dtype),
+                    preferred_element_type=jnp.float32,
+                )
+                tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                # Inactive slots write stale kv at their frozen position —
+                # safe: re-admission overwrites [0, P) via prefill and every
+                # later position is rewritten by the tick that reaches it
+                # BEFORE the attention that could read it. Freezing the
+                # caches with a jnp.where would copy the pool every token.
+                t = pos - P  # decode ticks completed before this one
+                idx = jnp.minimum(t + 1, self._max_new - 1)
+                gen = gen.at[jnp.arange(B), idx].set(
+                    jnp.where(act, tok, gen[jnp.arange(B), idx])
+                )
+                hit_eos = (
+                    (tok == self._eos_id) if self._eos_id is not None
+                    else jnp.zeros_like(act)
+                )
+                # Tokens after this tick = t + 2 (prefill's token 0 plus
+                # t+1 decode outputs); complete on EOS or a full buffer.
+                done_now = act & (hit_eos | (t + 2 >= self._max_new))
+                pos = jnp.where(act & ~done_now, pos + 1, pos)
+                last_tok = jnp.where(act, tok, last_tok)
+                n_out = jnp.where(
+                    done_now, jnp.minimum(t + 2, self._max_new), n_out
+                )
+                done_latch = done_latch | done_now
+                return (caches, last_tok, pos, gen, done_latch, n_out), None
+
+            done0 = jnp.zeros((B,), bool)
+            n0 = jnp.zeros((B,), jnp.int32)
+            (caches, last_tok, pos, gen, done, n_out), _ = lax.scan(
+                one, (caches, last_tok, pos, gen, done0, n0), None, length=K
+            )
+            return caches, last_tok, pos, gen, done, n_out
+
+        # Donate the cache pool: admit/tick rebuild it every call, and
+        # without donation each dispatch copies the full [L, B, M, K, Dh]
+        # pair. The run loop rebinds the returned buffers immediately.
+        self._admit_fn = jax.jit(admit, donate_argnums=(0,))
+        self._tick_fn = jax.jit(tick_block, donate_argnums=(0,))
         self._caches = (
             jnp.zeros((nl, B, M, kh, dh), cfg.dtype),
             jnp.zeros((nl, B, M, kh, dh), cfg.dtype),
@@ -187,6 +228,23 @@ class StreamingGenerator:
         self._last_tok = jnp.zeros((B,), jnp.int32)
         self._pos = jnp.zeros((B,), jnp.int32)
         self._gen = jnp.zeros((B, self._max_new), jnp.int32)
+
+    def warmup(self) -> None:
+        """Compile the admit and decode programs (no-op inputs) so the
+        first real generation doesn't pay XLA compilation; on remote-compile
+        transports that is minutes, not milliseconds. The no-op admit
+        (all-False mask) leaves the slot state semantically unchanged."""
+        B = self._slots
+        none = jnp.zeros((B,), bool)
+        self._caches, self._last_tok, self._pos, self._gen = self._admit_fn(
+            self._caches, self._last_tok, self._pos, self._gen,
+            jnp.zeros((B, self._prompt_len), jnp.int32), none,
+        )
+        out = self._tick_fn(
+            self._caches, self._last_tok, self._pos, self._gen, none
+        )
+        self._caches, self._last_tok, self._pos, self._gen = out[:4]
+        jax.device_get(out[4])
 
     def run(
         self, max_records: int | None = None, idle_timeout_ms: int = 2000
@@ -264,10 +322,11 @@ class StreamingGenerator:
             caches, last_tok, pos, gen, done, n_out = self._tick_fn(
                 caches, last_tok, pos, gen, jnp.asarray(active)
             )
-            done_h = np.asarray(done)
+            # ONE host sync per tick block: done/n_out/gen fetched together
+            # (separate np.asarray calls are separate round trips on
+            # high-latency transports).
+            done_h, n_out_h, gen_h = jax.device_get((done, n_out, gen))
             if done_h.any():
-                n_out_h = np.asarray(n_out)
-                gen_h = np.asarray(gen)
                 for i in np.nonzero(done_h)[0]:
                     rec = slot_rec[i]
                     assert rec is not None
