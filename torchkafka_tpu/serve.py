@@ -191,9 +191,10 @@ class StreamingGenerator:
                 # caches with a jnp.where would copy the pool every token.
                 t = pos - P  # decode ticks completed before this one
                 idx = jnp.minimum(t + 1, self._max_new - 1)
-                gen = gen.at[jnp.arange(B), idx].set(
-                    jnp.where(act, tok, gen[jnp.arange(B), idx])
-                )
+                # One-hot select, not .at[rows, idx].set: TPU scatter
+                # lowering costs ~2 ms even on this [B, max_new] buffer.
+                onehot = jnp.arange(self._max_new)[None, :] == idx[:, None]
+                gen = jnp.where(onehot & act[:, None], tok[:, None], gen)
                 hit_eos = (
                     (tok == self._eos_id) if self._eos_id is not None
                     else jnp.zeros_like(act)
