@@ -190,6 +190,43 @@ class TestStreamingGenerator:
         assert broker.committed("g5", tk.TopicPartition("p", 0)) == 3
         consumer.close()
 
+    def test_commit_failure_survivable(self, model, caplog):
+        """A rebalance mid-serving (second consumer joins the group) makes
+        the next commit raise CommitFailedError — the server must log and
+        continue, not die: uncommitted prompts simply re-deliver
+        (the reference's contract, kafka_dataset.py:131-135)."""
+        import logging
+
+        caplog.set_level(logging.ERROR, logger="torchkafka_tpu.serve")
+        cfg, params = model
+        broker = tk.InMemoryBroker()
+        broker.create_topic("p", partitions=1)
+        rng = np.random.default_rng(3)
+        for _ in range(4):
+            broker.produce(
+                "p", rng.integers(0, VOCAB, P, dtype=np.int32).tobytes()
+            )
+        c1 = tk.MemoryConsumer(broker, "p", group_id="gr")
+        server = StreamingGenerator(
+            c1, params, cfg, slots=2, prompt_len=P, max_new=MAX_NEW,
+            commit_every=1,
+        )
+        outs = []
+        c2 = None
+        for rec, _toks in server.run(max_records=4, idle_timeout_ms=500):
+            outs.append((rec.partition, rec.offset))
+            if c2 is None:
+                # Join the group mid-serving: bumps the generation, so the
+                # server's next commit (stale generation) must fail.
+                c2 = tk.MemoryConsumer(broker, "p", group_id="gr")
+        assert len(outs) >= 2  # served past the failed commit without dying
+        assert any(
+            "commit failed" in r.message for r in caplog.records
+        ), "rebalance never failed a commit: test is vacuous"
+        c1.close()
+        if c2 is not None:
+            c2.close()
+
     def test_rejects_bad_config(self, model):
         cfg, params = model
         consumer = object()

@@ -36,6 +36,7 @@ from jax import lax
 _logger = logging.getLogger(__name__)
 
 from torchkafka_tpu.commit.ledger import OffsetLedger
+from torchkafka_tpu.errors import CommitFailedError
 from torchkafka_tpu.models.generate import _attend_cached, _project_qkv, prefill
 from torchkafka_tpu.models.transformer import TransformerConfig, _rms_norm
 from torchkafka_tpu.source.records import Record
@@ -338,9 +339,19 @@ class StreamingGenerator:
                     uncommitted += 1
                     yield rec, gen_h[i, : n_out_h[i]].copy()
                 if uncommitted >= self._commit_every:
-                    self._consumer.commit(self._ledger.snapshot())
+                    self._commit()
                     uncommitted = 0
                 if max_records is not None and served >= max_records and not active.any():
                     break
         if uncommitted:
+            self._commit()
+
+    def _commit(self) -> None:
+        """Commit the ledger watermark; commit failure is survivable (the
+        reference's contract, /root/reference/src/kafka_dataset.py:131-135):
+        a rebalance raises CommitFailedError and the moved partitions'
+        uncommitted prompts simply re-deliver to their new owner."""
+        try:
             self._consumer.commit(self._ledger.snapshot())
+        except CommitFailedError:
+            _logger.exception("offset commit failed; prompts will re-deliver")
