@@ -227,6 +227,40 @@ class TestStreamingGenerator:
         if c2 is not None:
             c2.close()
 
+    def test_tp_sharded_params(self, model):
+        """Serving with tensor-parallel-sharded params: the server's jitted
+        admit/decode respect the params' committed shardings (GSPMD inserts
+        the collectives) — no server changes needed, outputs token-exact."""
+        from torchkafka_tpu.models.transformer import (
+            init_params, param_specs, shardings_for_mesh,
+        )
+        from torchkafka_tpu.parallel import make_mesh
+
+        # n_kv_heads=2 so the kv projections divide over tp=2 (the shared
+        # fixture uses 1 kv head, which cannot shard).
+        cfg = TransformerConfig(
+            vocab_size=VOCAB, d_model=32, n_layers=2, n_heads=2, n_kv_heads=2,
+            d_ff=64, max_seq_len=P + MAX_NEW, dtype=jnp.float32,
+        )
+        params = init_params(jax.random.key(0), cfg)
+        mesh = make_mesh({"data": 4, "tp": 2})
+        shardings = shardings_for_mesh(mesh, param_specs(cfg))
+        sharded = jax.device_put(params, shardings)
+        broker = tk.InMemoryBroker()
+        prompts = _topic(broker, 6)
+        consumer = tk.MemoryConsumer(broker, "p", group_id="gtp")
+        server = StreamingGenerator(
+            consumer, sharded, cfg, slots=2, prompt_len=P, max_new=MAX_NEW
+        )
+        expected = _expected(cfg, params, prompts)
+        seen = 0
+        for rec, toks in server.run(max_records=6):
+            idx = 2 * rec.offset + rec.partition
+            np.testing.assert_array_equal(toks, expected[idx], err_msg=f"prompt {idx}")
+            seen += 1
+        assert seen == 6
+        consumer.close()
+
     def test_rejects_bad_config(self, model):
         cfg, params = model
         consumer = object()
