@@ -60,6 +60,10 @@ class TransformerConfig:
     # 'dense' | 'flash' | 'ring' | 'auto': auto picks ring when the mesh has
     # sp>1, else the Pallas flash kernel on TPU, else dense XLA.
     attn_impl: str = "auto"
+    # Ring steps over the Pallas flash kernels: None = on TPU when the
+    # shard tiles; True forces (tests/dryruns exercise the kernels in
+    # interpret mode off-TPU); False forces the dense blockwise body.
+    ring_use_flash: bool | None = None
     # Mixture-of-experts MLP: 0 = dense SwiGLU; >0 = that many experts with
     # top-k routing, expert weights sharded over the mesh's 'ep' axis.
     n_experts: int = 0
@@ -282,7 +286,10 @@ class Transformer:
 
     def _attention(self, q, k, v):
         if self._use_ring:
-            return ring_attention(q, k, v, mesh=self.mesh, axis_name="sp", causal=True)
+            return ring_attention(
+                q, k, v, mesh=self.mesh, axis_name="sp", causal=True,
+                use_flash=self.cfg.ring_use_flash,
+            )
         if self._use_flash:
             from torchkafka_tpu.ops.flash import flash_attention
 
