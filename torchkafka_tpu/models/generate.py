@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from torchkafka_tpu.models.quant import embed_rows, load_weight
 from torchkafka_tpu.models.transformer import (
     Transformer,
     TransformerConfig,
@@ -61,22 +62,22 @@ def _attend_cached(x, q, cache_k, cache_v, valid, layer, cfg):
         "bhsm,bmhe->bshe", probs.astype(cfg.dtype), vv.astype(cfg.dtype),
         preferred_element_type=jnp.float32,
     ).astype(cfg.dtype)
-    x = x + jnp.einsum("bshe,hed->bsd", attn, layer["wo"].astype(cfg.dtype))
+    x = x + jnp.einsum("bshe,hed->bsd", attn, load_weight(layer["wo"], cfg.dtype))
     h = _rms_norm(x, layer["ln2"])
     if cfg.is_moe:
         mlp_out, _aux = _moe_mlp(h, layer, cfg)
         return x + mlp_out
-    gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", h, layer["w_gate"].astype(cfg.dtype)))
-    up = jnp.einsum("bsd,df->bsf", h, layer["w_up"].astype(cfg.dtype))
-    return x + jnp.einsum("bsf,fd->bsd", gate * up, layer["w_down"].astype(cfg.dtype))
+    gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", h, load_weight(layer["w_gate"], cfg.dtype)))
+    up = jnp.einsum("bsd,df->bsf", h, load_weight(layer["w_up"], cfg.dtype))
+    return x + jnp.einsum("bsf,fd->bsd", gate * up, load_weight(layer["w_down"], cfg.dtype))
 
 
 def _project_qkv(x, layer, cfg):
     """RMSNorm + q/k/v projections for one decode token. x: [B, 1, D]."""
     h = _rms_norm(x, layer["ln1"])
-    q = jnp.einsum("bsd,dhe->bshe", h, layer["wq"].astype(cfg.dtype))
-    k = jnp.einsum("bsd,dke->bske", h, layer["wk"].astype(cfg.dtype))
-    v = jnp.einsum("bsd,dke->bske", h, layer["wv"].astype(cfg.dtype))
+    q = jnp.einsum("bsd,dhe->bshe", h, load_weight(layer["wq"], cfg.dtype))
+    k = jnp.einsum("bsd,dke->bske", h, load_weight(layer["wk"], cfg.dtype))
+    v = jnp.einsum("bsd,dke->bske", h, load_weight(layer["wv"], cfg.dtype))
     return q, k, v
 
 
@@ -103,14 +104,14 @@ def prefill(params, cfg: TransformerConfig, tokens: jax.Array, max_len: int):
     """
     model = Transformer(cfg)
     batch, seq = tokens.shape
-    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = embed_rows(params["embed"], tokens, cfg.dtype)
     positions = jnp.arange(seq)
 
     def capture(x, layer):
         # Same math as Transformer._layer, but returns k/v for the cache.
         h = _rms_norm(x, layer["ln1"])
-        k = jnp.einsum("bsd,dke->bske", h, layer["wk"].astype(cfg.dtype))
-        v = jnp.einsum("bsd,dke->bske", h, layer["wv"].astype(cfg.dtype))
+        k = jnp.einsum("bsd,dke->bske", h, load_weight(layer["wk"], cfg.dtype))
+        v = jnp.einsum("bsd,dke->bske", h, load_weight(layer["wv"], cfg.dtype))
         k = _rope(k, positions, cfg.rope_theta)
         x, _aux = model._layer(x, layer)
         return x, (k, v)
@@ -118,7 +119,7 @@ def prefill(params, cfg: TransformerConfig, tokens: jax.Array, max_len: int):
     x, (ks, vs) = lax.scan(capture, x, params["layers"])
     x = _rms_norm(x, params["ln_f"])
     logits = jnp.einsum(
-        "bd,dv->bv", x[:, -1], params["lm_head"].astype(cfg.dtype),
+        "bd,dv->bv", x[:, -1], load_weight(params["lm_head"], cfg.dtype),
         preferred_element_type=jnp.float32,
     )
     nl, kh, dh = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
@@ -131,7 +132,7 @@ def prefill(params, cfg: TransformerConfig, tokens: jax.Array, max_len: int):
 
 def _decode_one(params, cfg, cache: KVCache, token: jax.Array, pos: jax.Array):
     """token: [B] → logits [B, V], updated cache. pos: scalar position."""
-    x = params["embed"].astype(cfg.dtype)[token][:, None, :]  # [B,1,D]
+    x = embed_rows(params["embed"], token, cfg.dtype)[:, None, :]  # [B,1,D]
 
     def body(x, inputs):
         layer, ck, cv = inputs
@@ -141,7 +142,7 @@ def _decode_one(params, cfg, cache: KVCache, token: jax.Array, pos: jax.Array):
     x, (ck, cv) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
     x = _rms_norm(x, params["ln_f"])
     logits = jnp.einsum(
-        "bd,dv->bv", x[:, 0], params["lm_head"].astype(cfg.dtype),
+        "bd,dv->bv", x[:, 0], load_weight(params["lm_head"], cfg.dtype),
         preferred_element_type=jnp.float32,
     )
     return logits, KVCache(ck, cv)

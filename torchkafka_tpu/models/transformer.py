@@ -41,6 +41,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from torchkafka_tpu.models.quant import embed_rows, load_weight
 from torchkafka_tpu.ops.attention import mha, ring_attention
 
 
@@ -232,11 +233,11 @@ def _moe_mlp(
         axis=2,
     )  # [B,S,E] — gate weight per (token, expert), 0 if not routed
     gate_e = jax.nn.silu(
-        jnp.einsum("bsd,edf->ebsf", h, layer["w_gate"].astype(cfg.dtype))
+        jnp.einsum("bsd,edf->ebsf", h, load_weight(layer["w_gate"], cfg.dtype))
     )
-    up_e = jnp.einsum("bsd,edf->ebsf", h, layer["w_up"].astype(cfg.dtype))
+    up_e = jnp.einsum("bsd,edf->ebsf", h, load_weight(layer["w_up"], cfg.dtype))
     out_e = jnp.einsum(
-        "ebsf,efd->ebsd", gate_e * up_e, layer["w_down"].astype(cfg.dtype)
+        "ebsf,efd->ebsd", gate_e * up_e, load_weight(layer["w_down"], cfg.dtype)
     )
     out = jnp.einsum("ebsd,bse->bsd", out_e, combine.astype(cfg.dtype))
     # Switch-style load balance: E * Σ_e (token fraction on e) * (mean prob e).
@@ -318,9 +319,9 @@ class Transformer:
         cfg = self.cfg
         positions = self._seq_positions(x.shape[1])
         h = _rms_norm(x, layer["ln1"])
-        q = jnp.einsum("bsd,dhe->bshe", h, layer["wq"].astype(cfg.dtype))
-        k = jnp.einsum("bsd,dke->bske", h, layer["wk"].astype(cfg.dtype))
-        v = jnp.einsum("bsd,dke->bske", h, layer["wv"].astype(cfg.dtype))
+        q = jnp.einsum("bsd,dhe->bshe", h, load_weight(layer["wq"], cfg.dtype))
+        k = jnp.einsum("bsd,dke->bske", h, load_weight(layer["wk"], cfg.dtype))
+        v = jnp.einsum("bsd,dke->bske", h, load_weight(layer["wv"], cfg.dtype))
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
         if cfg.n_kv_heads != cfg.n_heads:  # GQA: repeat kv heads
@@ -328,14 +329,14 @@ class Transformer:
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
         attn = self._attention(q, k, v)
-        x = x + jnp.einsum("bshe,hed->bsd", attn, layer["wo"].astype(cfg.dtype))
+        x = x + jnp.einsum("bshe,hed->bsd", attn, load_weight(layer["wo"], cfg.dtype))
         h = _rms_norm(x, layer["ln2"])
         if cfg.is_moe:
             mlp_out, aux = self._moe_mlp(h, layer)
             return x + mlp_out, aux
-        gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", h, layer["w_gate"].astype(cfg.dtype)))
-        up = jnp.einsum("bsd,df->bsf", h, layer["w_up"].astype(cfg.dtype))
-        x = x + jnp.einsum("bsf,fd->bsd", gate * up, layer["w_down"].astype(cfg.dtype))
+        gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", h, load_weight(layer["w_gate"], cfg.dtype)))
+        up = jnp.einsum("bsd,df->bsf", h, load_weight(layer["w_up"], cfg.dtype))
+        x = x + jnp.einsum("bsf,fd->bsd", gate * up, load_weight(layer["w_down"], cfg.dtype))
         return x, jnp.float32(0.0)
 
     def __call__(
@@ -344,7 +345,7 @@ class Transformer:
         """tokens [B, S] int32 → logits [B, S, V] float32 (and, with
         ``return_aux``, the mean per-layer router load-balance loss)."""
         cfg = self.cfg
-        x = params["embed"].astype(cfg.dtype)[tokens]
+        x = embed_rows(params["embed"], tokens, cfg.dtype)
 
         if self.mesh is not None and self.mesh.shape.get("pp", 1) > 1:
             # GPipe over the stacked layers; embed/head/norm stay outside the
@@ -381,7 +382,7 @@ class Transformer:
             x, auxes = lax.scan(body, x, params["layers"])
         x = _rms_norm(x, params["ln_f"])
         logits = jnp.einsum(
-            "bsd,dv->bsv", x, params["lm_head"].astype(cfg.dtype),
+            "bsd,dv->bsv", x, load_weight(params["lm_head"], cfg.dtype),
             preferred_element_type=jnp.float32,
         )
         if return_aux:

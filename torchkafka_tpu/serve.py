@@ -38,6 +38,7 @@ _logger = logging.getLogger(__name__)
 from torchkafka_tpu.commit.ledger import OffsetLedger
 from torchkafka_tpu.errors import CommitFailedError
 from torchkafka_tpu.models.generate import _attend_cached, _project_qkv, prefill
+from torchkafka_tpu.models.quant import embed_rows, load_weight
 from torchkafka_tpu.models.transformer import TransformerConfig, _rms_norm
 from torchkafka_tpu.source.records import Record
 
@@ -168,7 +169,7 @@ class StreamingGenerator:
             def one(carry, _):
                 caches, last_tok, pos, gen, done_latch, n_out = carry
                 act = active_in & ~done_latch
-                x = params["embed"].astype(cfg.dtype)[last_tok][:, None, :]
+                x = embed_rows(params["embed"], last_tok, cfg.dtype)[:, None, :]
 
                 def body(x, inputs):
                     layer, ck, cv = inputs
@@ -181,7 +182,7 @@ class StreamingGenerator:
                 caches = (ck, cv)
                 x = _rms_norm(x, params["ln_f"])
                 logits = jnp.einsum(
-                    "bd,dv->bv", x[:, 0], params["lm_head"].astype(cfg.dtype),
+                    "bd,dv->bv", x[:, 0], load_weight(params["lm_head"], cfg.dtype),
                     preferred_element_type=jnp.float32,
                 )
                 tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
