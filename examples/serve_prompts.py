@@ -84,7 +84,8 @@ def main() -> int:
     )
     print(
         f"\n{args.prompts} completions, {toks} tokens in {dt:.2f}s "
-        f"({toks / dt:,.0f} tok/s); {committed} offsets committed",
+        f"({toks / dt:,.0f} tok/s); {committed} offsets committed\n"
+        f"metrics: {server.metrics.summary()}",
         file=sys.stderr,
     )
     consumer.close()

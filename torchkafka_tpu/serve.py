@@ -41,6 +41,32 @@ from torchkafka_tpu.models.generate import _attend_cached, _project_qkv, prefill
 from torchkafka_tpu.models.quant import embed_rows, load_weight
 from torchkafka_tpu.models.transformer import TransformerConfig, _rms_norm
 from torchkafka_tpu.source.records import Record
+from torchkafka_tpu.utils.metrics import Gauge, RateMeter
+
+
+class ServeMetrics:
+    """Observability for the serving loop, mirroring StreamMetrics'
+    shape (utils/metrics.py) so dashboards treat both uniformly."""
+
+    def __init__(self) -> None:
+        self.completions = RateMeter()
+        self.tokens = RateMeter()
+        self.truncated = RateMeter()  # stopped by EOS before max_new
+        self.dropped = RateMeter()  # undecodable prompts retired
+        self.commit_failures = RateMeter()
+        self.slot_occupancy = Gauge()  # active slots / pool size, last tick
+
+    def summary(self) -> dict:
+        return {
+            "completions": self.completions.count,
+            "completions_per_s": self.completions.rate(),
+            "tokens": self.tokens.count,
+            "tokens_per_s": self.tokens.rate(),
+            "truncated_by_eos": self.truncated.count,
+            "dropped": self.dropped.count,
+            "commit_failures": self.commit_failures.count,
+            "slot_occupancy": round(self.slot_occupancy.value, 3),
+        }
 
 
 def _rope_rows(x: jax.Array, pos_b: jax.Array, theta: float) -> jax.Array:
@@ -135,6 +161,7 @@ class StreamingGenerator:
         self._ticks_per_sync = ticks_per_sync
         self._ledger = OffsetLedger()
         self._max_len = prompt_len + max_new
+        self.metrics = ServeMetrics()
         self._build()
 
     def _build(self) -> None:
@@ -303,6 +330,7 @@ class StreamingGenerator:
                             rec.topic, rec.partition, rec.offset,
                         )
                         self._ledger.dropped(rec)
+                        self.metrics.dropped.add(1)
                         continue
                     slot_rec[i] = rec
                     admit_mask[i] = True
@@ -329,6 +357,7 @@ class StreamingGenerator:
             # (separate np.asarray calls are separate round trips on
             # high-latency transports).
             done_h, n_out_h, gen_h = jax.device_get((done, n_out, gen))
+            self.metrics.slot_occupancy.set(float(active.mean()))
             if done_h.any():
                 for i in np.nonzero(done_h)[0]:
                     rec = slot_rec[i]
@@ -338,7 +367,12 @@ class StreamingGenerator:
                     slot_rec[i] = None
                     served += 1
                     uncommitted += 1
-                    yield rec, gen_h[i, : n_out_h[i]].copy()
+                    out = gen_h[i, : n_out_h[i]].copy()
+                    self.metrics.completions.add(1)
+                    self.metrics.tokens.add(len(out))
+                    if len(out) < self._max_new:
+                        self.metrics.truncated.add(1)
+                    yield rec, out
                 if uncommitted >= self._commit_every:
                     self._commit()
                     uncommitted = 0
@@ -355,4 +389,5 @@ class StreamingGenerator:
         try:
             self._consumer.commit(self._ledger.snapshot())
         except CommitFailedError:
+            self.metrics.commit_failures.add(1)
             _logger.exception("offset commit failed; prompts will re-deliver")
