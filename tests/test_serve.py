@@ -261,6 +261,29 @@ class TestStreamingGenerator:
         assert seen == 6
         consumer.close()
 
+    def test_moe_serving(self, rng):
+        """The decode tail routes through _moe_mlp for MoE configs — the
+        slot server must generate and commit with an expert-MLP model."""
+        cfg = TransformerConfig(
+            vocab_size=VOCAB, d_model=32, n_layers=2, n_heads=2, n_kv_heads=1,
+            d_ff=64, max_seq_len=P + MAX_NEW, dtype=jnp.float32, n_experts=4,
+        )
+        params = init_params(jax.random.key(2), cfg)
+        broker = tk.InMemoryBroker()
+        prompts = _topic(broker, 4)
+        consumer = tk.MemoryConsumer(broker, "p", group_id="gmoe")
+        server = StreamingGenerator(
+            consumer, params, cfg, slots=2, prompt_len=P, max_new=MAX_NEW
+        )
+        expected = _expected(cfg, params, prompts)
+        seen = 0
+        for rec, toks in server.run(max_records=4):
+            idx = 2 * rec.offset + rec.partition
+            np.testing.assert_array_equal(toks, expected[idx], err_msg=f"prompt {idx}")
+            seen += 1
+        assert seen == 4
+        consumer.close()
+
     def test_rejects_bad_config(self, model):
         cfg, params = model
         consumer = object()
