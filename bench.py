@@ -240,14 +240,38 @@ def probe_wire_mb_s() -> float:
     return float(np.median(rates))
 
 
+def _trials(fn, n: int, label: str) -> list[float]:
+    """Run up to ``n`` trials, tolerating transient failures (the tunneled
+    device transport occasionally drops a remote-compile or transfer);
+    at least one trial must succeed or the bench legitimately fails."""
+    out: list[float] = []
+    failures = 0
+    while len(out) < n and failures < n + 2:
+        try:
+            out.append(fn())
+        except Exception as e:  # noqa: BLE001 - transient transport errors
+            failures += 1
+            print(f"{label} trial failed ({e!r}); retrying", file=sys.stderr)
+            time.sleep(5)
+    if not out:
+        raise RuntimeError(f"all {label} trials failed")
+    return sorted(out)
+
+
 def main() -> None:
     trials = int(os.environ.get("BENCH_TRIALS", "5"))
     # Headline = MEDIAN over trials (robust to scheduler noise on this shared
     # box without crediting the best outlier); best and spread reported
     # alongside so the distribution is visible.
-    wire = probe_wire_mb_s()
-    ours_all = sorted(bench_ours(N_OURS) for _ in range(trials))
-    base_all = sorted(bench_reference_pattern(N_BASE) for _ in range(trials))
+    try:
+        wire = probe_wire_mb_s()
+    except Exception as e:  # noqa: BLE001
+        print(f"wire probe failed ({e!r})", file=sys.stderr)
+        wire = -1.0
+    ours_all = _trials(lambda: bench_ours(N_OURS), trials, "ours")
+    base_all = _trials(
+        lambda: bench_reference_pattern(N_BASE), trials, "reference-pattern"
+    )
     ours = float(np.median(ours_all))
     base = float(np.median(base_all))
     print(
