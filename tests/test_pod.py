@@ -103,32 +103,35 @@ def _diagnose(procs: list[subprocess.Popen], outdir: str) -> str:
 
 @pytest.mark.slow
 class TestPodCommit:
-    def test_two_process_stream_step_barrier_commit(self, tmp_path):
-        """Happy path: 2 jax.distributed processes, 4 global batches each
-        assembled via make_array_from_process_local_data, a jit'd cross-host
-        reduction, and a sync_global_devices-backed commit per batch."""
-        procs = _spawn_pod(2, str(tmp_path), "happy")
-        codes = _wait_all(procs, str(tmp_path), timeout_s=300)
-        assert codes == [0, 0], _diagnose(procs, str(tmp_path))
+    @pytest.mark.parametrize("nproc", [2, 4])
+    def test_pod_stream_step_barrier_commit(self, tmp_path, nproc):
+        """Happy path: N jax.distributed processes (2N devices), 4 global
+        batches each assembled via make_array_from_process_local_data, a
+        jit'd cross-host reduction, and a sync_global_devices-backed commit
+        per batch."""
+        procs = _spawn_pod(nproc, str(tmp_path), "happy")
+        codes = _wait_all(procs, str(tmp_path), timeout_s=420)
+        assert codes == [0] * nproc, _diagnose(procs, str(tmp_path))
 
-        done0 = _read(str(tmp_path), "done", 0)
-        done1 = _read(str(tmp_path), "done", 1)
-        assert done0 and done1
-        assert done0["batches"] == 4 and done1["batches"] == 4
+        dones = [_read(str(tmp_path), "done", pid) for pid in range(nproc)]
+        assert all(dones)
+        assert all(d["batches"] == 4 for d in dones)
         # The jit'd sum ran over the GLOBAL array: every process must see the
         # identical losses (a cross-host psum agreed on), and their total must
-        # be the GLOBAL sum over both hosts' records (rows carry
+        # be the GLOBAL sum over all hosts' records (rows carry
         # pid*1000 + idx, so a host summing only its local 16-row shard
         # produces a number this equation rejects).
-        assert done0["losses"] == done1["losses"]
-        assert len(done0["losses"]) == 4
+        assert all(d["losses"] == dones[0]["losses"] for d in dones)
+        assert len(dones[0]["losses"]) == 4
         expected_total = 8.0 * sum(
-            pid * 1000 + i for pid in (0, 1) for i in range(RECORDS_PER_PROCESS)
+            pid * 1000 + i
+            for pid in range(nproc)
+            for i in range(RECORDS_PER_PROCESS)
         )
-        assert sum(done0["losses"]) == expected_total
+        assert sum(dones[0]["losses"]) == expected_total
 
         # Commits are durable and cover exactly the emitted batches.
-        for pid in (0, 1):
+        for pid in range(nproc):
             committed = _read(str(tmp_path), "committed", pid)["batches"]
             assert len(committed) == 4
             final = {TopicPartition(t, p): off for t, p, off in committed[-1]}
