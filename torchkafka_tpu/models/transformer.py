@@ -324,7 +324,10 @@ class Transformer:
         v = jnp.einsum("bsd,dke->bske", h, load_weight(layer["wv"], cfg.dtype))
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
-        if cfg.n_kv_heads != cfg.n_heads:  # GQA: repeat kv heads
+        if cfg.n_kv_heads != cfg.n_heads and not self._use_flash:
+            # GQA: dense/ring paths need explicit head repeat; the flash
+            # kernels serve K < H through their kv index map instead of
+            # materialising H/K× the kv bytes in HBM.
             rep = cfg.n_heads // cfg.n_kv_heads
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)

@@ -136,16 +136,31 @@ def _offsets(q_offset, k_offset):
     )
 
 
+def _kv_index(n_q_heads: int, n_kv_heads: int):
+    """Grid-row → kv array row for grouped-query attention.
+
+    q rows are laid out [batch·H + h]; the matching kv row is
+    [batch·K + h // (H/K)]. With H == K this is the identity. Computed in
+    the BlockSpec index map, so the kernel reads the SMALL kv tensors
+    directly — no jnp.repeat materialising H/K× the kv bytes in HBM.
+    """
+    if n_q_heads == n_kv_heads:
+        return lambda b: b
+    rep = n_q_heads // n_kv_heads
+    return lambda b: (b // n_q_heads) * n_kv_heads + (b % n_q_heads) // rep
+
+
 def _flash_fwd_bhsd(
     q, k, v, *, causal: bool, block_q: int, block_k: int, interpret: bool,
-    q_offset=0, k_offset=0,
+    q_offset=0, k_offset=0, n_q_heads: int = 1, n_kv_heads: int = 1,
 ):
-    """q: [BH, Sq, D]; k,v: [BH, Sk, D] → ([BH, Sq, D], lse [BH, Sq, 1] f32).
+    """q: [B·H, Sq, D]; k,v: [B·K, Sk, D] → ([B·H, Sq, D], lse f32).
 
     ``q_offset``/``k_offset`` are the global positions of row 0 (traced i32
     scalars, SMEM) — this is what lets the same kernel serve the single-chip
     path (offsets 0) and one block step of ring attention (shard offsets),
-    mirroring ``mha``'s offset contract (attention.py).
+    mirroring ``mha``'s offset contract (attention.py). K < H (GQA) is
+    served by the kv index map, not by materialising repeated heads.
     """
     bh, sq, d = q.shape
     sk = k.shape[1]
@@ -156,6 +171,7 @@ def _flash_fwd_bhsd(
     )
     vmem = {} if _VMEM is None else {"memory_space": _VMEM}
     qoff, koff = _offsets(q_offset, k_offset)
+    kv = _kv_index(n_q_heads, n_kv_heads)
     return pl.pallas_call(
         kernel,
         out_shape=[
@@ -165,8 +181,8 @@ def _flash_fwd_bhsd(
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0), **vmem),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0), **vmem),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0), **vmem),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (kv(b), j, 0), **vmem),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (kv(b), j, 0), **vmem),
             _smem_spec(),
             _smem_spec(),
         ],
@@ -293,7 +309,8 @@ def _dkv_kernel(
 
 def _flash_bwd_bhsd(
     q, k, v, o, lse, do, *, causal: bool, block_q: int, block_k: int,
-    interpret: bool, q_offset=0, k_offset=0,
+    interpret: bool, q_offset=0, k_offset=0, n_q_heads: int = 1,
+    n_kv_heads: int = 1,
 ):
     """q,o,do [BH, Sq, D]; k,v [BH, Sk, D]; lse [BH, Sq, 1] →
     (dq [BH, Sq, D], dk, dv [BH, Sk, D])."""
@@ -307,6 +324,7 @@ def _flash_bwd_bhsd(
 
     vmem = {} if _VMEM is None else {"memory_space": _VMEM}
     qoff, koff = _offsets(q_offset, k_offset)
+    kv = _kv_index(n_q_heads, n_kv_heads)
 
     def qd(idx):
         return pl.BlockSpec((1, block_q, d), idx, **vmem)
@@ -325,8 +343,8 @@ def _flash_bwd_bhsd(
         grid=(bh, pl.cdiv(sq, block_q), pl.cdiv(sk, block_k)),
         in_specs=[
             qd(lambda b, i, j: (b, i, 0)),  # q
-            kd(lambda b, i, j: (b, j, 0)),  # k
-            kd(lambda b, i, j: (b, j, 0)),  # v
+            kd(lambda b, i, j: (kv(b), j, 0)),  # k
+            kd(lambda b, i, j: (kv(b), j, 0)),  # v
             qd(lambda b, i, j: (b, i, 0)),  # do
             col(lambda b, i, j: (b, i, 0)),  # lse
             col(lambda b, i, j: (b, i, 0)),  # delta
@@ -338,6 +356,8 @@ def _flash_bwd_bhsd(
         interpret=interpret,
     )(q, k, v, do, lse, delta, qoff, koff)
 
+    # dk/dv are written PER Q-HEAD (grid rows would race on a shared kv row
+    # otherwise); under GQA the caller group-sums the rep partials.
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k
@@ -349,8 +369,8 @@ def _flash_bwd_bhsd(
         grid=(bh, pl.cdiv(sk, block_k), pl.cdiv(sq, block_q)),
         in_specs=[
             qd(lambda b, j, i: (b, i, 0)),  # q
-            kd(lambda b, j, i: (b, j, 0)),  # k
-            kd(lambda b, j, i: (b, j, 0)),  # v
+            kd(lambda b, j, i: (kv(b), j, 0)),  # k
+            kd(lambda b, j, i: (kv(b), j, 0)),  # v
             qd(lambda b, j, i: (b, i, 0)),  # do
             col(lambda b, j, i: (b, i, 0)),  # lse
             col(lambda b, j, i: (b, i, 0)),  # delta
@@ -428,14 +448,23 @@ def _from_bhsd(x, b, h):
     return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
+def _repeat_kv(q, k, v):
+    rep = q.shape[2] // k.shape[2]
+    if rep == 1:
+        return k, v
+    return jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2)
+
+
 def _flash_impl(q, k, v, causal, block_q, block_k, interpret):
     b, s, h, d = q.shape
     block_q, block_k, interpret = _resolve(s, block_q, block_k, interpret)
     if not _supported(s, block_q, block_k):
-        return mha(q, k, v, causal=causal)
+        kk, vv = _repeat_kv(q, k, v)
+        return mha(q, kk, vv, causal=causal)
     out, _ = _flash_fwd_bhsd(
         _to_bhsd(q), _to_bhsd(k), _to_bhsd(v),
         causal=causal, block_q=block_q, block_k=block_k, interpret=interpret,
+        n_q_heads=h, n_kv_heads=k.shape[2],
     )
     return _from_bhsd(out, b, h)
 
@@ -445,10 +474,12 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
     block_q, block_k, interpret = _resolve(s, block_q, block_k, interpret)
     if not _supported(s, block_q, block_k):
         # Residuals (o=None, lse=None) route the backward to the dense vjp.
-        return mha(q, k, v, causal=causal), (q, k, v, None, None)
+        kk, vv = _repeat_kv(q, k, v)
+        return mha(q, kk, vv, causal=causal), (q, k, v, None, None)
     out, lse = _flash_fwd_bhsd(
         _to_bhsd(q), _to_bhsd(k), _to_bhsd(v),
         causal=causal, block_q=block_q, block_k=block_k, interpret=interpret,
+        n_q_heads=h, n_kv_heads=k.shape[2],
     )
     return _from_bhsd(out, b, h), (q, k, v, out, lse)
 
@@ -456,15 +487,31 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
 def _flash_bwd(causal, block_q, block_k, interpret, res, g):
     q, k, v, o_bhsd, lse = res
     if lse is None:  # untileable shape: dense fallback, matching the forward
-        _, vjp = jax.vjp(lambda q, k, v: mha(q, k, v, causal=causal), q, k, v)
+        def dense(q, k, v):
+            kk, vv = _repeat_kv(q, k, v)
+            return mha(q, kk, vv, causal=causal)
+
+        _, vjp = jax.vjp(dense, q, k, v)
         return vjp(g)
     b, s, h, d = q.shape
+    n_kv = k.shape[2]
     block_q, block_k, interpret = _resolve(s, block_q, block_k, interpret)
     dq, dk, dv = _flash_bwd_bhsd(
         _to_bhsd(q), _to_bhsd(k), _to_bhsd(v), o_bhsd, lse, _to_bhsd(g),
         causal=causal, block_q=block_q, block_k=block_k, interpret=interpret,
+        n_q_heads=h, n_kv_heads=n_kv,
     )
-    return _from_bhsd(dq, b, h), _from_bhsd(dk, b, h), _from_bhsd(dv, b, h)
+    if n_kv != h:
+        # dk/dv came back as per-q-head partials [B·H, S, D]: kv grads sum
+        # over each group of H/K consecutive q heads (the transpose of the
+        # kv broadcast), then land in [B, S, K, D] layout.
+        rep = h // n_kv
+        dk = dk.reshape(b, n_kv, rep, s, d).sum(axis=2).transpose(0, 2, 1, 3)
+        dv = dv.reshape(b, n_kv, rep, s, d).sum(axis=2).transpose(0, 2, 1, 3)
+    else:
+        dk = _from_bhsd(dk, b, n_kv)
+        dv = _from_bhsd(dv, b, n_kv)
+    return _from_bhsd(dq, b, h), dk, dv
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
