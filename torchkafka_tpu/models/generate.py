@@ -40,28 +40,34 @@ class KVCache(NamedTuple):
 
 
 def _attend_cached(x, q, cache_k, cache_v, valid, layer, cfg):
-    """Shared decode tail: GQA repeat over the cache, masked softmax
-    attention, output projection and the MLP residual. x: [B, 1, D];
+    """Shared decode tail: grouped-query attention over the kv cache,
+    masked softmax, output projection and the MLP residual. x: [B, 1, D];
     q: [B, 1, H, Dh]; caches [B, M, K, Dh]; valid: [B, M] or [M] bool mask
     of readable cache positions. Single source of truth for both the
     lockstep decode (scalar position, generate.py) and the continuous-
-    batching server's per-slot decode (serve.py)."""
-    kk, vv = cache_k, cache_v
-    if cfg.n_kv_heads != cfg.n_heads:
-        rep = cfg.n_heads // cfg.n_kv_heads
-        kk = jnp.repeat(kk, rep, axis=2)
-        vv = jnp.repeat(vv, rep, axis=2)
+    batching server's per-slot decode (serve.py).
+
+    GQA runs as a grouped einsum — q reshaped [B, S, K, rep, Dh] contracts
+    directly against the [B, M, K, Dh] cache. Decode is cache-bandwidth
+    bound, so never materialising a repeated H-head cache copy is the
+    difference between reading K heads and reading H heads per token."""
+    b, s, h, dh = q.shape
+    kk = cache_k.astype(cfg.dtype)
+    vv = cache_v.astype(cfg.dtype)
+    n_kv = kk.shape[2]
+    rep = h // n_kv
+    qg = q.reshape(b, s, n_kv, rep, dh)
     scores = jnp.einsum(
-        "bshe,bmhe->bhsm", q, kk.astype(cfg.dtype), preferred_element_type=jnp.float32
+        "bskre,bmke->bkrsm", qg, kk, preferred_element_type=jnp.float32
     ) / jnp.sqrt(jnp.float32(cfg.head_dim))
     if valid.ndim == 1:
         valid = valid[None, :]
-    scores = jnp.where(valid[:, None, None, :], scores, -1e30)
+    scores = jnp.where(valid[:, None, None, None, :], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
     attn = jnp.einsum(
-        "bhsm,bmhe->bshe", probs.astype(cfg.dtype), vv.astype(cfg.dtype),
+        "bkrsm,bmke->bskre", probs.astype(cfg.dtype), vv,
         preferred_element_type=jnp.float32,
-    ).astype(cfg.dtype)
+    ).astype(cfg.dtype).reshape(b, s, h, dh)
     x = x + jnp.einsum("bshe,hed->bsd", attn, load_weight(layer["wo"], cfg.dtype))
     h = _rms_norm(x, layer["ln2"])
     if cfg.is_moe:
