@@ -261,6 +261,34 @@ class TestStreamingGenerator:
         assert seen == 6
         consumer.close()
 
+    def test_temperature_sampling(self, model, rng):
+        """temperature > 0 samples per slot: the server completes and
+        commits, outputs are valid token ids, and two different rng keys
+        produce different continuations (same prompts)."""
+        cfg, params = model
+
+        def serve_with(key_seed):
+            broker = tk.InMemoryBroker()
+            _topic(broker, 4)
+            consumer = tk.MemoryConsumer(broker, "p", group_id=f"gt{key_seed}")
+            server = StreamingGenerator(
+                consumer, params, cfg, slots=2, prompt_len=P, max_new=MAX_NEW,
+                temperature=1.0, rng=jax.random.key(key_seed),
+            )
+            outs = {}
+            for rec, toks in server.run(max_records=4):
+                assert toks.min() >= 0 and toks.max() < VOCAB
+                outs[(rec.partition, rec.offset)] = toks
+            consumer.close()
+            return outs
+
+        a = serve_with(1)
+        b = serve_with(2)
+        assert len(a) == len(b) == 4
+        assert any(
+            not np.array_equal(a[k], b[k]) for k in a
+        ), "different rng keys produced identical samples"
+
     def test_moe_serving(self, rng):
         """The decode tail routes through _moe_mlp for MoE configs — the
         slot server must generate and commit with an expert-MLP model."""

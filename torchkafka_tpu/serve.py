@@ -137,11 +137,17 @@ class StreamingGenerator:
         decode_prompt: Callable[[Record], np.ndarray] | None = None,
         max_poll_records: int = 512,
         ticks_per_sync: int = 4,
+        temperature: float = 0.0,
+        rng: jax.Array | None = None,
     ) -> None:
         """``ticks_per_sync``: decode ticks chained per device dispatch
         (and per host sync of the done mask). Higher amortises dispatch
         latency; the cost is completed slots idling up to K-1 ticks before
-        re-admission. 1 = immediate recycling (lowest latency hardware)."""
+        re-admission. 1 = immediate recycling (lowest latency hardware).
+
+        ``temperature``: 0 = greedy (matches ``generate``'s default);
+        > 0 samples categorically per slot from logits/temperature, keyed
+        by ``rng`` (per-tick fold-in, deterministic for a fixed key)."""
         if prompt_len + max_new > cfg.max_seq_len:
             raise ValueError("prompt_len + max_new exceeds cfg.max_seq_len")
         if max_new < 2:
@@ -159,6 +165,8 @@ class StreamingGenerator:
         self._decode_prompt = decode_prompt or _default_decode_prompt(prompt_len)
         self._max_poll = max_poll_records
         self._ticks_per_sync = ticks_per_sync
+        self._temperature = float(temperature)
+        self._rng = jax.random.key(0) if rng is None else rng
         self._ledger = OffsetLedger()
         self._max_len = prompt_len + max_new
         self.metrics = ServeMetrics()
@@ -168,15 +176,23 @@ class StreamingGenerator:
         cfg, params = self._cfg, self._params
         B, P, M = self._slots, self._prompt_len, self._max_len
         nl, kh, dh = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+        temp = self._temperature
 
-        def admit(caches, last_tok, pos, gen, prompts, admit_mask):
+        def pick(logits, key):
+            if temp == 0.0:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return jax.random.categorical(key, logits / temp, axis=-1).astype(
+                jnp.int32
+            )
+
+        def admit(caches, last_tok, pos, gen, prompts, admit_mask, key):
             """Prefill the full [B, P] prompt batch; merge admitted rows in.
             prompts: [B, P] int32; admit_mask: [B] bool."""
             logits, fresh = prefill(params, cfg, prompts, M)
             sel = admit_mask[None, :, None, None, None]  # over [L, B, M, K, Dh]
             ck = jnp.where(sel, fresh.k, caches[0])
             cv = jnp.where(sel, fresh.v, caches[1])
-            tok0 = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B]
+            tok0 = pick(logits, key)  # [B]
             last_tok = jnp.where(admit_mask, tok0, last_tok)
             pos = jnp.where(admit_mask, P, pos)
             gen = jnp.where(admit_mask[:, None], 0, gen)
@@ -185,7 +201,7 @@ class StreamingGenerator:
 
         K = self._ticks_per_sync
 
-        def tick_block(caches, last_tok, pos, gen, active_in):
+        def tick_block(caches, last_tok, pos, gen, active_in, key):
             """K chained decode ticks in ONE dispatch (static K), with a
             LATCHED done mask: a slot that completes at inner tick j is
             masked out of ticks j+1..K, so its output cannot be clobbered.
@@ -194,7 +210,8 @@ class StreamingGenerator:
             serving budget on high-latency transports."""
 
             def one(carry, _):
-                caches, last_tok, pos, gen, done_latch, n_out = carry
+                caches, last_tok, pos, gen, done_latch, n_out, key = carry
+                key, sub = jax.random.split(key)
                 act = active_in & ~done_latch
                 x = embed_rows(params["embed"], last_tok, cfg.dtype)[:, None, :]
 
@@ -212,7 +229,7 @@ class StreamingGenerator:
                     "bd,dv->bv", x[:, 0], load_weight(params["lm_head"], cfg.dtype),
                     preferred_element_type=jnp.float32,
                 )
-                tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                tok = pick(logits, sub)
                 # Inactive slots write stale kv at their frozen position —
                 # safe: re-admission overwrites [0, P) via prefill and every
                 # later position is rewritten by the tick that reaches it
@@ -237,12 +254,12 @@ class StreamingGenerator:
                     done_now, jnp.minimum(t + 2, self._max_new), n_out
                 )
                 done_latch = done_latch | done_now
-                return (caches, last_tok, pos, gen, done_latch, n_out), None
+                return (caches, last_tok, pos, gen, done_latch, n_out, key), None
 
             done0 = jnp.zeros((B,), bool)
             n0 = jnp.zeros((B,), jnp.int32)
-            (caches, last_tok, pos, gen, done, n_out), _ = lax.scan(
-                one, (caches, last_tok, pos, gen, done0, n0), None, length=K
+            (caches, last_tok, pos, gen, done, n_out, _), _ = lax.scan(
+                one, (caches, last_tok, pos, gen, done0, n0, key), None, length=K
             )
             return caches, last_tok, pos, gen, done, n_out
 
@@ -266,12 +283,13 @@ class StreamingGenerator:
         (all-False mask) leaves the slot state semantically unchanged."""
         B = self._slots
         none = jnp.zeros((B,), bool)
+        key = jax.random.key(0)
         self._caches, self._last_tok, self._pos, self._gen = self._admit_fn(
             self._caches, self._last_tok, self._pos, self._gen,
-            jnp.zeros((B, self._prompt_len), jnp.int32), none,
+            jnp.zeros((B, self._prompt_len), jnp.int32), none, key,
         )
         out = self._tick_fn(
-            self._caches, self._last_tok, self._pos, self._gen, none
+            self._caches, self._last_tok, self._pos, self._gen, none, key
         )
         self._caches, self._last_tok, self._pos, self._gen = out[:4]
         jax.device_get(out[4])
@@ -337,9 +355,10 @@ class StreamingGenerator:
                     active[i] = True
                     budget -= 1
                 if admit_mask.any():
+                    self._rng, sub = jax.random.split(self._rng)
                     caches, last_tok, pos, gen = self._admit_fn(
                         caches, last_tok, pos, gen,
-                        jnp.asarray(prompts), jnp.asarray(admit_mask),
+                        jnp.asarray(prompts), jnp.asarray(admit_mask), sub,
                     )
             if not active.any():
                 if max_records is not None and served >= max_records:
@@ -350,8 +369,9 @@ class StreamingGenerator:
                     elif (time.monotonic() - exhausted_at) * 1000 >= idle_timeout_ms:
                         break
                 continue
+            self._rng, sub = jax.random.split(self._rng)
             caches, last_tok, pos, gen, done, n_out = self._tick_fn(
-                caches, last_tok, pos, gen, jnp.asarray(active)
+                caches, last_tok, pos, gen, jnp.asarray(active), sub
             )
             # ONE host sync per tick block: done/n_out/gen fetched together
             # (separate np.asarray calls are separate round trips on
