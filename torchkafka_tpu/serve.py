@@ -56,6 +56,15 @@ class ServeMetrics:
         self.commit_failures = RateMeter()
         self.slot_occupancy = Gauge()  # active slots / pool size, last tick
 
+    def reset(self) -> None:
+        """Zero the rate clocks — called at run() start so compile/warmup
+        time (minutes on remote-compile transports) doesn't dilute rates."""
+        for m in (
+            self.completions, self.tokens, self.truncated,
+            self.dropped, self.commit_failures,
+        ):
+            m.reset()
+
     def summary(self) -> dict:
         return {
             "completions": self.completions.count,
@@ -309,6 +318,7 @@ class StreamingGenerator:
         served = 0
         uncommitted = 0
         exhausted_at: float | None = None
+        self.metrics.reset()
         while True:
             free = [i for i in range(B) if not active[i]]
             in_flight = B - len(free)
