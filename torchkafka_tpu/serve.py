@@ -33,8 +33,6 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-_logger = logging.getLogger(__name__)
-
 from torchkafka_tpu.commit.ledger import OffsetLedger
 from torchkafka_tpu.errors import CommitFailedError
 from torchkafka_tpu.models.generate import _attend_cached, _project_qkv, prefill
@@ -42,6 +40,8 @@ from torchkafka_tpu.models.quant import embed_rows, load_weight
 from torchkafka_tpu.models.transformer import TransformerConfig, _rms_norm
 from torchkafka_tpu.source.records import Record
 from torchkafka_tpu.utils.metrics import Gauge, RateMeter
+
+_logger = logging.getLogger(__name__)
 
 
 class ServeMetrics:
