@@ -116,3 +116,38 @@ class TestProcessorIntegration:
         stacked, keep = json_tokens("text", 8)(recs)
         assert keep.tolist() == [True, False]
         assert stacked.shape == (1, 8)
+
+
+class TestFuzzDifferential:
+    """Random-bytes fuzz: the C++ scanners must agree bit-for-bit with the
+    NumPy fallbacks on arbitrary garbage (truncated escapes, embedded
+    quotes/braces/NULs, zero-length values) and never crash — a malformed
+    Kafka record must only ever become a dropped row."""
+
+    @needs_native
+    @pytest.mark.parametrize("seed", range(8))
+    def test_json_tokens_random_garbage(self, seed):
+        rng = np.random.default_rng(seed)
+        vals = []
+        for _ in range(64):
+            n = int(rng.integers(0, 60))
+            raw = bytes(rng.integers(0, 256, n, dtype=np.uint8))
+            if rng.random() < 0.4:  # bias toward json-ish shapes
+                raw = b'{"text": "' + raw.replace(b'"', b"") + b'"}'
+            if rng.random() < 0.2:
+                raw = raw[: max(0, n - 3)]  # truncate mid-structure
+            vals.append(raw)
+        fast, slow = _both("json_tokens_scan", vals, "text", 12, 0)
+        np.testing.assert_array_equal(fast[0], slow[0])
+        np.testing.assert_array_equal(fast[1], slow[1])
+
+    @needs_native
+    @pytest.mark.parametrize("seed", range(4))
+    def test_gather_rows_random_lengths(self, seed):
+        rng = np.random.default_rng(100 + seed)
+        vals = [
+            bytes(rng.integers(0, 256, int(rng.integers(0, 40)), dtype=np.uint8))
+            for _ in range(64)
+        ]
+        fast, slow = _both("gather_rows", vals, 6, np.int32, -1)
+        np.testing.assert_array_equal(fast, slow)
