@@ -312,6 +312,46 @@ class TestStreamingGenerator:
         assert seen == 4
         consumer.close()
 
+    def test_live_production_while_serving(self, model, rng):
+        """Prompts arrive WHILE generations run (a live topic, not a
+        pre-filled one): the server's non-blocking poll keeps slots busy,
+        admits stragglers as they appear, and serves everything."""
+        import threading
+        import time as _time
+
+        cfg, params = model
+        broker = tk.InMemoryBroker()
+        broker.create_topic("p", partitions=2)
+        total = 10
+        prompts = rng.integers(0, VOCAB, (total, P), dtype=np.int32)
+
+        def produce_slowly():
+            for i in range(total):
+                broker.produce("p", prompts[i].tobytes(), partition=i % 2)
+                _time.sleep(0.05)
+
+        consumer = tk.MemoryConsumer(broker, "p", group_id="glive")
+        server = StreamingGenerator(
+            consumer, params, cfg, slots=2, prompt_len=P, max_new=MAX_NEW,
+            commit_every=3,
+        )
+        t = threading.Thread(target=produce_slowly)
+        t.start()
+        expected = _expected(cfg, params, prompts)
+        seen = 0
+        for rec, toks in server.run(max_records=total, idle_timeout_ms=4000):
+            idx = 2 * rec.offset + rec.partition
+            np.testing.assert_array_equal(toks, expected[idx], err_msg=f"prompt {idx}")
+            seen += 1
+        t.join()
+        assert seen == total
+        committed = sum(
+            broker.committed("glive", tk.TopicPartition("p", p)) or 0
+            for p in (0, 1)
+        )
+        assert committed == total
+        consumer.close()
+
     def test_rejects_bad_config(self, model):
         cfg, params = model
         consumer = object()
