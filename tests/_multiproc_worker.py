@@ -15,6 +15,10 @@ Modes:
           barriers must fail CLOSED (nothing committed for batch 3): either
           the BarrierWatchdog fires (exit 42) or the coordination service
           notices the dead peer and the barrier raises BarrierError (exit 43).
+  serve — each process runs the continuous-batching generation server over
+          its own partition slice (replicated tiny model): pod serving is
+          embarrassingly parallel per host, but the jax.distributed runtime
+          must be up and the per-host commit accounting must hold.
 
 Each process uses its own InMemoryBroker primed with deterministic records —
 the per-host view of a disjoint partition slice, which is exactly what a real
@@ -51,6 +55,42 @@ def build_broker(tk, pid: int):
     return broker
 
 
+def serve_main(pid: int, outdir: str, mark) -> int:
+    """Pod serving: this host's slice of the prompt topic through the
+    continuous-batching server with a replicated tiny model."""
+    import jax
+    import numpy as np
+
+    import torchkafka_tpu as tk
+    from torchkafka_tpu.models.transformer import TransformerConfig, init_params
+    from torchkafka_tpu.serve import StreamingGenerator
+
+    P, MAX_NEW, N = 8, 4, 8
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=16, n_layers=1, n_heads=2, n_kv_heads=1,
+        d_ff=32, max_seq_len=P + MAX_NEW, dtype=jax.numpy.float32,
+    )
+    params = init_params(jax.random.key(0), cfg)
+    broker = tk.InMemoryBroker()
+    broker.create_topic("prompts", partitions=1)
+    rng = np.random.default_rng(pid)
+    for _ in range(N):
+        broker.produce(
+            "prompts", rng.integers(0, 64, P, dtype=np.int32).tobytes()
+        )
+    consumer = tk.MemoryConsumer(broker, "prompts", group_id="gs")
+    server = StreamingGenerator(
+        consumer, params, cfg, slots=2, prompt_len=P, max_new=MAX_NEW,
+        commit_every=2,
+    )
+    served = sum(1 for _ in server.run(max_records=N))
+    committed = broker.committed("gs", tk.TopicPartition("prompts", 0))
+    consumer.close()
+    mark("served", {"served": served, "committed": committed})
+    jax.distributed.shutdown()
+    return 0
+
+
 def main(pid: int, nproc: int, port: str, outdir: str, mode: str) -> int:
     import jax
 
@@ -66,6 +106,9 @@ def main(pid: int, nproc: int, port: str, outdir: str, mode: str) -> int:
     )
     assert jax.process_count() == nproc, jax.process_count()
     assert len(jax.devices()) == 2 * nproc, jax.devices()
+
+    if mode == "serve":
+        return serve_main(pid, outdir, mark)
 
     import jax.numpy as jnp
     import numpy as np

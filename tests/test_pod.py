@@ -137,6 +137,18 @@ class TestPodCommit:
             final = {TopicPartition(t, p): off for t, p, off in committed[-1]}
             assert sum(final.values()) == 4 * BATCH  # 64 rows committed
 
+    def test_pod_serving(self, tmp_path):
+        """Each pod process serves its own partition slice through the
+        continuous-batching server under a live jax.distributed runtime —
+        pod serving is per-host-parallel, but it must coexist with the
+        distributed client and keep per-host commit accounting exact."""
+        procs = _spawn_pod(2, str(tmp_path), "serve")
+        codes = _wait_all(procs, str(tmp_path), timeout_s=420)
+        assert codes == [0, 0], _diagnose(procs, str(tmp_path))
+        for pid in (0, 1):
+            served = _read(str(tmp_path), "served", pid)
+            assert served == {"served": 8, "committed": 8}, served
+
     def test_member_death_fails_closed_and_redelivers(self, tmp_path):
         """Kill process 1 before it commits batch 3: process 0's barrier must
         fail CLOSED (watchdog exit 42 or BarrierError exit 43 — in both cases
