@@ -251,12 +251,16 @@ def _moe_mlp(
 
 
 def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
-    """Rotary embedding. x: [B, S, H, D]; positions: [S] global positions."""
+    """Rotary embedding. x: [B, S, H, D]; positions: [S] global positions
+    shared across the batch, or [B, S] per-row positions (the continuous-
+    batching server's slots sit at different depths)."""
     dim = x.shape[-1]
     freqs = theta ** (-jnp.arange(0, dim, 2, dtype=jnp.float32) / dim)
-    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [S, D/2]
-    cos = jnp.cos(angles)[None, :, None, :]
-    sin = jnp.sin(angles)[None, :, None, :]
+    angles = positions.astype(jnp.float32)[..., None] * freqs  # [(B,) S, D/2]
+    if angles.ndim == 2:
+        angles = angles[None]  # broadcast over batch
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
     return out.astype(x.dtype)

@@ -26,6 +26,7 @@ generalised to out-of-order completions via the OffsetLedger.
 from __future__ import annotations
 
 import logging
+import time
 from typing import Callable, Iterator
 
 import jax
@@ -37,7 +38,7 @@ from torchkafka_tpu.commit.ledger import OffsetLedger
 from torchkafka_tpu.errors import CommitFailedError
 from torchkafka_tpu.models.generate import _attend_cached, _project_qkv, prefill
 from torchkafka_tpu.models.quant import embed_rows, load_weight
-from torchkafka_tpu.models.transformer import TransformerConfig, _rms_norm
+from torchkafka_tpu.models.transformer import TransformerConfig, _rms_norm, _rope
 from torchkafka_tpu.source.records import Record
 from torchkafka_tpu.utils.metrics import Gauge, RateMeter
 
@@ -78,27 +79,14 @@ class ServeMetrics:
         }
 
 
-def _rope_rows(x: jax.Array, pos_b: jax.Array, theta: float) -> jax.Array:
-    """Rotary embedding with a DIFFERENT position per batch row.
-    x: [B, 1, H, D]; pos_b: [B] int32."""
-    dim = x.shape[-1]
-    freqs = theta ** (-jnp.arange(0, dim, 2, dtype=jnp.float32) / dim)
-    angles = pos_b[:, None].astype(jnp.float32) * freqs[None, :]  # [B, D/2]
-    cos = jnp.cos(angles)[:, None, None, :]
-    sin = jnp.sin(angles)[:, None, None, :]
-    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
-    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
-    return out.astype(x.dtype)
-
-
 def _slot_layer_step(x, layer, cache_k, cache_v, pos_b, cfg):
     """One decode token through one layer with a DIFFERENT position per
     slot. x: [B, 1, D]; caches [B, M, K, Dh]; pos_b: [B]. Only the rope and
     the cache write differ from the lockstep ``generate._layer_step``; the
     attention/MLP tail is the shared ``_attend_cached``."""
     q, k, v = _project_qkv(x, layer, cfg)
-    q = _rope_rows(q, pos_b, cfg.rope_theta)
-    k = _rope_rows(k, pos_b, cfg.rope_theta)
+    q = _rope(q, pos_b[:, None], cfg.rope_theta)
+    k = _rope(k, pos_b[:, None], cfg.rope_theta)
     # Per-row cache write via vmapped dynamic_update_slice: XLA lowers this
     # to a masked select, ~10x faster on TPU than the equivalent
     # `.at[rows, pos_b].set` scatter (measured 1.9 ms vs noise-floor per
@@ -306,8 +294,6 @@ class StreamingGenerator:
     def run(
         self, max_records: int | None = None, idle_timeout_ms: int = 2000
     ) -> Iterator[tuple[Record, np.ndarray]]:
-        import time
-
         B = self._slots
         slot_rec: list[Record | None] = [None] * B
         pending: list[Record] = []
