@@ -355,11 +355,14 @@ def scenario_7(size: str = "tiny") -> dict:
     for i in range(n):
         broker.produce("t7", prompts[i].tobytes(), partition=i % 2)
     params = init_params(jax.random.key(0), cfg)
-    # Probe one prompt's lockstep continuation and use a mid-sequence token
-    # as EOS: random-init models repeat attractor tokens, so this truncates
-    # a meaningful fraction of the stream and exercises slot recycling.
-    probe = np.asarray(generate(params, cfg, jnp.asarray(prompts[:1]), max_new))
-    eos_id = int(probe[0, max_new // 2])
+    # Probe a few lockstep continuations and use the MODAL generated token
+    # as EOS: random-init models repeat attractor tokens, so the mode
+    # truncates a meaningful fraction of the stream and visibly exercises
+    # slot recycling (decode positions >= 1 only; prefill's token 0 is
+    # emitted unconditionally, matching the server's EOS rule).
+    probe = np.asarray(generate(params, cfg, jnp.asarray(prompts[:8]), max_new))
+    toks, counts = np.unique(probe[:, 1:], return_counts=True)
+    eos_id = int(toks[counts.argmax()])
 
     consumer = tk.MemoryConsumer(broker, "t7", group_id="s7")
     server = StreamingGenerator(
