@@ -352,6 +352,30 @@ class TestStreamingGenerator:
         assert committed == total
         consumer.close()
 
+    def test_close_commits_completed_work(self, model, rng):
+        """Context-manager exit (voluntary shutdown) commits completions
+        that the commit cadence hadn't flushed yet; in-flight/undelivered
+        prompts stay uncommitted for the next owner."""
+        cfg, params = model
+        broker = tk.InMemoryBroker()
+        _topic(broker, 6)
+        consumer = tk.MemoryConsumer(broker, "p", group_id="gclose")
+        with StreamingGenerator(
+            consumer, params, cfg, slots=2, prompt_len=P, max_new=MAX_NEW,
+            commit_every=100,  # cadence never fires: only close() commits
+        ) as server:
+            done = 0
+            for _rec, _toks in server.run(max_records=4):
+                done += 1
+                if done == 4:
+                    break  # voluntary stop with 2 prompts never admitted
+        committed = sum(
+            broker.committed("gclose", tk.TopicPartition("p", p)) or 0
+            for p in (0, 1)
+        )
+        assert committed == 4  # the 4 completions, not the 2 unserved
+        consumer.close()
+
     def test_rejects_bad_config(self, model):
         cfg, params = model
         consumer = object()

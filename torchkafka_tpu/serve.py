@@ -407,3 +407,18 @@ class StreamingGenerator:
         except CommitFailedError:
             self.metrics.commit_failures.add(1)
             _logger.exception("offset commit failed; prompts will re-deliver")
+
+    def close(self) -> None:
+        """Voluntary shutdown: commit the watermark for everything already
+        COMPLETED (abandoning ``run()`` mid-iteration intentionally skips
+        this — a crash must re-deliver). In-flight generations stay
+        uncommitted and re-deliver on restart, like the stream's close
+        contract (/root/reference/src/kafka_dataset.py:89 keeps unfinished
+        work uncommitted; finished-and-yielded work is the user's)."""
+        self._commit()
+
+    def __enter__(self) -> "StreamingGenerator":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
