@@ -149,6 +149,22 @@ class TestPodCommit:
             served = _read(str(tmp_path), "served", pid)
             assert served == {"served": 8, "committed": 8}, served
 
+    def test_pod_checkpoint_roundtrip(self, tmp_path):
+        """Multi-host checkpoint: Orbax's coordinated sharded write (no
+        np.asarray of non-addressable shards), per-process offsets files,
+        process-0 atomic rename between barriers — every process restores
+        the identical global state and its OWN offsets."""
+        procs = _spawn_pod(2, str(tmp_path), "ckpt")
+        codes = _wait_all(procs, str(tmp_path), timeout_s=420)
+        assert codes == [0, 0], _diagnose(procs, str(tmp_path))
+        for pid in (0, 1):
+            ok = _read(str(tmp_path), "ckpt_ok", pid)
+            assert ok is not None
+            assert ok["total"] == 4.0 * sum(range(4))
+            assert ok["offsets"] == {
+                f"TopicPartition(topic='t', partition={pid})": 100 + pid
+            }
+
     def test_member_death_fails_closed_and_redelivers(self, tmp_path):
         """Kill process 1 before it commits batch 3: process 0's barrier must
         fail CLOSED (watchdog exit 42 or BarrierError exit 43 — in both cases

@@ -38,6 +38,30 @@ logger = logging.getLogger(__name__)
 _OFFSETS_FILE = "stream_offsets.json"
 
 
+def _offsets_file(pid: int, multi: bool) -> str:
+    """Single-process keeps the historical name; each pod process writes its
+    own file (every host owns different partitions)."""
+    return f"stream_offsets_{pid}.json" if multi else _OFFSETS_FILE
+
+
+def _any_offsets_file(path: str) -> str | None:
+    """The offsets file this process should read from a checkpoint dir:
+    its own per-process file on a pod, else the single-process file, else
+    process 0's (restoring a pod checkpoint on one host)."""
+    import jax as _jax
+
+    multi = _jax.process_count() > 1
+    for name in (
+        _offsets_file(_jax.process_index(), multi),
+        _OFFSETS_FILE,
+        _offsets_file(0, True),
+    ):
+        cand = os.path.join(path, name)
+        if os.path.exists(cand):
+            return cand
+    return None
+
+
 def _encode_offsets(offsets: Mapping[TopicPartition, int]) -> dict[str, int]:
     return {f"{tp.topic}\x00{tp.partition}": int(off) for tp, off in offsets.items()}
 
@@ -82,23 +106,64 @@ class StreamCheckpointer:
         """
         final = os.path.join(self._root, str(step))
         tmp = final + ".tmp"
-        if os.path.exists(tmp):
+        multi = jax.process_count() > 1
+        pid = jax.process_index()
+        if pid == 0 and os.path.exists(tmp):
             import shutil
 
             shutil.rmtree(tmp)
-        state = jax.tree_util.tree_map(np.asarray, state)  # device → host
-        self._ckptr.save(os.path.join(tmp, "state"), state)
+        if multi:
+            # Pod save: state arrays stay jax.Arrays (Orbax coordinates the
+            # sharded multi-host write; np.asarray of a non-addressable
+            # global array would throw); every process calls save on the
+            # SAME path, process 0 performs the commit rename, and
+            # barriers order prepare → write → rename. Host-local leaves
+            # (per-host scalars/metrics, SingleDeviceSharding) are rejected
+            # by Orbax multi-host serialization — promote them to globally
+            # replicated arrays first (they are identical across hosts by
+            # the time they reach a checkpoint).
+            from jax.experimental import multihost_utils as _mh
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            rep = NamedSharding(
+                jax.sharding.Mesh(np.array(jax.devices()), ("all",)),
+                PartitionSpec(),
+            )
+
+            def _globalize(x):
+                if isinstance(x, jax.Array) and not x.is_fully_addressable:
+                    return x  # already a proper global array
+                arr = np.asarray(x)
+                return jax.make_array_from_callback(
+                    arr.shape, rep, lambda idx: arr[idx]
+                )
+
+            state = jax.tree_util.tree_map(_globalize, state)
+            _mh.sync_global_devices(f"ckpt-prepare-{step}")
+            self._ckptr.save(os.path.join(tmp, "state"), state)
+        else:
+            state = jax.tree_util.tree_map(np.asarray, state)  # device → host
+            self._ckptr.save(os.path.join(tmp, "state"), state)
         self._ckptr.wait_until_finished()
-        with open(os.path.join(tmp, _OFFSETS_FILE), "w") as f:
+        with open(os.path.join(tmp, _offsets_file(pid, multi)), "w") as f:
             json.dump({"step": step, "offsets": _encode_offsets(offsets)}, f)
             f.flush()
             os.fsync(f.fileno())
-        if os.path.exists(final):
-            import shutil
+        if multi:
+            from jax.experimental import multihost_utils as _mh
 
-            shutil.rmtree(final)
-        os.rename(tmp, final)  # the atomic commit point
-        self._gc()
+            _mh.sync_global_devices(f"ckpt-written-{step}")
+        if pid == 0:
+            if os.path.exists(final):
+                import shutil
+
+                shutil.rmtree(final)
+            os.rename(tmp, final)  # the atomic commit point
+            self._gc()
+        if multi:
+            from jax.experimental import multihost_utils as _mh
+
+            _mh.sync_global_devices(f"ckpt-renamed-{step}")
         logger.info("checkpoint %d saved (%d partitions)", step, len(offsets))
         return final
 
@@ -114,8 +179,8 @@ class StreamCheckpointer:
     def steps(self) -> list[int]:
         out = []
         for name in os.listdir(self._root):
-            if name.isdigit() and os.path.exists(
-                os.path.join(self._root, name, _OFFSETS_FILE)
+            if name.isdigit() and _any_offsets_file(
+                os.path.join(self._root, name)
             ):
                 out.append(int(name))
         return sorted(out)
@@ -137,7 +202,10 @@ class StreamCheckpointer:
         state = self._ckptr.restore(
             os.path.join(path, "state"), template if template is not None else None
         )
-        with open(os.path.join(path, _OFFSETS_FILE)) as f:
+        offsets_path = _any_offsets_file(path)
+        if offsets_path is None:
+            raise FileNotFoundError(f"no offsets file in {path}")
+        with open(offsets_path) as f:
             meta = json.load(f)
         return state, _decode_offsets(meta["offsets"]), step
 
