@@ -11,7 +11,7 @@ TPU-idiomatic serving pattern (static shapes, slot masks) the way vLLM-style
 continuous batching is the GPU one.
 
 XLA shape discipline: everything is static — the slot pool is [B] with
-per-slot positions, the admission step always prefllls a full [B, P] batch
+per-slot positions, the admission step always prefills a full [B, P] batch
 (rows masked by an admit mask; wasted rows cost one prefill of padding),
 and the decode tick advances all B slots with inactive slots masked out.
 Slot kv-cache rows are recycled without clearing: a freed slot's stale tail
