@@ -52,6 +52,34 @@ class TestQuantize:
         # int8 + small scales vs f32: close to 4x smaller overall.
         assert quantized_nbytes(qp) < 0.3 * quantized_nbytes(params)
 
+    def test_moe_quantize_specs_align(self):
+        """quantize_specs mirrors quantize_params' tree for MoE configs:
+        every QTensor leaf gets a (q, scale) spec pair with the contraction
+        axes unsharded in the scale."""
+        from jax.sharding import PartitionSpec as P
+
+        from torchkafka_tpu.models.quant import quantize_specs
+        from torchkafka_tpu.models.transformer import param_specs
+
+        cfg = TransformerConfig(
+            vocab_size=128, d_model=32, n_layers=2, n_heads=2, n_kv_heads=2,
+            d_ff=64, max_seq_len=32, dtype=jnp.float32, n_experts=4,
+        )
+        params = init_params(jax.random.key(0), cfg)
+        qp = quantize_params(params, cfg)
+        specs = quantize_specs(param_specs(cfg), cfg)
+        # Same tree structure (leaf-for-leaf), so shardings_for_mesh +
+        # device_put apply cleanly.
+        assert (
+            jax.tree_util.tree_structure(qp)
+            == jax.tree_util.tree_structure(specs)
+        )
+        # MoE w_gate [L, E, D, F] contracts D (axis 2): sharded in q,
+        # unsharded in scale.
+        wg = specs["layers"]["w_gate"]
+        assert wg.q == P("pp", "ep", "fsdp", "tp")
+        assert wg.scale == P("pp", "ep", None, "tp")
+
     def test_moe_weights_quantized_router_kept(self):
         cfg = TransformerConfig(
             vocab_size=128, d_model=32, n_layers=2, n_heads=2, n_kv_heads=2,
