@@ -261,6 +261,28 @@ class TestStreamingGenerator:
         assert seen == 6
         consumer.close()
 
+    @pytest.mark.parametrize("ticks", [1, 3])
+    def test_ticks_per_sync_variants(self, model, rng, ticks):
+        """K=1 (immediate recycling) and a K that does NOT divide max_new
+        both produce token-exact outputs — completion detection inside a
+        partial final block must latch correctly."""
+        cfg, params = model
+        broker = tk.InMemoryBroker()
+        prompts = _topic(broker, 6)
+        consumer = tk.MemoryConsumer(broker, "p", group_id=f"gk{ticks}")
+        server = StreamingGenerator(
+            consumer, params, cfg, slots=2, prompt_len=P, max_new=MAX_NEW,
+            ticks_per_sync=ticks,
+        )
+        expected = _expected(cfg, params, prompts)
+        seen = 0
+        for rec, toks in server.run(max_records=6):
+            idx = 2 * rec.offset + rec.partition
+            np.testing.assert_array_equal(toks, expected[idx], err_msg=f"prompt {idx}")
+            seen += 1
+        assert seen == 6
+        consumer.close()
+
     def test_temperature_sampling(self, model, rng):
         """temperature > 0 samples per slot: the server completes and
         commits, outputs are valid token ids, and two different rng keys
