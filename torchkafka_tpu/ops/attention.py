@@ -284,6 +284,12 @@ def ring_attention(
     None = Pallas flash kernels per ring step on TPU, dense XLA elsewhere;
     True/False forces.
     """
+    if q.shape[2] != k.shape[2]:
+        raise ValueError(
+            "ring_attention requires equal q/kv head counts — repeat kv "
+            "heads before the ring (GQA-native reads are a flash_attention "
+            "feature; the ring rotates whatever kv it is given)"
+        )
     axis_size = mesh.shape[axis_name]
     if axis_size == 1:
         return mha(q, k, v, causal=causal)

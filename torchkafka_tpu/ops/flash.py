@@ -455,7 +455,16 @@ def _repeat_kv(q, k, v):
     return jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2)
 
 
+def _check_heads(q, k):
+    h, kh = q.shape[2], k.shape[2]
+    if h % kh:
+        raise ValueError(
+            f"q heads ({h}) must be a multiple of kv heads ({kh}) for GQA"
+        )
+
+
 def _flash_impl(q, k, v, causal, block_q, block_k, interpret):
+    _check_heads(q, k)
     b, s, h, d = q.shape
     block_q, block_k, interpret = _resolve(s, block_q, block_k, interpret)
     if not _supported(s, block_q, block_k):
@@ -470,6 +479,7 @@ def _flash_impl(q, k, v, causal, block_q, block_k, interpret):
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    _check_heads(q, k)
     b, s, h, d = q.shape
     block_q, block_k, interpret = _resolve(s, block_q, block_k, interpret)
     if not _supported(s, block_q, block_k):
