@@ -182,6 +182,12 @@ class TestFlashBackward:
         g = jax.grad(lambda k: flash_attention(q, k, v, True).sum())(k)
         assert g.shape == k.shape
 
+    def test_bad_head_ratio_rejected(self, rng):
+        q = jnp.asarray(rng.normal(size=(1, 128, 6, 32)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(1, 128, 4, 32)), jnp.float32)
+        with pytest.raises(ValueError, match="multiple of kv heads"):
+            flash_attention(q, k, k, True)
+
     def test_grad_through_jit(self, rng):
         q, k, v = _qkv(rng, s=128)
         f = jax.jit(jax.grad(lambda q: flash_attention(q, k, v, True).sum()))
