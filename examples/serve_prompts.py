@@ -61,23 +61,23 @@ def main() -> int:
     )
     params = init_params(jax.random.key(0), cfg)
     consumer = tk.MemoryConsumer(broker, TOPIC, group_id="serve-demo")
-    server = StreamingGenerator(
+    with StreamingGenerator(
         consumer, params, cfg,
         slots=args.slots, prompt_len=PROMPT_LEN, max_new=args.max_new,
         eos_id=args.eos, commit_every=args.slots,
-    )
-    print(f"compiling ({args.slots} slots)...", file=sys.stderr)
-    server.warmup()
+    ) as server:  # exit commits completed work (crash semantics unchanged)
+        print(f"compiling ({args.slots} slots)...", file=sys.stderr)
+        server.warmup()
 
-    t0 = time.perf_counter()
-    toks = 0
-    for i, (rec, out) in enumerate(server.run(max_records=args.prompts)):
-        toks += len(out)
-        print(
-            f"#{i:3d} {rec.topic}@{rec.partition}:{rec.offset} "
-            f"-> {len(out)} tokens: {out[:8].tolist()}{'...' if len(out) > 8 else ''}"
-        )
-    dt = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        toks = 0
+        for i, (rec, out) in enumerate(server.run(max_records=args.prompts)):
+            toks += len(out)
+            print(
+                f"#{i:3d} {rec.topic}@{rec.partition}:{rec.offset} "
+                f"-> {len(out)} tokens: {out[:8].tolist()}{'...' if len(out) > 8 else ''}"
+            )
+        dt = time.perf_counter() - t0
     committed = sum(
         broker.committed("serve-demo", tk.TopicPartition(TOPIC, p)) or 0
         for p in (0, 1)
