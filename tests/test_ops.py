@@ -135,3 +135,20 @@ class TestRingFlash:
             )
         )(qs, ks, vs)
         np.testing.assert_allclose(np.asarray(dense), np.asarray(ring), atol=5e-5)
+
+    def test_bf16_matches_dense(self, rng):
+        """The production compute dtype through the flash-kernel ring path."""
+        mesh = make_mesh({"data": 2, "sp": 4})
+        q, k, v = (
+            jnp.asarray(rng.normal(size=(2, 1024, 2, 64)), jnp.bfloat16)
+            for _ in range(3)
+        )
+        shard = NamedSharding(mesh, P(None, "sp"))
+        qs, ks, vs = (jax.device_put(x, shard) for x in (q, k, v))
+        dense = mha(q, k, v, causal=True).astype(jnp.float32)
+        ring = jax.jit(
+            lambda a, b, c: ring_attention(a, b, c, mesh=mesh, use_flash=True)
+        )(qs, ks, vs).astype(jnp.float32)
+        np.testing.assert_allclose(
+            np.asarray(dense), np.asarray(ring), atol=0.04
+        )
