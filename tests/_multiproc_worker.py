@@ -136,7 +136,11 @@ def ckpt_main(pid: int, nproc: int, outdir: str, mark) -> int:
     }
     restored, off2, step = ck.restore(template=template)
     assert step == 3
-    assert off2 == offsets, off2  # each process reads ITS OWN offsets file
+    # restore merges every process's offsets file into the pod-global
+    # watermark (what makes elastic rescale work).
+    assert off2 == {
+        TopicPartition("t", p): 100 + p for p in range(nproc)
+    }, off2
     total = float(jnp.sum(restored["w"]))  # global sum across hosts
     expected = 4.0 * sum(range(2 * nproc))
     assert total == expected, (total, expected)

@@ -114,6 +114,81 @@ class TestKillAndResume:
         first = consumer.poll(max_records=1, timeout_ms=100)[0]
         assert first.offset == 4  # checkpoint wins
 
+    def test_elastic_resume_merges_pod_offsets(self, tmp_path, broker):
+        """Rescale down: a checkpoint written by a 4-process pod (four
+        per-process offsets files, disjoint partitions) restores on ONE
+        process as the merged global watermark, and resume seeks every
+        partition the new consumer owns — including partitions checkpointed
+        by OTHER old processes. This is the elastic-rescale contract."""
+        import json
+
+        broker.create_topic("t", partitions=4)
+        for p in range(4):
+            for i in range(8):
+                broker.produce(
+                    "t", np.full(1, i, np.int32).tobytes(), partition=p
+                )
+        ck = StreamCheckpointer(tmp_path / "ck")
+        # Old process 0's file lands via save() (single-process name)...
+        ck.save(7, _state(7), {TopicPartition("t", 0): 3})
+        # ...and old processes 1-3 each wrote their own per-process file
+        # (emulated: same schema save() writes on a pod).
+        for pid in (1, 2, 3):
+            path = tmp_path / "ck" / "7" / f"stream_offsets_{pid}.json"
+            with open(path, "w") as f:
+                json.dump(
+                    {
+                        "step": 7,
+                        "process_index": pid,
+                        "process_count": 4,
+                        "offsets": {f"t\x00{pid}": 3 + pid},
+                    },
+                    f,
+                )
+
+        _, offsets, step = ck.restore()
+        assert step == 7
+        assert offsets == {TopicPartition("t", p): 3 + p for p in range(4)}
+
+        consumer = tk.MemoryConsumer(
+            broker, "t", group_id="g",
+            assignment=[TopicPartition("t", p) for p in range(4)],
+        )
+        _, step = ck.resume(consumer)
+        for p in range(4):
+            assert consumer.position(TopicPartition("t", p)) == 3 + p
+
+    def test_incomplete_pod_checkpoint_raises(self, tmp_path):
+        """A pod checkpoint missing one process's offsets file (lost in a
+        copy/prune) must fail loudly — a silently partial watermark would
+        let missing partitions fall back to group offsets and skip records."""
+        import json
+
+        ck = StreamCheckpointer(tmp_path / "ck")
+        ck.save(2, _state(2), {TopicPartition("t", 0): 4})
+        # One surviving per-process file claims a 4-process save.
+        path = tmp_path / "ck" / "2" / "stream_offsets_3.json"
+        with open(path, "w") as f:
+            json.dump(
+                {"step": 2, "process_count": 4, "offsets": {"t\x003": 9}}, f
+            )
+        with pytest.raises(FileNotFoundError, match="incomplete pod checkpoint"):
+            ck.restore()
+
+    def test_overlapping_offsets_files_take_min(self, tmp_path):
+        """Two files claiming the same partition (double-written save across
+        a topology change): the smaller watermark wins — re-delivery is
+        at-least-once, skipping records is loss."""
+        import json
+
+        ck = StreamCheckpointer(tmp_path / "ck")
+        ck.save(1, _state(1), {TopicPartition("t", 0): 9})
+        path = tmp_path / "ck" / "1" / "stream_offsets_1.json"
+        with open(path, "w") as f:
+            json.dump({"step": 1, "offsets": {"t\x000": 5}}, f)
+        _, offsets, _ = ck.restore()
+        assert offsets == {TopicPartition("t", 0): 5}
+
     def test_unassigned_partition_warns_not_raises(self, tmp_path, broker):
         broker.create_topic("t", partitions=2)
         ck = StreamCheckpointer(tmp_path / "ck")

@@ -80,10 +80,9 @@ class TestRingFlash:
     accumulators around the ring. Shard size 128+ here forces that path
     (the tiny-shard tests above cover the dense fallback)."""
 
-    def _sharded(self, rng, mesh, sp, b=2, s=1024, h=2, d=64):
+    def _sharded(self, rng, mesh, sp, b=2, s=1024, h=2, d=64, dtype=jnp.float32):
         q, k, v = (
-            jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
-            for _ in range(3)
+            jnp.asarray(rng.normal(size=(b, s, h, d)), dtype) for _ in range(3)
         )
         shard = NamedSharding(mesh, P(None, "sp"))
         return q, k, v, tuple(jax.device_put(x, shard) for x in (q, k, v))
@@ -139,12 +138,7 @@ class TestRingFlash:
     def test_bf16_matches_dense(self, rng):
         """The production compute dtype through the flash-kernel ring path."""
         mesh = make_mesh({"data": 2, "sp": 4})
-        q, k, v = (
-            jnp.asarray(rng.normal(size=(2, 1024, 2, 64)), jnp.bfloat16)
-            for _ in range(3)
-        )
-        shard = NamedSharding(mesh, P(None, "sp"))
-        qs, ks, vs = (jax.device_put(x, shard) for x in (q, k, v))
+        q, k, v, (qs, ks, vs) = self._sharded(rng, mesh, 4, dtype=jnp.bfloat16)
         dense = mha(q, k, v, causal=True).astype(jnp.float32)
         ring = jax.jit(
             lambda a, b, c: ring_attention(a, b, c, mesh=mesh, use_flash=True)

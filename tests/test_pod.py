@@ -153,17 +153,18 @@ class TestPodCommit:
         """Multi-host checkpoint: Orbax's coordinated sharded write (no
         np.asarray of non-addressable shards), per-process offsets files,
         process-0 atomic rename between barriers — every process restores
-        the identical global state and its OWN offsets."""
+        the identical global state and the MERGED pod-global watermark."""
         procs = _spawn_pod(2, str(tmp_path), "ckpt")
         codes = _wait_all(procs, str(tmp_path), timeout_s=420)
         assert codes == [0, 0], _diagnose(procs, str(tmp_path))
+        merged = {
+            f"TopicPartition(topic='t', partition={p})": 100 + p for p in (0, 1)
+        }
         for pid in (0, 1):
             ok = _read(str(tmp_path), "ckpt_ok", pid)
             assert ok is not None
             assert ok["total"] == 4.0 * sum(range(4))
-            assert ok["offsets"] == {
-                f"TopicPartition(topic='t', partition={pid})": 100 + pid
-            }
+            assert ok["offsets"] == merged
 
     def test_member_death_fails_closed_and_redelivers(self, tmp_path):
         """Kill process 1 before it commits batch 3: process 0's barrier must

@@ -44,22 +44,23 @@ def _offsets_file(pid: int, multi: bool) -> str:
     return f"stream_offsets_{pid}.json" if multi else _OFFSETS_FILE
 
 
-def _any_offsets_file(path: str) -> str | None:
-    """The offsets file this process should read from a checkpoint dir:
-    its own per-process file on a pod, else the single-process file, else
-    process 0's (restoring a pod checkpoint on one host)."""
-    import jax as _jax
-
-    multi = _jax.process_count() > 1
-    for name in (
-        _offsets_file(_jax.process_index(), multi),
-        _OFFSETS_FILE,
-        _offsets_file(0, True),
-    ):
-        cand = os.path.join(path, name)
-        if os.path.exists(cand):
-            return cand
-    return None
+def _offsets_files(path: str) -> list[str]:
+    """Every offsets file in a checkpoint dir — the single-process file
+    and/or one per pod process. Restore merges ALL of them: partitions are
+    disjoint across processes at save time, and the union is the pod-global
+    watermark, which is what makes resuming at a DIFFERENT process count
+    (elastic rescale) correct — a new process's assignment may include
+    partitions a different old process checkpointed."""
+    try:
+        names = os.listdir(path)
+    except FileNotFoundError:
+        return []
+    return sorted(
+        os.path.join(path, n)
+        for n in names
+        if n == _OFFSETS_FILE
+        or (n.startswith("stream_offsets_") and n.endswith(".json"))
+    )
 
 
 def _encode_offsets(offsets: Mapping[TopicPartition, int]) -> dict[str, int]:
@@ -146,7 +147,15 @@ class StreamCheckpointer:
             self._ckptr.save(os.path.join(tmp, "state"), state)
         self._ckptr.wait_until_finished()
         with open(os.path.join(tmp, _offsets_file(pid, multi)), "w") as f:
-            json.dump({"step": step, "offsets": _encode_offsets(offsets)}, f)
+            json.dump(
+                {
+                    "step": step,
+                    "process_index": pid,
+                    "process_count": jax.process_count(),
+                    "offsets": _encode_offsets(offsets),
+                },
+                f,
+            )
             f.flush()
             os.fsync(f.fileno())
         if multi:
@@ -179,9 +188,7 @@ class StreamCheckpointer:
     def steps(self) -> list[int]:
         out = []
         for name in os.listdir(self._root):
-            if name.isdigit() and _any_offsets_file(
-                os.path.join(self._root, name)
-            ):
+            if name.isdigit() and _offsets_files(os.path.join(self._root, name)):
                 out.append(int(name))
         return sorted(out)
 
@@ -193,7 +200,17 @@ class StreamCheckpointer:
         self, step: int | None = None, *, template: Any | None = None
     ) -> tuple[Any, dict[TopicPartition, int], int]:
         """→ (state, offsets, step). ``template``: a pytree with the target
-        structure/dtypes (e.g. abstract arrays) for Orbax to restore into."""
+        structure/dtypes (e.g. abstract arrays) for Orbax to restore into.
+
+        ``offsets`` is the POD-GLOBAL watermark: the union of every
+        process's offsets file in the checkpoint. Partitions are disjoint
+        across processes at save time, so the union is exact; merging (not
+        picking the caller's own file) is what makes restoring at a
+        different process count — elastic rescale — correct, since the new
+        assignment need not match the old one. On the off chance two files
+        overlap on a partition (a save written twice across a topology
+        change), the SMALLER watermark wins: seeking too far forward would
+        skip records, while re-delivery is the at-least-once contract."""
         if step is None:
             step = self.latest_step()
             if step is None:
@@ -202,12 +219,28 @@ class StreamCheckpointer:
         state = self._ckptr.restore(
             os.path.join(path, "state"), template if template is not None else None
         )
-        offsets_path = _any_offsets_file(path)
-        if offsets_path is None:
+        files = _offsets_files(path)
+        if not files:
             raise FileNotFoundError(f"no offsets file in {path}")
-        with open(offsets_path) as f:
-            meta = json.load(f)
-        return state, _decode_offsets(meta["offsets"]), step
+        merged: dict[TopicPartition, int] = {}
+        saved_count = 0
+        for offsets_path in files:
+            with open(offsets_path) as f:
+                meta = json.load(f)
+            saved_count = max(saved_count, int(meta.get("process_count", 1)))
+            for tp, off in _decode_offsets(meta["offsets"]).items():
+                merged[tp] = min(off, merged.get(tp, off))
+        if saved_count > 1 and len(files) < saved_count:
+            # An incomplete pod checkpoint (a per-process file lost in a
+            # copy/prune) would restore a PARTIAL watermark: the missing
+            # partitions silently fall back to the group's committed
+            # offsets, which may be ahead — skipping records the restored
+            # state never saw. Fail loudly instead.
+            raise FileNotFoundError(
+                f"incomplete pod checkpoint in {path}: {len(files)} offsets "
+                f"files but the save recorded process_count={saved_count}"
+            )
+        return state, merged, step
 
     def resume(
         self,
@@ -217,17 +250,30 @@ class StreamCheckpointer:
         template: Any | None = None,
     ) -> tuple[Any, int]:
         """Restore AND align the consumer: seek every checkpointed partition
-        to its saved watermark, so the next poll continues exactly where the
-        restored state left off (regardless of the group's committed
-        offsets). → (state, step)."""
+        this process is assigned to its saved watermark, so the next poll
+        continues exactly where the restored state left off (regardless of
+        the group's committed offsets). → (state, step).
+
+        The restored watermark is pod-global (see ``restore``), so this
+        works across rescales: each process of the NEW topology seeks the
+        subset of partitions it now owns, whichever old process saved them.
+        Partitions owned by peers are skipped silently on a pod; on a
+        single process they are real orphans and warn."""
         state, offsets, step = self.restore(step, template=template)
         assigned = set(consumer.assignment())
+        elsewhere = 0
         for tp, off in offsets.items():
             if tp in assigned:
                 consumer.seek(tp, off)
+            elif jax.process_count() > 1:
+                elsewhere += 1
             else:
                 logger.warning(
                     "checkpointed partition %s not in current assignment; "
                     "its owner must resume it", tp,
                 )
+        if elsewhere:
+            logger.info(
+                "%d checkpointed partitions assigned to peer processes", elsewhere
+            )
         return state, step
