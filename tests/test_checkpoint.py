@@ -129,11 +129,12 @@ class TestKillAndResume:
                     "t", np.full(1, i, np.int32).tobytes(), partition=p
                 )
         ck = StreamCheckpointer(tmp_path / "ck")
-        # Old process 0's file lands via save() (single-process name)...
+        # save() writes the state tree and a single-process offsets file;
+        # rewrite the offsets as the four per-process files a 4-process pod
+        # save produces (same schema save() writes when process_count > 1).
         ck.save(7, _state(7), {TopicPartition("t", 0): 3})
-        # ...and old processes 1-3 each wrote their own per-process file
-        # (emulated: same schema save() writes on a pod).
-        for pid in (1, 2, 3):
+        os.remove(tmp_path / "ck" / "7" / "stream_offsets.json")
+        for pid in range(4):
             path = tmp_path / "ck" / "7" / f"stream_offsets_{pid}.json"
             with open(path, "w") as f:
                 json.dump(
@@ -158,22 +159,33 @@ class TestKillAndResume:
         for p in range(4):
             assert consumer.position(TopicPartition("t", p)) == 3 + p
 
-    def test_incomplete_pod_checkpoint_raises(self, tmp_path):
+    def test_incomplete_pod_checkpoint_raises_explicitly_skipped_by_auto(
+        self, tmp_path
+    ):
         """A pod checkpoint missing one process's offsets file (lost in a
-        copy/prune) must fail loudly — a silently partial watermark would
-        let missing partitions fall back to group offsets and skip records."""
+        copy/prune): restoring it EXPLICITLY fails loudly (a silently
+        partial watermark would let missing partitions fall back to group
+        offsets and skip records), while auto-selection falls back to the
+        newest COMPLETE checkpoint instead of bricking resume. A stale
+        single-process file must not count toward pod completeness."""
         import json
 
         ck = StreamCheckpointer(tmp_path / "ck")
-        ck.save(2, _state(2), {TopicPartition("t", 0): 4})
-        # One surviving per-process file claims a 4-process save.
+        ck.save(1, _state(1), {TopicPartition("t", 0): 2})  # complete
+        ck.save(2, _state(2), {TopicPartition("t", 0): 4})  # will be broken:
+        # one per-process file of a claimed 4-process save survives, plus
+        # the stale single-process file written above — 2 files, but only 1
+        # distinct pod process index.
         path = tmp_path / "ck" / "2" / "stream_offsets_3.json"
         with open(path, "w") as f:
             json.dump(
                 {"step": 2, "process_count": 4, "offsets": {"t\x003": 9}}, f
             )
         with pytest.raises(FileNotFoundError, match="incomplete pod checkpoint"):
-            ck.restore()
+            ck.restore(step=2)
+        assert ck.steps() == [1]
+        _, offsets, step = ck.restore()  # auto falls back to step 1
+        assert step == 1 and offsets == {TopicPartition("t", 0): 2}
 
     def test_overlapping_offsets_files_take_min(self, tmp_path):
         """Two files claiming the same partition (double-written save across

@@ -63,6 +63,33 @@ def _offsets_files(path: str) -> list[str]:
     )
 
 
+def _read_offsets_metas(path: str) -> list[dict]:
+    metas = []
+    for offsets_path in _offsets_files(path):
+        with open(offsets_path) as f:
+            meta = json.load(f)
+        if "process_index" not in meta:
+            # Pre-metadata files: recover the index from the filename.
+            name = os.path.basename(offsets_path)
+            if name != _OFFSETS_FILE:
+                meta["process_index"] = int(name[len("stream_offsets_"):-len(".json")])
+        metas.append(meta)
+    return metas
+
+
+def _pod_complete(metas: list[dict]) -> bool:
+    """A pod save of N processes is complete when all N distinct
+    per-process files are present. File COUNT is not enough: a stale
+    single-process file alongside N-1 per-process files would count to N
+    while a partition's watermark is silently missing."""
+    pod = [m for m in metas if int(m.get("process_count", 1)) > 1]
+    if not pod:
+        return bool(metas)
+    saved_count = max(int(m["process_count"]) for m in pod)
+    indexes = {int(m["process_index"]) for m in pod if "process_index" in m}
+    return len(indexes) >= saved_count
+
+
 def _encode_offsets(offsets: Mapping[TopicPartition, int]) -> dict[str, int]:
     return {f"{tp.topic}\x00{tp.partition}": int(off) for tp, off in offsets.items()}
 
@@ -186,9 +213,16 @@ class StreamCheckpointer:
     # --------------------------------------------------------------- restore
 
     def steps(self) -> list[int]:
+        """Steps with COMPLETE offsets state. An incomplete pod checkpoint
+        (a per-process file lost in a copy/prune) is excluded, so
+        auto-selection (``restore(step=None)``) falls back to the newest
+        restorable checkpoint instead of bricking resume; restoring an
+        incomplete step EXPLICITLY still fails loudly in ``restore``."""
         out = []
         for name in os.listdir(self._root):
-            if name.isdigit() and _offsets_files(os.path.join(self._root, name)):
+            if name.isdigit() and _pod_complete(
+                _read_offsets_metas(os.path.join(self._root, name))
+            ):
                 out.append(int(name))
         return sorted(out)
 
@@ -219,27 +253,23 @@ class StreamCheckpointer:
         state = self._ckptr.restore(
             os.path.join(path, "state"), template if template is not None else None
         )
-        files = _offsets_files(path)
-        if not files:
+        metas = _read_offsets_metas(path)
+        if not metas:
             raise FileNotFoundError(f"no offsets file in {path}")
-        merged: dict[TopicPartition, int] = {}
-        saved_count = 0
-        for offsets_path in files:
-            with open(offsets_path) as f:
-                meta = json.load(f)
-            saved_count = max(saved_count, int(meta.get("process_count", 1)))
-            for tp, off in _decode_offsets(meta["offsets"]).items():
-                merged[tp] = min(off, merged.get(tp, off))
-        if saved_count > 1 and len(files) < saved_count:
+        if not _pod_complete(metas):
             # An incomplete pod checkpoint (a per-process file lost in a
             # copy/prune) would restore a PARTIAL watermark: the missing
             # partitions silently fall back to the group's committed
             # offsets, which may be ahead — skipping records the restored
             # state never saw. Fail loudly instead.
             raise FileNotFoundError(
-                f"incomplete pod checkpoint in {path}: {len(files)} offsets "
-                f"files but the save recorded process_count={saved_count}"
+                f"incomplete pod checkpoint in {path}: missing per-process "
+                "offsets files for the recorded process_count"
             )
+        merged: dict[TopicPartition, int] = {}
+        for meta in metas:
+            for tp, off in _decode_offsets(meta["offsets"]).items():
+                merged[tp] = min(off, merged.get(tp, off))
         return state, merged, step
 
     def resume(

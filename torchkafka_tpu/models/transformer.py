@@ -42,7 +42,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from torchkafka_tpu.models.quant import embed_rows, load_weight
-from torchkafka_tpu.ops.attention import mha, ring_attention
+from torchkafka_tpu.ops.attention import mha, ring_attention, ulysses_attention
 
 
 @dataclasses.dataclass(frozen=True)
@@ -58,12 +58,15 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16  # compute dtype (MXU)
     param_dtype: Any = jnp.float32  # master weights
     remat: bool = False
-    # 'dense' | 'flash' | 'ring' | 'auto': auto picks ring when the mesh has
-    # sp>1, else the Pallas flash kernel on TPU, else dense XLA.
+    # 'dense' | 'flash' | 'ring' | 'ulysses' | 'auto': auto picks ring when
+    # the mesh has sp>1 (no head-divisibility constraint), else the Pallas
+    # flash kernel on TPU, else dense XLA. 'ulysses' selects all-to-all
+    # sequence parallelism (heads must divide by the sp size).
     attn_impl: str = "auto"
-    # Ring steps over the Pallas flash kernels: None = on TPU when the
-    # shard tiles; True forces (tests/dryruns exercise the kernels in
-    # interpret mode off-TPU); False forces the dense blockwise body.
+    # Sequence-parallel attention over the Pallas flash kernels — governs
+    # BOTH 'ring' (per ring step) and 'ulysses' (per head-shard): None =
+    # on TPU when the shard tiles; True forces (tests/dryruns exercise the
+    # kernels in interpret mode off-TPU); False forces the dense body.
     ring_use_flash: bool | None = None
     # Mixture-of-experts MLP: 0 = dense SwiGLU; >0 = that many experts with
     # top-k routing, expert weights sharded over the mesh's 'ep' axis.
@@ -281,15 +284,28 @@ class Transformer:
             )
         )
         self._use_ring = use_ring and mesh is not None
-        self._use_flash = not self._use_ring and (
+        self._use_ulysses = (
+            cfg.attn_impl == "ulysses"
+            and mesh is not None
+            and mesh.shape.get("sp", 1) > 1
+        )
+        self._use_flash = not (self._use_ring or self._use_ulysses) and (
             cfg.attn_impl == "flash"
-            or (cfg.attn_impl == "auto" and jax.default_backend() == "tpu")
+            or (
+                cfg.attn_impl in ("auto", "ulysses")
+                and jax.default_backend() == "tpu"
+            )
         )
 
     def init(self, rng: jax.Array) -> dict:
         return init_params(rng, self.cfg)
 
     def _attention(self, q, k, v):
+        if self._use_ulysses:
+            return ulysses_attention(
+                q, k, v, mesh=self.mesh, axis_name="sp", causal=True,
+                use_flash=self.cfg.ring_use_flash,
+            )
         if self._use_ring:
             return ring_attention(
                 q, k, v, mesh=self.mesh, axis_name="sp", causal=True,
@@ -328,10 +344,13 @@ class Transformer:
         v = jnp.einsum("bsd,dke->bske", h, load_weight(layer["wv"], cfg.dtype))
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
-        if cfg.n_kv_heads != cfg.n_heads and not self._use_flash:
+        if cfg.n_kv_heads != cfg.n_heads and not (
+            self._use_flash or self._use_ulysses
+        ):
             # GQA: dense/ring paths need explicit head repeat; the flash
-            # kernels serve K < H through their kv index map instead of
-            # materialising H/K× the kv bytes in HBM.
+            # kernels (and ulysses, which calls them per head-shard) serve
+            # K < H through their kv index map instead of materialising
+            # H/K× the kv bytes in HBM.
             rep = cfg.n_heads // cfg.n_kv_heads
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
@@ -364,10 +383,10 @@ class Transformer:
             from torchkafka_tpu.ops.pipeline import gpipe
 
             sp_size = self.mesh.shape.get("sp", 1)
-            if sp_size > 1 and not self._use_ring:
+            if sp_size > 1 and not (self._use_ring or self._use_ulysses):
                 raise ValueError(
-                    "a pp mesh with sp>1 requires ring attention "
-                    "(attn_impl='ring' or 'auto')"
+                    "a pp mesh with sp>1 requires sequence-parallel "
+                    "attention (attn_impl='ring', 'ulysses', or 'auto')"
                 )
             layer_fn = lambda a, layer: self._layer(a, layer)[0]  # noqa: E731
             if cfg.remat:

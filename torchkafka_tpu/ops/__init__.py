@@ -8,7 +8,7 @@ the hot op of every downstream consumer of our ingested batches, so the
 framework ships MXU-shaped implementations of it.
 """
 
-from torchkafka_tpu.ops.attention import mha, ring_attention
+from torchkafka_tpu.ops.attention import mha, ring_attention, ulysses_attention
 from torchkafka_tpu.ops.flash import flash_attention
 
-__all__ = ["flash_attention", "mha", "ring_attention"]
+__all__ = ["flash_attention", "mha", "ring_attention", "ulysses_attention"]

@@ -1,4 +1,4 @@
-"""Attention: dense (XLA) and ring (sequence-parallel) implementations.
+"""Attention: dense (XLA), ring, and Ulysses (sequence-parallel) implementations.
 
 Net-new vs the reference (SURVEY.md §2: no attention anywhere in its tree);
 built TPU-first:
@@ -14,6 +14,11 @@ built TPU-first:
   n× longer than a single chip's HBM would allow. Numerics follow the
   flash-attention online-softmax recurrence (running max m, running
   normalizer l) so the result is exact, not approximate.
+- ``ulysses_attention``: the all-to-all alternative — two ``lax.all_to_all``
+  exchanges convert the sequence split into a head split and back, so each
+  device runs one full-sequence flash call over H/n heads. Same exact
+  result, different comm/compute shape (see its docstring for the
+  ring-vs-ulysses tradeoff).
 
 Both are differentiable (``ppermute`` and ``lax.scan`` have transpose rules),
 so ring attention composes with ``jax.value_and_grad`` in the training step.
@@ -263,6 +268,110 @@ def _ring_flash_bwd(axis_name, axis_size, causal, block, res, g):
 
 
 _ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+# ------------------------------------------------- Ulysses (all-to-all) SP
+
+
+def _ulysses_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    axis_size: int,
+    causal: bool,
+    use_flash: bool | None,
+) -> jax.Array:
+    """Per-device body (runs under shard_map). q/k/v: local [B, Sl, H, D].
+
+    Two all-to-alls re-partition the problem: the first trades the sequence
+    split for a head split ([B, Sl, H, D] → [B, S, H/n, D]), so each device
+    runs FULL-sequence attention over its head subset — one flash kernel
+    call instead of a ring of n — and the second trades back. Both
+    all-to-alls move the same volume a ring moves in total, but as two
+    dense exchanges XLA schedules across ICI instead of n dependent
+    neighbour hops; ``lax.all_to_all`` has a transpose rule, so the
+    backward differentiates through the same pattern reversed.
+    """
+    from torchkafka_tpu.ops.flash import _auto_block, flash_attention
+
+    a2a = functools.partial(lax.all_to_all, axis_name=axis_name, tiled=True)
+    qh = a2a(q, split_axis=2, concat_axis=1)  # [B, S, Hq/n, D]
+    kh = a2a(k, split_axis=2, concat_axis=1)  # [B, S, Hkv/n, D]
+    vh = a2a(v, split_axis=2, concat_axis=1)
+    if use_flash is None:
+        use_flash = jax.default_backend() == "tpu"
+    if use_flash and _auto_block(qh.shape[1]):
+        out = flash_attention(qh, kh, vh, causal)  # GQA-native kv reads
+    else:
+        from torchkafka_tpu.ops.flash import _repeat_kv
+
+        kh, vh = _repeat_kv(qh, kh, vh)  # dense path: repeat kv for GQA
+        out = mha(qh, kh, vh, causal=causal)
+    return a2a(out, split_axis=1, concat_axis=2)  # back to [B, Sl, H, D]
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mesh: Mesh,
+    axis_name: str = "sp",
+    causal: bool = True,
+    use_flash: bool | None = None,
+) -> jax.Array:
+    """Exact sequence-parallel attention via all-to-all head re-partitioning
+    (the DeepSpeed-Ulysses pattern, built from ``lax.all_to_all`` over the
+    mesh axis rather than any NCCL analog).
+
+    Same contract as ``ring_attention`` — global [B, S, H, D] arrays,
+    seq-sharded over ``axis_name`` — but a different comm/compute shape:
+    2 all-to-alls bracketing ONE full-sequence attention per device,
+    versus n dependent ppermute hops each bracketing a shard-sized
+    attention. Ulysses needs head counts divisible by the axis size
+    (heads are the re-partition currency); ring has no head constraint
+    and GQA kv travels unrepeated. Pick per model: many-headed dense
+    models → ulysses; few-kv-head GQA at extreme context → ring.
+    """
+    axis_size = mesh.shape[axis_name]
+    if axis_size == 1:
+        return mha(q, k, v, causal=causal) if q.shape[2] == k.shape[2] else (
+            _gqa_dense(q, k, v, causal)
+        )
+    if q.shape[2] % axis_size or k.shape[2] % axis_size:
+        raise ValueError(
+            f"ulysses_attention re-partitions heads over {axis_name!r} "
+            f"(size {axis_size}): q heads {q.shape[2]} and kv heads "
+            f"{k.shape[2]} must both be divisible by it — use "
+            "ring_attention for indivisible head counts"
+        )
+    from jax.sharding import get_abstract_mesh
+
+    ctx = get_abstract_mesh()
+    body = functools.partial(
+        _ulysses_local, axis_name=axis_name, axis_size=axis_size,
+        causal=causal, use_flash=use_flash,
+    )
+    if axis_name in getattr(ctx, "manual_axes", ()):
+        return body(q, k, v)
+    spec = P(None, axis_name, None, None)
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        axis_names=frozenset({axis_name}),
+        check_vma=False,
+    )(q, k, v)
+
+
+def _gqa_dense(q, k, v, causal):
+    from torchkafka_tpu.ops.flash import _repeat_kv
+
+    k, v = _repeat_kv(q, k, v)
+    return mha(q, k, v, causal=causal)
 
 
 def ring_attention(
