@@ -90,36 +90,42 @@ def main() -> None:
         params, opt_state = init_fn(jax.random.key(0))
         start = 0
 
-    with tk.KafkaStream(
-        consumer,
-        tk.fixed_width(SEQ, np.int32),
-        batch_size=args.batch,
-        mesh=mesh,
-        idle_timeout_ms=2000,
-        owns_consumer=True,
-    ) as stream:
-        step = start
-        fut = None
-        for batch, token in stream:
-            mask = jnp.broadcast_to(
-                jnp.asarray(batch.valid_mask()[:, None]), batch.data.shape
-            ).astype(jnp.int32)
-            params, opt_state, loss = step_fn(params, opt_state, batch.data, mask)
-            # Pipelined commit-after-step: offsets become durable only once
-            # this step's loss is device-complete on every host.
-            fut = token.commit_async(wait_for=loss)
-            if step % 10 == 0:
-                print(f"step {step}  loss {float(loss):.4f}")
-            if step and step % args.ckpt_every == 0:
-                fut.result()  # offsets for this state are durable
-                ckpt.save(step, jax.tree_util.tree_map(np.asarray, (params, opt_state)),
-                          token.offsets)
-                print(f"checkpoint @ step {step}")
-            step += 1
-            if step - start >= args.steps:
-                break
-        if fut is not None:
-            fut.result()
+    try:
+        with tk.KafkaStream(
+            consumer,
+            tk.fixed_width(SEQ, np.int32),
+            batch_size=args.batch,
+            mesh=mesh,
+            idle_timeout_ms=2000,
+            owns_consumer=True,
+        ) as stream:
+            step = start
+            fut = None
+            for batch, token in stream:
+                mask = jnp.broadcast_to(
+                    jnp.asarray(batch.valid_mask()[:, None]), batch.data.shape
+                ).astype(jnp.int32)
+                params, opt_state, loss = step_fn(params, opt_state, batch.data, mask)
+                # Pipelined commit-after-step: offsets become durable only once
+                # this step's loss is device-complete on every host.
+                fut = token.commit_async(wait_for=loss)
+                if step % 10 == 0:
+                    print(f"step {step}  loss {float(loss):.4f}")
+                if step and step % args.ckpt_every == 0:
+                    fut.result()  # offsets for this state are durable
+                    # Non-blocking: the write drains while training continues;
+                    # save_async snapshots the state before returning.
+                    ckpt.save_async(step, (params, opt_state), token.offsets)
+                    print(f"checkpoint @ step {step} (async)")
+                step += 1
+                if step - start >= args.steps:
+                    break
+            if fut is not None:
+                fut.result()
+    finally:
+        # The finalizer thread is a daemon: exiting (even on an exception)
+        # without joining it could kill the commit rename mid-flight.
+        ckpt.wait_until_finished()
     print(f"done at step {step}; metrics: {stream.metrics.summary()}")
 
 

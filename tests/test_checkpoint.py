@@ -52,6 +52,44 @@ class TestSaveRestore:
             ck.restore()
 
 
+class TestAsyncSave:
+    def test_async_roundtrip(self, tmp_path):
+        """save_async returns before the rename; restore (which waits for
+        the finalizer) sees the committed checkpoint."""
+        ck = StreamCheckpointer(tmp_path / "ck")
+        offsets = {TopicPartition("t", 0): 11}
+        ck.save_async(3, _state(3), offsets)
+        state, got, step = ck.restore()
+        assert step == 3 and got == offsets
+        np.testing.assert_array_equal(state["w"], _state(3)["w"])
+
+    def test_async_saves_serialize_in_step_order(self, tmp_path):
+        ck = StreamCheckpointer(tmp_path / "ck", keep=2)
+        for s in (1, 2, 3):
+            ck.save_async(s, _state(s), {TopicPartition("t", 0): s})
+        ck.wait_until_finished()
+        assert ck.steps() == [2, 3]
+        _, offsets, step = ck.restore()
+        assert step == 3 and offsets[TopicPartition("t", 0)] == 3
+
+    def test_mutating_state_after_dispatch_does_not_tear(self, tmp_path):
+        """The training loop keeps updating params while the write drains;
+        the checkpoint must hold the values at dispatch time (the
+        device→host snapshot taken inside save_async)."""
+        ck = StreamCheckpointer(tmp_path / "ck")
+        state = {"w": np.full((4,), 1.0, np.float32)}
+        ck.save_async(1, state, {TopicPartition("t", 0): 1})
+        state["w"] += 99.0  # "next train step"
+        restored, _, _ = ck.restore()
+        np.testing.assert_array_equal(restored["w"], np.full((4,), 1.0, np.float32))
+
+    def test_sync_save_waits_for_async(self, tmp_path):
+        ck = StreamCheckpointer(tmp_path / "ck")
+        ck.save_async(1, _state(1), {TopicPartition("t", 0): 1})
+        ck.save(2, _state(2), {TopicPartition("t", 0): 2})
+        assert ck.steps() == [1, 2]
+
+
 class TestKillAndResume:
     def test_resume_replays_exactly_after_checkpoint(self, tmp_path, broker):
         """Train 4 batches, checkpoint at batch 2, 'crash', resume: the new

@@ -117,6 +117,8 @@ class StreamCheckpointer:
         os.makedirs(self._root, exist_ok=True)
         self._keep = keep
         self._ckptr = ocp.StandardCheckpointer()
+        self._pending = None  # in-flight save_async finalizer thread
+        self._pending_error: BaseException | None = None
 
     # ------------------------------------------------------------------ save
 
@@ -132,6 +134,7 @@ class StreamCheckpointer:
         into ``state`` — i.e. commit watermark and weights describe the same
         records.
         """
+        self.wait_until_finished()  # serialize after any async save
         final = os.path.join(self._root, str(step))
         tmp = final + ".tmp"
         multi = jax.process_count() > 1
@@ -173,6 +176,96 @@ class StreamCheckpointer:
             state = jax.tree_util.tree_map(np.asarray, state)  # device → host
             self._ckptr.save(os.path.join(tmp, "state"), state)
         self._ckptr.wait_until_finished()
+        self._write_offsets(tmp, pid, multi, step, offsets)
+        if multi:
+            from jax.experimental import multihost_utils as _mh
+
+            _mh.sync_global_devices(f"ckpt-written-{step}")
+        if pid == 0:
+            self._commit_rename(tmp, final)
+        if multi:
+            from jax.experimental import multihost_utils as _mh
+
+            _mh.sync_global_devices(f"ckpt-renamed-{step}")
+        logger.info("checkpoint %d saved (%d partitions)", step, len(offsets))
+        return final
+
+    def save_async(
+        self,
+        step: int,
+        state: Any,
+        offsets: Mapping[TopicPartition, int],
+    ) -> None:
+        """Non-blocking ``save``: dispatch the Orbax write and return; a
+        finalizer thread performs the atomic rename once the write lands.
+        The training loop keeps stepping while the checkpoint drains —
+        Orbax snapshots device arrays to host before returning from its
+        (async) ``save``, so later parameter updates cannot tear the
+        checkpoint.
+
+        Serialization: a second ``save_async`` (or ``save``) first waits
+        for the previous one, so checkpoints commit in step order. Call
+        ``wait_until_finished()`` before reading ``steps()``/``restore()``
+        if you need the async save visible. On a pod this falls back to
+        the synchronous path: the rename barriers must interleave
+        identically on every host, which a background thread racing the
+        main thread's commit barriers cannot guarantee."""
+        if jax.process_count() > 1:
+            self.save(step, state, offsets)
+            return
+        self.wait_until_finished()
+        final = os.path.join(self._root, str(step))
+        tmp = final + ".tmp"
+        if os.path.exists(tmp):
+            import shutil
+
+            shutil.rmtree(tmp)
+        # Copy only host-resident leaves: np.asarray on an already-host
+        # array is a view, and the caller's next in-place update would
+        # tear the still-draining write. jax.Arrays are snapshotted by
+        # Orbax's own async D2H copy — no need to block on them here.
+        state = jax.tree_util.tree_map(
+            lambda x: x if isinstance(x, jax.Array) else np.array(x), state
+        )
+        self._ckptr.save(os.path.join(tmp, "state"), state)
+        self._write_offsets(tmp, 0, False, step, offsets)
+
+        def _finalize() -> None:
+            try:
+                self._ckptr.wait_until_finished()
+                self._commit_rename(tmp, final)
+                logger.info("async checkpoint %d committed", step)
+            except BaseException as e:  # noqa: BLE001 - re-raised on join
+                self._pending_error = e
+
+        import threading
+
+        self._pending = threading.Thread(
+            target=_finalize, name=f"ckpt-finalize-{step}", daemon=True
+        )
+        self._pending.start()
+
+    def wait_until_finished(self) -> None:
+        """Block until any in-flight ``save_async`` has fully committed.
+        Re-raises the finalizer's failure — a checkpoint that failed to
+        commit must not look durable."""
+        pending = getattr(self, "_pending", None)
+        if pending is not None:
+            pending.join()
+            self._pending = None
+        err = getattr(self, "_pending_error", None)
+        if err is not None:
+            self._pending_error = None
+            raise RuntimeError("async checkpoint failed to commit") from err
+
+    def _write_offsets(
+        self,
+        tmp: str,
+        pid: int,
+        multi: bool,
+        step: int,
+        offsets: Mapping[TopicPartition, int],
+    ) -> None:
         with open(os.path.join(tmp, _offsets_file(pid, multi)), "w") as f:
             json.dump(
                 {
@@ -185,23 +278,14 @@ class StreamCheckpointer:
             )
             f.flush()
             os.fsync(f.fileno())
-        if multi:
-            from jax.experimental import multihost_utils as _mh
 
-            _mh.sync_global_devices(f"ckpt-written-{step}")
-        if pid == 0:
-            if os.path.exists(final):
-                import shutil
+    def _commit_rename(self, tmp: str, final: str) -> None:
+        if os.path.exists(final):
+            import shutil
 
-                shutil.rmtree(final)
-            os.rename(tmp, final)  # the atomic commit point
-            self._gc()
-        if multi:
-            from jax.experimental import multihost_utils as _mh
-
-            _mh.sync_global_devices(f"ckpt-renamed-{step}")
-        logger.info("checkpoint %d saved (%d partitions)", step, len(offsets))
-        return final
+            shutil.rmtree(final)
+        os.rename(tmp, final)  # the atomic commit point
+        self._gc()
 
     def _gc(self) -> None:
         steps = self.steps()
@@ -245,6 +329,7 @@ class StreamCheckpointer:
         overlap on a partition (a save written twice across a topology
         change), the SMALLER watermark wins: seeking too far forward would
         skip records, while re-delivery is the at-least-once contract."""
+        self.wait_until_finished()  # make any in-flight async save visible
         if step is None:
             step = self.latest_step()
             if step is None:
