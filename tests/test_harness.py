@@ -8,7 +8,7 @@ import pytest
 from torchkafka_tpu.harness import run_scenario
 
 
-@pytest.mark.parametrize("num", [1, 2, 3, 4, 5, 6, 7])
+@pytest.mark.parametrize("num", [1, 2, 3, 4, 5, 6, 7, 8])
 def test_scenario_runs_and_reports(num):
     out = run_scenario(num, "tiny")
     assert out["records"] > 0
@@ -21,6 +21,12 @@ def test_scenario_runs_and_reports(num):
 def test_scenario_3_trains():
     out = run_scenario(3, "tiny")
     assert out["last_loss"] < out["first_loss"]
+
+
+def test_scenario_8_trains():
+    out = run_scenario(8, "tiny")
+    # Streaming: every step is a fresh batch, so compare quartile means.
+    assert out["tail_loss_mean"] < out["head_loss_mean"]
 
 
 def test_scenario_5_token_accounting():

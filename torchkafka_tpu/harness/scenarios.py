@@ -1,4 +1,5 @@
-"""The five BASELINE.json scenarios, each returning a metrics dict.
+"""The harness scenarios (BASELINE.json's five configs + net-new ones),
+each returning a metrics dict.
 
 | # | Scenario | Reference analog |
 |---|----------|------------------|
@@ -9,6 +10,7 @@
 | 5 | prompt topic → KV-cache generate → commit post-generation | none |
 | 6 | scenario 1 at batch 256 | isolates the reference's toy batch-4 choice |
 | 7 | continuous-batching serving (slot recycling, EOS) | none |
+| 8 | streaming CTR: DLRM train, tp-sharded embedding tables | none |
 
 Every scenario runs the full transactional loop (poll → transform → batch →
 device → step → barrier → commit) and reports ``records_per_s`` plus commit
@@ -402,6 +404,91 @@ def scenario_7(size: str = "tiny") -> dict:
     }
 
 
+def scenario_8(size: str = "tiny") -> dict:
+    """Streaming CTR: DLRM-style recommender trained from a Kafka event
+    stream — label + dense features + hashed categorical ids per record,
+    row-sharded embedding tables over tp, commit-after-step. The canonical
+    production consumer of the reference's ingest loop (no reference
+    analog: it ships no model code)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import torchkafka_tpu as tk
+    from torchkafka_tpu.models.recsys import (
+        DLRMConfig, count_params, make_dlrm_train_step, make_processor,
+        record_nbytes,
+    )
+
+    n_dev = len(jax.devices())
+    tp = 2 if n_dev % 2 == 0 and n_dev > 1 else 1
+    mesh = tk.make_mesh({"data": n_dev // tp, "tp": tp})
+    cfg = (
+        DLRMConfig(dense_dim=4, vocab_sizes=(64, 32, 128), embed_dim=8,
+                   bottom_mlp=(16, 8), top_mlp=(32, 1))
+        if size == "tiny"
+        else DLRMConfig()  # 8 tables x 100k rows x 64 — tables are the bytes
+    )
+    steps = 24 if size == "tiny" else 40
+    local_batch = 4 * n_dev if size == "tiny" else 4096
+    n = steps * local_batch
+
+    broker = tk.InMemoryBroker()
+    parts = max(n_dev, 4)
+    broker.create_topic("ctr", partitions=parts)
+    rng = np.random.default_rng(0)
+
+    def _records():
+        for _ in range(n):
+            dense = rng.normal(size=cfg.dense_dim).astype(np.float32)
+            cats = np.array(
+                [rng.integers(0, v) for v in cfg.vocab_sizes], np.int32
+            )
+            label = np.float32(dense.sum() > 0)
+            yield label.tobytes() + dense.tobytes() + cats.tobytes()
+
+    broker.produce_many("ctr", _records())
+    consumer = tk.MemoryConsumer(
+        broker, "ctr", group_id="s8",
+        assignment=tk.partitions_for_process("ctr", parts, 0, 1),
+    )
+    init_fn, step_fn = make_dlrm_train_step(cfg, mesh, optax.adam(1e-2))
+    params, opt_state = init_fn(jax.random.key(0))
+    state = {"params": params, "opt": opt_state, "losses": []}
+
+    def step(batch):
+        mask = jnp.asarray(batch.valid_mask(), jnp.float32)
+        state["params"], state["opt"], loss = step_fn(
+            state["params"], state["opt"], batch.data["dense"],
+            batch.data["cats"], batch.data["label"], mask,
+        )
+        state["losses"].append(loss)
+        return loss
+
+    with tk.KafkaStream(
+        consumer, make_processor(cfg), batch_size=local_batch,
+        mesh=mesh, idle_timeout_ms=2000, owns_consumer=True,
+        transform_threads=4 if size == "full" else 0,
+    ) as stream:
+        rows, elapsed = _drain(stream, step, n)
+    losses = [float(x) for x in state["losses"]]
+    q = max(1, len(losses) // 4)
+    return _result(
+        "8:streaming-ctr", rows, elapsed, stream,
+        {
+            "mesh": dict(mesh.shape),
+            "record_bytes": record_nbytes(cfg),
+            "params_m": round(count_params(state["params"]) / 1e6, 1),
+            "first_loss": round(losses[0], 4),
+            "last_loss": round(losses[-1], 4),
+            # Every step sees a FRESH batch (true streaming), so single-step
+            # losses are noisy; head/tail quartile means are the trend.
+            "head_loss_mean": round(float(np.mean(losses[:q])), 4),
+            "tail_loss_mean": round(float(np.mean(losses[-q:])), 4),
+        },
+    )
+
+
 SCENARIOS = {
     1: scenario_1,
     2: scenario_2,
@@ -410,6 +497,7 @@ SCENARIOS = {
     5: scenario_5,
     6: scenario_6,
     7: scenario_7,
+    8: scenario_8,
 }
 
 

@@ -9,10 +9,17 @@ sharded batch → pjit step → barrier → commit — is demonstrated and bench
 against real MXU-shaped compute, not a stub.
 """
 
+from torchkafka_tpu.models.recsys import DLRMConfig, make_dlrm_train_step
 from torchkafka_tpu.models.transformer import (
     Transformer,
     TransformerConfig,
     make_train_step,
 )
 
-__all__ = ["Transformer", "TransformerConfig", "make_train_step"]
+__all__ = [
+    "DLRMConfig",
+    "Transformer",
+    "TransformerConfig",
+    "make_dlrm_train_step",
+    "make_train_step",
+]

@@ -1,0 +1,228 @@
+"""DLRM-style streaming recommender: embedding tables + interaction + MLPs.
+
+The canonical consumer of a Kafka ingest pipeline is not an LLM but a
+click-through-rate model fed by an event stream — the workload the
+reference's users run DataLoader ingest for (the reference itself ships no
+model code, SURVEY.md §2). This module makes that concrete, TPU-first:
+
+- **Embedding tables are the capacity.** Production CTR models put >90% of
+  parameters in the tables, so they shard ROW-wise over the mesh's ``tp``
+  axis (``P("tp", None)``): each device holds a vocab stripe, and
+  ``jnp.take`` over the sharded table lowers to XLA's distributed gather
+  over ICI — no parameter server, no host-side sharding logic (the DLRM
+  pattern re-expressed as sharding annotations instead of NCCL alltoall).
+- **MLPs are MXU food.** Bottom (dense features) and top (post-interaction)
+  towers run in bf16; they are small relative to the tables and replicate.
+- **Feature interaction** is the standard pairwise-dot block: stack the
+  bottom output with the per-feature embeddings [B, C+1, E] and take the
+  upper triangle of the Gram matrix — one batched matmul, no gathers.
+
+Record layout for the streaming path (``parse_record`` /
+``make_processor``): float32 label, float32[dense_dim] dense features,
+int32[n_tables] categorical ids — the shape a Kafka CTR event naturally
+has after feature hashing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from torchkafka_tpu.models.transformer import shardings_for_mesh
+from torchkafka_tpu.source.records import Record
+
+
+@dataclasses.dataclass(frozen=True)
+class DLRMConfig:
+    dense_dim: int = 13
+    vocab_sizes: tuple[int, ...] = tuple([100_000] * 8)
+    embed_dim: int = 64
+    bottom_mlp: tuple[int, ...] = (128, 64)  # last entry must equal embed_dim
+    top_mlp: tuple[int, ...] = (256, 128, 1)  # last entry must be 1 (logit)
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    def __post_init__(self):
+        if self.bottom_mlp[-1] != self.embed_dim:
+            raise ValueError(
+                f"bottom_mlp must end at embed_dim ({self.embed_dim}) so the "
+                f"dense vector joins the interaction block; got {self.bottom_mlp}"
+            )
+        if self.top_mlp[-1] != 1:
+            raise ValueError("top_mlp must end at 1 (the CTR logit)")
+
+    @property
+    def n_tables(self) -> int:
+        return len(self.vocab_sizes)
+
+    @property
+    def n_interactions(self) -> int:
+        n = self.n_tables + 1  # +1: the bottom-MLP dense vector
+        return n * (n - 1) // 2
+
+
+def param_specs(cfg: DLRMConfig) -> dict:
+    """Tables shard rows over ``tp`` (the capacity axis); towers replicate
+    (they are KBs next to the tables' GBs). Axes absent from the actual
+    mesh are stripped by ``shardings_for_mesh``."""
+    return {
+        "tables": {f"t{i}": P("tp", None) for i in range(cfg.n_tables)},
+        "bottom": [(P(None, None), P(None)) for _ in cfg.bottom_mlp],
+        "top": [(P(None, None), P(None)) for _ in cfg.top_mlp],
+    }
+
+
+def init_params(rng: jax.Array, cfg: DLRMConfig) -> dict:
+    n_bottom, n_top = len(cfg.bottom_mlp), len(cfg.top_mlp)
+    keys = jax.random.split(rng, cfg.n_tables + n_bottom + n_top)
+    pd = cfg.param_dtype
+
+    def norm(key, shape, fan_in):
+        return (jax.random.normal(key, shape, pd) / np.sqrt(fan_in)).astype(pd)
+
+    def mlp(keys, dims, d_in):
+        layers = []
+        for key, d_out in zip(keys, dims):
+            wkey, bkey = jax.random.split(key)
+            layers.append((norm(wkey, (d_in, d_out), d_in), jnp.zeros(d_out, pd)))
+            d_in = d_out
+        return layers
+
+    tables = {
+        f"t{i}": norm(keys[i], (v, cfg.embed_dim), cfg.embed_dim)
+        for i, v in enumerate(cfg.vocab_sizes)
+    }
+    return {
+        "tables": tables,
+        "bottom": mlp(keys[cfg.n_tables:cfg.n_tables + n_bottom], cfg.bottom_mlp, cfg.dense_dim),
+        "top": mlp(
+            keys[cfg.n_tables + n_bottom:],
+            cfg.top_mlp,
+            cfg.n_interactions + cfg.embed_dim,
+        ),
+    }
+
+
+def _tower(x: jax.Array, layers, dtype, final_linear: bool) -> jax.Array:
+    for i, (w, b) in enumerate(layers):
+        x = x @ w.astype(dtype) + b.astype(dtype)
+        if not (final_linear and i == len(layers) - 1):
+            x = jax.nn.relu(x)
+    return x
+
+
+def forward(params: dict, dense: jax.Array, cats: jax.Array, cfg: DLRMConfig) -> jax.Array:
+    """dense [B, dense_dim] f32, cats [B, n_tables] int32 → logits [B] f32."""
+    dt = cfg.dtype
+    bottom = _tower(dense.astype(dt), params["bottom"], dt, final_linear=False)
+    embs = [
+        jnp.take(params["tables"][f"t{i}"], cats[:, i], axis=0).astype(dt)
+        for i in range(cfg.n_tables)
+    ]
+    feats = jnp.stack([bottom, *embs], axis=1)  # [B, C+1, E]
+    gram = jnp.einsum(
+        "bie,bje->bij", feats, feats, preferred_element_type=jnp.float32
+    )
+    iu, ju = jnp.triu_indices(feats.shape[1], k=1)
+    inter = gram[:, iu, ju].astype(dt)  # [B, n_interactions]
+    top_in = jnp.concatenate([bottom, inter], axis=-1)
+    logits = _tower(top_in, params["top"], dt, final_linear=True)
+    return logits[:, 0].astype(jnp.float32)
+
+
+def loss_fn(
+    params: dict,
+    dense: jax.Array,
+    cats: jax.Array,
+    labels: jax.Array,
+    mask: jax.Array,
+    cfg: DLRMConfig,
+) -> jax.Array:
+    """Masked mean sigmoid binary cross-entropy (mask: padded batcher rows
+    contribute nothing — the reference's None-drop analog at batch level)."""
+    logits = forward(params, dense, cats, cfg)
+    per_row = jnp.maximum(logits, 0) - logits * labels + jnp.log1p(
+        jnp.exp(-jnp.abs(logits))
+    )
+    mask = mask.astype(jnp.float32)
+    return (per_row * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def _dlrm_batch_spec(mesh: Mesh) -> P:
+    daxes = tuple(a for a in ("data", "fsdp") if a in mesh.shape)
+    return P(daxes if daxes else None)
+
+
+def make_dlrm_train_step(
+    cfg: DLRMConfig,
+    mesh: Mesh,
+    optimizer: Any,
+) -> tuple[Callable[[jax.Array], tuple], Callable[..., tuple]]:
+    """(init_fn, step_fn) jitted over the mesh, same contract as the
+    transformer's ``make_train_step``: step_fn(params, opt_state, dense,
+    cats, labels, mask) → (params, opt_state, loss), donating state."""
+    p_shardings = shardings_for_mesh(mesh, param_specs(cfg))
+    row = NamedSharding(mesh, _dlrm_batch_spec(mesh))
+    mat = NamedSharding(mesh, P(*_dlrm_batch_spec(mesh), None))
+    repl = NamedSharding(mesh, P())
+
+    @jax.jit
+    def init_fn(rng):
+        params = init_params(rng, cfg)
+        params = jax.lax.with_sharding_constraint(params, p_shardings)
+        return params, optimizer.init(params)
+
+    def _step(params, opt_state, dense, cats, labels, mask):
+        dense = jax.lax.with_sharding_constraint(dense, mat)
+        cats = jax.lax.with_sharding_constraint(cats, mat)
+        labels = jax.lax.with_sharding_constraint(labels, row)
+        mask = jax.lax.with_sharding_constraint(mask, row)
+        loss, grads = jax.value_and_grad(loss_fn)(
+            params, dense, cats, labels, mask, cfg
+        )
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        params = jax.lax.with_sharding_constraint(params, p_shardings)
+        return params, opt_state, loss
+
+    step_fn = jax.jit(
+        _step, donate_argnums=(0, 1), out_shardings=(p_shardings, None, repl)
+    )
+    return init_fn, step_fn
+
+
+# ------------------------------------------------------------- stream glue
+
+
+def record_nbytes(cfg: DLRMConfig) -> int:
+    return 4 * (1 + cfg.dense_dim + cfg.n_tables)
+
+
+def parse_record(value: bytes, cfg: DLRMConfig) -> dict[str, np.ndarray]:
+    """float32 label | float32[dense_dim] | int32[n_tables] → element pytree."""
+    d = cfg.dense_dim
+    head = np.frombuffer(value, np.float32, count=1 + d)
+    cats = np.frombuffer(value, np.int32, count=cfg.n_tables, offset=4 * (1 + d))
+    return {"label": head[0], "dense": head[1 : 1 + d], "cats": cats}
+
+
+def make_processor(cfg: DLRMConfig) -> Callable[[Record], dict | None]:
+    """Per-record processor for ``KafkaStream`` (None-drop on short records,
+    the reference's ``_process`` contract)."""
+    nbytes = record_nbytes(cfg)
+
+    def processor(record: Record) -> dict | None:
+        if len(record.value) != nbytes:
+            return None
+        return parse_record(record.value, cfg)
+
+    return processor
+
+
+def count_params(params: dict) -> int:
+    return int(sum(np.prod(l.shape) for l in jax.tree_util.tree_leaves(params)))
