@@ -42,6 +42,9 @@ FakeRecord = collections.namedtuple(
     "ConsumerRecord",
     ["topic", "partition", "offset", "value", "key", "timestamp", "headers"],
 )
+OffsetAndTimestamp = collections.namedtuple(
+    "OffsetAndTimestamp", ["offset", "timestamp"]
+)
 
 
 def fake_record(topic, partition, offset, value=b"v"):
@@ -93,6 +96,24 @@ class FakeKafkaConsumer:
 
     def close(self, autocommit=True):
         self.close_calls.append(autocommit)
+
+    def offsets_for_times(self, times):
+        self.offsets_for_times_calls = getattr(self, "offsets_for_times_calls", [])
+        self.offsets_for_times_calls.append(dict(times))
+        # One partition found, one too-new (kafka-python returns None).
+        return {
+            ktp: (None if ktp.partition == 1 else OffsetAndTimestamp(7, ts))
+            for ktp, ts in times.items()
+        }
+
+    def pause(self, *tps):
+        self._paused = getattr(self, "_paused", set()) | set(tps)
+
+    def resume(self, *tps):
+        self._paused = getattr(self, "_paused", set()) - set(tps)
+
+    def paused(self):
+        return getattr(self, "_paused", set())
 
 
 def _install_stub(oam_cls):
@@ -256,3 +277,33 @@ class TestClose:
         c.close()
         c.close()
         assert c._consumer.close_calls == [False]
+
+
+class TestTimeAndFlowControl:
+    """offsets_for_times / pause / resume translation."""
+
+    def test_offsets_for_times_translation(self, adapter):
+        c = adapter.KafkaConsumer(
+            "t", bootstrap_servers=["b:9092"], group_id="g",
+            assignment=[TopicPartition("t", 0), TopicPartition("t", 1)],
+        )
+        out = c.offsets_for_times(
+            {TopicPartition("t", 0): 1_000, TopicPartition("t", 1): 2_000}
+        )
+        # Framework types in, framework types out; None passes through.
+        assert out == {TopicPartition("t", 0): 7, TopicPartition("t", 1): None}
+        sent = c._consumer.offsets_for_times_calls[0]
+        assert set(sent) == {
+            FakeTopicPartition("t", 0), FakeTopicPartition("t", 1)
+        }
+        assert sorted(sent.values()) == [1_000, 2_000]
+
+    def test_pause_resume_translation(self, adapter):
+        tps = [TopicPartition("t", 0), TopicPartition("t", 1)]
+        c = adapter.KafkaConsumer(
+            "t", bootstrap_servers=["b:9092"], group_id="g", assignment=tps
+        )
+        c.pause(*tps)
+        assert c.paused() == tps
+        c.resume(tps[0])
+        assert c.paused() == [tps[1]]

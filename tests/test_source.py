@@ -225,3 +225,111 @@ class TestManualAssignment:
         )
         with pytest.raises(NotAssignedError):
             c.commit({TopicPartition("t", 1): 1})
+
+
+class TestTimeAndFlowControl:
+    def test_offsets_for_times(self, broker):
+        broker.create_topic("t", partitions=1)
+        tp = TopicPartition("t", 0)
+        for ts in (100, 200, 300):
+            broker.produce("t", b"v", timestamp_ms=ts)
+        c = MemoryConsumer(broker, "t", group_id="g", assignment=[tp])
+        assert c.offsets_for_times({tp: 50}) == {tp: 0}
+        assert c.offsets_for_times({tp: 200}) == {tp: 1}
+        assert c.offsets_for_times({tp: 201}) == {tp: 2}
+        assert c.offsets_for_times({tp: 999}) == {tp: None}  # all older
+
+    def test_seek_to_timestamp_replays_from_time_point(self, broker):
+        """The time-travel resume: every assigned partition positions at the
+        first record at/after the timestamp; partitions with nothing newer
+        seek to their log END (replay nothing — a fresh consumer must not
+        fall back to auto_offset_reset and replay the stale partition)."""
+        from torchkafka_tpu.source import seek_to_timestamp
+
+        broker.create_topic("t", partitions=2)
+        for i in range(4):
+            broker.produce("t", f"a{i}".encode(), partition=0, timestamp_ms=100 + i)
+        broker.produce("t", b"old", partition=1, timestamp_ms=50)
+        tps = [TopicPartition("t", 0), TopicPartition("t", 1)]
+        c = MemoryConsumer(broker, "t", group_id="g", assignment=tps)
+        # Drain everything first; then rewind to ts=102.
+        while c.poll(max_records=100, timeout_ms=10):
+            pass
+        seeked = seek_to_timestamp(c, 102)
+        # Partition 1 has nothing >= 102: positioned at its end (offset 1).
+        assert seeked == {tps[0]: 2, tps[1]: 1}
+        got = []
+        while True:
+            recs = c.poll(max_records=100, timeout_ms=10)
+            if not recs:
+                break
+            got.extend(r.value for r in recs)
+        assert got == [b"a2", b"a3"]
+
+    def test_pause_and_resume(self, broker):
+        broker.create_topic("t", partitions=2)
+        for p in (0, 1):
+            for i in range(3):
+                broker.produce("t", f"p{p}-{i}".encode(), partition=p)
+        tps = [TopicPartition("t", 0), TopicPartition("t", 1)]
+        c = MemoryConsumer(broker, "t", group_id="g", assignment=tps)
+        c.pause(tps[0])
+        assert c.paused() == [tps[0]]
+        recs = c.poll(max_records=100, timeout_ms=10)
+        assert {r.partition for r in recs} == {1}  # paused partition skipped
+        c.resume(tps[0])
+        assert c.paused() == []
+        recs = c.poll(max_records=100, timeout_ms=10)
+        assert {r.partition for r in recs} == {0}  # nothing lost, just deferred
+
+    def test_pause_unassigned_raises(self, broker):
+        broker.create_topic("t", partitions=2)
+        c = MemoryConsumer(
+            broker, "t", group_id="g", assignment=[TopicPartition("t", 0)]
+        )
+        with pytest.raises(NotAssignedError):
+            c.pause(TopicPartition("t", 1))
+
+    def test_iterator_withholds_buffered_paused_records(self, broker):
+        """Records already fetched into the iterator buffer must not be
+        yielded while their partition is paused (kafka-python withholds
+        fetched-but-paused records); they re-deliver in order on resume."""
+        broker.create_topic("t", partitions=2)
+        for i in range(3):
+            broker.produce("t", f"p0-{i}".encode(), partition=0)
+            broker.produce("t", f"p1-{i}".encode(), partition=1)
+        tps = [TopicPartition("t", 0), TopicPartition("t", 1)]
+        c = MemoryConsumer(
+            broker, "t", group_id="g", assignment=tps, consumer_timeout_ms=200
+        )
+        got = []
+        it = iter(c)
+        first = next(it)  # one poll has now buffered several records
+        got.append(first.value)
+        c.pause(tps[0])
+        for rec in it:
+            got.append(rec.value)
+            if len(got) == 3:
+                c.resume(tps[0])
+        p0 = [v for v in got if v.startswith(b"p0")]
+        p1 = [v for v in got if v.startswith(b"p1")]
+        assert p1 == [b"p1-0", b"p1-1", b"p1-2"]
+        assert p0 == [b"p0-0", b"p0-1", b"p0-2"]  # order survives the stash
+        assert len(got) == 6
+        # While paused, p0 records after the first must not appear before
+        # the resume point (index 3).
+        assert all(not v.startswith(b"p0") for v in got[1:3])
+
+    def test_seek_to_timestamp_fresh_consumer_skips_stale_partition(self, broker):
+        """The review scenario: a FRESH consumer (nothing committed) must
+        not replay a partition whose records are all older than the target
+        time — its position lands at the log end, not auto_offset_reset."""
+        from torchkafka_tpu.source import seek_to_timestamp
+
+        broker.create_topic("t", partitions=1)
+        tp = TopicPartition("t", 0)
+        for i in range(5):
+            broker.produce("t", f"stale{i}".encode(), timestamp_ms=100 + i)
+        c = MemoryConsumer(broker, "t", group_id="fresh", assignment=[tp])
+        seek_to_timestamp(c, 9_999)
+        assert c.poll(max_records=100, timeout_ms=10) == []

@@ -31,6 +31,7 @@ from torchkafka_tpu.source import (
     InMemoryBroker,
     KafkaConsumer,
     MemoryConsumer,
+    seek_to_timestamp,
     Record,
     TopicPartition,
     partitions_for_process,
@@ -63,6 +64,7 @@ __all__ = [
     "KafkaStream",
     "LocalBarrier",
     "MemoryConsumer",
+    "seek_to_timestamp",
     "OffsetLedger",
     "Record",
     "StreamCheckpointer",

@@ -52,12 +52,61 @@ class Consumer(Protocol):
         """Partitions currently owned by this consumer."""
         ...
 
+    def offsets_for_times(
+        self, times: Mapping[TopicPartition, int]
+    ) -> dict[TopicPartition, int | None]:
+        """For each partition, the earliest offset whose record timestamp is
+        >= the given epoch-ms — ``None`` if every record is older
+        (kafka-python's ``offsets_for_times`` surface). Feed the result to
+        ``seek`` to replay from a point in time."""
+        ...
+
+    def end_offsets(self, tps: Sequence[TopicPartition]) -> dict[TopicPartition, int]:
+        """Next-offset-to-be-produced per partition (the log end)."""
+        ...
+
+    def pause(self, *tps: TopicPartition) -> None:
+        """Stop fetching from these partitions (``poll`` skips them) without
+        losing the assignment — per-partition backpressure."""
+        ...
+
+    def resume(self, *tps: TopicPartition) -> None:
+        """Undo ``pause``."""
+        ...
+
+    def paused(self) -> Sequence[TopicPartition]: ...
+
     def close(self) -> None:
         """Release assignment. NEVER commits on close — uncommitted work must
         be re-delivered (/root/reference/src/kafka_dataset.py:89)."""
         ...
 
     def __iter__(self) -> Iterator[Record]: ...
+
+
+def seek_to_timestamp(consumer: Consumer, timestamp_ms: int) -> dict[TopicPartition, int]:
+    """Position every assigned partition at the first record at/after
+    ``timestamp_ms``. Partitions whose records are ALL older seek to their
+    log end — otherwise a fresh consumer (no committed offsets) would
+    resolve them to ``auto_offset_reset`` and replay the entire stale
+    partition, the opposite of "replay from this point in time" (the
+    standard Kafka pattern: seek the ``offsets_for_times`` result, end
+    offset where it returns None). Returns the offsets seeked to.
+
+    The time-travel analog of the reference's "restart with the same
+    group_id" resume story (/root/reference/README.md:92-96): instead of
+    resuming at the last commit, replay from a wall-clock point.
+    """
+    assigned = list(consumer.assignment())
+    found = consumer.offsets_for_times({tp: timestamp_ms for tp in assigned})
+    missing = [tp for tp, off in found.items() if off is None]
+    ends = consumer.end_offsets(missing) if missing else {}
+    seeked: dict[TopicPartition, int] = {}
+    for tp, offset in found.items():
+        offset = ends[tp] if offset is None else offset
+        consumer.seek(tp, offset)
+        seeked[tp] = offset
+    return seeked
 
 
 class ConsumerIterMixin:
@@ -75,12 +124,27 @@ class ConsumerIterMixin:
         import time as _time
 
         buf: list[Record] = []
+        # Records fetched before their partition was paused: withheld here
+        # (kafka-python retains fetched-but-paused records the same way) and
+        # re-injected ahead of new fetches once the partition resumes —
+        # while paused, poll skips the partition, so nothing newer can
+        # overtake them and per-partition order holds. Keyed off the
+        # transport's `_paused` set when it has one; transports that
+        # withhold natively (kafka-python) never surface paused records
+        # from poll in the first place.
+        stash: dict[TopicPartition, list[Record]] = {}
         idle_limit_ms = getattr(self, "_consumer_timeout_ms", None)
         # kafka-python semantics: the timeout clock measures time spent
         # *waiting for the next record*, not wall time since the last fetch —
         # time the caller spends processing buffered records must not count.
         wait_start: float | None = None
         while True:
+            paused = getattr(self, "_paused", None) or ()
+            if stash:
+                for tp in [tp for tp in stash if tp not in paused]:
+                    resumed = stash.pop(tp)
+                    resumed.reverse()
+                    buf.extend(resumed)  # popped (from the end) before new polls
             if not buf:
                 if getattr(self, "_closed", False):
                     return
@@ -97,6 +161,9 @@ class ConsumerIterMixin:
                 wait_start = None
                 buf.reverse()  # pop from the end, preserve order
             rec = buf.pop()
+            if rec.tp in paused:
+                stash.setdefault(rec.tp, []).append(rec)
+                continue
             # kafka-python iterator semantics: the consumed position advances
             # per record *yielded to the user*, not per record fetched into
             # the buffer — so commit(offsets=None) after iteration covers

@@ -29,6 +29,11 @@ except ImportError:  # pragma: no cover
     HAVE_KAFKA_PYTHON = False
 
 
+def _ktp(tp: TopicPartition):
+    """Framework TopicPartition → kafka-python's (the one conversion)."""
+    return _kafka.TopicPartition(tp.topic, tp.partition)
+
+
 def _offset_and_metadata(offset: int):
     """kafka-python 2.0.2's OffsetAndMetadata is (offset, metadata); newer
     releases added leader_epoch (/root/reference/setup.py:9 pins >=2.0.2, so
@@ -73,7 +78,7 @@ class KafkaConsumer(ConsumerIterMixin):
         if assignment is not None:
             self._consumer = _kafka.KafkaConsumer(**kafka_kwargs)
             self._consumer.assign(
-                [_kafka.TopicPartition(tp.topic, tp.partition) for tp in assignment]
+                [_ktp(tp) for tp in assignment]
             )
         else:
             self._consumer = _kafka.KafkaConsumer(*topics, **kafka_kwargs)
@@ -110,7 +115,7 @@ class KafkaConsumer(ConsumerIterMixin):
             else:
                 self._consumer.commit(
                     {
-                        _kafka.TopicPartition(tp.topic, tp.partition):
+                        _ktp(tp):
                             _offset_and_metadata(off)
                         for tp, off in offsets.items()
                     }
@@ -121,16 +126,59 @@ class KafkaConsumer(ConsumerIterMixin):
             raise errors.CommitFailedError(str(e)) from e
 
     def committed(self, tp: TopicPartition) -> int | None:
-        return self._consumer.committed(_kafka.TopicPartition(tp.topic, tp.partition))
+        return self._consumer.committed(_ktp(tp))
 
     def position(self, tp: TopicPartition) -> int:
-        return self._consumer.position(_kafka.TopicPartition(tp.topic, tp.partition))
+        return self._consumer.position(_ktp(tp))
 
     def seek(self, tp: TopicPartition, offset: int) -> None:
-        self._consumer.seek(_kafka.TopicPartition(tp.topic, tp.partition), offset)
+        self._consumer.seek(_ktp(tp), offset)
 
     def assignment(self) -> list[TopicPartition]:
         return [TopicPartition(tp.topic, tp.partition) for tp in self._consumer.assignment()]
+
+    def offsets_for_times(
+        self, times: Mapping[TopicPartition, int]
+    ) -> dict[TopicPartition, int | None]:
+        found = self._consumer.offsets_for_times(
+            {
+                _ktp(tp): int(ts)
+                for tp, ts in times.items()
+            }
+        )
+        # kafka-python returns {ktp: OffsetAndTimestamp | None}.
+        return {
+            TopicPartition(ktp.topic, ktp.partition):
+                (None if ot is None else int(ot.offset))
+            for ktp, ot in found.items()
+        }
+
+    def end_offsets(self, tps: Sequence[TopicPartition]) -> dict[TopicPartition, int]:
+        ends = self._consumer.end_offsets([_ktp(tp) for tp in tps])
+        return {
+            TopicPartition(ktp.topic, ktp.partition): int(off)
+            for ktp, off in ends.items()
+        }
+
+    def _check_assigned(self, tps) -> None:
+        """Match the memory double's contract (NotAssignedError) instead of
+        leaking kafka-python's internal KeyError/IllegalStateError."""
+        stray = set(tps) - set(self.assignment())
+        if stray:
+            raise errors.NotAssignedError(f"not assigned: {sorted(stray)}")
+
+    def pause(self, *tps: TopicPartition) -> None:
+        self._check_assigned(tps)
+        self._consumer.pause(*(_ktp(tp) for tp in tps))
+
+    def resume(self, *tps: TopicPartition) -> None:
+        self._check_assigned(tps)
+        self._consumer.resume(*(_ktp(tp) for tp in tps))
+
+    def paused(self) -> list[TopicPartition]:
+        return sorted(
+            TopicPartition(tp.topic, tp.partition) for tp in self._consumer.paused()
+        )
 
     def close(self) -> None:
         if self._closed:

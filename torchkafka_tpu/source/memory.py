@@ -111,13 +111,19 @@ class InMemoryBroker:
                 raise ValueError(f"partition {partition} out of range for {topic!r}")
             tp = TopicPartition(topic, partition)
             log = self._logs[tp]
+            ts = int(time.time() * 1000) if timestamp_ms is None else int(timestamp_ms)
+            if log:
+                # LogAppendTime semantics: timestamps are monotone per
+                # partition (clamped, like a broker with its own clock) —
+                # the invariant offset_for_time's bisect relies on.
+                ts = max(ts, log[-1].timestamp_ms)
             rec = Record(
                 topic=topic,
                 partition=partition,
                 offset=len(log),
                 value=value,
                 key=key,
-                timestamp_ms=int(time.time() * 1000) if timestamp_ms is None else timestamp_ms,
+                timestamp_ms=ts,
             )
             log.append(rec)
             self._data_arrived.notify_all()
@@ -138,6 +144,19 @@ class InMemoryBroker:
                 raise UnknownTopicError(tp)
             log = self._logs[tp]
             return log[offset : offset + max_records]
+
+    def offset_for_time(self, tp: TopicPartition, timestamp_ms: int) -> int | None:
+        """Earliest offset whose record timestamp >= ``timestamp_ms``; None
+        if every record is older. Produce order gives monotone timestamps
+        per partition (as Kafka's log-append time does), so bisect applies."""
+        import bisect
+
+        with self._lock:
+            if tp not in self._logs:
+                raise UnknownTopicError(tp)
+            log = self._logs[tp]
+            i = bisect.bisect_left(log, timestamp_ms, key=lambda r: r.timestamp_ms)
+            return log[i].offset if i < len(log) else None
 
     # -------------------------------------------------------------- groups
 
@@ -285,6 +304,7 @@ class MemoryConsumer(ConsumerIterMixin):
         # Positions of records handed out via the iterator (see
         # ConsumerIterMixin): commit(None) prefers these over poll positions.
         self._last_yielded: dict[TopicPartition, int] = {}
+        self._paused: set[TopicPartition] = set()
 
         # Topics must exist either way; surfaces config errors eagerly.
         for t in self._topics:
@@ -321,6 +341,10 @@ class MemoryConsumer(ConsumerIterMixin):
             self._generation, self._assignment = gen, assign
             self._positions.clear()
             self._last_yielded.clear()
+            # Kafka clients rebuild partition state on reassignment: a
+            # revoked-then-reacquired partition comes back UNpaused, and a
+            # paused flag must never outlive the assignment that set it.
+            self._paused.clear()
 
     def _resolve_position(self, tp: TopicPartition) -> int:
         if tp not in self._positions:
@@ -352,6 +376,8 @@ class MemoryConsumer(ConsumerIterMixin):
                 for tp in order:
                     if budget <= 0:
                         break
+                    if tp in self._paused:
+                        continue
                     pos = self._resolve_position(tp)
                     recs = self._broker.fetch(tp, pos, budget)
                     if recs:
@@ -401,6 +427,39 @@ class MemoryConsumer(ConsumerIterMixin):
         self._check_open()
         self._sync_group()
         return list(self._assignment)
+
+    def offsets_for_times(
+        self, times: Mapping[TopicPartition, int]
+    ) -> dict[TopicPartition, int | None]:
+        """Earliest offset with record timestamp >= the given epoch-ms per
+        partition (None if every record is older) — kafka-python's
+        ``offsets_for_times`` over the in-memory log. Timestamps are
+        produce-assigned and monotone per partition here, so bisect applies."""
+        self._check_open()
+        out: dict[TopicPartition, int | None] = {}
+        for tp, ts in times.items():
+            out[tp] = self._broker.offset_for_time(tp, int(ts))
+        return out
+
+    def end_offsets(self, tps: Sequence[TopicPartition]) -> dict[TopicPartition, int]:
+        self._check_open()
+        return {tp: self._broker.end_offset(tp) for tp in tps}
+
+    def pause(self, *tps: TopicPartition) -> None:
+        self._check_open()
+        self._sync_group()  # validate against the CURRENT assignment
+        stray = set(tps) - set(self._assignment)
+        if stray:
+            raise NotAssignedError(f"not assigned: {sorted(stray)}")
+        self._paused.update(tps)
+
+    def resume(self, *tps: TopicPartition) -> None:
+        self._check_open()
+        self._paused.difference_update(tps)
+
+    def paused(self) -> list[TopicPartition]:
+        self._check_open()
+        return sorted(self._paused)
 
     def close(self) -> None:
         """Release assignment. Never commits (the reference's
