@@ -27,6 +27,7 @@ from torchkafka_tpu.errors import (
 from torchkafka_tpu.parallel import batch_sharding, global_batch, make_mesh
 from torchkafka_tpu.pipeline import KafkaStream, stream
 from torchkafka_tpu.source import (
+    ChaosConsumer,
     Consumer,
     InMemoryBroker,
     KafkaConsumer,
@@ -57,6 +58,7 @@ __all__ = [
     "CommitBarrier",
     "CommitFailedError",
     "CommitToken",
+    "ChaosConsumer",
     "Consumer",
     "ConsumerClosedError",
     "InMemoryBroker",

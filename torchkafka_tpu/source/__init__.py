@@ -1,12 +1,14 @@
 """Source layer: transports implementing the Consumer protocol."""
 
 from torchkafka_tpu.source.assignment import local_batch_size, partitions_for_process
+from torchkafka_tpu.source.chaos import ChaosConsumer
 from torchkafka_tpu.source.consumer import Consumer, seek_to_timestamp
 from torchkafka_tpu.source.kafka import HAVE_KAFKA_PYTHON, KafkaConsumer
 from torchkafka_tpu.source.memory import InMemoryBroker, MemoryConsumer
 from torchkafka_tpu.source.records import Record, TopicPartition
 
 __all__ = [
+    "ChaosConsumer",
     "Consumer",
     "HAVE_KAFKA_PYTHON",
     "InMemoryBroker",

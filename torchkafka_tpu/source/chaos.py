@@ -1,0 +1,136 @@
+"""Deterministic fault injection over any Consumer — chaos for tests.
+
+The reference's failure story is implicit (SURVEY.md §5: recovery IS the
+consumer-group protocol) and it ships no way to exercise it. This wrapper
+makes failure a first-class test input: wrap any transport and inject
+commit failures, transient empty polls, and poll latency — all driven by a
+seeded RNG, so a failing fuzz case replays exactly.
+
+    chaos = ChaosConsumer(consumer, seed=7, commit_failure_rate=0.3)
+    # stream/commit code runs unchanged; ~30% of commits raise
+    # CommitFailedError exactly as a rebalancing broker would.
+
+The invariants under chaos are the framework's core contract: commit
+failures are survivable (the reference swallows CommitFailedError,
+/root/reference/src/kafka_dataset.py:131-135), no record is lost, and the
+committed watermark never overtakes what was actually processed.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Mapping
+
+import numpy as np
+
+from torchkafka_tpu.errors import CommitFailedError
+from torchkafka_tpu.source.consumer import Consumer, ConsumerIterMixin
+from torchkafka_tpu.source.records import Record, TopicPartition
+
+
+class ChaosConsumer(ConsumerIterMixin):
+    """Wraps a Consumer; forwards everything, injecting faults on the way.
+
+    Parameters
+    ----------
+    commit_failure_rate: probability a ``commit`` raises CommitFailedError
+        WITHOUT committing (the broker-rebalanced case — offsets stay
+        uncommitted, records re-deliver on restart).
+    poll_empty_rate: probability a ``poll`` returns [] despite available
+        records (transient fetch hiccup).
+    poll_delay_ms: (lo, hi) uniform latency added to every poll — models a
+        slow/jittery broker link.
+    seed: the determinism handle; same seed → same fault schedule.
+    """
+
+    def __init__(
+        self,
+        inner: Consumer,
+        *,
+        seed: int = 0,
+        commit_failure_rate: float = 0.0,
+        poll_empty_rate: float = 0.0,
+        poll_delay_ms: tuple[float, float] = (0.0, 0.0),
+    ) -> None:
+        for name, rate in (
+            ("commit_failure_rate", commit_failure_rate),
+            ("poll_empty_rate", poll_empty_rate),
+        ):
+            if not 0.0 <= rate <= 1.0:
+                raise ValueError(f"{name} must be in [0, 1], got {rate}")
+        self._inner = inner
+        self._rng = np.random.default_rng(seed)
+        self._commit_failure_rate = commit_failure_rate
+        self._poll_empty_rate = poll_empty_rate
+        self._poll_delay_ms = poll_delay_ms
+        self.injected_commit_failures = 0
+        self.injected_empty_polls = 0
+
+    def poll(self, max_records: int = 500, timeout_ms: int = 0) -> list[Record]:
+        lo, hi = self._poll_delay_ms
+        if hi > 0:
+            time.sleep(self._rng.uniform(lo, hi) / 1e3)
+        if self._poll_empty_rate and self._rng.random() < self._poll_empty_rate:
+            self.injected_empty_polls += 1
+            return []
+        return self._inner.poll(max_records=max_records, timeout_ms=timeout_ms)
+
+    def commit(self, offsets: Mapping[TopicPartition, int] | None = None) -> None:
+        if (
+            self._commit_failure_rate
+            and self._rng.random() < self._commit_failure_rate
+        ):
+            self.injected_commit_failures += 1
+            # Fail WITHOUT committing: exactly what a generation-bumped
+            # broker does — the offsets stay wherever they were.
+            raise CommitFailedError("injected fault: group rebalanced")
+        self._inner.commit(offsets)
+
+    # Everything else is the inner transport's business.
+    def committed(self, tp: TopicPartition) -> int | None:
+        return self._inner.committed(tp)
+
+    def position(self, tp: TopicPartition) -> int:
+        return self._inner.position(tp)
+
+    def seek(self, tp: TopicPartition, offset: int) -> None:
+        self._inner.seek(tp, offset)
+
+    def assignment(self):
+        return self._inner.assignment()
+
+    def offsets_for_times(self, times):
+        return self._inner.offsets_for_times(times)
+
+    def end_offsets(self, tps):
+        return self._inner.end_offsets(tps)
+
+    def pause(self, *tps: TopicPartition) -> None:
+        self._inner.pause(*tps)
+
+    def resume(self, *tps: TopicPartition) -> None:
+        self._inner.resume(*tps)
+
+    def paused(self):
+        return self._inner.paused()
+
+    def close(self) -> None:
+        self._inner.close()
+
+    # Iteration comes from ConsumerIterMixin over SELF.poll, so the
+    # record-at-a-time path (the reference's canonical loop shape) goes
+    # through the fault injector too — delegating to iter(inner) would
+    # silently bypass every fault. The mixin's state hooks proxy to the
+    # inner transport so closed/timeout/yield-position semantics match.
+
+    @property
+    def _closed(self) -> bool:
+        return bool(getattr(self._inner, "_closed", False))
+
+    @property
+    def _consumer_timeout_ms(self):
+        return getattr(self._inner, "_consumer_timeout_ms", None)
+
+    @property
+    def _last_yielded(self):
+        return getattr(self._inner, "_last_yielded", None)

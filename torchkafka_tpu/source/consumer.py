@@ -98,6 +98,16 @@ def seek_to_timestamp(consumer: Consumer, timestamp_ms: int) -> dict[TopicPartit
     resuming at the last commit, replay from a wall-clock point.
     """
     assigned = list(consumer.assignment())
+    if not assigned:
+        # A group-managed consumer has no assignment until the join
+        # completes (first poll); silently seeking nothing would replay the
+        # entire stale stream — the exact failure this function prevents.
+        from torchkafka_tpu.errors import NotAssignedError
+
+        raise NotAssignedError(
+            "no partitions assigned — with a group-managed consumer, poll "
+            "once (completing the group join) before seek_to_timestamp"
+        )
     found = consumer.offsets_for_times({tp: timestamp_ms for tp in assigned})
     missing = [tp for tp, off in found.items() if off is None]
     ends = consumer.end_offsets(missing) if missing else {}
@@ -128,18 +138,22 @@ class ConsumerIterMixin:
         # (kafka-python retains fetched-but-paused records the same way) and
         # re-injected ahead of new fetches once the partition resumes —
         # while paused, poll skips the partition, so nothing newer can
-        # overtake them and per-partition order holds. Keyed off the
-        # transport's `_paused` set when it has one; transports that
-        # withhold natively (kafka-python) never surface paused records
-        # from poll in the first place.
+        # overtake them and per-partition order holds. Consults the
+        # transport's public paused() so it works for ANY transport; native
+        # withholding (kafka-python) only covers records poll hasn't
+        # surfaced yet, not ones already in this buffer.
         stash: dict[TopicPartition, list[Record]] = {}
+        paused_fn = getattr(self, "paused", None)
         idle_limit_ms = getattr(self, "_consumer_timeout_ms", None)
         # kafka-python semantics: the timeout clock measures time spent
         # *waiting for the next record*, not wall time since the last fetch —
         # time the caller spends processing buffered records must not count.
         wait_start: float | None = None
         while True:
-            paused = getattr(self, "_paused", None) or ()
+            closed = getattr(self, "_closed", False)
+            paused = (
+                set(paused_fn()) if paused_fn is not None and not closed else ()
+            )
             if stash:
                 for tp in [tp for tp in stash if tp not in paused]:
                     resumed = stash.pop(tp)

@@ -455,6 +455,10 @@ class MemoryConsumer(ConsumerIterMixin):
 
     def resume(self, *tps: TopicPartition) -> None:
         self._check_open()
+        self._sync_group()
+        stray = set(tps) - set(self._assignment)
+        if stray:  # same contract as the kafka adapter's _check_assigned
+            raise NotAssignedError(f"not assigned: {sorted(stray)}")
         self._paused.difference_update(tps)
 
     def paused(self) -> list[TopicPartition]:

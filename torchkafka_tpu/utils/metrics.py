@@ -117,3 +117,31 @@ class StreamMetrics:
             "commit_failures": self.commit_failures.count,
             "ingest_lag_ms": round(self.ingest_lag_ms.value, 3),
         }
+
+    def render_prometheus(self, prefix: str = "torchkafka") -> str:
+        """Prometheus text exposition of the summary — paste into any
+        scrape endpoint. Names follow the counter/gauge conventions
+        (_total suffix on monotone counters, unit-suffixed gauges)."""
+        s = self.summary()
+        lines = [
+            f"# TYPE {prefix}_records_total counter",
+            f"{prefix}_records_total {s['records']}",
+            f"# TYPE {prefix}_batches_total counter",
+            f"{prefix}_batches_total {s['batches']}",
+            f"# TYPE {prefix}_dropped_records_total counter",
+            f"{prefix}_dropped_records_total {s['dropped']}",
+            f"# TYPE {prefix}_commit_failures_total counter",
+            f"{prefix}_commit_failures_total {s['commit_failures']}",
+            f"# TYPE {prefix}_commits_total counter",
+            f"{prefix}_commits_total {s['commit']['count']}",
+            f"# TYPE {prefix}_records_per_second gauge",
+            f"{prefix}_records_per_second {s['records_per_s']:.6g}",
+            # 'percentile' label, not 'quantile': the exposition format
+            # reserves quantile for TYPE summary series.
+            f"# TYPE {prefix}_commit_latency_ms gauge",
+            f'{prefix}_commit_latency_ms{{percentile="p50"}} {s["commit"]["p50_ms"]:.6g}',
+            f'{prefix}_commit_latency_ms{{percentile="p99"}} {s["commit"]["p99_ms"]:.6g}',
+            f"# TYPE {prefix}_ingest_lag_ms gauge",
+            f"{prefix}_ingest_lag_ms {s['ingest_lag_ms']:.6g}",
+        ]
+        return "\n".join(lines) + "\n"
