@@ -1,0 +1,109 @@
+"""Pallas int8 dequant-matmul: parity with the XLA dequant path (which is
+itself exact dequantized math — the kernel must only differ by f32
+accumulation order), block autotuning, fallback shapes, and the
+scale-on-accumulator identity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchkafka_tpu.models.quant import quantize
+from torchkafka_tpu.ops.qmatmul import quantized_matmul
+
+
+@pytest.fixture
+def qw(rng):
+    w = jnp.asarray(rng.normal(size=(512, 256)), jnp.float32)
+    return quantize(w, (0,))
+
+
+def _ref(x, qt):
+    return (x @ (qt.q * qt.scale).astype(x.dtype)).astype(x.dtype)
+
+
+class TestParity:
+    def test_matches_xla_dequant_f32(self, rng, qw):
+        x = jnp.asarray(rng.normal(size=(16, 512)), jnp.float32)
+        out = quantized_matmul(x, qw.q, qw.scale)
+        np.testing.assert_allclose(
+            np.asarray(_ref(x, qw)), np.asarray(out), rtol=1e-4, atol=1e-4
+        )
+
+    def test_matches_xla_dequant_bf16(self, rng, qw):
+        x = jnp.asarray(rng.normal(size=(8, 512)), jnp.bfloat16)
+        out = quantized_matmul(x, qw.q, qw.scale)
+        np.testing.assert_allclose(
+            np.asarray(_ref(x, qw)).astype(np.float32),
+            np.asarray(out).astype(np.float32),
+            rtol=0.05, atol=0.25,
+        )
+
+    def test_leading_dims_preserved(self, rng, qw):
+        x = jnp.asarray(rng.normal(size=(2, 8, 512)), jnp.float32)
+        out = quantized_matmul(x, qw.q, qw.scale)
+        assert out.shape == (2, 8, 256)
+        np.testing.assert_allclose(
+            np.asarray(_ref(x.reshape(16, 512), qw)).reshape(2, 8, 256),
+            np.asarray(out), rtol=1e-4, atol=1e-4,
+        )
+
+    def test_1d_scale_accepted(self, rng, qw):
+        x = jnp.asarray(rng.normal(size=(8, 512)), jnp.float32)
+        out = quantized_matmul(x, qw.q, qw.scale[0])
+        np.testing.assert_allclose(
+            np.asarray(_ref(x, qw)), np.asarray(out), rtol=1e-4, atol=1e-4
+        )
+
+    def test_multi_k_blocks_accumulate(self, rng):
+        """K spanning several grid steps: the f32 accumulator must carry
+        across them (the pl.when init/finish bracketing)."""
+        w = jnp.asarray(rng.normal(size=(1024, 128)), jnp.float32)
+        qt = quantize(w, (0,))
+        x = jnp.asarray(rng.normal(size=(8, 1024)), jnp.float32)
+        out = quantized_matmul(x, qt.q, qt.scale, block_k=256)
+        np.testing.assert_allclose(
+            np.asarray(_ref(x, qt)), np.asarray(out), rtol=1e-4, atol=1e-4
+        )
+
+    def test_non_tiling_shapes_fall_back(self, rng):
+        w = jnp.asarray(rng.normal(size=(300, 200)), jnp.float32)
+        qt = quantize(w, (0,))
+        x = jnp.asarray(rng.normal(size=(5, 300)), jnp.float32)
+        out = quantized_matmul(x, qt.q, qt.scale)
+        np.testing.assert_allclose(
+            np.asarray(_ref(x, qt)), np.asarray(out), rtol=1e-4, atol=1e-4
+        )
+
+    def test_jit_and_grad_free(self, rng, qw):
+        """Inference op: must jit cleanly (weights are constants — no vjp
+        needed; quantization is post-training)."""
+        x = jnp.asarray(rng.normal(size=(8, 512)), jnp.float32)
+        out = jax.jit(lambda a: quantized_matmul(a, qw.q, qw.scale))(x)
+        np.testing.assert_allclose(
+            np.asarray(_ref(x, qw)), np.asarray(out), rtol=1e-4, atol=1e-4
+        )
+
+
+class TestContracts:
+    def test_mismatched_q_raises(self, rng, qw):
+        x = jnp.asarray(rng.normal(size=(8, 256)), jnp.float32)  # K=256 != 512
+        with pytest.raises(ValueError, match=r"q must be \[K"):
+            quantized_matmul(x, qw.q, qw.scale)
+
+    def test_mismatched_scale_raises(self, rng, qw):
+        x = jnp.asarray(rng.normal(size=(8, 512)), jnp.float32)
+        with pytest.raises(ValueError, match="scale must broadcast"):
+            quantized_matmul(x, qw.q, qw.scale[:, :128])
+
+    def test_bf16_scale_fallback_keeps_f32_dequant(self, rng):
+        """Non-tiling fallback with a bf16 scale must still dequantize in
+        f32 (one cast after the product, not before)."""
+        w = jnp.asarray(rng.normal(size=(300, 200)), jnp.float32)
+        qt = quantize(w, (0,))
+        x = jnp.asarray(rng.normal(size=(5, 300)), jnp.float32)
+        out = quantized_matmul(x, qt.q, qt.scale.astype(jnp.bfloat16))
+        ref = x @ (qt.q * qt.scale.astype(jnp.bfloat16).astype(jnp.float32))
+        np.testing.assert_allclose(
+            np.asarray(ref), np.asarray(out), rtol=1e-4, atol=1e-4
+        )

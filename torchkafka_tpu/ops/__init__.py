@@ -10,5 +10,12 @@ framework ships MXU-shaped implementations of it.
 
 from torchkafka_tpu.ops.attention import mha, ring_attention, ulysses_attention
 from torchkafka_tpu.ops.flash import flash_attention
+from torchkafka_tpu.ops.qmatmul import quantized_matmul
 
-__all__ = ["flash_attention", "mha", "ring_attention", "ulysses_attention"]
+__all__ = [
+    "flash_attention",
+    "mha",
+    "quantized_matmul",
+    "ring_attention",
+    "ulysses_attention",
+]
