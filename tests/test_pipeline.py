@@ -275,3 +275,110 @@ class TestStreamOnMesh:
         assert mesh.shape["data"] == 4
         with pytest.raises(ValueError):
             tk.make_mesh({"data": 3})
+
+
+class TestProcessorErrorPolicy:
+    """A raising processor: 'raise' ends the stream (default — malformed
+    data is a bug), 'drop' turns the error into the None-drop contract
+    (offset retires, watermark advances, DLQ callback fires)."""
+
+    @staticmethod
+    def _flaky(record):
+        i = json.loads(record.value)["i"]
+        if i % 10 == 3:
+            raise ValueError(f"poison pill {i}")
+        return np.int32(i)
+
+    def test_default_raise_surfaces_on_consumer_thread(self, broker):
+        make_topic(broker, 16, partitions=1)
+        consumer = tk.MemoryConsumer(broker, "t", group_id="g")
+        with tk.KafkaStream(
+            consumer, self._flaky, batch_size=4, to_device=False,
+            idle_timeout_ms=200, owns_consumer=True,
+        ) as s:
+            with pytest.raises(ValueError, match="poison pill 3"):
+                for _ in s:
+                    pass
+
+    def test_drop_policy_continues_and_commits_past_poison(self, broker):
+        make_topic(broker, 40, partitions=1)
+        consumer = tk.MemoryConsumer(broker, "t", group_id="g")
+        letters = []
+        with tk.KafkaStream(
+            consumer, self._flaky, batch_size=4, to_device=False,
+            idle_timeout_ms=200, owns_consumer=True,
+            on_processor_error="drop",
+            dead_letter=lambda r, e: letters.append((r.offset, str(e))),
+        ) as s:
+            seen = []
+            for batch, token in s:
+                seen.extend(np.asarray(batch.data).tolist())
+                token.commit()
+        poisoned = [i for i in range(40) if i % 10 == 3]
+        assert sorted(seen) == [i for i in range(40) if i not in poisoned]
+        assert [off for off, _ in letters] == poisoned
+        assert all("poison pill" in msg for _, msg in letters)
+        assert s.metrics.summary()["processor_errors"] == len(poisoned)
+        # The watermark advanced past every poison pill: the last full
+        # batch's commit covers offsets beyond them.
+        committed = broker.committed("g", tk.TopicPartition("t", 0))
+        assert committed is not None and committed > max(poisoned)
+
+    def test_broken_dlq_does_not_kill_ingest(self, broker):
+        make_topic(broker, 20, partitions=1)
+        consumer = tk.MemoryConsumer(broker, "t", group_id="g")
+
+        def bad_dlq(record, exc):
+            raise RuntimeError("dlq down")
+
+        with tk.KafkaStream(
+            consumer, self._flaky, batch_size=4, to_device=False,
+            idle_timeout_ms=200, owns_consumer=True,
+            on_processor_error="drop", dead_letter=bad_dlq,
+        ) as s:
+            seen = sum(len(b.data) for b, t in s if t.commit() or True)
+        assert seen > 0  # stream survived both the poison and the dead DLQ
+
+    def test_chunked_processor_error_drops_whole_chunk(self, broker):
+        from torchkafka_tpu.transform.processor import chunked
+
+        @chunked
+        def strict(records):
+            rows = [np.frombuffer(r.value, np.int32) for r in records]
+            if any(row.shape != (2,) for row in rows):
+                raise ValueError("malformed record in chunk")
+            return np.stack(rows), None
+
+        broker.create_topic("c", partitions=1)
+        for i in range(16):
+            broker.produce("c", np.full(2, i, np.int32).tobytes())
+        broker.produce("c", b"shrt")  # 1 int32: malformed
+        for i in range(16, 20):
+            broker.produce("c", np.full(2, i, np.int32).tobytes())
+        consumer = tk.MemoryConsumer(broker, "c", group_id="g")
+        with tk.KafkaStream(
+            consumer, strict, batch_size=4, pad_policy="pad",
+            to_device=False, idle_timeout_ms=200, owns_consumer=True,
+            on_processor_error="drop", max_poll_records=7,
+        ) as s:
+            rows = 0
+            for batch, token in s:
+                token.commit()
+                rows += batch.valid_count
+        m = s.metrics.summary()
+        # Whichever chunk contained the malformed record dropped whole;
+        # every other record made it through, and the watermark reached
+        # the end of the partition (21 records).
+        assert m["processor_errors"] > 0
+        assert rows == 21 - m["processor_errors"]
+        assert broker.committed("g", tk.TopicPartition("c", 0)) == 21
+
+    def test_bad_policy_rejected(self, broker):
+        broker.create_topic("t", partitions=1)
+        consumer = tk.MemoryConsumer(broker, "t", group_id="g")
+        with pytest.raises(ValueError, match="on_processor_error"):
+            tk.KafkaStream(
+                consumer, int_processor, batch_size=4,
+                on_processor_error="ignore",
+            )
+        consumer.close()

@@ -99,7 +99,6 @@ def quantized_matmul(
     if scale.ndim == 1:
         scale = scale[None, :]
     *lead, k = x.shape
-    n = q.shape[1]
     # Validate the operand contract up front: the Pallas path would run on
     # mismatched shapes and return silent garbage (blocks index whatever is
     # there), where a plain matmul raises.
@@ -108,6 +107,7 @@ def quantized_matmul(
             f"q must be [K={k}, N], got {q.shape} — quantize() with "
             "contract_axes=(0,) for 2-D weights"
         )
+    n = q.shape[1]
     if scale.shape != (1, n):
         raise ValueError(
             f"scale must broadcast as [1, N={n}] (one per output channel), "
@@ -132,8 +132,12 @@ def quantized_matmul(
     if pltpu is not None and not interpret:
         # Without parallel semantics Mosaic serializes the whole grid
         # (measured 60x slower) — m/n blocks are independent; only the k
-        # (accumulation) dim carries state.
-        kw["compiler_params"] = pltpu.CompilerParams(
+        # (accumulation) dim carries state. (CompilerParams was named
+        # TPUCompilerParams before jax 0.7.)
+        params_cls = getattr(pltpu, "CompilerParams", None) or getattr(
+            pltpu, "TPUCompilerParams"
+        )
+        kw["compiler_params"] = params_cls(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         )
     out2 = pl.pallas_call(

@@ -76,6 +76,18 @@ class KafkaStream:
         records (flushing the tail under 'pad'); if None, it streams forever.
     transform_threads: >0 runs the processor in a thread pool (order
         preserved); numpy-heavy processors release the GIL and scale.
+    on_processor_error: what a RAISING processor does to the stream.
+        'raise' (default): the error surfaces on the consuming thread and
+        ends the stream — malformed data is a bug until declared otherwise.
+        'drop': the record is dropped exactly like a ``None`` return (its
+        offset retires so the commit watermark keeps advancing), the error
+        is counted in ``metrics.processor_errors`` and logged, and the
+        stream continues — the poison-pill policy. For a CHUNKED processor
+        the whole failing chunk drops (the chunk call is all-or-nothing).
+    dead_letter: optional ``(record, exception) -> None`` callback invoked
+        for each record dropped by the 'drop' policy — wire it to a DLQ
+        producer, a file, or a metrics sink. Exceptions it raises are
+        logged and swallowed (a broken DLQ must not take down ingest).
     """
 
     def __init__(
@@ -95,7 +107,13 @@ class KafkaStream:
         to_device: bool = True,
         barrier: CommitBarrier | None = None,
         owns_consumer: bool = False,
+        on_processor_error: str = "raise",
+        dead_letter: Any | None = None,
     ) -> None:
+        if on_processor_error not in ("raise", "drop"):
+            raise ValueError(
+                f"on_processor_error must be 'raise'|'drop', got {on_processor_error!r}"
+            )
         self._consumer = consumer
         self._processor = processor
         self._chunked = bool(getattr(processor, "chunked", False))
@@ -106,6 +124,8 @@ class KafkaStream:
         self._poll_timeout_ms = poll_timeout_ms
         self._idle_timeout_ms = idle_timeout_ms
         self._owns_consumer = owns_consumer
+        self._on_processor_error = on_processor_error
+        self._dead_letter = dead_letter
         self._barrier = barrier if barrier is not None else CommitBarrier()
         self.metrics = StreamMetrics()
         self._ledger = OffsetLedger()
@@ -164,6 +184,32 @@ class KafkaStream:
         transfers overlap the consumer's step."""
         self._put(self._to_dev(batch))
 
+    def _drop_errored(self, record, exc: Exception, quiet: bool = False) -> None:
+        """The 'drop' policy for one failing record: count, log, DLQ.
+        ``quiet`` skips the per-record log (chunk drops log once)."""
+        self.metrics.processor_errors.add(1)
+        if not quiet:
+            _logger.warning(
+                "processor raised on %s offset %d; dropping (%s)",
+                record.tp, record.offset, exc,
+            )
+        if self._dead_letter is not None:
+            try:
+                self._dead_letter(record, exc)
+            except Exception:  # noqa: BLE001 - a broken DLQ must not kill ingest
+                _logger.exception("dead_letter callback raised; record lost to DLQ")
+
+    def _apply(self, record):
+        """Processor with the error policy applied; an error under 'drop'
+        becomes the None-drop contract (offset retires, stream continues)."""
+        try:
+            return self._processor(record)
+        except Exception as e:  # noqa: BLE001 - policy decides
+            if self._on_processor_error == "raise":
+                raise
+            self._drop_errored(record, e)
+            return None
+
     def _process_chunk(self, records) -> list[Batch]:
         """One poll chunk through ledger + transform + batcher. Shared by the
         threaded producer loop and the synchronous path."""
@@ -175,7 +221,24 @@ class KafkaStream:
         if self._chunked:
             # Vectorized path: one processor call per poll chunk, one
             # slice-copy per emitted batch — the throughput hot path.
-            stacked, keep = self._processor(records)
+            try:
+                stacked, keep = self._processor(records)
+            except Exception as e:  # noqa: BLE001 - policy decides
+                if self._on_processor_error == "raise":
+                    raise
+                # The chunk call is all-or-nothing: the whole chunk drops.
+                # ONE log line for the chunk (a 1024-record poll would
+                # otherwise emit 1024 identical warnings per bad record);
+                # DLQ + metrics still run per record.
+                _logger.warning(
+                    "chunk processor raised; dropping %d records "
+                    "(%s offsets %d-%d) (%s)",
+                    len(records), records[0].tp, records[0].offset,
+                    records[-1].offset, e,
+                )
+                for r in records:
+                    self._drop_errored(r, e, quiet=True)
+                stacked, keep = None, None
             if keep is not None:
                 self.metrics.dropped.add(int(len(keep) - keep.sum()))
             elif stacked is None:
@@ -187,9 +250,9 @@ class KafkaStream:
             # Lazy: results stream out in order as workers finish, so a
             # batch ships as soon as it fills instead of waiting for the
             # whole poll chunk to transform.
-            elements = self._pool.map(self._processor, records)
+            elements = self._pool.map(self._apply, records)
         else:
-            elements = (self._processor(r) for r in records)
+            elements = (self._apply(r) for r in records)
         outs = []
         for r, el in zip(records, elements):
             if el is None:

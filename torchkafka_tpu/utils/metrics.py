@@ -103,6 +103,7 @@ class StreamMetrics:
         self.records = RateMeter()  # records fetched off the broker
         self.batches = RateMeter()  # batches emitted to the consumer
         self.dropped = RateMeter()  # records dropped by the processor
+        self.processor_errors = RateMeter()  # drops caused by a RAISING processor
         self.commit_latency = LatencyHistogram()
         self.commit_failures = RateMeter()
         self.ingest_lag_ms = Gauge()  # append-time -> poll-time of newest record
@@ -113,6 +114,7 @@ class StreamMetrics:
             "records_per_s": self.records.rate(),
             "batches": self.batches.count,
             "dropped": self.dropped.count,
+            "processor_errors": self.processor_errors.count,
             "commit": self.commit_latency.summary(),
             "commit_failures": self.commit_failures.count,
             "ingest_lag_ms": round(self.ingest_lag_ms.value, 3),
@@ -130,6 +132,8 @@ class StreamMetrics:
             f"{prefix}_batches_total {s['batches']}",
             f"# TYPE {prefix}_dropped_records_total counter",
             f"{prefix}_dropped_records_total {s['dropped']}",
+            f"# TYPE {prefix}_processor_errors_total counter",
+            f"{prefix}_processor_errors_total {s['processor_errors']}",
             f"# TYPE {prefix}_commit_failures_total counter",
             f"{prefix}_commit_failures_total {s['commit_failures']}",
             f"# TYPE {prefix}_commits_total counter",
