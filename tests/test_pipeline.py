@@ -382,3 +382,22 @@ class TestProcessorErrorPolicy:
                 on_processor_error="ignore",
             )
         consumer.close()
+
+    def test_sync_mode_raise_is_sticky(self, broker):
+        """prefetch=0 + 'raise': after the processor error surfaces, the
+        stream is DEAD — a caller that catches and keeps iterating must not
+        silently resume past the poisoned chunk (whose offsets are
+        half-resolved; at-least-once holds only because nothing more
+        commits)."""
+        make_topic(broker, 40, partitions=1)
+        consumer = tk.MemoryConsumer(broker, "t", group_id="g")
+        with tk.KafkaStream(
+            consumer, self._flaky, batch_size=4, prefetch=0,
+            to_device=False, idle_timeout_ms=200, owns_consumer=True,
+        ) as s:
+            it = iter(s)
+            with pytest.raises(ValueError, match="poison pill"):
+                for _ in it:
+                    pass
+            with pytest.raises(ValueError, match="poison pill"):
+                next(it)  # sticky: same error, no silent resume

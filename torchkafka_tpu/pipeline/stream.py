@@ -309,7 +309,19 @@ class KafkaStream:
                 self._stop.set()
             if records:
                 self._idle_since = None
-                self._ready.extend(self._process_chunk(records))
+                try:
+                    self._ready.extend(self._process_chunk(records))
+                except BaseException as e:  # noqa: BLE001 - sticky, then re-raised
+                    # Same sticky-death contract as the threaded path: a
+                    # processor error ENDS the stream. Without this, a
+                    # caller that catches the error and keeps iterating
+                    # would silently resume past a poisoned chunk whose
+                    # offsets are half-resolved — completed batches lost,
+                    # commit watermark frozen at the poison offset.
+                    self._error = e
+                    self._exhausted = True
+                    self._stop.set()
+                    raise
                 continue
             now = monotonic()
             if self._idle_since is None:
