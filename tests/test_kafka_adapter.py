@@ -70,6 +70,10 @@ class FakeKafkaConsumer:
         self._committed = {}
         self._positions = {}
 
+    def subscribe(self, topics=(), pattern=None):
+        self.subscribe_calls = getattr(self, "subscribe_calls", [])
+        self.subscribe_calls.append({"pattern": pattern} if pattern else {"topics": list(topics)})
+
     def assign(self, tps):
         self.assign_calls.append(list(tps))
 
@@ -307,3 +311,16 @@ class TestTimeAndFlowControl:
         assert c.paused() == tps
         c.resume(tps[0])
         assert c.paused() == [tps[1]]
+
+
+class TestPatternSubscription:
+    def test_pattern_subscribe_translation(self, adapter):
+        c = adapter.KafkaConsumer(
+            pattern=r"metrics-.*", bootstrap_servers=["b:9092"], group_id="g"
+        )
+        assert c._consumer.init_topics == ()  # no positional subscribe
+        assert c._consumer.subscribe_calls == [{"pattern": r"metrics-.*"}]
+
+    def test_pattern_exclusive_with_topics(self, adapter):
+        with pytest.raises(ValueError, match="exclusive"):
+            adapter.KafkaConsumer("t", pattern="t.*")

@@ -333,3 +333,88 @@ class TestTimeAndFlowControl:
         c = MemoryConsumer(broker, "t", group_id="fresh", assignment=[tp])
         seek_to_timestamp(c, 9_999)
         assert c.poll(max_records=100, timeout_ms=10) == []
+
+
+class TestPatternSubscription:
+    def test_pattern_matches_existing_topics(self, broker):
+        broker.create_topic("metrics-a", partitions=2)
+        broker.create_topic("metrics-b", partitions=1)
+        broker.create_topic("logs", partitions=1)
+        c = MemoryConsumer(broker, pattern=r"metrics-.*", group_id="g")
+        assert {tp.topic for tp in c.assignment()} == {"metrics-a", "metrics-b"}
+        assert len(c.assignment()) == 3
+
+    def test_new_matching_topic_joins_subscription(self, broker):
+        """A topic created AFTER the subscription rebalances in (Kafka's
+        metadata-refresh behavior) and its records flow."""
+        broker.create_topic("metrics-a", partitions=1)
+        c = MemoryConsumer(broker, pattern=r"metrics-.*", group_id="g")
+        broker.produce("metrics-a", b"a0")
+        assert [r.value for r in c.poll(max_records=10, timeout_ms=10)] == [b"a0"]
+
+        broker.create_topic("metrics-b", partitions=1)
+        broker.produce("metrics-b", b"b0")
+        got = list(c.poll(max_records=10, timeout_ms=10))
+        got += c.poll(max_records=10, timeout_ms=10)
+        assert {tp.topic for tp in c.assignment()} == {"metrics-a", "metrics-b"}
+        # The rebalance re-resolves positions from committed offsets:
+        # nothing committed, so a0 MUST re-deliver alongside b0 (eager
+        # rebalance semantics — at-least-once, never loss).
+        assert {r.value for r in got} == {b"a0", b"b0"}
+
+    def test_non_matching_topic_excluded(self, broker):
+        broker.create_topic("metrics-a", partitions=1)
+        c = MemoryConsumer(broker, pattern=r"metrics-.*", group_id="g")
+        broker.create_topic("other", partitions=1)
+        broker.produce("other", b"x")
+        assert c.poll(max_records=10, timeout_ms=10) == []
+        assert {tp.topic for tp in c.assignment()} == {"metrics-a"}
+
+    def test_pattern_and_explicit_members_share_a_group(self, broker):
+        broker.create_topic("metrics-a", partitions=2)
+        broker.create_topic("logs", partitions=2)
+        a = MemoryConsumer(broker, pattern=r"metrics-.*", group_id="g")
+        b = MemoryConsumer(broker, ["metrics-a", "logs"], group_id="g")
+        pa, pb = set(a.assignment()), set(b.assignment())
+        assert pa.isdisjoint(pb)
+        # logs partitions can only go to the explicit member.
+        assert {tp.topic for tp in pa} <= {"metrics-a"}
+        assert {tp for tp in pa | pb} == {
+            TopicPartition(t, p) for t in ("metrics-a", "logs") for p in (0, 1)
+        }
+
+    def test_pattern_is_prefix_match_like_kafka_python(self, broker):
+        """kafka-python's subscribe(pattern=...) applies unanchored
+        re.match — 'metrics' also subscribes 'metrics-extra'; anchor with
+        '$' for exact names. The double mirrors the client it doubles."""
+        broker.create_topic("metrics", partitions=1)
+        broker.create_topic("metrics-extra", partitions=1)
+        c = MemoryConsumer(broker, pattern="metrics", group_id="g")
+        assert {tp.topic for tp in c.assignment()} == {"metrics", "metrics-extra"}
+        exact = MemoryConsumer(broker, pattern="metrics$", group_id="g2")
+        assert {tp.topic for tp in exact.assignment()} == {"metrics"}
+
+    def test_assignment_only_construction(self, broker):
+        """Manual assignment needs neither topics nor pattern — matching
+        the kafka adapter's surface."""
+        broker.create_topic("t", partitions=2)
+        fill(broker, "t", 4)
+        c = MemoryConsumer(
+            broker, group_id="g", assignment=[TopicPartition("t", 0)]
+        )
+        recs = c.poll(max_records=10, timeout_ms=10)
+        assert {r.partition for r in recs} == {0}
+
+    def test_invalid_combinations_rejected(self, broker):
+        broker.create_topic("t", partitions=1)
+        with pytest.raises(ValueError, match="exclusive"):
+            MemoryConsumer(broker, "t", group_id="g", pattern="t.*")
+        with pytest.raises(ValueError, match="one of topics"):
+            MemoryConsumer(broker, group_id="g")
+        with pytest.raises(ValueError, match="exclusive"):
+            MemoryConsumer(
+                broker, group_id="g", pattern="t.*",
+                assignment=[TopicPartition("t", 0)],
+            )
+        with pytest.raises(ValueError, match="group_id is required"):
+            MemoryConsumer(broker, "t")

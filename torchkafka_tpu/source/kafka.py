@@ -54,8 +54,9 @@ class KafkaConsumer(ConsumerIterMixin):
 
     def __init__(
         self,
-        topics: str | Sequence[str],
+        topics: str | Sequence[str] | None = None,
         *,
+        pattern: str | None = None,
         assignment: Sequence[TopicPartition] | None = None,
         **kafka_kwargs,
     ) -> None:
@@ -68,7 +69,17 @@ class KafkaConsumer(ConsumerIterMixin):
         # (/root/reference/src/kafka_dataset.py:201): offsets are committed by
         # the commit barrier, never by a background auto-commit timer.
         kafka_kwargs["enable_auto_commit"] = False
-        topics = [topics] if isinstance(topics, str) else list(topics)
+        if pattern is not None and (topics is not None or assignment is not None):
+            raise ValueError("pattern is exclusive with topics/assignment")
+        if pattern is None and topics is None and assignment is None:
+            # Same contract as the memory double: a consumer subscribed to
+            # nothing would poll [] forever with no error.
+            raise ValueError("one of topics, pattern, or assignment is required")
+        topics = (
+            []
+            if topics is None
+            else [topics] if isinstance(topics, str) else list(topics)
+        )
         self._closed = False
         # Iteration is built on poll() via ConsumerIterMixin, so the
         # iterator-ending timeout and the yielded-position tracking both live
@@ -80,6 +91,9 @@ class KafkaConsumer(ConsumerIterMixin):
             self._consumer.assign(
                 [_ktp(tp) for tp in assignment]
             )
+        elif pattern is not None:
+            self._consumer = _kafka.KafkaConsumer(**kafka_kwargs)
+            self._consumer.subscribe(pattern=pattern)
         else:
             self._consumer = _kafka.KafkaConsumer(*topics, **kafka_kwargs)
 

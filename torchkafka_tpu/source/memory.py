@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import itertools
 import json
+import re
 import threading
 import time
 import zlib
@@ -55,8 +56,9 @@ class _Group:
 
     def __init__(self) -> None:
         self.generation = 0
-        # member_id -> set of subscribed topics (group-managed members only)
-        self.members: dict[str, frozenset[str]] = {}
+        # member_id -> subscription: a frozenset of topic names, or a
+        # compiled regex (pattern subscription) resolved at rebalance time
+        self.members: dict[str, "frozenset[str] | re.Pattern"] = {}
         self.assignment: dict[str, list[TopicPartition]] = {}
         self.committed: dict[TopicPartition, int] = {}
 
@@ -82,6 +84,14 @@ class InMemoryBroker:
             self._topics[topic] = partitions
             for p in range(partitions):
                 self._logs[TopicPartition(topic, p)] = []
+            # Pattern subscribers pick up matching NEW topics via a
+            # rebalance (Kafka's metadata-refresh path).
+            for g in self._groups.values():
+                if any(
+                    isinstance(sub, re.Pattern) and sub.match(topic)
+                    for sub in g.members.values()
+                ):
+                    self._rebalance(g)
 
     def partitions_for(self, topic: str) -> int:
         with self._lock:
@@ -163,13 +173,33 @@ class InMemoryBroker:
     def _group(self, group_id: str) -> _Group:
         return self._groups.setdefault(group_id, _Group())
 
-    def join(self, group_id: str, member_id: str, topics: frozenset[str]) -> int:
-        """Add a member and rebalance; returns the new generation."""
+    def join(
+        self,
+        group_id: str,
+        member_id: str,
+        topics: frozenset[str],
+        pattern: str | None = None,
+    ) -> int:
+        """Add a member and rebalance; returns the new generation.
+
+        ``pattern``: a regex subscribing the member to every topic whose
+        name matches — unanchored ``re.match`` (prefix) semantics, the
+        same matching kafka-python's ``subscribe(pattern=...)`` applies;
+        anchor with ``$`` for exact names. Includes topics created LATER
+        (create_topic triggers the rebalance, Kafka's metadata-refresh
+        behavior)."""
         with self._lock:
             g = self._group(group_id)
-            g.members[member_id] = topics
+            g.members[member_id] = (
+                re.compile(pattern) if pattern is not None else topics
+            )
             self._rebalance(g)
             return g.generation
+
+    def _member_topics(self, sub) -> set[str]:
+        if isinstance(sub, re.Pattern):
+            return {t for t in self._topics if sub.match(t)}
+        return set(sub)
 
     def leave(self, group_id: str, member_id: str) -> None:
         with self._lock:
@@ -190,7 +220,8 @@ class InMemoryBroker:
         members = sorted(g.members)
         if not members:
             return
-        topics = sorted({t for ts in g.members.values() for t in ts})
+        resolved = {m: self._member_topics(g.members[m]) for m in members}
+        topics = sorted({t for ts in resolved.values() for t in ts})
         all_tps = [
             TopicPartition(t, p)
             for t in topics
@@ -198,7 +229,7 @@ class InMemoryBroker:
         ]
         # Only members subscribed to a topic are eligible for its partitions.
         for t in topics:
-            eligible = [m for m in members if t in g.members[m]]
+            eligible = [m for m in members if t in resolved[m]]
             tps = [tp for tp in all_tps if tp.topic == t]
             for i, tp in enumerate(tps):
                 g.assignment[eligible[i % len(eligible)]].append(tp)
@@ -273,6 +304,11 @@ class MemoryConsumer(ConsumerIterMixin):
       partition → jax.process_index() mapping is static (SURVEY.md §2 TPU
       equivalents table).
 
+    Group mode also accepts ``pattern=`` (a regex, fullmatch against topic
+    names) instead of explicit topics — the subscription covers matching
+    topics created LATER too, via rebalance (kafka-python's
+    ``subscribe(pattern=...)``).
+
     Never auto-commits, by construction: there is no code path that commits
     except the explicit ``commit()`` — the invariant the reference enforces by
     forcing ``enable_auto_commit=False`` (/root/reference/src/kafka_dataset.py:201).
@@ -281,9 +317,10 @@ class MemoryConsumer(ConsumerIterMixin):
     def __init__(
         self,
         broker: InMemoryBroker,
-        topics: str | Sequence[str],
-        group_id: str,
+        topics: str | Sequence[str] | None = None,
+        group_id: str | None = None,
         *,
+        pattern: str | None = None,
         assignment: Sequence[TopicPartition] | None = None,
         auto_offset_reset: str = "earliest",
         member_id: str | None = None,
@@ -291,8 +328,25 @@ class MemoryConsumer(ConsumerIterMixin):
     ) -> None:
         if auto_offset_reset not in ("earliest", "latest"):
             raise ValueError(f"auto_offset_reset must be earliest|latest, got {auto_offset_reset!r}")
+        if group_id is None:
+            # Loud, not a shared "" group: omitting group_id would silently
+            # make unrelated consumers rebalance each other and share a
+            # committed-offset namespace.
+            raise ValueError("group_id is required (commits are per-group)")
+        if pattern is not None and (topics is not None or assignment is not None):
+            raise ValueError("pattern is exclusive with topics/assignment")
+        if pattern is None and topics is None and assignment is None:
+            raise ValueError("one of topics, pattern, or assignment is required")
         self._broker = broker
-        self._topics = frozenset([topics] if isinstance(topics, str) else topics)
+        self._pattern = pattern
+        if topics is not None:
+            self._topics = frozenset([topics] if isinstance(topics, str) else topics)
+        elif assignment is not None:
+            # Assignment-only construction (the kafka adapter allows it too);
+            # the topic set exists for the eager existence check below.
+            self._topics = frozenset(tp.topic for tp in assignment)
+        else:
+            self._topics = frozenset()
         self._group_id = group_id
         self._auto_offset_reset = auto_offset_reset
         self._closed = False
@@ -319,7 +373,9 @@ class MemoryConsumer(ConsumerIterMixin):
             self._manual = False
             self._member_id = member_id or f"member-{next(_member_counter)}"
             self._generation, self._assignment = 0, []
-            self._generation = broker.join(self._group_id, self._member_id, self._topics)
+            self._generation = broker.join(
+                self._group_id, self._member_id, self._topics, pattern=pattern
+            )
             _, self._assignment = broker.group_state(self._group_id, self._member_id)
 
     # ---------------------------------------------------------------- state
