@@ -418,3 +418,19 @@ class TestPatternSubscription:
             )
         with pytest.raises(ValueError, match="group_id is required"):
             MemoryConsumer(broker, "t")
+
+
+class TestLag:
+    def test_lag_tracks_consumption(self, broker):
+        broker.create_topic("t", partitions=2)
+        fill(broker, "t", 10)
+        tps = [TopicPartition("t", 0), TopicPartition("t", 1)]
+        c = MemoryConsumer(broker, "t", group_id="g", assignment=tps)
+        assert sum(c.lag().values()) == 10
+        c.poll(max_records=6, timeout_ms=10)
+        assert sum(c.lag().values()) == 4
+        while c.poll(max_records=10, timeout_ms=10):
+            pass
+        assert c.lag() == {tps[0]: 0, tps[1]: 0}
+        broker.produce("t", b"new")
+        assert sum(c.lag().values()) == 1

@@ -105,6 +105,9 @@ class ChaosConsumer(ConsumerIterMixin):
     def end_offsets(self, tps):
         return self._inner.end_offsets(tps)
 
+    def lag(self):
+        return self._inner.lag()
+
     def pause(self, *tps: TopicPartition) -> None:
         self._inner.pause(*tps)
 

@@ -65,6 +65,10 @@ class Consumer(Protocol):
         """Next-offset-to-be-produced per partition (the log end)."""
         ...
 
+    def lag(self) -> dict[TopicPartition, int]:
+        """Per-assigned-partition lag: log end minus position."""
+        ...
+
     def pause(self, *tps: TopicPartition) -> None:
         """Stop fetching from these partitions (``poll`` skips them) without
         losing the assignment — per-partition backpressure."""

@@ -174,6 +174,14 @@ class KafkaConsumer(ConsumerIterMixin):
             for ktp, off in ends.items()
         }
 
+    def lag(self) -> dict[TopicPartition, int]:
+        """Per-assigned-partition lag: end offset minus position."""
+        tps = self.assignment()
+        ends = self.end_offsets(tps)
+        return {
+            tp: max(0, ends[tp] - self.position(tp)) for tp in tps
+        }
+
     def _check_assigned(self, tps) -> None:
         """Match the memory double's contract (NotAssignedError) instead of
         leaking kafka-python's internal KeyError/IllegalStateError."""

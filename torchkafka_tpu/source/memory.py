@@ -304,10 +304,10 @@ class MemoryConsumer(ConsumerIterMixin):
       partition → jax.process_index() mapping is static (SURVEY.md §2 TPU
       equivalents table).
 
-    Group mode also accepts ``pattern=`` (a regex, fullmatch against topic
-    names) instead of explicit topics — the subscription covers matching
-    topics created LATER too, via rebalance (kafka-python's
-    ``subscribe(pattern=...)``).
+    Group mode also accepts ``pattern=`` (a regex; unanchored ``re.match``
+    prefix semantics like kafka-python's ``subscribe(pattern=...)`` — add
+    ``$`` for exact names) instead of explicit topics. The subscription
+    covers matching topics created LATER too, via rebalance.
 
     Never auto-commits, by construction: there is no code path that commits
     except the explicit ``commit()`` — the invariant the reference enforces by
@@ -338,7 +338,6 @@ class MemoryConsumer(ConsumerIterMixin):
         if pattern is None and topics is None and assignment is None:
             raise ValueError("one of topics, pattern, or assignment is required")
         self._broker = broker
-        self._pattern = pattern
         if topics is not None:
             self._topics = frozenset([topics] if isinstance(topics, str) else topics)
         elif assignment is not None:
@@ -500,6 +499,17 @@ class MemoryConsumer(ConsumerIterMixin):
     def end_offsets(self, tps: Sequence[TopicPartition]) -> dict[TopicPartition, int]:
         self._check_open()
         return {tp: self._broker.end_offset(tp) for tp in tps}
+
+    def lag(self) -> dict[TopicPartition, int]:
+        """Per-assigned-partition consumer lag: log end minus position —
+        the records fetched-side still ahead of this consumer (the
+        operator's 'are we keeping up' number)."""
+        self._check_open()
+        self._sync_group()
+        return {
+            tp: max(0, self._broker.end_offset(tp) - self._resolve_position(tp))
+            for tp in self._assignment
+        }
 
     def pause(self, *tps: TopicPartition) -> None:
         self._check_open()
