@@ -398,6 +398,24 @@ class TestStreamingGenerator:
         assert committed == 4  # the 4 completions, not the 2 unserved
         consumer.close()
 
+    def test_metrics_prometheus_render(self, model):
+        cfg, params = model
+        broker = tk.InMemoryBroker()
+        _topic(broker, 4)
+        consumer = tk.MemoryConsumer(broker, "p", group_id="gm")
+        server = StreamingGenerator(
+            consumer, params, cfg, slots=2, prompt_len=P, max_new=MAX_NEW,
+        )
+        done = sum(1 for _ in server.run(max_records=4))
+        assert done == 4
+        text = server.metrics.render_prometheus()
+        assert "torchkafka_serve_completions_total 4" in text
+        assert f"torchkafka_serve_tokens_total {4 * MAX_NEW}" in text
+        for line in text.strip().split("\n"):
+            if not line.startswith("#"):
+                float(line.rsplit(" ", 1)[1])
+        consumer.close()
+
     def test_rejects_bad_config(self, model):
         cfg, params = model
         consumer = object()

@@ -78,6 +78,23 @@ class ServeMetrics:
             "slot_occupancy": round(self.slot_occupancy.value, 3),
         }
 
+    def render_prometheus(self, prefix: str = "torchkafka_serve") -> str:
+        """Prometheus text exposition — same conventions (and shared
+        renderer) as StreamMetrics.render_prometheus."""
+        from torchkafka_tpu.utils.metrics import render_exposition
+
+        s = self.summary()
+        return render_exposition(prefix, [
+            ("completions_total", "counter", s["completions"]),
+            ("tokens_total", "counter", s["tokens"]),
+            ("truncated_by_eos_total", "counter", s["truncated_by_eos"]),
+            ("dropped_prompts_total", "counter", s["dropped"]),
+            ("commit_failures_total", "counter", s["commit_failures"]),
+            ("completions_per_second", "gauge", s["completions_per_s"]),
+            ("tokens_per_second", "gauge", s["tokens_per_s"]),
+            ("slot_occupancy", "gauge", s["slot_occupancy"]),
+        ])
+
 
 def _slot_layer_step(x, layer, cache_k, cache_v, pos_b, cfg):
     """One decode token through one layer with a DIFFERENT position per

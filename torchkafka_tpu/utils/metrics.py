@@ -96,6 +96,22 @@ class Gauge:
             return self._value
 
 
+def render_exposition(prefix: str, series: list[tuple]) -> str:
+    """Prometheus text exposition shared by every metrics set. ``series``:
+    (name, type, value) — value a number, or a list of (labels, number)
+    where labels is e.g. 'percentile="p50"'. Counters follow the _total
+    convention at the call site; gauges format with :.6g."""
+    lines = []
+    for name, mtype, value in series:
+        lines.append(f"# TYPE {prefix}_{name} {mtype}")
+        entries = value if isinstance(value, list) else [("", value)]
+        for labels, v in entries:
+            label_part = f"{{{labels}}}" if labels else ""
+            v_part = f"{v:.6g}" if mtype == "gauge" else f"{v}"
+            lines.append(f"{prefix}_{name}{label_part} {v_part}")
+    return "\n".join(lines) + "\n"
+
+
 class StreamMetrics:
     """The metric set one KafkaStream maintains."""
 
@@ -123,29 +139,21 @@ class StreamMetrics:
     def render_prometheus(self, prefix: str = "torchkafka") -> str:
         """Prometheus text exposition of the summary — paste into any
         scrape endpoint. Names follow the counter/gauge conventions
-        (_total suffix on monotone counters, unit-suffixed gauges)."""
+        (_total suffix on monotone counters, unit-suffixed gauges); the
+        latency percentiles use a 'percentile' label, not 'quantile',
+        which the exposition format reserves for TYPE summary series."""
         s = self.summary()
-        lines = [
-            f"# TYPE {prefix}_records_total counter",
-            f"{prefix}_records_total {s['records']}",
-            f"# TYPE {prefix}_batches_total counter",
-            f"{prefix}_batches_total {s['batches']}",
-            f"# TYPE {prefix}_dropped_records_total counter",
-            f"{prefix}_dropped_records_total {s['dropped']}",
-            f"# TYPE {prefix}_processor_errors_total counter",
-            f"{prefix}_processor_errors_total {s['processor_errors']}",
-            f"# TYPE {prefix}_commit_failures_total counter",
-            f"{prefix}_commit_failures_total {s['commit_failures']}",
-            f"# TYPE {prefix}_commits_total counter",
-            f"{prefix}_commits_total {s['commit']['count']}",
-            f"# TYPE {prefix}_records_per_second gauge",
-            f"{prefix}_records_per_second {s['records_per_s']:.6g}",
-            # 'percentile' label, not 'quantile': the exposition format
-            # reserves quantile for TYPE summary series.
-            f"# TYPE {prefix}_commit_latency_ms gauge",
-            f'{prefix}_commit_latency_ms{{percentile="p50"}} {s["commit"]["p50_ms"]:.6g}',
-            f'{prefix}_commit_latency_ms{{percentile="p99"}} {s["commit"]["p99_ms"]:.6g}',
-            f"# TYPE {prefix}_ingest_lag_ms gauge",
-            f"{prefix}_ingest_lag_ms {s['ingest_lag_ms']:.6g}",
-        ]
-        return "\n".join(lines) + "\n"
+        return render_exposition(prefix, [
+            ("records_total", "counter", s["records"]),
+            ("batches_total", "counter", s["batches"]),
+            ("dropped_records_total", "counter", s["dropped"]),
+            ("processor_errors_total", "counter", s["processor_errors"]),
+            ("commit_failures_total", "counter", s["commit_failures"]),
+            ("commits_total", "counter", s["commit"]["count"]),
+            ("records_per_second", "gauge", s["records_per_s"]),
+            ("commit_latency_ms", "gauge", [
+                ('percentile="p50"', s["commit"]["p50_ms"]),
+                ('percentile="p99"', s["commit"]["p99_ms"]),
+            ]),
+            ("ingest_lag_ms", "gauge", s["ingest_lag_ms"]),
+        ])
