@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from torchkafka_tpu.models.quant import embed_rows, load_weight, quantize
 from torchkafka_tpu.models.transformer import shardings_for_mesh
 from torchkafka_tpu.source.records import Record
 
@@ -110,18 +111,23 @@ def init_params(rng: jax.Array, cfg: DLRMConfig) -> dict:
 
 def _tower(x: jax.Array, layers, dtype, final_linear: bool) -> jax.Array:
     for i, (w, b) in enumerate(layers):
-        x = x @ w.astype(dtype) + b.astype(dtype)
+        x = x @ load_weight(w, dtype) + b.astype(dtype)
         if not (final_linear and i == len(layers) - 1):
             x = jax.nn.relu(x)
     return x
 
 
 def forward(params: dict, dense: jax.Array, cats: jax.Array, cfg: DLRMConfig) -> jax.Array:
-    """dense [B, dense_dim] f32, cats [B, n_tables] int32 → logits [B] f32."""
+    """dense [B, dense_dim] f32, cats [B, n_tables] int32 → logits [B] f32.
+
+    Weights may be plain arrays or int8 ``QTensor``s
+    (``quantize_dlrm_params``): table lookups gather int8 rows FIRST and
+    scale only the gathered rows — the 4× table-memory win decode-side
+    recommenders quantize for."""
     dt = cfg.dtype
     bottom = _tower(dense.astype(dt), params["bottom"], dt, final_linear=False)
     embs = [
-        jnp.take(params["tables"][f"t{i}"], cats[:, i], axis=0).astype(dt)
+        embed_rows(params["tables"][f"t{i}"], cats[:, i], dt)
         for i in range(cfg.n_tables)
     ]
     feats = jnp.stack([bottom, *embs], axis=1)  # [B, C+1, E]
@@ -222,6 +228,21 @@ def make_processor(cfg: DLRMConfig) -> Callable[[Record], dict | None]:
         return parse_record(record.value, cfg)
 
     return processor
+
+
+def quantize_dlrm_params(params: dict) -> dict:
+    """Post-training int8 of the capacity-heavy weights: tables per-ROW
+    (the gather output dim — scale applies to gathered rows only) and
+    tower matmul weights per-output-column; biases stay full precision
+    (tiny, additive). The result flows through ``forward``/``loss_fn``
+    unchanged — inference only, like ``models.quant.quantize_params``."""
+    return {
+        "tables": {
+            name: quantize(w, (1,)) for name, w in params["tables"].items()
+        },
+        "bottom": [(quantize(w, (0,)), b) for w, b in params["bottom"]],
+        "top": [(quantize(w, (0,)), b) for w, b in params["top"]],
+    }
 
 
 def count_params(params: dict) -> int:
