@@ -84,14 +84,25 @@ class TestTransformerPP:
         return toks, jnp.ones_like(toks)
 
     @pytest.mark.parametrize(
-        "axes", [{"data": 2, "pp": 4}, {"pp": 2, "sp": 2, "data": 2}]
+        "axes,attn",
+        [
+            ({"data": 2, "pp": 4}, "auto"),
+            ({"pp": 2, "sp": 2, "data": 2}, "auto"),  # ring in-stage
+            # ulysses inside a pipeline stage: the stage binds 'sp'
+            # manually, so ulysses_attention takes its manual-region
+            # branch (direct local body, no nested shard_map).
+            ({"pp": 2, "sp": 2, "data": 2}, "ulysses"),
+        ],
     )
-    def test_pp_loss_matches_dense(self, batch, axes):
+    def test_pp_loss_matches_dense(self, batch, axes, attn):
+        import dataclasses
+
         toks, mask = batch
+        cfg = dataclasses.replace(CFG, attn_impl=attn)
         params = Transformer(CFG).init(jax.random.key(0))
         dense = Transformer(CFG).loss(params, toks, mask)
         mesh = make_mesh(axes)
-        pp = jax.jit(lambda p, t, m: Transformer(CFG, mesh).loss(p, t, m))(
+        pp = jax.jit(lambda p, t, m: Transformer(cfg, mesh).loss(p, t, m))(
             params, toks, mask
         )
         assert abs(float(dense) - float(pp)) < 1e-4
@@ -112,10 +123,14 @@ class TestTransformerPP:
             first = float(loss) if first is None else first
         assert float(loss) < first
 
-    def test_pp_sp_training(self, batch):
+    @pytest.mark.parametrize("attn", ["auto", "ulysses"])
+    def test_pp_sp_training(self, batch, attn):
+        import dataclasses
+
         toks, mask = batch
+        cfg = dataclasses.replace(CFG, attn_impl=attn)
         mesh = make_mesh({"pp": 2, "sp": 2, "data": 2})
-        init_fn, step_fn = make_train_step(CFG, mesh, optax.adamw(3e-3))
+        init_fn, step_fn = make_train_step(cfg, mesh, optax.adamw(3e-3))
         p, o = init_fn(jax.random.key(0))
         first = None
         for _ in range(5):
