@@ -70,9 +70,12 @@ class FakeKafkaConsumer:
         self._committed = {}
         self._positions = {}
 
-    def subscribe(self, topics=(), pattern=None):
+    def subscribe(self, topics=(), pattern=None, listener=None):
         self.subscribe_calls = getattr(self, "subscribe_calls", [])
-        self.subscribe_calls.append({"pattern": pattern} if pattern else {"topics": list(topics)})
+        call = {"pattern": pattern} if pattern else {"topics": list(topics)}
+        if listener is not None:
+            call["listener"] = listener
+        self.subscribe_calls.append(call)
 
     def assign(self, tps):
         self.assign_calls.append(list(tps))
@@ -125,6 +128,7 @@ def _install_stub(oam_cls):
     kafka_mod.KafkaConsumer = FakeKafkaConsumer
     kafka_mod.TopicPartition = FakeTopicPartition
     kafka_mod.OffsetAndMetadata = oam_cls
+    kafka_mod.ConsumerRebalanceListener = object
     errors_mod = types.ModuleType("kafka.errors")
     errors_mod.CommitFailedError = FakeCommitFailedError
     kafka_mod.errors = errors_mod
@@ -324,3 +328,37 @@ class TestPatternSubscription:
     def test_pattern_exclusive_with_topics(self, adapter):
         with pytest.raises(ValueError, match="exclusive"):
             adapter.KafkaConsumer("t", pattern="t.*")
+
+
+class TestRebalanceListenerTranslation:
+    def test_listener_wrapped_and_types_translated(self, adapter):
+        events = []
+
+        class Rec:
+            def on_partitions_revoked(self, revoked):
+                events.append(("revoked", revoked))
+
+            def on_partitions_assigned(self, assigned):
+                events.append(("assigned", assigned))
+
+        c = adapter.KafkaConsumer(
+            ["t"], bootstrap_servers=["b:9092"], group_id="g",
+            rebalance_listener=Rec(),
+        )
+        (call,) = c._consumer.subscribe_calls
+        assert call["topics"] == ["t"]
+        wrapper = call["listener"]
+        # The wrapper hands the user listener FRAMEWORK TopicPartitions.
+        wrapper.on_partitions_revoked([FakeTopicPartition("t", 0)])
+        wrapper.on_partitions_assigned([FakeTopicPartition("t", 1)])
+        assert events == [
+            ("revoked", [TopicPartition("t", 0)]),
+            ("assigned", [TopicPartition("t", 1)]),
+        ]
+
+    def test_listener_rejected_with_manual_assignment(self, adapter):
+        with pytest.raises(ValueError, match="group-mode only"):
+            adapter.KafkaConsumer(
+                assignment=[TopicPartition("t", 0)],
+                rebalance_listener=object(),
+            )

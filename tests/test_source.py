@@ -434,3 +434,106 @@ class TestLag:
         assert c.lag() == {tps[0]: 0, tps[1]: 0}
         broker.produce("t", b"new")
         assert sum(c.lag().values()) == 1
+
+
+class TestRebalanceListener:
+    class Recorder:
+        def __init__(self):
+            self.events = []
+
+        def on_partitions_revoked(self, revoked):
+            self.events.append(("revoked", sorted(revoked)))
+
+        def on_partitions_assigned(self, assigned):
+            self.events.append(("assigned", sorted(assigned)))
+
+    def test_listener_sees_revoked_then_assigned(self, broker):
+        broker.create_topic("t", partitions=4)
+        rec = self.Recorder()
+        a = MemoryConsumer(broker, "t", group_id="g", rebalance_listener=rec)
+        all_tps = [TopicPartition("t", p) for p in range(4)]
+        # kafka-python timing: the initial assigned fires on the first sync
+        # after construction, not inside __init__ (so the hook can hold a
+        # reference to the consumer and e.g. seek()).
+        assert rec.events == []
+        a.poll(max_records=1, timeout_ms=10)
+        assert rec.events == [("assigned", all_tps)]
+
+        b = MemoryConsumer(broker, "t", group_id="g")  # triggers rebalance
+        a.poll(max_records=1, timeout_ms=10)  # a syncs and sees it
+        assert rec.events[1][0] == "revoked"
+        assert rec.events[1][1] == all_tps  # eager: everything revoked
+        assert rec.events[2][0] == "assigned"
+        assert set(rec.events[2][1]) == set(a.assignment())
+        b.close()
+
+    def test_listener_may_reenter_consumer_apis(self, broker):
+        """The revoked hook calling assignment()/lag() re-enters
+        _sync_group; the generation is adopted before the hook runs, so
+        this must neither recurse nor duplicate callbacks — and the hook
+        still observes the OLD assignment."""
+        broker.create_topic("t", partitions=4)
+        seen = []
+        holder = {}
+
+        class Reentrant:
+            def on_partitions_revoked(self, revoked):
+                seen.append(("revoked-during", sorted(holder["c"].assignment())))
+
+            def on_partitions_assigned(self, assigned):
+                seen.append(("assigned", sorted(assigned)))
+
+        c = MemoryConsumer(
+            broker, "t", group_id="g", rebalance_listener=Reentrant()
+        )
+        holder["c"] = c
+        c.poll(max_records=1, timeout_ms=10)
+        all_tps = [TopicPartition("t", p) for p in range(4)]
+        MemoryConsumer(broker, "t", group_id="g")
+        c.poll(max_records=1, timeout_ms=10)
+        # initial assigned, then exactly one revoked (seeing the OLD
+        # 4-partition assignment) and one assigned — no duplicates.
+        assert seen[0] == ("assigned", all_tps)
+        assert seen[1] == ("revoked-during", all_tps)
+        assert seen[2][0] == "assigned" and len(seen) == 3
+
+    def test_listener_rejected_with_manual_assignment(self, broker):
+        broker.create_topic("t", partitions=1)
+        with pytest.raises(ValueError, match="group-mode only"):
+            MemoryConsumer(
+                broker, group_id="g",
+                assignment=[TopicPartition("t", 0)],
+                rebalance_listener=object(),
+            )
+
+    def test_listener_can_snapshot_positions_before_revoke(self, broker):
+        """The revoked hook runs BEFORE local state clears — a listener can
+        record how far it got (the flush-before-revoke pattern)."""
+        broker.create_topic("t", partitions=2)
+        fill(broker, "t", 8)
+        snapshots = []
+        holder = {}
+
+        class Snap:
+            def on_partitions_revoked(self, revoked):
+                snapshots.append(
+                    {tp: holder["c"].position(tp) for tp in revoked}
+                )
+
+        c = MemoryConsumer(broker, "t", group_id="g", rebalance_listener=Snap())
+        holder["c"] = c
+        c.poll(max_records=8, timeout_ms=10)
+        MemoryConsumer(broker, "t", group_id="g")  # rebalance
+        c.poll(max_records=1, timeout_ms=10)
+        assert snapshots and sum(snapshots[0].values()) == 8
+
+    def test_raising_listener_does_not_wedge_consumer(self, broker):
+        broker.create_topic("t", partitions=2)
+        fill(broker, "t", 4)
+
+        class Bad:
+            def on_partitions_assigned(self, assigned):
+                raise RuntimeError("listener bug")
+
+        c = MemoryConsumer(broker, "t", group_id="g", rebalance_listener=Bad())
+        assert len(c.poll(max_records=10, timeout_ms=10)) == 4

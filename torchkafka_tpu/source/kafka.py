@@ -34,6 +34,26 @@ def _ktp(tp: TopicPartition):
     return _kafka.TopicPartition(tp.topic, tp.partition)
 
 
+def _wrap_listener(listener):
+    """User listeners receive FRAMEWORK TopicPartitions on both transports;
+    kafka-python would hand its own type through, so translate here (and
+    subclass its listener base, which subscribe() type-checks)."""
+    base = getattr(_kafka, "ConsumerRebalanceListener", object)
+
+    class _Adapter(base):  # type: ignore[misc, valid-type]
+        def on_partitions_revoked(self, revoked):
+            fn = getattr(listener, "on_partitions_revoked", None)
+            if fn is not None:
+                fn([TopicPartition(tp.topic, tp.partition) for tp in revoked])
+
+        def on_partitions_assigned(self, assigned):
+            fn = getattr(listener, "on_partitions_assigned", None)
+            if fn is not None:
+                fn([TopicPartition(tp.topic, tp.partition) for tp in assigned])
+
+    return _Adapter()
+
+
 def _offset_and_metadata(offset: int):
     """kafka-python 2.0.2's OffsetAndMetadata is (offset, metadata); newer
     releases added leader_epoch (/root/reference/setup.py:9 pins >=2.0.2, so
@@ -58,6 +78,7 @@ class KafkaConsumer(ConsumerIterMixin):
         *,
         pattern: str | None = None,
         assignment: Sequence[TopicPartition] | None = None,
+        rebalance_listener=None,
         **kafka_kwargs,
     ) -> None:
         if not HAVE_KAFKA_PYTHON:  # pragma: no cover
@@ -87,13 +108,30 @@ class KafkaConsumer(ConsumerIterMixin):
         self._consumer_timeout_ms = kafka_kwargs.pop("consumer_timeout_ms", None)
         self._last_yielded: dict[TopicPartition, int] = {}
         if assignment is not None:
+            if rebalance_listener is not None:
+                raise ValueError(
+                    "rebalance_listener is group-mode only (manual "
+                    "assignment never rebalances)"
+                )
             self._consumer = _kafka.KafkaConsumer(**kafka_kwargs)
             self._consumer.assign(
                 [_ktp(tp) for tp in assignment]
             )
         elif pattern is not None:
             self._consumer = _kafka.KafkaConsumer(**kafka_kwargs)
-            self._consumer.subscribe(pattern=pattern)
+            if rebalance_listener is not None:
+                self._consumer.subscribe(
+                    pattern=pattern, listener=_wrap_listener(rebalance_listener)
+                )
+            else:
+                self._consumer.subscribe(pattern=pattern)
+        elif rebalance_listener is not None:
+            # Listener requires the explicit subscribe() path; topics in the
+            # constructor would bypass it.
+            self._consumer = _kafka.KafkaConsumer(**kafka_kwargs)
+            self._consumer.subscribe(
+                topics=topics, listener=_wrap_listener(rebalance_listener)
+            )
         else:
             self._consumer = _kafka.KafkaConsumer(*topics, **kafka_kwargs)
 

@@ -33,11 +33,12 @@ from __future__ import annotations
 
 import itertools
 import json
+import logging
 import re
 import threading
 import time
 import zlib
-from typing import Iterable, Mapping, Sequence
+from typing import Any, Iterable, Mapping, Sequence
 
 from torchkafka_tpu.errors import (
     CommitFailedError,
@@ -325,6 +326,7 @@ class MemoryConsumer(ConsumerIterMixin):
         auto_offset_reset: str = "earliest",
         member_id: str | None = None,
         consumer_timeout_ms: int | None = None,
+        rebalance_listener: Any | None = None,
     ) -> None:
         if auto_offset_reset not in ("earliest", "latest"):
             raise ValueError(f"auto_offset_reset must be earliest|latest, got {auto_offset_reset!r}")
@@ -337,6 +339,13 @@ class MemoryConsumer(ConsumerIterMixin):
             raise ValueError("pattern is exclusive with topics/assignment")
         if pattern is None and topics is None and assignment is None:
             raise ValueError("one of topics, pattern, or assignment is required")
+        if rebalance_listener is not None and assignment is not None:
+            # Same contract as the kafka adapter: manual assignment never
+            # rebalances, so a listener there would silently never fire.
+            raise ValueError(
+                "rebalance_listener is group-mode only (manual assignment "
+                "never rebalances)"
+            )
         self._broker = broker
         if topics is not None:
             self._topics = frozenset([topics] if isinstance(topics, str) else topics)
@@ -358,6 +367,10 @@ class MemoryConsumer(ConsumerIterMixin):
         # ConsumerIterMixin): commit(None) prefers these over poll positions.
         self._last_yielded: dict[TopicPartition, int] = {}
         self._paused: set[TopicPartition] = set()
+        # Object with optional on_partitions_revoked / on_partitions_assigned
+        # methods (kafka-python's ConsumerRebalanceListener shape).
+        self._rebalance_listener = rebalance_listener
+        self._pending_initial_assign = rebalance_listener is not None
 
         # Topics must exist either way; surfaces config errors eagerly.
         for t in self._topics:
@@ -388,18 +401,58 @@ class MemoryConsumer(ConsumerIterMixin):
 
         Models Kafka's eager rebalance: ALL partitions are revoked and
         re-acquired, so every position re-resolves from the committed offset —
-        anything fetched but uncommitted is re-delivered (at-least-once)."""
+        anything fetched but uncommitted is re-delivered (at-least-once).
+        A registered rebalance listener sees revoked(old) then
+        assigned(new), in that order — the kafka-python
+        ConsumerRebalanceListener contract; the revoked callback runs
+        BEFORE local state clears, so it may still read positions (but a
+        commit there can already fail generation-checked, exactly as a
+        real broker mid-rebalance — re-delivery covers it)."""
         if self._manual:
             return
         gen, assign = self._broker.group_state(self._group_id, self._member_id)
+        listener = self._rebalance_listener
+        if self._pending_initial_assign:
+            # The initial join's assigned callback fires on the first sync
+            # AFTER construction (kafka-python's timing) — so a listener
+            # holding a reference to this consumer can seek() in the hook.
+            self._pending_initial_assign = False
+            if listener is not None:
+                self._call_listener(
+                    listener, "on_partitions_assigned", self._assignment
+                )
         if gen != self._generation:
-            self._generation, self._assignment = gen, assign
+            # Adopt the new generation BEFORE the revoked hook: a listener
+            # that calls assignment()/lag()/pause() re-enters _sync_group,
+            # and a stale generation there would recurse into the hooks
+            # unboundedly. The hook still observes the OLD assignment and
+            # positions — they are replaced after it returns.
+            old, self._generation = list(self._assignment), gen
+            if listener is not None:
+                self._call_listener(listener, "on_partitions_revoked", old)
+            self._assignment = assign
             self._positions.clear()
             self._last_yielded.clear()
             # Kafka clients rebuild partition state on reassignment: a
             # revoked-then-reacquired partition comes back UNpaused, and a
             # paused flag must never outlive the assignment that set it.
             self._paused.clear()
+            if listener is not None:
+                self._call_listener(listener, "on_partitions_assigned", assign)
+
+    @staticmethod
+    def _call_listener(listener, hook: str, tps) -> None:
+        """A raising listener must not wedge the consumer mid-rebalance
+        (kafka-python logs and continues the same way)."""
+        fn = getattr(listener, hook, None)
+        if fn is None:
+            return
+        try:
+            fn(list(tps))
+        except Exception:  # noqa: BLE001 - listener errors are not ours
+            logging.getLogger(__name__).exception(
+                "rebalance listener %s raised", hook
+            )
 
     def _resolve_position(self, tp: TopicPartition) -> int:
         if tp not in self._positions:
