@@ -1,0 +1,177 @@
+"""Where do the flagship train step's FLOPs go, and what's the MFU?
+
+Times the 45.4M-parameter flagship transformer's jitted train step on the
+real TPU (strict completion: chained steps, scalar loss fetch), comparing
+the fused blocked CE (default) against the round-2 dense CE
+(`ce_block_size=0`), and decomposing a step into trunk / head+CE / backward
+/ optimizer by timing nested jits. Writes a markdown table to stdout for
+PERF.md.
+
+Usage:  python benchmarks/mfu_breakdown.py [--batches 8,32,64] [--steps 20]
+        python benchmarks/mfu_breakdown.py --long-ctx   # B=4/S=2048, B=1/S=16384
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from torchkafka_tpu.models import Transformer, TransformerConfig, make_train_step
+from torchkafka_tpu.models.transformer import count_params
+
+V5E_BF16_PEAK = 197e12  # TPU v5e bf16 peak FLOP/s
+
+
+def train_flops_per_step(cfg: TransformerConfig, batch: int, seq: int) -> float:
+    """6·N·tokens (N = matmul params incl. head, excl. embedding gather)
+    + attention 6·L·d·B·S² — the CAUSAL-halved count (non-causal would be
+    12·L·d·B·S²: QK^T + PV at 2 FLOPs/MAC × 3 fwd+bwd passes); the flash
+    kernels skip the masked half, so this matches executed FLOPs. Same
+    convention as PERF.md round 2."""
+    n = (
+        cfg.n_layers
+        * (
+            cfg.d_model * (cfg.n_heads + 2 * cfg.n_kv_heads) * cfg.head_dim
+            + cfg.n_heads * cfg.head_dim * cfg.d_model
+            + 3 * cfg.d_model * cfg.d_ff
+        )
+        + cfg.d_model * cfg.vocab_size
+    )
+    tokens = batch * seq
+    return 6.0 * n * tokens + 6.0 * cfg.n_layers * cfg.d_model * batch * seq * seq
+
+
+def timed(fn, *args, steps: int, fetch) -> float:
+    """Median of 3 timed windows of `steps` chained calls, strict fetch."""
+    outs = fn(*args)
+    fetch(outs)  # compile + warmup
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        o = outs
+        for _ in range(steps):
+            o = fn(*args)
+        fetch(o)
+        times.append((time.perf_counter() - t0) / steps)
+    return float(np.median(times))
+
+
+def run_config(cfg: TransformerConfig, batch: int, seq: int, steps: int) -> dict:
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:1]), ("data",))
+    init_fn, step_fn = make_train_step(cfg, mesh, optax.adamw(3e-4))
+    params, opt_state = init_fn(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32)
+    mask = jnp.ones((batch, seq), jnp.float32)
+
+    # step_fn donates params/opt_state; time with rebinding.
+    state = {"p": params, "o": opt_state}
+
+    def step():
+        state["p"], state["o"], loss = step_fn(state["p"], state["o"], tokens, mask)
+        return loss
+
+    loss = step()
+    float(loss)  # compile
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = step()
+        float(loss)  # strict completion proof
+        times.append((time.perf_counter() - t0) / steps)
+    dt = float(np.median(times))
+    fl = train_flops_per_step(cfg, batch, seq)
+    return {"ms": dt * 1e3, "tflop": fl / 1e12, "mfu": fl / dt / V5E_BF16_PEAK}
+
+
+def decompose(cfg: TransformerConfig, batch: int, seq: int, steps: int) -> dict:
+    """Forward-only pieces + full fwd+bwd, each as its own jit."""
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0))
+    params = jax.device_put(params)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32)
+    mask = jnp.ones((batch, seq), jnp.float32)
+
+    trunk = jax.jit(lambda p, t: model.trunk(p, t)[0].sum())
+    lossf = jax.jit(lambda p, t, m: model.loss(p, t, m))
+    gradf = jax.jit(lambda p, t, m: jax.grad(model.loss)(p, t, m))
+
+    t_trunk = timed(trunk, params, tokens, steps=steps, fetch=lambda o: float(o))
+    t_loss = timed(lossf, params, tokens, mask, steps=steps, fetch=lambda o: float(o))
+    t_grad = timed(
+        gradf, params, tokens, mask, steps=steps,
+        fetch=lambda o: float(jax.tree_util.tree_leaves(o)[0].ravel()[0]),
+    )
+    return {
+        "trunk_fwd_ms": t_trunk * 1e3,
+        "loss_fwd_ms": t_loss * 1e3,
+        "headce_fwd_ms": (t_loss - t_trunk) * 1e3,
+        "fwd_bwd_ms": t_grad * 1e3,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batches", default="8,32,64")
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--long-ctx", action="store_true")
+    ap.add_argument("--decompose", action="store_true")
+    args = ap.parse_args()
+
+    print(f"backend={jax.default_backend()} devices={jax.devices()}")
+    if args.long_ctx:
+        combos = [
+            (TransformerConfig(max_seq_len=2048, attn_impl="flash"), 4, 2048),
+            (
+                TransformerConfig(max_seq_len=16384, attn_impl="flash", remat=True),
+                1, 16384,
+            ),
+        ]
+        for cfg, b, s in combos:
+            for blk in (None, 0):
+                import dataclasses
+
+                c = dataclasses.replace(cfg, ce_block_size=blk)
+                label = "fused" if blk is None else "dense"
+                try:
+                    r = run_config(c, b, s, max(4, args.steps // 4))
+                    print(
+                        f"B={b} S={s} ce={label}: {r['ms']:.1f} ms/step, "
+                        f"{r['tflop']:.2f} TFLOP, MFU {r['mfu'] * 100:.1f}%"
+                    )
+                except Exception as e:  # noqa: BLE001 — report OOMs inline
+                    print(f"B={b} S={s} ce={label}: FAILED {type(e).__name__}: {e}")
+        return
+
+    import dataclasses
+
+    cfg = TransformerConfig()
+    n_params = count_params(Transformer(cfg).init(jax.random.key(0)))
+    print(f"flagship params: {n_params / 1e6:.1f}M, seq {cfg.max_seq_len}")
+    for b in [int(x) for x in args.batches.split(",")]:
+        for blk in (None, 0):
+            c = dataclasses.replace(cfg, ce_block_size=blk)
+            label = "fused" if blk is None else "dense"
+            r = run_config(c, b, cfg.max_seq_len, args.steps)
+            print(
+                f"B={b} ce={label}: {r['ms']:.1f} ms/step, {r['tflop']:.2f} "
+                f"TFLOP/step, MFU {r['mfu'] * 100:.1f}%"
+            )
+        if args.decompose:
+            d = decompose(cfg, b, cfg.max_seq_len, args.steps)
+            print(
+                f"  decompose B={b}: trunk fwd {d['trunk_fwd_ms']:.1f} ms, "
+                f"+head+CE {d['headce_fwd_ms']:.1f} ms, full fwd "
+                f"{d['loss_fwd_ms']:.1f} ms, fwd+bwd {d['fwd_bwd_ms']:.1f} ms"
+            )
+
+
+if __name__ == "__main__":
+    main()

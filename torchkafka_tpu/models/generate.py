@@ -18,6 +18,7 @@ single-token decode step is specialised here.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import NamedTuple
 
 import jax
@@ -108,7 +109,14 @@ def prefill(params, cfg: TransformerConfig, tokens: jax.Array, max_len: int):
     Uses Transformer.__call__ for the logits (single source of truth) and an
     auxiliary scan to capture per-layer k/v.
     """
-    model = Transformer(cfg)
+    # Inference is mesh-less here: a training config that requested a
+    # sequence-parallel attn_impl ('ring'/'ulysses') must still be servable
+    # from its checkpoint, so fall back to the adaptive spelling rather than
+    # tripping the constructor's misconfigured-mesh guard.
+    if cfg.attn_impl in ("ring", "ulysses"):
+        model = Transformer(dataclasses.replace(cfg, attn_impl="auto"))
+    else:
+        model = Transformer(cfg)
     batch, seq = tokens.shape
     x = embed_rows(params["embed"], tokens, cfg.dtype)
     positions = jnp.arange(seq)

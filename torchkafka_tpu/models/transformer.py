@@ -41,8 +41,9 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from torchkafka_tpu.models.quant import embed_rows, load_weight
+from torchkafka_tpu.models.quant import QTensor, embed_rows, load_weight
 from torchkafka_tpu.ops.attention import mha, ring_attention, ulysses_attention
+from torchkafka_tpu.ops.xent import dense_softmax_xent, fused_softmax_xent
 
 
 @dataclasses.dataclass(frozen=True)
@@ -78,6 +79,10 @@ class TransformerConfig:
     # microbatches (None = pipeline depth). The router aux loss is not
     # collected under pp (the router still trains through the main loss).
     pp_microbatches: int | None = None
+    # Fused blocked cross-entropy (ops/xent.py): None = auto block size,
+    # >0 = that sequence block, 0 = disable (always full-logits dense CE).
+    # Auto-disabled under sp>1 meshes and quantized heads either way.
+    ce_block_size: int | None = None
 
     @property
     def head_dim(self) -> int:
@@ -275,26 +280,25 @@ class Transformer:
     def __init__(self, cfg: TransformerConfig, mesh: Mesh | None = None):
         self.cfg = cfg
         self.mesh = mesh
-        use_ring = (
-            cfg.attn_impl == "ring"
-            or (
-                cfg.attn_impl == "auto"
-                and mesh is not None
-                and mesh.shape.get("sp", 1) > 1
+        sp_size = mesh.shape.get("sp", 1) if mesh is not None else 1
+        if cfg.attn_impl in ("ring", "ulysses") and sp_size <= 1:
+            # An *explicitly* requested sequence-parallel impl that cannot
+            # engage is a misconfigured mesh, not a preference — degrading
+            # silently would run without the parallelism the caller asked
+            # for (ADVICE r2). 'auto' remains the adaptive spelling.
+            raise ValueError(
+                f"attn_impl={cfg.attn_impl!r} requires a mesh with an 'sp' "
+                f"axis of size > 1 (got sp={sp_size}); use attn_impl='auto' "
+                "to fall back to flash/dense when sp is absent"
             )
+        use_ring = cfg.attn_impl == "ring" or (
+            cfg.attn_impl == "auto" and sp_size > 1
         )
         self._use_ring = use_ring and mesh is not None
-        self._use_ulysses = (
-            cfg.attn_impl == "ulysses"
-            and mesh is not None
-            and mesh.shape.get("sp", 1) > 1
-        )
+        self._use_ulysses = cfg.attn_impl == "ulysses"
         self._use_flash = not (self._use_ring or self._use_ulysses) and (
             cfg.attn_impl == "flash"
-            or (
-                cfg.attn_impl in ("auto", "ulysses")
-                and jax.default_backend() == "tpu"
-            )
+            or (cfg.attn_impl == "auto" and jax.default_backend() == "tpu")
         )
 
     def init(self, rng: jax.Array) -> dict:
@@ -365,11 +369,13 @@ class Transformer:
         x = x + jnp.einsum("bsf,fd->bsd", gate * up, load_weight(layer["w_down"], cfg.dtype))
         return x, jnp.float32(0.0)
 
-    def __call__(
-        self, params: dict, tokens: jax.Array, *, return_aux: bool = False
-    ):
-        """tokens [B, S] int32 → logits [B, S, V] float32 (and, with
-        ``return_aux``, the mean per-layer router load-balance loss)."""
+    def trunk(
+        self, params: dict, tokens: jax.Array
+    ) -> tuple[jax.Array, jax.Array]:
+        """tokens [B, S] int32 → (final-norm hidden states [B, S, D] in
+        compute dtype, mean per-layer router aux loss). Everything except
+        the lm_head projection — split out so ``loss`` can feed the fused
+        blocked CE without ever materialising [B, S, V] logits."""
         cfg = self.cfg
         x = embed_rows(params["embed"], tokens, cfg.dtype)
 
@@ -406,14 +412,30 @@ class Transformer:
             if cfg.remat:
                 body = jax.checkpoint(body)
             x, auxes = lax.scan(body, x, params["layers"])
-        x = _rms_norm(x, params["ln_f"])
+        return _rms_norm(x, params["ln_f"]), jnp.mean(auxes)
+
+    def __call__(
+        self, params: dict, tokens: jax.Array, *, return_aux: bool = False
+    ):
+        """tokens [B, S] int32 → logits [B, S, V] float32 (and, with
+        ``return_aux``, the mean per-layer router load-balance loss)."""
+        x, aux = self.trunk(params, tokens)
         logits = jnp.einsum(
-            "bsd,dv->bsv", x, load_weight(params["lm_head"], cfg.dtype),
+            "bsd,dv->bsv", x, load_weight(params["lm_head"], self.cfg.dtype),
             preferred_element_type=jnp.float32,
         )
         if return_aux:
-            return logits, jnp.mean(auxes)
+            return logits, aux
         return logits
+
+    def _use_fused_ce(self, params: dict) -> bool:
+        """Fused blocked CE engages unless disabled, sequence-sharded (the
+        block scan would serialise over sp), or the head is quantized."""
+        if self.cfg.ce_block_size == 0:
+            return False
+        if self.mesh is not None and self.mesh.shape.get("sp", 1) > 1:
+            return False
+        return not isinstance(params["lm_head"], QTensor)
 
     def loss(
         self, params: dict, tokens: jax.Array, mask: jax.Array | None = None
@@ -421,23 +443,34 @@ class Transformer:
         """Next-token cross-entropy. mask [B, S] 1=real row/token, 0=padding
         (the ingest batcher's valid_mask — padded rows must not train).
 
-        The forward runs at full length S (so the sequence stays divisible by
-        the sp axis) and the shift happens on the logits.
+        The forward runs at full length S (so the sequence stays divisible
+        by the sp axis) and the shift happens on the loss side: position i
+        predicts token i+1, the final position is masked out. The default
+        path is the fused blocked CE (ops/xent.py) — full [B, S, V] logits
+        are never materialised; sp>1 / quantized heads take the dense path.
         """
         cfg = self.cfg
-        aux = 0.0
-        if cfg.is_moe and cfg.router_aux_coef > 0:
-            logits, aux = self(params, tokens, return_aux=True)
-            logits = logits[:, :-1]
+        x, aux = self.trunk(params, tokens)
+        aux = aux if (cfg.is_moe and cfg.router_aux_coef > 0) else 0.0
+        # Shift once for both CE paths: position i predicts token i+1; the
+        # final position (and padded rows) carry mask 0. Keeping full length
+        # S also keeps the batch divisible over an sp axis.
+        targets = jnp.pad(tokens[:, 1:], ((0, 0), (0, 1)))
+        m = jnp.ones(tokens.shape, jnp.float32) if mask is None else mask
+        m = jnp.pad(m[:, 1:].astype(jnp.float32), ((0, 0), (0, 1)))
+        if self._use_fused_ce(params):
+            ce = fused_softmax_xent(
+                x, params["lm_head"], targets, m,
+                cfg.ce_block_size, cfg.dtype,
+            )
         else:
-            logits = self(params, tokens)[:, :-1]
-        targets = tokens[:, 1:]
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        if mask is None:
-            return nll.mean() + cfg.router_aux_coef * aux
-        m = mask[:, 1:].astype(nll.dtype)
-        return (nll * m).sum() / jnp.maximum(m.sum(), 1.0) + cfg.router_aux_coef * aux
+            # Dense fallback shares the oracle implementation (ops/xent.py)
+            # — one CE definition, two materialisation strategies.
+            ce = dense_softmax_xent(
+                x, load_weight(params["lm_head"], cfg.dtype), targets, m,
+                cfg.dtype,
+            )
+        return ce + cfg.router_aux_coef * aux
 
 
 # ----------------------------------------------------------------- train step

@@ -1,0 +1,194 @@
+"""Fused blocked softmax cross-entropy — the lm_head + loss hot path.
+
+Net-new vs the reference (no model code in its tree, SURVEY.md §2). This op
+exists for one TPU reason: on a vocab-sized head the naive loss
+
+    logits = x @ W            # [B, S, V] f32
+    logp   = log_softmax(logits)
+    nll    = -take_along_axis(logp, targets)
+
+materialises two full ``[B, S, V]`` float32 tensors in HBM (4.2 GB each at
+B=64, S=512, V=32k) and keeps one alive as the log_softmax residual for the
+backward pass. For a small-d_model LM the head matmul is >half the model
+FLOPs, so this traffic dominates the step — measured 18-32% MFU on the 45M
+flagship before this op (PERF.md round 2).
+
+Design (the standard fused-CE shape, e.g. the "blocked cross-entropy" in
+large-vocab LM trainers, re-derived for XLA):
+
+- **Scan over sequence blocks.** Each block computes ``[B, blk, V]`` logits
+  (bf16 MXU matmul, f32 accumulate), reduces them to per-token logsumexp +
+  target logit, and discards them. Peak HBM for the head is one block of
+  logits instead of the full tensor.
+- **Analytic gradients in the forward scan — no backward recompute.** The
+  loss is scalar and its cotangent ``g`` enters linearly, so
+  ``dlogits = (softmax(logits) - onehot(targets)) * mask`` can be computed
+  while the block's logits are still live, contracted immediately into
+  ``dx`` ([B, S, D]) and ``dW`` ([D, V], f32 accumulator in the scan
+  carry), and simply scaled by ``g / count`` in the VJP. Total head matmul
+  cost is exactly 3 passes (fwd + dx + dW) — the same FLOPs as unfused
+  AD — with zero ``[B, S, V]`` residuals and zero recompute (a
+  ``jax.checkpoint``-based blocking would pay a 4th pass).
+- **Sharding-transparent.** Everything is ``jnp`` under ``jit``: batch
+  stays sharded over data/fsdp (the scan iterates sequence blocks only),
+  and a tp-sharded ``W`` shards each block's logits over vocab with XLA
+  inserting the logsumexp psum. The one layout this op must NOT be used
+  with is sequence parallelism (sp>1): the scan would serialise over the
+  sharded axis. ``Transformer.loss`` guards that case and keeps the dense
+  path (ring/ulysses activations never materialise full-S logits anyway).
+
+The primal path (loss value only, e.g. eval) skips the gradient work.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# Peak bytes of f32 block logits to aim for when auto-picking a block size.
+# 256 MB keeps the per-block matmul M-dim (B*blk) MXU-sized at realistic
+# batch/vocab while bounding HBM pressure; measured insensitive ±2× on v5e.
+_AUTO_BLOCK_BYTES = 256 * 1024 * 1024
+
+
+def auto_block_size(batch: int, seq: int, vocab: int) -> int:
+    """Largest power-of-two sequence block with ≤ _AUTO_BLOCK_BYTES of f32
+    block logits, clamped to [16, seq]."""
+    budget = max(1, _AUTO_BLOCK_BYTES // (4 * batch * max(vocab, 1)))
+    blk = 2 ** int(math.floor(math.log2(budget))) if budget > 1 else 1
+    return max(16, min(seq, blk))
+
+
+def dense_softmax_xent(x, w, targets, mask, compute_dtype=jnp.bfloat16):
+    """Reference implementation: full-logits masked-mean CE. Used as the
+    fallback (sp>1 / quantized heads) and as the test oracle."""
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x.astype(compute_dtype), w.astype(compute_dtype),
+        preferred_element_type=jnp.float32,
+    )
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    m = mask.astype(nll.dtype)
+    return (nll * m).sum() / jnp.maximum(m.sum(), 1.0)
+
+
+def _resolve_block(block_size, batch, seq, vocab) -> int:
+    """None → auto. 0/negative is an error here, NOT a dense fallback: the
+    'ce_block_size=0 disables fusion' contract lives in Transformer, which
+    routes to the dense path before this op is ever called."""
+    if block_size is None:
+        return auto_block_size(batch, seq, vocab)
+    if block_size <= 0:
+        raise ValueError(
+            f"block_size must be a positive int or None (auto), got "
+            f"{block_size}; use the dense CE for an unblocked loss"
+        )
+    return block_size
+
+
+def _pad_blocks(x, targets, mask, block):
+    """Pad S up to a multiple of ``block`` with mask-0 rows and reshape to
+    scan layout [nb, B, block, ...]."""
+    b, s, _ = x.shape
+    nb = -(-s // block)
+    pad = nb * block - s
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)))
+        mask = jnp.pad(mask, ((0, 0), (0, pad)))
+    xb = x.reshape(b, nb, block, x.shape[-1]).transpose(1, 0, 2, 3)
+    tb = targets.reshape(b, nb, block).transpose(1, 0, 2)
+    mb = mask.reshape(b, nb, block).transpose(1, 0, 2)
+    return xb, tb, mb, pad
+
+
+def _block_stats(xx, wc, tt, mm, compute_dtype):
+    """One block's logits → (f32 logits, logsumexp, masked nll sum)."""
+    logits = jnp.einsum(
+        "bsd,dv->bsv", xx.astype(compute_dtype), wc,
+        preferred_element_type=jnp.float32,
+    )
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    tgt = jnp.take_along_axis(logits, tt[..., None], axis=-1)[..., 0]
+    nll_sum = jnp.sum((lse - tgt) * mm)
+    return logits, lse, nll_sum
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def fused_softmax_xent(
+    x, w, targets, mask, block_size=None, compute_dtype=jnp.bfloat16
+):
+    """Masked-mean next-token CE over a vocab head, blocked over sequence.
+
+    x: [B, S, D] trunk output; w: [D, V] head (master dtype — cast to
+    ``compute_dtype`` inside so dW comes back in master precision);
+    targets: [B, S] int; mask: [B, S] (0 ⇒ position excluded).
+    Matches ``dense_softmax_xent`` to f32-reduction tolerance.
+    """
+    b, s, _ = x.shape
+    blk = _resolve_block(block_size, b, s, w.shape[-1])
+    wc = w.astype(compute_dtype)
+    xb, tb, mb, _ = _pad_blocks(x, targets, mask.astype(jnp.float32), blk)
+
+    def body(tot, inp):
+        xx, tt, mm = inp
+        _, _, nll_sum = _block_stats(xx, wc, tt, mm, compute_dtype)
+        return tot + nll_sum, None
+
+    tot, _ = lax.scan(body, jnp.float32(0.0), (xb, tb, mb))
+    return tot / jnp.maximum(mask.astype(jnp.float32).sum(), 1.0)
+
+
+def _fused_fwd(x, w, targets, mask, block_size, compute_dtype):
+    b, s, _ = x.shape
+    v = w.shape[-1]
+    blk = _resolve_block(block_size, b, s, v)
+    wc = w.astype(compute_dtype)
+    xb, tb, mb, pad = _pad_blocks(x, targets, mask.astype(jnp.float32), blk)
+
+    def body(carry, inp):
+        tot, dw = carry
+        xx, tt, mm = inp
+        logits, lse, nll_sum = _block_stats(xx, wc, tt, mm, compute_dtype)
+        # d(nll_sum)/d(logits), before the 1/count and cotangent scaling
+        # applied in the bwd rule (both enter linearly).
+        p = jnp.exp(logits - lse[..., None])
+        dlog = (
+            (p - jax.nn.one_hot(tt, v, dtype=jnp.float32)) * mm[..., None]
+        ).astype(compute_dtype)
+        dx = jnp.einsum(
+            "bsv,dv->bsd", dlog, wc, preferred_element_type=jnp.float32
+        )
+        dw = dw + jnp.einsum(
+            "bsd,bsv->dv", xx.astype(compute_dtype), dlog,
+            preferred_element_type=jnp.float32,
+        )
+        return (tot + nll_sum, dw), dx
+
+    dw0 = jnp.zeros(w.shape, jnp.float32)
+    (tot, dw), dxb = lax.scan(body, (jnp.float32(0.0), dw0), (xb, tb, mb))
+    cnt = jnp.maximum(mask.astype(jnp.float32).sum(), 1.0)
+    dx = dxb.transpose(1, 0, 2, 3).reshape(b, s + pad, x.shape[-1])[:, :s]
+    # Zero-size sentinels carry the primal dtypes into bwd (raw dtypes are
+    # not valid residual-pytree leaves).
+    x_like = jnp.zeros((0,), x.dtype)
+    w_like = jnp.zeros((0,), w.dtype)
+    return tot / cnt, (dx, dw, cnt, x_like, w_like)
+
+
+def _fused_bwd(block_size, compute_dtype, res, g):
+    dx, dw, cnt, x_like, w_like = res
+    scale = (g / cnt).astype(jnp.float32)
+    return (
+        (dx * scale).astype(x_like.dtype),
+        (dw * scale).astype(w_like.dtype),
+        None,  # integer targets
+        None,  # mask treated as non-differentiable selection weights
+    )
+
+
+fused_softmax_xent.defvjp(_fused_fwd, _fused_bwd)
