@@ -51,6 +51,38 @@ class TestSaveRestore:
         with pytest.raises(FileNotFoundError):
             ck.restore()
 
+    def test_corrupt_offsets_file_quarantines_only_that_step(self, tmp_path):
+        """ADVICE r2: one damaged/odd offsets file must not brick discovery
+        and GC of every other checkpoint — the damaged step drops out of
+        steps()/auto-selection; explicitly restoring it fails loudly."""
+        ck = StreamCheckpointer(tmp_path / "ck")
+        for s in (1, 2, 3):
+            ck.save(s, _state(s), {TopicPartition("t", 0): s * 10})
+        # Corrupt step 3's offsets JSON and drop a stray misnamed file
+        # into step 2 (filename parses, content doesn't).
+        with open(tmp_path / "ck" / "3" / "stream_offsets.json", "w") as f:
+            f.write("{truncated")
+        with open(
+            tmp_path / "ck" / "2" / "stream_offsets_notanint.json", "w"
+        ) as f:
+            f.write("[]")
+        assert ck.steps() == [1]
+        assert ck.latest_step() == 1
+        _, offsets, step = ck.restore()
+        assert step == 1 and offsets[TopicPartition("t", 0)] == 10
+        with pytest.raises(FileNotFoundError):
+            ck.restore(3)
+        # GC reclaims damaged dirs too (they'd otherwise leak their Orbax
+        # state payloads forever): with keep=1 the next save prunes every
+        # dir older than the kept step, damaged or not.
+        ck2 = StreamCheckpointer(tmp_path / "ck", keep=1)
+        ck2.save(4, _state(4), {TopicPartition("t", 0): 40})
+        assert ck2.steps() == [4]
+        for old in (1, 2, 3):
+            assert not (tmp_path / "ck" / str(old)).exists(), (
+                f"gc leaked dir {old}"
+            )
+
 
 class TestAsyncSave:
     def test_async_roundtrip(self, tmp_path):

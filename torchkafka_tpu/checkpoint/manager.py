@@ -64,15 +64,30 @@ def _offsets_files(path: str) -> list[str]:
 
 
 def _read_offsets_metas(path: str) -> list[dict]:
+    """Parse every offsets file in a checkpoint dir. A single corrupt or
+    oddly-named file marks THIS dir damaged (it is excluded from
+    auto-selection via ``_pod_complete``) instead of raising — ``steps()``
+    scans every checkpoint, so one torn write must not brick discovery and
+    GC of all the healthy ones (ADVICE r2)."""
     metas = []
     for offsets_path in _offsets_files(path):
-        with open(offsets_path) as f:
-            meta = json.load(f)
-        if "process_index" not in meta:
-            # Pre-metadata files: recover the index from the filename.
-            name = os.path.basename(offsets_path)
-            if name != _OFFSETS_FILE:
-                meta["process_index"] = int(name[len("stream_offsets_"):-len(".json")])
+        try:
+            with open(offsets_path) as f:
+                meta = json.load(f)
+            if not isinstance(meta, dict):
+                raise ValueError(f"offsets file is not a JSON object: {meta!r}")
+            if "process_index" not in meta:
+                # Pre-metadata files: recover the index from the filename.
+                name = os.path.basename(offsets_path)
+                if name != _OFFSETS_FILE:
+                    meta["process_index"] = int(
+                        name[len("stream_offsets_"):-len(".json")]
+                    )
+        except (OSError, ValueError) as exc:  # json.JSONDecodeError ⊂ ValueError
+            logger.warning(
+                "skipping damaged offsets file %s: %s", offsets_path, exc
+            )
+            return [{"damaged": True}]
         metas.append(meta)
     return metas
 
@@ -82,6 +97,8 @@ def _pod_complete(metas: list[dict]) -> bool:
     per-process files are present. File COUNT is not enough: a stale
     single-process file alongside N-1 per-process files would count to N
     while a partition's watermark is silently missing."""
+    if any(m.get("damaged") for m in metas):
+        return False
     pod = [m for m in metas if int(m.get("process_count", 1)) > 1]
     if not pod:
         return bool(metas)
@@ -288,11 +305,22 @@ class StreamCheckpointer:
         self._gc()
 
     def _gc(self) -> None:
+        """Prune every checkpoint dir older than the keep-th newest COMPLETE
+        step — including damaged/incomplete dirs (excluded from ``steps()``,
+        they would otherwise leak their Orbax state payloads forever). A
+        damaged dir NEWER than the kept floor survives for forensics until
+        newer complete saves age it out."""
+        if not self._keep:
+            return
         steps = self.steps()
-        for old in steps[: -self._keep] if self._keep else []:
-            import shutil
+        if not steps:
+            return
+        keep_floor = steps[-self._keep] if len(steps) >= self._keep else steps[0]
+        import shutil
 
-            shutil.rmtree(os.path.join(self._root, str(old)), ignore_errors=True)
+        for name in os.listdir(self._root):
+            if name.isdigit() and int(name) < keep_floor:
+                shutil.rmtree(os.path.join(self._root, name), ignore_errors=True)
 
     # --------------------------------------------------------------- restore
 
@@ -335,12 +363,17 @@ class StreamCheckpointer:
             if step is None:
                 raise FileNotFoundError(f"no checkpoints under {self._root}")
         path = os.path.join(self._root, str(step))
-        state = self._ckptr.restore(
-            os.path.join(path, "state"), template if template is not None else None
-        )
+        # Validate the offsets state BEFORE the (potentially minutes-long)
+        # Orbax state restore, and distinguish torn files from lost ones so
+        # the operator chases the right failure.
         metas = _read_offsets_metas(path)
         if not metas:
             raise FileNotFoundError(f"no offsets file in {path}")
+        if any(m.get("damaged") for m in metas):
+            raise FileNotFoundError(
+                f"damaged checkpoint in {path}: an offsets file exists but "
+                "failed to parse (torn write?) — see the logged warning"
+            )
         if not _pod_complete(metas):
             # An incomplete pod checkpoint (a per-process file lost in a
             # copy/prune) would restore a PARTIAL watermark: the missing
@@ -351,6 +384,9 @@ class StreamCheckpointer:
                 f"incomplete pod checkpoint in {path}: missing per-process "
                 "offsets files for the recorded process_count"
             )
+        state = self._ckptr.restore(
+            os.path.join(path, "state"), template if template is not None else None
+        )
         merged: dict[TopicPartition, int] = {}
         for meta in metas:
             for tp, off in _decode_offsets(meta["offsets"]).items():

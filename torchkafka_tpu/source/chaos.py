@@ -117,6 +117,12 @@ class ChaosConsumer(ConsumerIterMixin):
     def paused(self):
         return self._inner.paused()
 
+    def has_paused(self) -> bool:
+        # The fast-path hint is optional on duck-typed consumers — don't
+        # turn its absence on the inner into a crash.
+        fn = getattr(self._inner, "has_paused", None)
+        return bool(self._inner.paused()) if fn is None else fn()
+
     def close(self) -> None:
         self._inner.close()
 

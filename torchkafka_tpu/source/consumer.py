@@ -80,6 +80,13 @@ class Consumer(Protocol):
 
     def paused(self) -> Sequence[TopicPartition]: ...
 
+    def has_paused(self) -> bool:
+        """Cheap O(1) probe for "is anything paused?". The per-record
+        iterator hot loop consults this before paying for ``paused()``
+        (which allocates a sorted list per call) — pause is rare, the loop
+        is not (ADVICE r2)."""
+        ...
+
     def close(self) -> None:
         """Release assignment. NEVER commits on close — uncommitted work must
         be re-delivered (/root/reference/src/kafka_dataset.py:89)."""
@@ -148,6 +155,11 @@ class ConsumerIterMixin:
         # surfaced yet, not ones already in this buffer.
         stash: dict[TopicPartition, list[Record]] = {}
         paused_fn = getattr(self, "paused", None)
+        # O(1) "anything paused?" probe — skips the per-record paused()
+        # sorted-list allocation in the (overwhelmingly common) case where
+        # pause is never used. A non-empty stash forces the full check so
+        # resumed partitions re-inject promptly.
+        has_paused_fn = getattr(self, "has_paused", None)
         idle_limit_ms = getattr(self, "_consumer_timeout_ms", None)
         # kafka-python semantics: the timeout clock measures time spent
         # *waiting for the next record*, not wall time since the last fetch —
@@ -155,9 +167,12 @@ class ConsumerIterMixin:
         wait_start: float | None = None
         while True:
             closed = getattr(self, "_closed", False)
-            paused = (
-                set(paused_fn()) if paused_fn is not None and not closed else ()
-            )
+            if paused_fn is None or closed:
+                paused = ()
+            elif stash or has_paused_fn is None or has_paused_fn():
+                paused = set(paused_fn())
+            else:
+                paused = ()
             if stash:
                 for tp in [tp for tp in stash if tp not in paused]:
                     resumed = stash.pop(tp)

@@ -102,6 +102,7 @@ class KafkaConsumer(ConsumerIterMixin):
             else [topics] if isinstance(topics, str) else list(topics)
         )
         self._closed = False
+        self._any_paused = False  # O(1) hint for ConsumerIterMixin's hot loop
         # Iteration is built on poll() via ConsumerIterMixin, so the
         # iterator-ending timeout and the yielded-position tracking both live
         # here, not in kafka-python's own (unused) iterator.
@@ -230,15 +231,24 @@ class KafkaConsumer(ConsumerIterMixin):
     def pause(self, *tps: TopicPartition) -> None:
         self._check_assigned(tps)
         self._consumer.pause(*(_ktp(tp) for tp in tps))
+        self._any_paused = True
 
     def resume(self, *tps: TopicPartition) -> None:
         self._check_assigned(tps)
         self._consumer.resume(*(_ktp(tp) for tp in tps))
+        # Recompute rather than clear: a partial resume may leave others
+        # paused. Rebalances can also drop paused partitions underneath us,
+        # so the flag is conservative (may say True when nothing is paused —
+        # has_paused callers then pay one full paused() and see the truth).
+        self._any_paused = bool(self._consumer.paused())
 
     def paused(self) -> list[TopicPartition]:
         return sorted(
             TopicPartition(tp.topic, tp.partition) for tp in self._consumer.paused()
         )
+
+    def has_paused(self) -> bool:
+        return self._any_paused
 
     def close(self) -> None:
         if self._closed:

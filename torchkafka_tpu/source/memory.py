@@ -584,6 +584,9 @@ class MemoryConsumer(ConsumerIterMixin):
         self._check_open()
         return sorted(self._paused)
 
+    def has_paused(self) -> bool:
+        return bool(self._paused)
+
     def close(self) -> None:
         """Release assignment. Never commits (the reference's
         close(autocommit=False), /root/reference/src/kafka_dataset.py:89)."""
