@@ -20,9 +20,17 @@ broker and the SAME records:
 Prints ONE JSON line:
   {"metric": "...", "value": N, "unit": "records/sec", "vs_baseline": N}
 
-Env knobs: BENCH_RECORDS (ours, default 1_000_000), BENCH_BASELINE_RECORDS
-(default 150_000), BENCH_BATCH (default 32768), BENCH_SEQ (tokens/record, 32),
-BENCH_TRIALS (default 5), BENCH_COMMIT_EVERY (default 16).
+Trial protocol (VERDICT r2): trials are INTERLEAVED ours/baseline pairs over
+EQUAL record counts, each pair preceded by a wire probe, and ``vs_baseline``
+is the MEDIAN OF PER-PAIR RATIOS — adjacent runs sample the same transport
+conditions, so the ratio stays stable even when absolute throughput swings
+several× across the run (every trial's wire speed is emitted for post-hoc
+normalisation).
+
+Env knobs: BENCH_RECORDS (default 1_000_000 — both sides),
+BENCH_BASELINE_RECORDS (override the baseline side only), BENCH_BATCH
+(default 32768), BENCH_SEQ (tokens/record, 32), BENCH_TRIALS (default 5),
+BENCH_SLICES (alternating slices per trial, 4), BENCH_COMMIT_EVERY (16).
 """
 
 from __future__ import annotations
@@ -35,8 +43,10 @@ import time
 import numpy as np
 
 SEQ = int(os.environ.get("BENCH_SEQ", "32"))
+# Equal records per side by default: asymmetric trial lengths sample a
+# drifting wire differently even when interleaved (the r2 spread problem).
 N_OURS = int(os.environ.get("BENCH_RECORDS", "1000000"))
-N_BASE = int(os.environ.get("BENCH_BASELINE_RECORDS", "150000"))
+N_BASE = int(os.environ.get("BENCH_BASELINE_RECORDS", str(N_OURS)))
 # Batch 32768 = ~2 MB uint16 wire transfers: host→device dispatch is
 # latency-dominated on tunneled transports (~45 ms for 0.5 MB, ~80 ms for
 # 2 MB), so larger batches quadruple rows-per-roundtrip for ~2x the cost.
@@ -101,17 +111,36 @@ def _device_step():
     return step
 
 
+_BROKERS: dict = {}
+# Unique consumer-group id per bench invocation: groups carry committed
+# offsets on the shared broker, so a retried trial reusing a group would
+# resume mid-stream instead of replaying from 0.
+_GROUP_SEQ = iter(range(10**9))
+
+
+def _shared_broker(side: str, n_records: int):
+    """Fill each side's broker ONCE and re-read it with a fresh consumer
+    group per trial: refilling 600k records per trial put ~30s between the
+    two sides of an interleaved pair, long enough for the shared box's wire
+    to drift and reopen the ratio spread the pairing exists to close."""
+    import torchkafka_tpu as tk
+
+    if side not in _BROKERS:
+        _BROKERS[side] = fill_broker(tk, n_records)
+    return _BROKERS[side]
+
+
 def bench_ours(n_records: int) -> float:
     import jax
     import jax.numpy as jnp
 
     import torchkafka_tpu as tk
 
-    broker, total = fill_broker(tk, n_records)
+    broker, total = _shared_broker("ours", n_records)
     consumer = tk.MemoryConsumer(
         broker,
         "bench",
-        group_id="bench-tpu",
+        group_id=f"bench-tpu-{next(_GROUP_SEQ)}",
         assignment=tk.partitions_for_process("bench", N_PARTS, 0, 1),
     )
 
@@ -134,9 +163,11 @@ def bench_ours(n_records: int) -> float:
         transform_threads=0,
         owns_consumer=True,
     ) as stream:
-        # Warm the compile outside the timed region (strict: scalar fetch —
-        # block_until_ready alone returns early through the tunnel).
-        float(step(jnp.zeros((BATCH, SEQ), jnp.uint16)))
+        # Warm the compile AND the host→device transfer route outside the
+        # timed region (strict: scalar fetch — block_until_ready alone
+        # returns early through the tunnel). jnp.zeros would materialise
+        # on-device and leave the transfer path cold for the first batch.
+        float(step(jnp.asarray(np.zeros((BATCH, SEQ), np.uint16))))
         fut = None
         n_batches = 0
         t0 = time.perf_counter()
@@ -179,7 +210,7 @@ def bench_reference_pattern(n_records: int) -> float:
     import torchkafka_tpu as tk
     from torchkafka_tpu.compat import KafkaDataset, auto_commit
 
-    broker, total = fill_broker(tk, n_records)
+    broker, total = _shared_broker("ref", n_records)
 
     class BenchDataset(KafkaDataset):
         def _process(self, record):
@@ -198,10 +229,11 @@ def bench_reference_pattern(n_records: int) -> float:
                 **kwargs,
             )
 
-    dataset = BenchDataset("bench", group_id="bench-ref")
+    dataset = BenchDataset("bench", group_id=f"bench-ref-{next(_GROUP_SEQ)}")
     loader = DataLoader(dataset, batch_size=BATCH)
     step = _device_step()
-    float(step(jnp.zeros((BATCH, SEQ), jnp.uint16)))  # warm outside timing
+    # Warm compile + transfer route outside timing (symmetric with ours).
+    float(step(jnp.asarray(np.zeros((BATCH, SEQ), np.uint16))))
     rows = 0
     acc = None
     t0 = time.perf_counter()
@@ -263,47 +295,88 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001
         print(f"wire probe failed ({e!r})", file=sys.stderr)
         wire = -1.0
-    # INTERLEAVED trials: the shared box's conditions drift minute-to-minute,
-    # so alternating sides samples the same conditions for both and keeps the
-    # ratio honest; a bounded retry budget covers transient transport drops.
-    budget = [trials + 4]
+    # INTERLEAVED ours/baseline pairs: the shared box's conditions drift
+    # minute-to-minute, so adjacent runs sample (nearly) the same transport
+    # and the PER-PAIR ratio cancels the drift that swamps absolute numbers.
+    # A wire probe before each pair records the conditions it ran under.
+    budget = [2 * trials + 6]
     ours_all: list[float] = []
     base_all: list[float] = []
-    for _ in range(trials):
-        r = _one_trial(lambda: bench_ours(N_OURS), "ours", budget)
-        if r is not None:
-            ours_all.append(r)
-        r = _one_trial(
-            lambda: bench_reference_pattern(N_BASE), "reference-pattern", budget
-        )
-        if r is not None:
-            base_all.append(r)
+    pair_ratios: list[float] = []
+    wires: list[float] = [wire]
+    # Each trial runs SLICES slices per side, alternating O/B/O/B…: the two
+    # sides of a slice pair execute within seconds of each other, so the
+    # per-trial ratio (sum of timed regions per side) samples near-identical
+    # wire conditions even though the wire drifts several× across the run.
+    slices = max(1, int(os.environ.get("BENCH_SLICES", "4")))
+    n_o, n_b = N_OURS // slices, N_BASE // slices
+    for i in range(trials):
+        if i > 0:
+            try:
+                wires.append(probe_wire_mb_s())
+            except Exception:  # noqa: BLE001
+                wires.append(-1.0)
+        o_time = b_time = 0.0
+        o_rows = b_rows = 0
+        for _ in range(slices):
+            r = _one_trial(lambda: bench_ours(n_o), "ours", budget)
+            if r is not None:
+                o_time += n_o / r
+                o_rows += n_o
+            r = _one_trial(
+                lambda: bench_reference_pattern(n_b), "reference-pattern",
+                budget,
+            )
+            if r is not None:
+                b_time += n_b / r
+                b_rows += n_b
+        o = o_rows / o_time if o_time else None
+        b = b_rows / b_time if b_time else None
+        if o is not None:
+            ours_all.append(o)
+        if b is not None:
+            base_all.append(b)
+        if o is not None and b is not None:
+            pair_ratios.append(o / b)
     if not ours_all or not base_all:
         raise RuntimeError("no successful trials on one side")
-    ours_all.sort()
-    base_all.sort()
-    ours = float(np.median(ours_all))
+    if not pair_ratios:
+        raise RuntimeError("no complete ours/baseline pair succeeded")
+    ours_sorted = sorted(ours_all)
     base = float(np.median(base_all))
+    ours = float(np.median(ours_all))
+    ratios = sorted(pair_ratios)
+    # Median over SUCCESSFUL probes only — folding the -1.0 failure
+    # sentinel into the median would fabricate a wire figure.
+    wire_ok = [w for w in wires if w > 0]
+    wire_med = float(np.median(wire_ok)) if wire_ok else -1.0
     print(
         json.dumps(
             {
                 "metric": "sustained_ingest_throughput",
                 "value": round(ours, 1),
                 "unit": "records/sec",
-                "vs_baseline": round(ours / base, 3),
+                # Median of per-interleaved-pair ratios: robust to wire
+                # drift across the run (each pair saw the same conditions).
+                "vs_baseline": round(float(np.median(ratios)), 3),
                 "trials": trials,
-                "spread": [round(ours_all[0], 1), round(ours_all[-1], 1)],
-                "best": round(ours_all[-1], 1),
+                "spread": [round(ours_sorted[0], 1), round(ours_sorted[-1], 1)],
+                "best": round(ours_sorted[-1], 1),
                 "baseline_median": round(base, 1),
-                "wire_mb_s": round(wire, 1),
+                "pair_ratios": [round(r, 3) for r in pair_ratios],
+                "ratio_spread": [round(ratios[0], 3), round(ratios[-1], 3)],
+                "records_per_trial": [N_OURS, N_BASE],
+                "wire_mb_s": round(wire_med, 1),
+                "wire_mb_s_per_pair": [round(w, 1) for w in wires],
             }
         )
     )
     print(
-        f"ours median={ours:,.0f} rec/s (min {ours_all[0]:,.0f}, max "
-        f"{ours_all[-1]:,.0f})  reference-pattern median={base:,.0f} rec/s  "
+        f"ours median={ours:,.0f} rec/s (min {ours_sorted[0]:,.0f}, max "
+        f"{ours_sorted[-1]:,.0f})  reference-pattern median={base:,.0f} rec/s  "
+        f"pair ratios={[f'{r:.2f}' for r in pair_ratios]}  "
         f"records={N_OURS:,}/{N_BASE:,} batch={BATCH} seq={SEQ} "
-        f"device-step=mlp-tower  wire={wire:.1f} MB/s",
+        f"device-step=mlp-tower  wire(median)={wire_med:.1f} MB/s",
         file=sys.stderr,
     )
 

@@ -309,7 +309,10 @@ class StreamCheckpointer:
         step — including damaged/incomplete dirs (excluded from ``steps()``,
         they would otherwise leak their Orbax state payloads forever). A
         damaged dir NEWER than the kept floor survives for forensics until
-        newer complete saves age it out."""
+        newer complete saves age it out. Deleting an aged-out damaged dir is
+        the same retention policy as for healthy ones: had its offsets file
+        been intact, age-based GC would prune the dir at this point anyway,
+        and ``keep`` newer complete checkpoints exist by construction."""
         if not self._keep:
             return
         steps = self.steps()

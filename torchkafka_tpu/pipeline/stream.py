@@ -88,6 +88,13 @@ class KafkaStream:
         for each record dropped by the 'drop' policy — wire it to a DLQ
         producer, a file, or a metrics sink. Exceptions it raises are
         logged and swallowed (a broken DLQ must not take down ingest).
+    barrier: override the commit barrier. Default: a plain CommitBarrier
+        single-process, and a BarrierWatchdog-wrapped one (exit 42 on
+        timeout) on multi-process pods — a dead member must fail the pod
+        closed and restartable, not wedge the collective forever.
+    barrier_timeout_s / on_barrier_timeout: the default pod watchdog's
+        timeout and optional extra callback (ignored when ``barrier`` is
+        passed explicitly).
     """
 
     def __init__(
@@ -106,6 +113,8 @@ class KafkaStream:
         transform_threads: int = 0,
         to_device: bool = True,
         barrier: CommitBarrier | None = None,
+        barrier_timeout_s: float = 300.0,
+        on_barrier_timeout: Any | None = None,
         owns_consumer: bool = False,
         on_processor_error: str = "raise",
         dead_letter: Any | None = None,
@@ -126,7 +135,24 @@ class KafkaStream:
         self._owns_consumer = owns_consumer
         self._on_processor_error = on_processor_error
         self._dead_letter = dead_letter
-        self._barrier = barrier if barrier is not None else CommitBarrier()
+        if barrier is not None:
+            self._barrier = barrier
+        elif jax.process_count() > 1:
+            # Multi-process pods get a watchdog-wrapped barrier BY DEFAULT
+            # (VERDICT r2): a dead pod member otherwise wedges the commit
+            # collective forever. Timing out is fail-closed — nothing was
+            # committed, so exiting (42) and restarting from the last commit
+            # loses no records; Kafka re-delivers the uncommitted tail.
+            from torchkafka_tpu.parallel.multihost import BarrierWatchdog
+
+            self._barrier = BarrierWatchdog(
+                CommitBarrier(),
+                timeout_s=barrier_timeout_s,
+                on_timeout=on_barrier_timeout,
+                exit_on_timeout=True,
+            )
+        else:
+            self._barrier = CommitBarrier()
         self.metrics = StreamMetrics()
         self._ledger = OffsetLedger()
         self._batcher = Batcher(batch_size, self._ledger, pad_policy=pad_policy)
