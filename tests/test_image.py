@@ -1,0 +1,160 @@
+"""PNG encode/decode: roundtrip, native-vs-fallback differential, drops.
+
+The decode path is the scenario-4 host hot loop (VERDICT r2: the image
+scenario must run a REAL decompression, not a reshape); these tests pin its
+correctness against the pure-Python mirror and, when available, a
+third-party decoder.
+"""
+
+import io
+
+import numpy as np
+import pytest
+
+from torchkafka_tpu import native
+from torchkafka_tpu.transform.image import encode_png_rgb, png_images
+
+needs_native = pytest.mark.skipif(
+    not native.available(), reason="native extension unavailable"
+)
+
+
+def _img(h=24, w=16, seed=0):
+    rng = np.random.default_rng(seed)
+    # Gradient + noise: compressible like a photo, not like white noise.
+    base = (np.arange(h)[:, None, None] * 3 + np.arange(w)[None, :, None] * 2)
+    return ((base % 200) + rng.integers(0, 40, (h, w, 3))).astype(np.uint8)
+
+
+def _fallback_decode(values, h, w):
+    saved = native._native
+    try:
+        native._native = None
+        return native.decode_png_rgb(values, h, w)
+    finally:
+        native._native = saved
+
+
+class TestPngRoundtrip:
+    @pytest.mark.parametrize("filters", [0, 1, 2, 3, 4, "cycle"])
+    def test_encode_decode_exact(self, filters):
+        img = _img()
+        payload = encode_png_rgb(img, filters=filters)
+        assert len(payload) < img.nbytes  # actually compressed
+        out, keep = native.decode_png_rgb([payload], 24, 16)
+        assert keep[0] == 1
+        np.testing.assert_array_equal(out[0], img)
+
+    @pytest.mark.parametrize("filters", [0, 1, 2, 3, 4, "cycle"])
+    def test_fallback_matches_native_or_is_exact(self, filters):
+        imgs = [_img(seed=s) for s in range(4)]
+        payloads = [encode_png_rgb(i, filters=filters) for i in imgs]
+        out_f, keep_f = _fallback_decode(payloads, 24, 16)
+        assert keep_f.all()
+        for got, want in zip(out_f, imgs):
+            np.testing.assert_array_equal(got, want)
+        if native.available():
+            out_n, keep_n = native.decode_png_rgb(payloads, 24, 16)
+            np.testing.assert_array_equal(out_n, out_f)
+            np.testing.assert_array_equal(keep_n, keep_f)
+
+    def test_third_party_decoder_agrees(self):
+        """Our encoder must produce PNGs an independent decoder accepts."""
+        PIL = pytest.importorskip("PIL.Image")
+        img = _img()
+        payload = encode_png_rgb(img, filters="cycle")
+        decoded = np.asarray(PIL.open(io.BytesIO(payload)).convert("RGB"))
+        np.testing.assert_array_equal(decoded, img)
+
+    def test_third_party_encoded_png_decodes(self):
+        """And our decoder must accept a PNG WE did not encode."""
+        PIL = pytest.importorskip("PIL.Image")
+        img = _img(h=20, w=20, seed=3)
+        buf = io.BytesIO()
+        PIL.fromarray(img, "RGB").save(buf, format="PNG")
+        out, keep = native.decode_png_rgb([buf.getvalue()], 20, 20)
+        assert keep[0] == 1
+        np.testing.assert_array_equal(out[0], img)
+
+
+class TestPngDrops:
+    def test_garbage_and_mismatch_drop(self):
+        img = _img()
+        good = encode_png_rgb(img)
+        values = [
+            good,
+            b"not a png at all",
+            good[:40],  # truncated
+            encode_png_rgb(_img(h=8, w=8, seed=1)),  # wrong dimensions
+        ]
+        out, keep = native.decode_png_rgb(values, 24, 16)
+        assert list(keep) == [1, 0, 0, 0]
+        np.testing.assert_array_equal(out[0], img)
+        assert not out[1].any() and not out[3].any()
+
+    def test_corrupt_idat_drops(self):
+        img = _img()
+        payload = bytearray(encode_png_rgb(img))
+        # Flip bytes inside the IDAT body: inflate must fail → drop.
+        idat_at = bytes(payload).find(b"IDAT") + 8
+        payload[idat_at : idat_at + 4] = b"\x00\x00\x00\x00"
+        out, keep = native.decode_png_rgb([bytes(payload)], 24, 16)
+        assert keep[0] == 0
+
+    def test_unknown_filter_byte_drops_both_paths(self):
+        """A valid zlib stream whose rows carry filter byte 5 must DROP on
+        both the native and fallback paths (not raise) — accept/reject
+        parity is the differential contract."""
+        import struct
+        import zlib
+
+        h, w = 4, 4
+        raw = b"".join(b"\x05" + bytes(w * 3) for _ in range(h))
+        ihdr = struct.pack(">IIBBBBB", w, h, 8, 2, 0, 0, 0)
+
+        def chunk(t, d):
+            return (
+                struct.pack(">I", len(d)) + t + d
+                + struct.pack(">I", zlib.crc32(t + d) & 0xFFFFFFFF)
+            )
+
+        payload = (
+            b"\x89PNG\r\n\x1a\n" + chunk(b"IHDR", ihdr)
+            + chunk(b"IDAT", zlib.compress(raw)) + chunk(b"IEND", b"")
+        )
+        out_f, keep_f = _fallback_decode([payload], h, w)
+        assert keep_f[0] == 0
+        if native.available():
+            out_n, keep_n = native.decode_png_rgb([payload], h, w)
+            assert keep_n[0] == 0
+
+    def test_fallback_drop_semantics_match(self):
+        values = [b"junk", encode_png_rgb(_img())]
+        out_f, keep_f = _fallback_decode(values, 24, 16)
+        assert list(keep_f) == [0, 1]
+        if native.available():
+            out_n, keep_n = native.decode_png_rgb(values, 24, 16)
+            np.testing.assert_array_equal(keep_n, keep_f)
+            np.testing.assert_array_equal(out_n, out_f)
+
+
+class TestPngProcessor:
+    def test_chunk_processor_streams_and_drops(self, broker):
+        import torchkafka_tpu as tk
+
+        broker.create_topic("imgs", partitions=2)
+        imgs = [_img(seed=s) for s in range(8)]
+        for i, im in enumerate(imgs):
+            broker.produce("imgs", encode_png_rgb(im), partition=i % 2)
+        broker.produce("imgs", b"poison", partition=0)  # must drop, not crash
+        consumer = tk.MemoryConsumer(broker, "imgs", group_id="g")
+        with tk.KafkaStream(
+            consumer, tk.png_images(24, 16), batch_size=4, pad_policy="pad",
+            to_device=False, idle_timeout_ms=500, owns_consumer=True,
+        ) as stream:
+            rows = 0
+            for batch, token in stream:
+                assert batch.data.shape[1:] == (24, 16, 3)
+                rows += batch.valid_count
+                assert token.commit()
+        assert rows == 8  # 8 good images; the poison record dropped

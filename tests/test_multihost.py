@@ -61,9 +61,28 @@ class TestWatchdog:
                 time.sleep(0.25)
 
         wd = BarrierWatchdog(
-            SlowBarrier(), timeout_s=0.05, on_timeout=lambda: fired.append(1)
+            SlowBarrier(), timeout_s=0.05, first_grace_s=0.05,
+            on_timeout=lambda: fired.append(1),
         )
         wd(None)
+        assert wd.timed_out and fired == [1]
+
+    def test_first_barrier_gets_compile_grace(self):
+        """The first barrier call legitimately includes cross-host compile
+        skew — the steady-state timeout must not exit a healthy pod there."""
+        fired = []
+
+        class SlowBarrier(tk.LocalBarrier):
+            def __call__(self, wait_for=None):
+                time.sleep(0.2)
+
+        wd = BarrierWatchdog(
+            SlowBarrier(), timeout_s=0.05, first_grace_s=5.0,
+            on_timeout=lambda: fired.append(1),
+        )
+        wd(None)  # 0.2s > timeout but < grace: must NOT fire
+        assert not wd.timed_out and not fired
+        wd(None)  # steady state: 0.2s > 0.05s timeout → fires
         assert wd.timed_out and fired == [1]
 
     def test_barrier_error_propagates_and_timer_cancelled(self):

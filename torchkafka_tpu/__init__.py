@@ -43,9 +43,11 @@ from torchkafka_tpu.transform import (
     chunk_of,
     chunked,
     compose,
+    encode_png_rgb,
     fixed_width,
     json_field,
     json_tokens,
+    png_images,
     raw_bytes,
 )
 
@@ -76,12 +78,14 @@ __all__ = [
     "chunk_of",
     "chunked",
     "compose",
+    "encode_png_rgb",
     "fixed_width",
     "global_batch",
     "json_field",
     "json_tokens",
     "make_mesh",
     "partitions_for_process",
+    "png_images",
     "raw_bytes",
     "stream",
 ]

@@ -12,6 +12,11 @@ Public surface:
 - ``json_tokens_scan(values, field, seq_len, pad_id)`` — list[bytes] →
   (int32 [n, seq_len], keep uint8 [n]); minimal flat-JSON string-field scan,
   utf-8-byte tokenization (raw bytes — escape sequences are not decoded).
+- ``decode_png_rgb(values, height, width)`` — list[bytes] of 8-bit RGB PNGs
+  → (uint8 [n, h, w, 3], keep uint8 [n]); real zlib inflate + all five
+  scanline filters; keep=0 (zeroed row) for anything structurally invalid
+  or with mismatched dimensions. Chunk CRCs are not verified (Kafka already
+  checksums the payload; corruption fails structurally → drop).
 """
 
 from __future__ import annotations
@@ -36,7 +41,7 @@ def _build() -> bool:
     include = sysconfig.get_paths()["include"]
     cmd = [
         "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-        f"-I{include}", _SRC, "-o", _SO + ".tmp",
+        f"-I{include}", _SRC, "-o", _SO + ".tmp", "-lz",
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
@@ -163,3 +168,121 @@ def json_tokens_scan(
         tokens[i, : row.shape[0]] = row
         tokens[i, row.shape[0] :] = pad_id
     return tokens, keep
+
+
+# ---------------------------------------------------------------- png decode
+
+
+def _py_defilter_row(filt: int, cur, out, prior, stride: int):
+    """Reverse one PNG scanline filter (bpp=3). ``cur`` is the filtered
+    bytes (int32 work dtype), ``out`` the row being produced (uint8),
+    ``prior`` the previous defiltered row or None."""
+    if filt == 0:
+        out[:] = cur
+    elif filt == 1:  # Sub — per-channel cumulative sum is exactly +left mod 256
+        px = cur.reshape(-1, 3)
+        out[:] = (np.cumsum(px, axis=0, dtype=np.int64) % 256).astype(
+            np.uint8
+        ).reshape(-1)
+    elif filt == 2:  # Up
+        out[:] = cur if prior is None else (cur + prior) % 256
+    elif filt == 3:  # Average — sequential in x (left depends on output)
+        up = np.zeros(stride, np.int32) if prior is None else prior.astype(np.int32)
+        px, upx = cur.reshape(-1, 3), up.reshape(-1, 3)
+        o = out.reshape(-1, 3)
+        left = np.zeros(3, np.int32)
+        for x in range(px.shape[0]):
+            left = (px[x] + ((left + upx[x]) >> 1)) % 256
+            o[x] = left
+    elif filt == 4:  # Paeth — sequential in x
+        up = np.zeros(stride, np.int32) if prior is None else prior.astype(np.int32)
+        px, upx = cur.reshape(-1, 3), up.reshape(-1, 3)
+        o = out.reshape(-1, 3)
+        left = np.zeros(3, np.int32)
+        ul = np.zeros(3, np.int32)
+        for x in range(px.shape[0]):
+            p = left + upx[x] - ul
+            pa, pb, pc = np.abs(p - left), np.abs(p - upx[x]), np.abs(p - ul)
+            pred = np.where(
+                (pa <= pb) & (pa <= pc), left, np.where(pb <= pc, upx[x], ul)
+            )
+            left = (px[x] + pred) % 256
+            o[x] = left
+            ul = upx[x]
+    else:
+        raise ValueError(f"unknown PNG filter {filt}")
+
+
+def _py_decode_one_png(buf: bytes, h: int, w: int) -> np.ndarray | None:
+    """Python mirror of the C++ decoder (same accept/reject semantics)."""
+    import struct
+    import zlib
+
+    if len(buf) < 33 or buf[:8] != b"\x89PNG\r\n\x1a\n":
+        return None
+    pos = 8
+    idat = bytearray()
+    saw_ihdr = False
+    while pos + 8 <= len(buf):
+        (clen,) = struct.unpack_from(">I", buf, pos)
+        ctype = buf[pos + 4 : pos + 8]
+        data = buf[pos + 8 : pos + 8 + clen]
+        if pos + 8 + clen + 4 > len(buf):
+            return None
+        if ctype == b"IHDR":
+            if clen != 13:
+                return None
+            pw, ph = struct.unpack_from(">II", data, 0)
+            if (pw, ph) != (w, h) or data[8:13] != b"\x08\x02\x00\x00\x00":
+                return None
+            saw_ihdr = True
+        elif ctype == b"IDAT":
+            idat += data
+        elif ctype == b"IEND":
+            break
+        pos += 8 + clen + 4
+    if not saw_ihdr or not idat:
+        return None
+    stride = w * 3
+    try:
+        raw = zlib.decompress(bytes(idat))
+    except zlib.error:
+        return None
+    if len(raw) != h * (1 + stride):
+        return None
+    rows = np.frombuffer(raw, np.uint8).reshape(h, 1 + stride)
+    out = np.empty((h, stride), np.uint8)
+    prior = None
+    for y in range(h):
+        if rows[y, 0] > 4:
+            return None  # unknown filter byte — drop, same as the C++ path
+        _py_defilter_row(
+            int(rows[y, 0]), rows[y, 1:].astype(np.int32), out[y], prior, stride
+        )
+        prior = out[y]
+    return out.reshape(h, w, 3)
+
+
+def decode_png_rgb(
+    values: list[bytes], height: int, width: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """list of 8-bit RGB PNG payloads → (uint8 [n, h, w, 3], keep uint8 [n]).
+    Invalid/mismatched records decode to zeros with keep=0 (the vectorized
+    None-drop contract). One C call for the whole chunk when native."""
+    n = len(values)
+    out = np.empty((n, height, width, 3), dtype=np.uint8)
+    keep = np.empty((n,), dtype=np.uint8)
+    if n == 0:
+        return out, keep
+    if _native is not None:
+        _native.decode_png_rgb(values, out, keep, height, width)
+        return out, keep
+    for i, v in enumerate(values):
+        img = _py_decode_one_png(v, height, width)
+        if img is None:
+            keep[i] = 0
+            out[i] = 0
+        else:
+            keep[i] = 1
+            out[i] = img
+    return out, keep

@@ -105,12 +105,24 @@ class BarrierWatchdog:
         barrier: CommitBarrier | None = None,
         *,
         timeout_s: float = 300.0,
+        first_grace_s: float | None = None,
         on_timeout: Callable[[], None] | None = None,
         exit_on_timeout: bool = False,
         exit_code: int = 42,
     ) -> None:
         self._barrier = barrier if barrier is not None else CommitBarrier()
         self._timeout_s = timeout_s
+        # The FIRST barrier call legitimately includes cross-host XLA
+        # compile skew (one host may compile for many minutes while its
+        # peers wait at the barrier) — a steady-state timeout there would
+        # exit-42 a healthy pod into a compile crash-loop. Default grace:
+        # 6x the timeout, floor 1800 s.
+        self._first_grace_s = (
+            first_grace_s
+            if first_grace_s is not None
+            else max(6 * timeout_s, 1800.0)
+        )
+        self._first_done = False
         self._exit = exit_on_timeout
         self._exit_code = exit_code
         self._on_timeout = on_timeout
@@ -129,10 +141,12 @@ class BarrierWatchdog:
             os._exit(self._exit_code)
 
     def __call__(self, wait_for: Any = None) -> None:
-        timer = threading.Timer(self._timeout_s, self._fire)
+        timeout = self._timeout_s if self._first_done else self._first_grace_s
+        timer = threading.Timer(timeout, self._fire)
         timer.daemon = True
         timer.start()
         try:
             self._barrier(wait_for)
+            self._first_done = True
         finally:
             timer.cancel()

@@ -1,6 +1,7 @@
 """Transform layer: per-record / per-chunk processors and fixed-shape batching."""
 
 from torchkafka_tpu.transform.batcher import Batch, Batcher
+from torchkafka_tpu.transform.image import encode_png_rgb, png_images
 from torchkafka_tpu.transform.processor import (
     Processor,
     chunk_of,
@@ -20,9 +21,11 @@ __all__ = [
     "chunk_of",
     "chunked",
     "compose",
+    "encode_png_rgb",
     "fixed_width",
     "is_chunked",
     "json_field",
     "json_tokens",
+    "png_images",
     "raw_bytes",
 ]
