@@ -1,0 +1,225 @@
+"""Pod commit-barrier cost curve: 1/2/4/8 localhost processes.
+
+Measures what the north-star extrapolation ("per-host ingest × hosts, the
+barrier amortises", PERF.md) actually costs: steady-state ingest throughput
+per process and per-commit barrier latency as the pod grows, on real
+``jax.distributed`` processes (localhost coordinator, CPU backend — the
+same coordination path a TPU pod takes over DCN, minus the wire).
+
+Every process streams its own partitions of a deterministic broker, runs a
+jitted global-mean step (a real cross-host psum) per batch, and commits
+EVERY batch through the pod barrier (worst-case cadence — production
+commits every N batches, so per-commit cost amortises further).
+
+Usage: python benchmarks/bench_pod.py [--procs 1,2,4,8] [--batches 40]
+Prints one markdown table row per pod size, plus a JSON line per size.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+BATCH = 256
+SEQ = 16
+N_PARTS = 8
+TOPIC = "podbench"
+
+
+def build_broker(tk, n_records: int):
+    """Deterministic content: every process builds identical topic state."""
+    import numpy as np
+
+    broker = tk.InMemoryBroker()
+    broker.create_topic(TOPIC, partitions=N_PARTS)
+    rng = np.random.default_rng(0)
+    payload = rng.integers(0, 1000, size=(64, SEQ), dtype=np.int32)
+    broker.produce_many(
+        TOPIC, (payload[i % 64].tobytes() for i in range(n_records))
+    )
+    return broker
+
+
+def worker(
+    pid: int, nproc: int, port: int, outdir: str, n_batches: int,
+    commit_every: int,
+) -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+    if nproc > 1:
+        jax.distributed.initialize(
+            coordinator_address=f"localhost:{port}",
+            num_processes=nproc,
+            process_id=pid,
+        )
+    import jax.numpy as jnp
+    import numpy as np
+
+    import torchkafka_tpu as tk
+
+    # Each process consumes a disjoint stride of partitions (8/nproc of
+    # them); records are spread round-robin, so sizing the topic at
+    # n_batches × BATCH × nproc gives every process exactly n_batches
+    # full batches.
+    n_records = n_batches * BATCH * nproc
+    broker = build_broker(tk, n_records)
+    consumer = tk.MemoryConsumer(
+        broker,
+        TOPIC,
+        group_id="podbench",
+        assignment=tk.partitions_for_process(TOPIC, N_PARTS, pid, nproc),
+    )
+    mesh = tk.make_mesh({"data": 2 * nproc})
+
+    @jax.jit
+    def step(x):
+        return jnp.mean(x)  # global mean: a true cross-host reduction
+
+    commit_s: list[float] = []
+    batch_times: list[float] = []
+    n = 0
+    with tk.KafkaStream(
+        consumer,
+        tk.fixed_width(SEQ, np.int32),
+        batch_size=BATCH,
+        mesh=mesh,
+        idle_timeout_ms=3000,
+        owns_consumer=True,
+    ) as stream:
+        t_prev = None
+        for batch, token in stream:
+            loss = step(batch.data)
+            n += 1
+            # Commit cadence: every batch is the worst case (barrier per
+            # batch); production commits every k batches and a later
+            # token's offsets subsume the earlier uncommitted ones.
+            if n % commit_every == 0 or n >= n_batches:
+                t0 = time.perf_counter()
+                ok = token.commit(wait_for=loss)
+                t1 = time.perf_counter()
+                assert ok, f"commit failed at batch {n}"
+                # Steady state only: skip compile/pipeline fill AND the
+                # final flush commit (it waits out the whole remaining
+                # device queue, which is drain cost, not barrier cost).
+                if n > 2 and n % commit_every == 0 and n < n_batches:
+                    commit_s.append(t1 - t0)
+            else:
+                t1 = time.perf_counter()
+            if n > 2 and t_prev is not None:
+                batch_times.append(t1 - t_prev)
+            t_prev = t1
+            if n >= n_batches:
+                break
+
+    import numpy as np
+
+    cs = np.asarray(commit_s)
+    bt = np.asarray(batch_times)
+    out = {
+        "pid": pid,
+        "nproc": nproc,
+        "commit_every": commit_every,
+        "batches": n,
+        "rows_per_s": BATCH / float(bt.mean()) if bt.size else 0.0,
+        "commit_p50_ms": float(np.percentile(cs, 50) * 1e3),
+        "commit_p99_ms": float(np.percentile(cs, 99) * 1e3),
+        "commit_mean_ms": float(cs.mean() * 1e3),
+        "stream_metrics": stream.metrics.summary(),
+    }
+    with open(os.path.join(outdir, f"pod_{nproc}_{pid}.json"), "w") as f:
+        json.dump(out, f)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def run_pod(nproc: int, n_batches: int, outdir: str, commit_every: int) -> dict:
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    procs = []
+    for pid in range(nproc):
+        log = open(os.path.join(outdir, f"pod_{nproc}_{pid}.log"), "wb")
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable, os.path.abspath(__file__), "--worker",
+                    str(pid), str(nproc), str(port), outdir,
+                    "--batches", str(n_batches),
+                    "--commit-every", str(commit_every),
+                ],
+                env=env, stdout=log, stderr=subprocess.STDOUT,
+            )
+        )
+    deadline = time.time() + 600
+    for p in procs:
+        p.wait(timeout=max(1, deadline - time.time()))
+    assert all(p.returncode == 0 for p in procs), (
+        f"pod {nproc}: exit codes {[p.returncode for p in procs]} "
+        f"(see {outdir}/pod_{nproc}_*.log)"
+    )
+    import numpy as np
+
+    per = []
+    for pid in range(nproc):
+        with open(os.path.join(outdir, f"pod_{nproc}_{pid}.json")) as f:
+            per.append(json.load(f))
+    return {
+        "nproc": nproc,
+        "commit_every": commit_every,
+        "rows_per_s_per_proc": float(np.mean([p["rows_per_s"] for p in per])),
+        "rows_per_s_total": float(np.sum([p["rows_per_s"] for p in per])),
+        "commit_p50_ms": float(np.median([p["commit_p50_ms"] for p in per])),
+        "commit_p99_ms": float(np.max([p["commit_p99_ms"] for p in per])),
+        "commit_mean_ms": float(np.mean([p["commit_mean_ms"] for p in per])),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--worker", nargs=4, metavar=("PID", "NPROC", "PORT", "OUT"))
+    ap.add_argument("--procs", default="1,2,4,8")
+    ap.add_argument("--batches", type=int, default=40)
+    ap.add_argument("--commit-every", type=int, default=1)
+    ap.add_argument("--cadences", default="1,16")
+    args = ap.parse_args()
+    if args.worker:
+        pid, nproc, port, outdir = args.worker
+        worker(
+            int(pid), int(nproc), int(port), outdir, args.batches,
+            args.commit_every,
+        )
+        return
+
+    import tempfile
+
+    outdir = tempfile.mkdtemp(prefix="tk-pod-bench-")
+    print(f"logs/results in {outdir}", file=sys.stderr)
+    print("| procs | commit cadence | rows/s/proc | rows/s total | commit mean | p50 | p99 |")
+    print("|---|---|---|---|---|---|---|")
+    for nproc in (int(x) for x in args.procs.split(",")):
+        for cadence in (int(x) for x in args.cadences.split(",")):
+            r = run_pod(nproc, args.batches, outdir, cadence)
+            print(
+                f"| {r['nproc']} | every {r['commit_every']} | "
+                f"{r['rows_per_s_per_proc']:,.0f} | "
+                f"{r['rows_per_s_total']:,.0f} | {r['commit_mean_ms']:.2f} ms | "
+                f"{r['commit_p50_ms']:.2f} ms | {r['commit_p99_ms']:.2f} ms |"
+            )
+            print(json.dumps(r), file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -55,10 +55,58 @@ class FakeCommitFailedError(Exception):
     pass
 
 
+class FakeIllegalStateError(Exception):
+    pass
+
+
+class FakeKafkaConfigurationError(Exception):
+    pass
+
+
+class FakeConsumerRebalanceListener:
+    """kafka-python's abstract listener base; subscribe() type-checks
+    against it, so the stub's isinstance check mirrors the real library."""
+
+
+# Representative subset of kafka-python 2.0.2's KafkaConsumer.DEFAULT_CONFIG
+# keys: the real constructor raises KafkaConfigurationError on anything it
+# does not recognise, so the stub must too — otherwise the adapter could
+# leak a framework-only kwarg through and only fail against the real
+# library (VERDICT r2: the stub is the contract witness).
+KNOWN_CONFIGS = {
+    "bootstrap_servers", "client_id", "group_id", "key_deserializer",
+    "value_deserializer", "fetch_max_wait_ms", "fetch_min_bytes",
+    "fetch_max_bytes", "max_partition_fetch_bytes", "request_timeout_ms",
+    "retry_backoff_ms", "reconnect_backoff_ms", "reconnect_backoff_max_ms",
+    "max_in_flight_requests_per_connection", "auto_offset_reset",
+    "enable_auto_commit", "auto_commit_interval_ms", "default_offset_commit_callback",
+    "check_crcs", "metadata_max_age_ms", "partition_assignment_strategy",
+    "max_poll_records", "max_poll_interval_ms", "session_timeout_ms",
+    "heartbeat_interval_ms", "receive_buffer_bytes", "send_buffer_bytes",
+    "socket_options", "consumer_timeout_ms", "security_protocol",
+    "ssl_context", "ssl_check_hostname", "ssl_cafile", "ssl_certfile",
+    "ssl_keyfile", "ssl_password", "api_version", "api_version_auto_timeout_ms",
+    "connections_max_idle_ms", "metric_reporters", "metrics_num_samples",
+    "metrics_sample_window_ms", "selector", "exclude_internal_topics",
+    "sasl_mechanism", "sasl_plain_username", "sasl_plain_password",
+}
+
+
 class FakeKafkaConsumer:
-    """Records every call the adapter makes; scripted poll results."""
+    """Records every call the adapter makes; scripted poll results.
+
+    Also ENFORCES kafka-python 2.0.2's behavioral contract at the adapter
+    boundary: unknown config kwargs, listener type-checks, the
+    assign/subscribe mutual exclusion, and commit-requires-group_id — so a
+    contract violation fails here instead of only against the real library.
+    """
 
     def __init__(self, *topics, **kwargs):
+        unknown = set(kwargs) - KNOWN_CONFIGS
+        if unknown:
+            raise FakeKafkaConfigurationError(
+                f"Unrecognized configs: {sorted(unknown)}"
+            )
         self.init_topics = topics
         self.init_kwargs = kwargs
         self.assign_calls: list = []
@@ -69,8 +117,20 @@ class FakeKafkaConsumer:
         self.fail_next_commit = False
         self._committed = {}
         self._positions = {}
+        self._subscribed = bool(topics)
 
     def subscribe(self, topics=(), pattern=None, listener=None):
+        if self.assign_calls:
+            raise FakeIllegalStateError(
+                "Subscription to topics, partitions and pattern are mutually exclusive"
+            )
+        if topics and pattern:
+            raise FakeIllegalStateError("only one of topics or pattern allowed")
+        if listener is not None and not isinstance(
+            listener, FakeConsumerRebalanceListener
+        ):
+            raise TypeError("listener must be a ConsumerRebalanceListener")
+        self._subscribed = True
         self.subscribe_calls = getattr(self, "subscribe_calls", [])
         call = {"pattern": pattern} if pattern else {"topics": list(topics)}
         if listener is not None:
@@ -78,12 +138,20 @@ class FakeKafkaConsumer:
         self.subscribe_calls.append(call)
 
     def assign(self, tps):
+        if self._subscribed:
+            raise FakeIllegalStateError(
+                "Subscription to topics, partitions and pattern are mutually exclusive"
+            )
         self.assign_calls.append(list(tps))
 
     def poll(self, timeout_ms=0, max_records=None):
         return self.poll_queue.pop(0) if self.poll_queue else {}
 
     def commit(self, offsets=None):
+        # kafka-python asserts a configured group before committing.
+        assert self.init_kwargs.get("group_id") is not None, (
+            "Requires group_id"
+        )
         if self.fail_next_commit:
             self.fail_next_commit = False
             raise FakeCommitFailedError("group rebalanced")
@@ -128,9 +196,11 @@ def _install_stub(oam_cls):
     kafka_mod.KafkaConsumer = FakeKafkaConsumer
     kafka_mod.TopicPartition = FakeTopicPartition
     kafka_mod.OffsetAndMetadata = oam_cls
-    kafka_mod.ConsumerRebalanceListener = object
+    kafka_mod.ConsumerRebalanceListener = FakeConsumerRebalanceListener
     errors_mod = types.ModuleType("kafka.errors")
     errors_mod.CommitFailedError = FakeCommitFailedError
+    errors_mod.IllegalStateError = FakeIllegalStateError
+    errors_mod.KafkaConfigurationError = FakeKafkaConfigurationError
     kafka_mod.errors = errors_mod
     sys.modules["kafka"] = kafka_mod
     sys.modules["kafka.errors"] = errors_mod
@@ -170,14 +240,14 @@ class TestConstruction:
         assert c._consumer.init_kwargs["group_id"] == "g"
 
     def test_subscribe_mode_passes_topics_positionally(self, adapter):
-        c = adapter.KafkaConsumer(["a", "b"], bootstrap_servers=["x:9092"])
+        c = adapter.KafkaConsumer(["a", "b"], bootstrap_servers=["x:9092"], group_id="g")
         assert c._consumer.init_topics == ("a", "b")
         assert c._consumer.assign_calls == []
         assert c._consumer.init_kwargs["bootstrap_servers"] == ["x:9092"]
 
     def test_manual_assignment_mode(self, adapter):
         tps = [TopicPartition("t", 0), TopicPartition("t", 2)]
-        c = adapter.KafkaConsumer("t", assignment=tps)
+        c = adapter.KafkaConsumer("t", assignment=tps, group_id="g")
         assert c._consumer.init_topics == ()  # no subscribe
         assert c._consumer.assign_calls == [
             [FakeTopicPartition("t", 0), FakeTopicPartition("t", 2)]
@@ -186,15 +256,43 @@ class TestConstruction:
             c.assignment()
         ) == {TopicPartition("t", 0), TopicPartition("t", 2)}
 
+    def test_group_id_required(self, adapter):
+        """Parity with MemoryConsumer, and a clear error instead of
+        kafka-python's bare `assert group_id` at the first commit."""
+        with pytest.raises(ValueError, match="group_id"):
+            adapter.KafkaConsumer("t")
+
+    def test_unknown_config_surfaces_from_library(self, adapter):
+        """kwargs passthrough means kafka-python's own unknown-config
+        rejection reaches the caller verbatim (the stub enforces the real
+        constructor's KafkaConfigurationError behavior)."""
+        with pytest.raises(Exception, match="Unrecognized configs"):
+            adapter.KafkaConsumer("t", group_id="g", not_a_real_config=1)
+
+    def test_stub_enforces_listener_type(self, adapter):
+        """Meta-test: the stub really rejects non-ConsumerRebalanceListener
+        listeners like kafka-python 2.0.2 does — so the adapter's wrapper
+        subclassing (exercised by TestRebalanceListenerTranslation) is
+        load-bearing, not decorative."""
+        raw = FakeKafkaConsumer(group_id="g")
+        with pytest.raises(TypeError, match="ConsumerRebalanceListener"):
+            raw.subscribe(topics=["t"], listener=object())
+
+    def test_stub_enforces_assign_subscribe_exclusion(self, adapter):
+        raw = FakeKafkaConsumer(group_id="g")
+        raw.assign([FakeTopicPartition("t", 0)])
+        with pytest.raises(FakeIllegalStateError):
+            raw.subscribe(topics=["t"])
+
     def test_consumer_timeout_ms_not_forwarded(self, adapter):
-        c = adapter.KafkaConsumer("t", consumer_timeout_ms=500)
+        c = adapter.KafkaConsumer("t", consumer_timeout_ms=500, group_id="g")
         assert "consumer_timeout_ms" not in c._consumer.init_kwargs
         assert c._consumer_timeout_ms == 500
 
 
 class TestCommitTranslation:
     def test_offset_map_to_offset_and_metadata_3arg(self, adapter):
-        c = adapter.KafkaConsumer("t")
+        c = adapter.KafkaConsumer("t", group_id="g")
         c.commit({TopicPartition("t", 0): 5, TopicPartition("t", 1): 9})
         (call,) = c._consumer.commit_calls
         assert call == {
@@ -203,18 +301,18 @@ class TestCommitTranslation:
         }
 
     def test_offset_map_to_offset_and_metadata_2arg(self, adapter_old_oam):
-        c = adapter_old_oam.KafkaConsumer("t")
+        c = adapter_old_oam.KafkaConsumer("t", group_id="g")
         c.commit({TopicPartition("t", 0): 7})
         (call,) = c._consumer.commit_calls
         assert call == {FakeTopicPartition("t", 0): OffsetAndMetadata2(7, None)}
 
     def test_commit_none_with_nothing_yielded_commits_positions(self, adapter):
-        c = adapter.KafkaConsumer("t")
+        c = adapter.KafkaConsumer("t", group_id="g")
         c.commit(None)
         assert c._consumer.commit_calls == [None]
 
     def test_commit_failed_error_translated(self, adapter):
-        c = adapter.KafkaConsumer("t")
+        c = adapter.KafkaConsumer("t", group_id="g")
         c._consumer.fail_next_commit = True
         with pytest.raises(errors.CommitFailedError, match="rebalanced"):
             c.commit({TopicPartition("t", 0): 1})
@@ -225,7 +323,7 @@ class TestCommitTranslation:
 
 class TestPollTranslation:
     def test_poll_flattens_and_maps_fields(self, adapter):
-        c = adapter.KafkaConsumer("t")
+        c = adapter.KafkaConsumer("t", group_id="g")
         c._consumer.poll_queue = [
             {
                 FakeTopicPartition("t", 0): [fake_record("t", 0, 3, b"a")],
@@ -244,7 +342,7 @@ class TestPollTranslation:
         assert all(r.timestamp_ms == 1234 and r.headers == () for r in records)
 
     def test_committed_position_seek_translate_tp(self, adapter):
-        c = adapter.KafkaConsumer("t")
+        c = adapter.KafkaConsumer("t", group_id="g")
         c._consumer._committed[FakeTopicPartition("t", 0)] = 11
         c._consumer._positions[FakeTopicPartition("t", 0)] = 13
         assert c.committed(TopicPartition("t", 0)) == 11
@@ -257,7 +355,7 @@ class TestIteratorMode:
     def test_iter_commit_covers_exactly_yielded(self, adapter):
         """commit(None) after partial iteration must cover what the USER saw,
         not kafka-python's position (which advanced past the whole fetch)."""
-        c = adapter.KafkaConsumer("t", consumer_timeout_ms=200)
+        c = adapter.KafkaConsumer("t", consumer_timeout_ms=200, group_id="g")
         c._consumer.poll_queue = [
             {
                 FakeTopicPartition("t", 0): [
@@ -275,13 +373,13 @@ class TestIteratorMode:
         assert call == {FakeTopicPartition("t", 0): OffsetAndMetadata3(2, None, -1)}
 
     def test_iter_ends_after_consumer_timeout(self, adapter):
-        c = adapter.KafkaConsumer("t", consumer_timeout_ms=50)
+        c = adapter.KafkaConsumer("t", consumer_timeout_ms=50, group_id="g")
         assert list(c) == []
 
 
 class TestClose:
     def test_close_never_autocommits_and_is_idempotent(self, adapter):
-        c = adapter.KafkaConsumer("t")
+        c = adapter.KafkaConsumer("t", group_id="g")
         c.close()
         c.close()
         assert c._consumer.close_calls == [False]
@@ -327,7 +425,7 @@ class TestPatternSubscription:
 
     def test_pattern_exclusive_with_topics(self, adapter):
         with pytest.raises(ValueError, match="exclusive"):
-            adapter.KafkaConsumer("t", pattern="t.*")
+            adapter.KafkaConsumer("t", pattern="t.*", group_id="g")
 
 
 class TestRebalanceListenerTranslation:
@@ -361,4 +459,5 @@ class TestRebalanceListenerTranslation:
             adapter.KafkaConsumer(
                 assignment=[TopicPartition("t", 0)],
                 rebalance_listener=object(),
+                group_id="g",
             )

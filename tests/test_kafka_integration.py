@@ -1,0 +1,168 @@
+"""Integration tier: the kafka adapter against REAL kafka-python + broker.
+
+Two gates, each lighting up as the environment provides more:
+
+1. ``kafka-python importable`` → brokerless surface checks against the
+   genuine classes (OffsetAndMetadata arity, TopicPartition compat,
+   ConsumerRebalanceListener isinstance, errors module shape). These run
+   anywhere the dependency exists — no broker needed.
+2. ``KAFKA_BOOTSTRAP`` env set (e.g. ``localhost:9092``) → full
+   stream→step→commit→kill→resume loop against a live broker, matching the
+   stack the reference was validated on (/root/reference/README.md:9).
+
+Neither gate is satisfiable in the build environment (no pip, no egress,
+no broker) — the suite exists so the contract LIGHTS UP on a machine with
+the dependency instead of the hand-written stub being the only witness to
+source/kafka.py (VERDICT r2 item 2).
+
+Run: KAFKA_BOOTSTRAP=localhost:9092 python -m pytest -m integration tests/
+"""
+
+from __future__ import annotations
+
+import os
+import uuid
+
+import numpy as np
+import pytest
+
+kafka = pytest.importorskip("kafka", reason="kafka-python not installed")
+
+from torchkafka_tpu.source.kafka import (  # noqa: E402
+    HAVE_KAFKA_PYTHON,
+    KafkaConsumer,
+    _offset_and_metadata,
+    _wrap_listener,
+)
+from torchkafka_tpu.source.records import TopicPartition  # noqa: E402
+
+BOOTSTRAP = os.environ.get("KAFKA_BOOTSTRAP")
+needs_broker = pytest.mark.skipif(
+    not BOOTSTRAP, reason="KAFKA_BOOTSTRAP not set (no live broker)"
+)
+
+
+class TestRealLibrarySurface:
+    """Brokerless: the genuine kafka-python class surface, not the stub."""
+
+    def test_gate_consistent(self):
+        assert HAVE_KAFKA_PYTHON
+
+    def test_offset_and_metadata_arity_probe(self):
+        oam = _offset_and_metadata(41)
+        assert oam.offset == 41
+        assert isinstance(oam, kafka.OffsetAndMetadata)
+
+    def test_topic_partition_fields(self):
+        ktp = kafka.TopicPartition("t", 3)
+        assert (ktp.topic, ktp.partition) == ("t", 3)
+
+    def test_wrapped_listener_passes_real_type_check(self):
+        class L:
+            def on_partitions_revoked(self, revoked): ...
+            def on_partitions_assigned(self, assigned): ...
+
+        wrapper = _wrap_listener(L())
+        assert isinstance(wrapper, kafka.ConsumerRebalanceListener)
+
+    def test_errors_module_shape(self):
+        import kafka.errors
+
+        assert issubclass(kafka.errors.CommitFailedError, Exception)
+
+
+@pytest.mark.integration
+@needs_broker
+class TestLiveBroker:
+    """Full transactional-ingest loop against a real broker."""
+
+    @pytest.fixture
+    def topic(self):
+        from kafka.admin import KafkaAdminClient, NewTopic
+
+        name = f"tk-int-{uuid.uuid4().hex[:8]}"
+        admin = KafkaAdminClient(bootstrap_servers=BOOTSTRAP)
+        admin.create_topics([NewTopic(name, num_partitions=4, replication_factor=1)])
+        yield name
+        admin.delete_topics([name])
+        admin.close()
+
+    def _produce(self, topic: str, n: int, seq: int = 16):
+        from kafka import KafkaProducer
+
+        rng = np.random.default_rng(0)
+        prod = KafkaProducer(bootstrap_servers=BOOTSTRAP)
+        for i in range(n):
+            prod.send(
+                topic,
+                rng.integers(0, 1000, seq, dtype=np.int32).tobytes(),
+                partition=i % 4,
+            )
+        prod.flush()
+        prod.close()
+
+    def test_stream_step_commit_kill_resume(self, topic):
+        """The at-least-once contract on real Kafka: consume half, commit,
+        drop the consumer uncommitted, re-open the group — everything after
+        the last commit re-delivers, nothing before it does."""
+        import jax.numpy as jnp
+
+        import torchkafka_tpu as tk
+
+        seq, batch, n = 16, 32, 128
+        self._produce(topic, n, seq)
+        group = f"g-{uuid.uuid4().hex[:8]}"
+
+        def consume(n_batches: int):
+            consumer = KafkaConsumer(
+                topic, group_id=group, bootstrap_servers=BOOTSTRAP,
+                auto_offset_reset="earliest",
+            )
+            seen = 0
+            with tk.KafkaStream(
+                consumer, tk.fixed_width(seq, np.int32), batch_size=batch,
+                idle_timeout_ms=5000, owns_consumer=True,
+            ) as stream:
+                for i, (b, token) in enumerate(stream):
+                    loss = jnp.sum(b.data)
+                    seen += b.valid_count
+                    if i < n_batches - 1:
+                        assert token.commit(wait_for=loss)
+                    # Last batch: consumed but NOT committed (the "kill").
+                    if i + 1 >= n_batches:
+                        break
+            return seen
+
+        first = consume(2)  # 2 batches read, only 1 committed
+        assert first == 2 * batch
+        # Resume: the uncommitted batch + the untouched tail re-deliver.
+        second = consume(100)
+        assert second == n - batch
+
+    def test_commit_survives_rebalance_error(self, topic):
+        """A second consumer joining the group triggers a rebalance; the
+        stale member's commit raises CommitFailedError, translated to the
+        framework error and survivable (at-least-once, reference
+        src/kafka_dataset.py:131-135)."""
+        self._produce(topic, 64)
+        group = f"g-{uuid.uuid4().hex[:8]}"
+        c1 = KafkaConsumer(
+            topic, group_id=group, bootstrap_servers=BOOTSTRAP,
+            auto_offset_reset="earliest", session_timeout_ms=6000,
+            heartbeat_interval_ms=2000,
+        )
+        records = c1.poll(max_records=8, timeout_ms=10000)
+        assert records
+        c2 = KafkaConsumer(
+            topic, group_id=group, bootstrap_servers=BOOTSTRAP,
+            auto_offset_reset="earliest",
+        )
+        c2.poll(timeout_ms=10000)  # join → rebalance
+        from torchkafka_tpu import errors
+
+        try:
+            c1.commit({r.tp: r.offset + 1 for r in records})
+        except errors.CommitFailedError:
+            pass  # the survivable path
+        c1.close()
+        c2.close()

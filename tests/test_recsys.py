@@ -188,6 +188,49 @@ class TestStreamTraining:
         np.testing.assert_allclose(el["dense"], dense)
         assert float(el["label"]) == 1.0
 
+    def test_chunk_processor_matches_per_record(self, rng):
+        """The chunked decoder (one native call per poll chunk) must be a
+        drop-in for make_processor — same columns, same drop semantics."""
+        from torchkafka_tpu.models.recsys import make_chunk_processor
+
+        per_record = make_processor(CFG)
+        chunkp = make_chunk_processor(CFG)
+        records = []
+        for i in range(6):
+            dense = rng.normal(size=CFG.dense_dim).astype(np.float32)
+            cats = np.asarray(
+                [rng.integers(0, v) for v in CFG.vocab_sizes], np.int32
+            )
+            records.append(
+                tk.Record("t", 0, i, _encode(dense, cats, float(i % 2)))
+            )
+        records.insert(3, tk.Record("t", 0, 99, b"short"))  # must drop
+        out, keep = chunkp(records)
+        expect_keep = [True] * 3 + [False] + [True] * 3
+        assert list(keep) == expect_keep
+        kept = [r for r in records if len(r.value) == record_nbytes(CFG)]
+        for i, rec in enumerate(kept):
+            ref = per_record(rec)
+            np.testing.assert_array_equal(out["cats"][i], ref["cats"])
+            np.testing.assert_allclose(out["dense"][i], ref["dense"])
+            assert out["label"][i] == ref["label"]
+
+    def test_chunk_processor_all_good_and_all_bad(self, rng):
+        from torchkafka_tpu.models.recsys import make_chunk_processor
+
+        chunkp = make_chunk_processor(CFG)
+        good = tk.Record(
+            "t", 0, 0,
+            _encode(
+                rng.normal(size=CFG.dense_dim).astype(np.float32),
+                np.zeros(len(CFG.vocab_sizes), np.int32), 0.0,
+            ),
+        )
+        out, keep = chunkp([good, good])
+        assert keep is None and out["dense"].shape[0] == 2
+        out, keep = chunkp([tk.Record("t", 0, 1, b"x")])
+        assert out is None and list(keep) == [False]
+
 
 class TestQuantized:
     def test_quantized_forward_tracks_f32(self, rng):

@@ -90,6 +90,11 @@ class KafkaConsumer(ConsumerIterMixin):
         # (/root/reference/src/kafka_dataset.py:201): offsets are committed by
         # the commit barrier, never by a background auto-commit timer.
         kafka_kwargs["enable_auto_commit"] = False
+        if kafka_kwargs.get("group_id") is None:
+            # Same contract as MemoryConsumer (commits are per-group), and
+            # it surfaces here as a clear error instead of kafka-python's
+            # bare `assert group_id` at the first commit.
+            raise ValueError("group_id is required (commits are per-group)")
         if pattern is not None and (topics is not None or assignment is not None):
             raise ValueError("pattern is exclusive with topics/assignment")
         if pattern is None and topics is None and assignment is None:
