@@ -122,11 +122,17 @@ def worker(
 
     cs = np.asarray(commit_s)
     bt = np.asarray(batch_times)
+    if not cs.size:
+        raise SystemExit(
+            f"no steady-state commits at cadence {commit_every} over "
+            f"{n_batches} batches — raise --batches above 2+2×cadence"
+        )
     out = {
         "pid": pid,
         "nproc": nproc,
         "commit_every": commit_every,
         "batches": n,
+        "commit_samples": int(cs.size),
         "rows_per_s": BATCH / float(bt.mean()) if bt.size else 0.0,
         "commit_p50_ms": float(np.percentile(cs, 50) * 1e3),
         "commit_p99_ms": float(np.percentile(cs, 99) * 1e3),
@@ -144,6 +150,16 @@ def _free_port() -> int:
 
 
 def run_pod(nproc: int, n_batches: int, outdir: str, commit_every: int) -> dict:
+    if N_PARTS % nproc:
+        # Uneven partition strides give members unequal batch counts; the
+        # short member stops committing while the rest wedge in the pod
+        # barrier until the watchdog kills them. Fail fast instead.
+        raise SystemExit(f"--procs must divide {N_PARTS} partitions, got {nproc}")
+    if n_batches < 2 + 2 * commit_every:
+        raise SystemExit(
+            f"--batches {n_batches} leaves no steady-state commit samples "
+            f"at cadence {commit_every}"
+        )
     port = _free_port()
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
