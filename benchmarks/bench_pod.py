@@ -221,12 +221,27 @@ def main() -> None:
 
     import tempfile
 
+    # Validate the whole sweep up front — an invalid (procs, cadence) pair
+    # must not abort mid-sweep after earlier pods already spent minutes.
+    proc_list = [int(x) for x in args.procs.split(",")]
+    cadence_list = [int(x) for x in args.cadences.split(",")]
+    for nproc in proc_list:
+        if N_PARTS % nproc:
+            raise SystemExit(
+                f"--procs must divide {N_PARTS} partitions, got {nproc}"
+            )
+    for cadence in cadence_list:
+        if args.batches < 2 + 2 * cadence:
+            raise SystemExit(
+                f"--batches {args.batches} leaves no steady-state commit "
+                f"samples at cadence {cadence}"
+            )
     outdir = tempfile.mkdtemp(prefix="tk-pod-bench-")
     print(f"logs/results in {outdir}", file=sys.stderr)
     print("| procs | commit cadence | rows/s/proc | rows/s total | commit mean | p50 | p99 |")
     print("|---|---|---|---|---|---|---|")
-    for nproc in (int(x) for x in args.procs.split(",")):
-        for cadence in (int(x) for x in args.cadences.split(",")):
+    for nproc in proc_list:
+        for cadence in cadence_list:
             r = run_pod(nproc, args.batches, outdir, cadence)
             print(
                 f"| {r['nproc']} | every {r['commit_every']} | "

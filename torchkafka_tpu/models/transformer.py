@@ -83,6 +83,12 @@ class TransformerConfig:
     # >0 = that sequence block, 0 = disable (always full-logits dense CE).
     # Auto-disabled under sp>1 meshes and quantized heads either way.
     ce_block_size: int | None = None
+    # Unroll factor for the lax.scan over the stacked layers. None = auto:
+    # fully unroll stacks of ≤ 8 layers (XLA schedules the unrolled trunk
+    # ~15% faster on v5e at batch 64; measured in PERF.md), scan deeper
+    # stacks (compile time independent of depth — the reason scan is the
+    # default structure). 1 = never unroll.
+    scan_unroll: int | None = None
 
     @property
     def head_dim(self) -> int:
@@ -411,7 +417,10 @@ class Transformer:
 
             if cfg.remat:
                 body = jax.checkpoint(body)
-            x, auxes = lax.scan(body, x, params["layers"])
+            unroll = cfg.scan_unroll
+            if unroll is None:
+                unroll = cfg.n_layers if cfg.n_layers <= 8 else 1
+            x, auxes = lax.scan(body, x, params["layers"], unroll=unroll)
         return _rms_norm(x, params["ln_f"]), jnp.mean(auxes)
 
     def __call__(
