@@ -143,13 +143,8 @@ def worker(
         json.dump(out, f)
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("localhost", 0))
-        return s.getsockname()[1]
-
-
-def run_pod(nproc: int, n_batches: int, outdir: str, commit_every: int) -> dict:
+def _validate(nproc: int, n_batches: int, commit_every: int) -> None:
+    """Shared guard for main()'s up-front sweep check and run_pod."""
     if N_PARTS % nproc:
         # Uneven partition strides give members unequal batch counts; the
         # short member stops committing while the rest wedge in the pod
@@ -160,6 +155,16 @@ def run_pod(nproc: int, n_batches: int, outdir: str, commit_every: int) -> dict:
             f"--batches {n_batches} leaves no steady-state commit samples "
             f"at cadence {commit_every}"
         )
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def run_pod(nproc: int, n_batches: int, outdir: str, commit_every: int) -> dict:
+    _validate(nproc, n_batches, commit_every)
     port = _free_port()
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
@@ -226,16 +231,8 @@ def main() -> None:
     proc_list = [int(x) for x in args.procs.split(",")]
     cadence_list = [int(x) for x in args.cadences.split(",")]
     for nproc in proc_list:
-        if N_PARTS % nproc:
-            raise SystemExit(
-                f"--procs must divide {N_PARTS} partitions, got {nproc}"
-            )
-    for cadence in cadence_list:
-        if args.batches < 2 + 2 * cadence:
-            raise SystemExit(
-                f"--batches {args.batches} leaves no steady-state commit "
-                f"samples at cadence {cadence}"
-            )
+        for cadence in cadence_list:
+            _validate(nproc, args.batches, cadence)
     outdir = tempfile.mkdtemp(prefix="tk-pod-bench-")
     print(f"logs/results in {outdir}", file=sys.stderr)
     print("| procs | commit cadence | rows/s/proc | rows/s total | commit mean | p50 | p99 |")

@@ -1,0 +1,196 @@
+"""Producer protocol: memory transport, DLQ-to-topic bridge, kafka adapter.
+
+The reference is consume-only; the producer closes the
+consume→transform→produce loop (derived records durable BEFORE source
+offsets commit — the ordering `KafkaProducer`'s docstring documents).
+"""
+
+import collections
+import importlib
+import sys
+import types
+
+import numpy as np
+import pytest
+
+import torchkafka_tpu as tk
+from torchkafka_tpu.errors import ProducerClosedError
+
+
+class TestMemoryProducer:
+    def test_send_returns_metadata_and_appends(self, broker):
+        broker.create_topic("out", partitions=2)
+        p = tk.MemoryProducer(broker)
+        md = p.send("out", b"a", partition=1).get()
+        assert (md.topic, md.partition, md.offset) == ("out", 1, 0)
+        md2 = p.send("out", b"b", partition=1).get()
+        assert md2.offset == 1
+        c = tk.MemoryConsumer(broker, "out", group_id="g")
+        got = sorted(r.value for r in c.poll(max_records=10, timeout_ms=100))
+        assert got == [b"a", b"b"]
+
+    def test_key_hash_partitioning_is_stable(self, broker):
+        broker.create_topic("out", partitions=4)
+        p = tk.MemoryProducer(broker)
+        parts = {p.send("out", b"v", key=b"user-42").get().partition for _ in range(5)}
+        assert len(parts) == 1  # same key → same partition, every time
+
+    def test_round_robin_without_key(self, broker):
+        broker.create_topic("out", partitions=3)
+        p = tk.MemoryProducer(broker)
+        parts = [p.send("out", b"v").get().partition for _ in range(6)]
+        assert sorted(set(parts)) == [0, 1, 2]
+
+    def test_headers_roundtrip(self, broker):
+        broker.create_topic("out", partitions=1)
+        tk.MemoryProducer(broker).send(
+            "out", b"v", headers=(("h", b"x"),)
+        ).get()
+        c = tk.MemoryConsumer(broker, "out", group_id="g")
+        (rec,) = c.poll(max_records=1, timeout_ms=100)
+        assert rec.headers == (("h", b"x"),)
+
+    def test_closed_producer_raises(self, broker):
+        broker.create_topic("out", partitions=1)
+        p = tk.MemoryProducer(broker)
+        p.close()
+        with pytest.raises(ProducerClosedError):
+            p.send("out", b"v")
+        with pytest.raises(ProducerClosedError):
+            p.flush()
+
+    def test_unknown_topic_raises(self, broker):
+        p = tk.MemoryProducer(broker)
+        with pytest.raises(tk.TpuKafkaError):
+            p.send("nope", b"v")
+
+
+class TestDeadLetterToTopic:
+    def test_poison_records_land_on_dlq_with_provenance(self, broker):
+        """End-to-end: stream with on_processor_error='drop' routes poison
+        records to a quarantine topic; the main watermark still advances
+        past them (at-least-once, nothing reprocessed on resume)."""
+        broker.create_topic("in", partitions=1)
+        broker.create_topic("dlq", partitions=1)
+        for i in range(6):
+            v = b"BAD!" if i == 3 else np.int32([i] * 4).tobytes()
+            broker.produce("in", v, key=f"k{i}".encode())
+
+        def processor(record):
+            arr = np.frombuffer(record.value, np.int32)
+            if arr.shape[0] != 4:
+                raise ValueError("short record")
+            return arr
+
+        dlq = tk.MemoryProducer(broker)
+        consumer = tk.MemoryConsumer(broker, "in", group_id="g")
+        with tk.KafkaStream(
+            consumer, processor, batch_size=5, pad_policy="pad",
+            to_device=False, idle_timeout_ms=300, owns_consumer=True,
+            on_processor_error="drop",
+            dead_letter=tk.dead_letter_to_topic(dlq, "dlq"),
+        ) as stream:
+            rows = 0
+            for batch, token in stream:
+                rows += batch.valid_count
+                assert token.commit()
+        assert rows == 5
+        c = tk.MemoryConsumer(broker, "dlq", group_id="g2")
+        (rec,) = c.poll(max_records=10, timeout_ms=100)
+        assert rec.value == b"BAD!"
+        assert rec.key == b"k3"
+        headers = dict(rec.headers)
+        assert headers["dlq.topic"] == b"in"
+        assert headers["dlq.offset"] == b"3"
+        assert b"short record" in headers["dlq.error"]
+        # Source watermark covers the poison record (it was quarantined,
+        # not left for re-delivery).
+        assert broker.committed("g", tk.TopicPartition("in", 0)) == 6
+
+    def test_broken_dlq_does_not_kill_ingest(self, broker):
+        broker.create_topic("in", partitions=1)
+        broker.produce("in", b"BAD!")
+        broker.produce("in", np.int32([1, 2, 3, 4]).tobytes())
+
+        def processor(record):
+            arr = np.frombuffer(record.value, np.int32)
+            if arr.shape[0] != 4:
+                raise ValueError("poison")
+            return arr
+
+        dead = tk.MemoryProducer(broker)
+        dead.close()  # every DLQ send will raise ProducerClosedError
+        consumer = tk.MemoryConsumer(broker, "in", group_id="g")
+        with tk.KafkaStream(
+            consumer, processor, batch_size=1, to_device=False,
+            idle_timeout_ms=300, owns_consumer=True,
+            on_processor_error="drop",
+            dead_letter=tk.dead_letter_to_topic(dead, "dlq"),
+        ) as stream:
+            rows = sum(b.valid_count for b, t in stream if t.commit())
+        assert rows == 1  # ingest survived the broken DLQ
+
+
+class TestKafkaProducerAdapter:
+    """Against the same stubbed kafka module as the consumer adapter."""
+
+    @pytest.fixture
+    def adapter(self):
+        from tests.test_kafka_adapter import (
+            FakeTopicPartition, OffsetAndMetadata3, _install_stub, _remove_stub,
+        )
+
+        class FakeFuture:
+            def __init__(self, md):
+                self._md = md
+
+            def get(self, timeout=None):
+                return self._md
+
+        class FakeKafkaProducer:
+            def __init__(self, **kwargs):
+                self.init_kwargs = kwargs
+                self.sends = []
+                self.flushes = []
+                self.closed = False
+
+            def send(self, topic, value=None, key=None, partition=None,
+                     timestamp_ms=None, headers=None):
+                self.sends.append(
+                    dict(topic=topic, value=value, key=key,
+                         partition=partition, headers=headers)
+                )
+                md = collections.namedtuple(
+                    "RecordMetadata", ["topic", "partition", "offset"]
+                )(topic, partition or 0, len(self.sends) - 1)
+                return FakeFuture(md)
+
+            def flush(self, timeout=None):
+                self.flushes.append(timeout)
+
+            def close(self):
+                self.closed = True
+
+        mod = _install_stub(OffsetAndMetadata3)
+        sys.modules["kafka"].KafkaProducer = FakeKafkaProducer
+        mod = importlib.reload(mod)
+        yield mod
+        _remove_stub()
+
+    def test_send_flush_close_translation(self, adapter):
+        p = adapter.KafkaProducer(bootstrap_servers=["b:9092"], acks="all")
+        assert p._producer.init_kwargs["acks"] == "all"
+        h = p.send("out", b"v", key=b"k", headers=(("h", b"x"),))
+        md = h.get()
+        assert (md.topic, md.offset) == ("out", 0)
+        sent = p._producer.sends[0]
+        assert sent["headers"] == [("h", b"x")]
+        h2 = p.send("out", b"w")
+        assert p._producer.sends[1]["headers"] is None  # empty → None
+        assert h2.get().offset == 1
+        p.flush(timeout_s=5)
+        assert p._producer.flushes == [5]
+        p.close()
+        assert p._producer.closed
+        with pytest.raises(ProducerClosedError):
+            p.send("out", b"z")

@@ -22,6 +22,7 @@ from torchkafka_tpu.errors import (
     BarrierError,
     CommitFailedError,
     ConsumerClosedError,
+    ProducerClosedError,
     TpuKafkaError,
 )
 from torchkafka_tpu.parallel import batch_sharding, global_batch, make_mesh
@@ -31,7 +32,12 @@ from torchkafka_tpu.source import (
     Consumer,
     InMemoryBroker,
     KafkaConsumer,
+    KafkaProducer,
     MemoryConsumer,
+    MemoryProducer,
+    Producer,
+    RecordMetadata,
+    dead_letter_to_topic,
     seek_to_timestamp,
     Record,
     TopicPartition,
@@ -65,9 +71,15 @@ __all__ = [
     "ConsumerClosedError",
     "InMemoryBroker",
     "KafkaConsumer",
+    "KafkaProducer",
     "KafkaStream",
     "LocalBarrier",
     "MemoryConsumer",
+    "MemoryProducer",
+    "Producer",
+    "ProducerClosedError",
+    "RecordMetadata",
+    "dead_letter_to_topic",
     "seek_to_timestamp",
     "OffsetLedger",
     "Record",

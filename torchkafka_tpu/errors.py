@@ -34,6 +34,10 @@ class NotAssignedError(TpuKafkaError):
     """Commit/seek referenced a partition this consumer does not own."""
 
 
+class ProducerClosedError(TpuKafkaError):
+    """Operation attempted on a closed producer."""
+
+
 class UnknownTopicError(TpuKafkaError):
     """Topic does not exist on the broker."""
 

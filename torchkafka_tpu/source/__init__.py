@@ -1,10 +1,20 @@
-"""Source layer: transports implementing the Consumer protocol."""
+"""Source layer: transports implementing the Consumer/Producer protocols."""
 
 from torchkafka_tpu.source.assignment import local_batch_size, partitions_for_process
 from torchkafka_tpu.source.chaos import ChaosConsumer
 from torchkafka_tpu.source.consumer import Consumer, seek_to_timestamp
-from torchkafka_tpu.source.kafka import HAVE_KAFKA_PYTHON, KafkaConsumer
+from torchkafka_tpu.source.kafka import (
+    HAVE_KAFKA_PYTHON,
+    KafkaConsumer,
+    KafkaProducer,
+)
 from torchkafka_tpu.source.memory import InMemoryBroker, MemoryConsumer
+from torchkafka_tpu.source.producer import (
+    MemoryProducer,
+    Producer,
+    RecordMetadata,
+    dead_letter_to_topic,
+)
 from torchkafka_tpu.source.records import Record, TopicPartition
 
 __all__ = [
@@ -13,7 +23,12 @@ __all__ = [
     "HAVE_KAFKA_PYTHON",
     "InMemoryBroker",
     "KafkaConsumer",
+    "KafkaProducer",
     "MemoryConsumer",
+    "MemoryProducer",
+    "Producer",
+    "RecordMetadata",
+    "dead_letter_to_topic",
     "seek_to_timestamp",
     "Record",
     "TopicPartition",

@@ -265,3 +265,73 @@ class KafkaConsumer(ConsumerIterMixin):
 
     def __iter__(self) -> Iterator[Record]:
         return super().__iter__()
+
+
+class _KafkaSendHandle:
+    """Wraps kafka-python's FutureRecordMetadata behind SendHandle.get."""
+
+    def __init__(self, future) -> None:
+        self._future = future
+
+    def get(self, timeout_s: float | None = None):
+        from torchkafka_tpu.source.producer import RecordMetadata
+
+        md = self._future.get(timeout=timeout_s)
+        return RecordMetadata(md.topic, md.partition, md.offset)
+
+
+class KafkaProducer:
+    """Producer-protocol adapter over kafka-python's KafkaProducer.
+
+    Same kwargs-passthrough philosophy as the consumer adapter: every
+    keyword flows verbatim to the client. ``send`` returns a handle whose
+    ``get`` blocks until the broker acks (at the client's configured
+    ``acks`` level) — pair with ``flush()`` before committing consumer
+    offsets when producing derived records (the classic consume-transform-
+    produce ordering: derived records durable BEFORE the source offsets
+    commit, so a crash re-derives rather than loses).
+    """
+
+    def __init__(self, **kafka_kwargs) -> None:
+        if not HAVE_KAFKA_PYTHON:  # pragma: no cover
+            raise ImportError(
+                "kafka-python is not installed; install it or use "
+                "torchkafka_tpu.source.producer.MemoryProducer"
+            )
+        self._closed = False
+        self._producer = _kafka.KafkaProducer(**kafka_kwargs)
+
+    def send(
+        self,
+        topic: str,
+        value: bytes,
+        *,
+        key: bytes | None = None,
+        partition: int | None = None,
+        timestamp_ms: int | None = None,
+        headers: tuple[tuple[str, bytes], ...] = (),
+    ) -> _KafkaSendHandle:
+        if self._closed:
+            raise errors.ProducerClosedError("producer is closed")
+        fut = self._producer.send(
+            topic,
+            value=value,
+            key=key,
+            partition=partition,
+            timestamp_ms=timestamp_ms,
+            # kafka-python takes list[(str, bytes)]; None when absent
+            # (older client versions reject an empty list on old brokers).
+            headers=list(headers) or None,
+        )
+        return _KafkaSendHandle(fut)
+
+    def flush(self, timeout_s: float | None = None) -> None:
+        if self._closed:
+            raise errors.ProducerClosedError("producer is closed")
+        self._producer.flush(timeout=timeout_s)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._producer.close()

@@ -107,6 +107,7 @@ class InMemoryBroker:
         key: bytes | None = None,
         partition: int | None = None,
         timestamp_ms: int | None = None,
+        headers: tuple[tuple[str, bytes], ...] = (),
     ) -> Record:
         """Append one record; partition chosen by explicit arg, key hash, or
         round-robin (Kafka's default partitioner behavior)."""
@@ -135,6 +136,7 @@ class InMemoryBroker:
                 value=value,
                 key=key,
                 timestamp_ms=ts,
+                headers=tuple(headers),
             )
             log.append(rec)
             self._data_arrived.notify_all()

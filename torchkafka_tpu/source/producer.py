@@ -1,0 +1,140 @@
+"""Producer protocol + transports: write records back to Kafka.
+
+The reference is consume-only (SURVEY.md §2 — no producer anywhere in its
+tree), but its users' pipelines don't end at the training loop: dead
+letters go to a quarantine topic, serving results go to an output topic,
+and metrics/audit events go somewhere durable. This module closes the
+loop with the same transport split as the consumer side: an in-memory
+producer over ``InMemoryBroker`` (hermetic tests) and a kafka-python
+adapter (gated import, in source/kafka.py).
+
+Delivery contract: ``send`` is asynchronous-capable — it returns a
+``SendHandle`` whose ``get(timeout_s)`` blocks until the record is durable
+on the broker and returns its ``RecordMetadata``; ``flush()`` drains
+everything in flight. The memory transport resolves synchronously (the
+broker append IS durability); the kafka adapter wraps the client's future.
+Partitioning matches Kafka's default partitioner: explicit partition wins,
+else key-hash, else round-robin.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Protocol, runtime_checkable
+
+from torchkafka_tpu.errors import ProducerClosedError
+from torchkafka_tpu.source.memory import InMemoryBroker
+from torchkafka_tpu.source.records import Record
+
+
+@dataclasses.dataclass(frozen=True, slots=True)
+class RecordMetadata:
+    """Where a produced record landed (Kafka's RecordMetadata analog)."""
+
+    topic: str
+    partition: int
+    offset: int
+
+
+class SendHandle(Protocol):
+    def get(self, timeout_s: float | None = None) -> RecordMetadata: ...
+
+
+@dataclasses.dataclass(frozen=True, slots=True)
+class _ResolvedSend:
+    """A send that was durable the moment it returned (memory transport)."""
+
+    metadata: RecordMetadata
+
+    def get(self, timeout_s: float | None = None) -> RecordMetadata:
+        return self.metadata
+
+
+@runtime_checkable
+class Producer(Protocol):
+    def send(
+        self,
+        topic: str,
+        value: bytes,
+        *,
+        key: bytes | None = None,
+        partition: int | None = None,
+        timestamp_ms: int | None = None,
+        headers: tuple[tuple[str, bytes], ...] = (),
+    ) -> SendHandle: ...
+
+    def flush(self, timeout_s: float | None = None) -> None: ...
+
+    def close(self) -> None: ...
+
+
+class MemoryProducer:
+    """Producer over ``InMemoryBroker`` — the hermetic twin of
+    ``MemoryConsumer``. Appends are durable synchronously; partitioning
+    (explicit / key-hash / round-robin) is the broker's, which mirrors
+    Kafka's default partitioner."""
+
+    def __init__(self, broker: InMemoryBroker) -> None:
+        self._broker = broker
+        self._closed = False
+
+    def send(
+        self,
+        topic: str,
+        value: bytes,
+        *,
+        key: bytes | None = None,
+        partition: int | None = None,
+        timestamp_ms: int | None = None,
+        headers: tuple[tuple[str, bytes], ...] = (),
+    ) -> SendHandle:
+        if self._closed:
+            raise ProducerClosedError("producer is closed")
+        rec = self._broker.produce(
+            topic, value, key=key, partition=partition,
+            timestamp_ms=timestamp_ms, headers=headers,
+        )
+        return _ResolvedSend(RecordMetadata(rec.topic, rec.partition, rec.offset))
+
+    def flush(self, timeout_s: float | None = None) -> None:
+        if self._closed:
+            raise ProducerClosedError("producer is closed")
+        # Synchronous appends: nothing is ever in flight.
+
+    def close(self) -> None:
+        self._closed = True
+
+
+def dead_letter_to_topic(
+    producer: Producer, topic: str, *, timeout_s: float | None = 30.0
+):
+    """Adapt a Producer into a ``KafkaStream(dead_letter=...)`` callback:
+    poison records land on a quarantine topic with their provenance and
+    the error in headers, key preserved (so compacted/keyed DLQ topics
+    keep working).
+
+    The callback BLOCKS on the send handle (``get(timeout_s)``): the
+    poison record's offset retires into the source watermark the moment
+    this returns, so the quarantine copy must be durable FIRST — an async
+    fire-and-forget would let a broker-side send failure (or a crash
+    before flush) lose the record permanently with the source already
+    committed past it. Failures raise here and land in the stream's DLQ
+    guard, which logs and swallows them — a broken DLQ must not take down
+    ingest (pipeline/stream.py's dead_letter contract) — but the failure
+    is at least visible in the logs and metrics. Poison is rare by
+    definition; the per-record ack round-trip is not a hot path."""
+
+    def on_dead_letter(record: Record, exc: BaseException) -> None:
+        producer.send(
+            topic,
+            record.value,
+            key=record.key,
+            headers=(
+                ("dlq.error", str(exc).encode()),
+                ("dlq.topic", record.topic.encode()),
+                ("dlq.partition", str(record.partition).encode()),
+                ("dlq.offset", str(record.offset).encode()),
+            ),
+        ).get(timeout_s)
+
+    return on_dead_letter
