@@ -47,6 +47,7 @@ def main() -> int:
 
     broker = tk.InMemoryBroker()
     broker.create_topic(TOPIC, partitions=2)
+    broker.create_topic("completions", partitions=2)
     rng = np.random.default_rng(0)
     for i in range(args.prompts):
         broker.produce(
@@ -61,10 +62,14 @@ def main() -> int:
     )
     params = init_params(jax.random.key(0), cfg)
     consumer = tk.MemoryConsumer(broker, TOPIC, group_id="serve-demo")
+    producer = tk.MemoryProducer(broker)
     with StreamingGenerator(
         consumer, params, cfg,
         slots=args.slots, prompt_len=PROMPT_LEN, max_new=args.max_new,
         eos_id=args.eos, commit_every=args.slots,
+        # consume→generate→produce: completions become durable on their
+        # topic BEFORE the prompts that produced them commit.
+        output_producer=producer, output_topic="completions",
     ) as server:  # exit commits completed work (crash semantics unchanged)
         print(f"compiling ({args.slots} slots)...", file=sys.stderr)
         server.warmup()
@@ -82,9 +87,13 @@ def main() -> int:
         broker.committed("serve-demo", tk.TopicPartition(TOPIC, p)) or 0
         for p in (0, 1)
     )
+    out_c = tk.MemoryConsumer(broker, "completions", group_id="audit")
+    published = len(out_c.poll(max_records=10_000, timeout_ms=200))
+    out_c.close()
     print(
         f"\n{args.prompts} completions, {toks} tokens in {dt:.2f}s "
-        f"({toks / dt:,.0f} tok/s); {committed} offsets committed\n"
+        f"({toks / dt:,.0f} tok/s); {committed} offsets committed; "
+        f"{published} completions on the output topic\n"
         f"metrics: {server.metrics.summary()}",
         file=sys.stderr,
     )

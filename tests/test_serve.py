@@ -425,3 +425,137 @@ class TestStreamingGenerator:
             )
         with pytest.raises(ValueError, match="max_new"):
             StreamingGenerator(consumer, params, cfg, prompt_len=P, max_new=1)
+
+
+class TestOutputTopic:
+    def test_completions_published_before_commit(self, model):
+        """Every completion lands on the output topic (key preserved) and
+        the producer is flushed before offsets commit."""
+        cfg, params = model
+        broker = tk.InMemoryBroker()
+        _topic(broker, 6)
+        broker.create_topic("out", partitions=1)
+        consumer = tk.MemoryConsumer(broker, "p", group_id="g")
+        producer = tk.MemoryProducer(broker)
+        server = StreamingGenerator(
+            consumer, params, cfg, slots=4, prompt_len=P, max_new=MAX_NEW,
+            commit_every=2,
+            output_producer=producer, output_topic="out",
+        )
+        got = list(server.run(max_records=6))
+        assert len(got) == 6
+        c2 = tk.MemoryConsumer(broker, "out", group_id="g2")
+        outs = c2.poll(max_records=100, timeout_ms=200)
+        assert len(outs) == 6
+        by_val = sorted(o.value for o in outs)
+        want = sorted(np.asarray(t, np.int32).tobytes() for _, t in got)
+        assert by_val == want
+        assert server.metrics.summary()["output_flush_failures"] == 0
+        consumer.close()
+
+    def test_failed_output_flush_skips_commit(self, model, caplog):
+        """Fail closed: completions that never became durable must leave
+        their prompts uncommitted (regenerate, don't lose output)."""
+        cfg, params = model
+        broker = tk.InMemoryBroker()
+        _topic(broker, 4)
+        broker.create_topic("out", partitions=1)
+        consumer = tk.MemoryConsumer(broker, "p", group_id="g")
+
+        class FlakyProducer(tk.MemoryProducer):
+            def flush(self, timeout_s=None):
+                raise RuntimeError("output broker gone")
+
+        server = StreamingGenerator(
+            consumer, params, cfg, slots=4, prompt_len=P, max_new=MAX_NEW,
+            commit_every=2,
+            output_producer=FlakyProducer(broker), output_topic="out",
+        )
+        got = list(server.run(max_records=4))
+        assert len(got) == 4  # serving itself continues
+        assert server.metrics.summary()["output_flush_failures"] >= 1
+        committed = sum(
+            broker.committed("g", tk.TopicPartition("p", p)) or 0 for p in (0, 1)
+        )
+        assert committed == 0  # nothing committed: all prompts re-deliver
+
+    def test_sync_send_failure_stalls_watermark_not_server(self, model):
+        """A synchronous send refusal (buffer full / closed / bad topic)
+        must neither kill serving nor let the affected prompt commit: the
+        ledger watermark stalls at exactly that record."""
+        cfg, params = model
+        broker = tk.InMemoryBroker()
+        _topic(broker, 6)
+        broker.create_topic("out", partitions=1)
+        consumer = tk.MemoryConsumer(broker, "p", group_id="g")
+
+        class FailOnce(tk.MemoryProducer):
+            def __init__(self, broker):
+                super().__init__(broker)
+                self.fails = 0
+
+            def send(self, topic, value, **kw):
+                # Fail exactly the first send (prompt p0:0 or p1:0 —
+                # whichever completes first).
+                if self.fails == 0:
+                    self.fails = 1
+                    raise RuntimeError("buffer full")
+                return super().send(topic, value, **kw)
+
+        producer = FailOnce(broker)
+        server = StreamingGenerator(
+            consumer, params, cfg, slots=4, prompt_len=P, max_new=MAX_NEW,
+            commit_every=2, output_producer=producer, output_topic="out",
+        )
+        got = list(server.run(max_records=6))
+        assert len(got) == 6  # serving survived
+        assert server.metrics.summary()["output_send_failures"] == 1
+        committed = sum(
+            broker.committed("g", tk.TopicPartition("p", p)) or 0 for p in (0, 1)
+        )
+        # Exactly one record's watermark is stalled (its partition commits
+        # stop just before it); everything else committed.
+        assert committed < 6
+        c2 = tk.MemoryConsumer(broker, "out", group_id="g2")
+        assert len(c2.poll(max_records=100, timeout_ms=200)) == 5
+
+    def test_terminal_delivery_failure_is_fatal(self, model):
+        """A send that FAILED after the flush (async, terminal) must raise
+        OutputDeliveryError instead of committing past lost output."""
+        cfg, params = model
+        broker = tk.InMemoryBroker()
+        _topic(broker, 4)
+        broker.create_topic("out", partitions=1)
+        consumer = tk.MemoryConsumer(broker, "p", group_id="g")
+
+        class DeadHandle:
+            def get(self, timeout_s=None):
+                raise RuntimeError("retries exhausted")
+
+        class AsyncFail(tk.MemoryProducer):
+            def send(self, topic, value, **kw):
+                super().send(topic, value, **kw)
+                return DeadHandle()
+
+        server = StreamingGenerator(
+            consumer, params, cfg, slots=4, prompt_len=P, max_new=MAX_NEW,
+            commit_every=2, output_producer=AsyncFail(broker),
+            output_topic="out",
+        )
+        with pytest.raises(tk.OutputDeliveryError):
+            list(server.run(max_records=4))
+        committed = sum(
+            broker.committed("g", tk.TopicPartition("p", p)) or 0 for p in (0, 1)
+        )
+        assert committed == 0  # nothing committed past the lost outputs
+
+    def test_producer_without_topic_rejected(self, model):
+        cfg, params = model
+        broker = tk.InMemoryBroker()
+        _topic(broker, 2)
+        consumer = tk.MemoryConsumer(broker, "p", group_id="g")
+        with pytest.raises(ValueError, match="together"):
+            StreamingGenerator(
+                consumer, params, cfg, slots=2, prompt_len=P, max_new=MAX_NEW,
+                output_producer=tk.MemoryProducer(broker),
+            )

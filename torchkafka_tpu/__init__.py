@@ -22,6 +22,7 @@ from torchkafka_tpu.errors import (
     BarrierError,
     CommitFailedError,
     ConsumerClosedError,
+    OutputDeliveryError,
     ProducerClosedError,
     TpuKafkaError,
 )
@@ -76,6 +77,7 @@ __all__ = [
     "LocalBarrier",
     "MemoryConsumer",
     "MemoryProducer",
+    "OutputDeliveryError",
     "Producer",
     "ProducerClosedError",
     "RecordMetadata",

@@ -38,6 +38,13 @@ class ProducerClosedError(TpuKafkaError):
     """Operation attempted on a closed producer."""
 
 
+class OutputDeliveryError(TpuKafkaError):
+    """A produced output record terminally failed delivery (retries
+    exhausted, too large, authorization). Raised instead of committing
+    source offsets past the lost output: fail-stop = crash-before-commit,
+    so the affected inputs re-deliver and the output regenerates."""
+
+
 class UnknownTopicError(TpuKafkaError):
     """Topic does not exist on the broker."""
 

@@ -35,7 +35,7 @@ import numpy as np
 from jax import lax
 
 from torchkafka_tpu.commit.ledger import OffsetLedger
-from torchkafka_tpu.errors import CommitFailedError
+from torchkafka_tpu.errors import CommitFailedError, OutputDeliveryError
 from torchkafka_tpu.models.generate import _attend_cached, _project_qkv, prefill
 from torchkafka_tpu.models.quant import embed_rows, load_weight
 from torchkafka_tpu.models.transformer import TransformerConfig, _rms_norm, _rope
@@ -55,6 +55,8 @@ class ServeMetrics:
         self.truncated = RateMeter()  # stopped by EOS before max_new
         self.dropped = RateMeter()  # undecodable prompts retired
         self.commit_failures = RateMeter()
+        self.output_flush_failures = RateMeter()  # output topic not durable
+        self.output_send_failures = RateMeter()  # sync send refusals (stall)
         self.slot_occupancy = Gauge()  # active slots / pool size, last tick
 
     def reset(self) -> None:
@@ -75,6 +77,8 @@ class ServeMetrics:
             "truncated_by_eos": self.truncated.count,
             "dropped": self.dropped.count,
             "commit_failures": self.commit_failures.count,
+            "output_flush_failures": self.output_flush_failures.count,
+            "output_send_failures": self.output_send_failures.count,
             "slot_occupancy": round(self.slot_occupancy.value, 3),
         }
 
@@ -90,6 +94,8 @@ class ServeMetrics:
             ("truncated_by_eos_total", "counter", s["truncated_by_eos"]),
             ("dropped_prompts_total", "counter", s["dropped"]),
             ("commit_failures_total", "counter", s["commit_failures"]),
+            ("output_flush_failures_total", "counter", s["output_flush_failures"]),
+            ("output_send_failures_total", "counter", s["output_send_failures"]),
             ("completions_per_second", "gauge", s["completions_per_s"]),
             ("tokens_per_second", "gauge", s["tokens_per_s"]),
             ("slot_occupancy", "gauge", s["slot_occupancy"]),
@@ -153,6 +159,9 @@ class StreamingGenerator:
         ticks_per_sync: int = 4,
         temperature: float = 0.0,
         rng: jax.Array | None = None,
+        output_producer=None,
+        output_topic: str | None = None,
+        encode_output: Callable[[Record, np.ndarray], bytes] | None = None,
     ) -> None:
         """``ticks_per_sync``: decode ticks chained per device dispatch
         (and per host sync of the done mask). Higher amortises dispatch
@@ -161,7 +170,16 @@ class StreamingGenerator:
 
         ``temperature``: 0 = greedy (matches ``generate``'s default);
         > 0 samples categorically per slot from logits/temperature, keyed
-        by ``rng`` (per-tick fold-in, deterministic for a fixed key)."""
+        by ``rng`` (per-tick fold-in, deterministic for a fixed key).
+
+        ``output_producer``/``output_topic``: publish each completion to a
+        topic (key = the prompt record's key; ``encode_output(record,
+        tokens) -> bytes``, default int32 token bytes). Sends are async;
+        the producer is FLUSHED before every offset commit, and a failed
+        flush SKIPS the commit (fail closed) — outputs are durable before
+        the prompts that produced them commit, so a crash regenerates
+        instead of losing completions (at-least-once end to end; the
+        output topic may see duplicates, keyed by the prompt's key)."""
         if prompt_len + max_new > cfg.max_seq_len:
             raise ValueError("prompt_len + max_new exceeds cfg.max_seq_len")
         if max_new < 2:
@@ -181,6 +199,16 @@ class StreamingGenerator:
         self._ticks_per_sync = ticks_per_sync
         self._temperature = float(temperature)
         self._rng = jax.random.key(0) if rng is None else rng
+        if (output_producer is None) != (output_topic is None):
+            raise ValueError(
+                "output_producer and output_topic must be given together"
+            )
+        self._output_producer = output_producer
+        self._output_topic = output_topic
+        self._encode_output = encode_output or (
+            lambda rec, toks: np.asarray(toks, np.int32).tobytes()
+        )
+        self._pending_outputs: list = []  # send handles since last commit
         self._ledger = OffsetLedger()
         self._max_len = prompt_len + max_new
         self.metrics = ServeMetrics()
@@ -395,16 +423,43 @@ class StreamingGenerator:
                 for i in np.nonzero(done_h)[0]:
                     rec = slot_rec[i]
                     assert rec is not None
-                    self._ledger.emitted(rec)
                     active[i] = False
                     slot_rec[i] = None
                     served += 1
-                    uncommitted += 1
                     out = gen_h[i, : n_out_h[i]].copy()
                     self.metrics.completions.add(1)
                     self.metrics.tokens.add(len(out))
                     if len(out) < self._max_new:
                         self.metrics.truncated.add(1)
+                    sent_ok = True
+                    if self._output_producer is not None:
+                        # Async send; durability is settled in _commit
+                        # (flush + per-handle get) BEFORE offsets commit. A
+                        # SYNCHRONOUS send failure (buffer full with the
+                        # output broker down, closed producer, missing
+                        # topic) must not kill serving OR let the record
+                        # commit: skip emitted() so the ledger watermark
+                        # stalls at exactly this record — it re-delivers
+                        # and regenerates on restart.
+                        try:
+                            self._pending_outputs.append(
+                                self._output_producer.send(
+                                    self._output_topic,
+                                    self._encode_output(rec, out),
+                                    key=rec.key,
+                                )
+                            )
+                        except Exception:  # noqa: BLE001 - fail closed per record
+                            sent_ok = False
+                            self.metrics.output_send_failures.add(1)
+                            _logger.exception(
+                                "output send failed for %s@%d:%d; leaving "
+                                "it uncommitted to re-deliver",
+                                rec.topic, rec.partition, rec.offset,
+                            )
+                    if sent_ok:
+                        self._ledger.emitted(rec)
+                        uncommitted += 1
                     yield rec, out
                 if uncommitted >= self._commit_every:
                     self._commit()
@@ -418,7 +473,39 @@ class StreamingGenerator:
         """Commit the ledger watermark; commit failure is survivable (the
         reference's contract, /root/reference/src/kafka_dataset.py:131-135):
         a rebalance raises CommitFailedError and the moved partitions'
-        uncommitted prompts simply re-deliver to their new owner."""
+        uncommitted prompts simply re-deliver to their new owner.
+
+        With an output topic configured, output durability is settled
+        FIRST: flush, then ``get()`` every send handle since the last
+        commit (kafka-python's ``flush`` resolves futures but does NOT
+        re-raise per-record failures — a terminally failed send would
+        otherwise slip through a clean flush). A TRANSIENT flush failure
+        skips the commit and keeps the handles (retried next commit); a
+        TERMINAL per-record failure raises ``OutputDeliveryError`` —
+        fail-stop equals crash-before-commit, so everything since the
+        last commit re-delivers and regenerates rather than committing
+        past lost output."""
+        if self._output_producer is not None:
+            try:
+                self._output_producer.flush()
+            except Exception:  # noqa: BLE001 - any flush failure fails closed
+                self.metrics.output_flush_failures.add(1)
+                _logger.exception(
+                    "output flush failed; SKIPPING offset commit so the "
+                    "affected prompts re-deliver and regenerate"
+                )
+                return
+            pending, self._pending_outputs = self._pending_outputs, []
+            for handle in pending:
+                try:
+                    handle.get(30.0)
+                except Exception as exc:
+                    self.metrics.output_flush_failures.add(1)
+                    raise OutputDeliveryError(
+                        "an output record terminally failed delivery; "
+                        "refusing to commit source offsets past lost "
+                        "output (restart re-delivers and regenerates)"
+                    ) from exc
         try:
             self._consumer.commit(self._ledger.snapshot())
         except CommitFailedError:
