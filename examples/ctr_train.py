@@ -30,7 +30,7 @@ from torchkafka_tpu.models.recsys import (
     DLRMConfig,
     count_params,
     make_dlrm_train_step,
-    make_processor,
+    make_chunk_processor,
 )
 
 N_PARTS = 8
@@ -82,12 +82,13 @@ def main() -> None:
 
     with tk.KafkaStream(
         consumer,
-        make_processor(cfg),
+        # Chunked columnar decode: one native call per poll chunk (the
+        # thread pool is unused on this path, so no transform_threads).
+        make_chunk_processor(cfg),
         batch_size=args.batch,
         mesh=mesh,
         idle_timeout_ms=2000,
         owns_consumer=True,
-        transform_threads=4,
     ) as stream:
         step = 0
         for batch, token in stream:

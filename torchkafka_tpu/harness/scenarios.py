@@ -449,9 +449,9 @@ def scenario_7(size: str = "tiny") -> dict:
         "truncated_by_eos": truncated,
         "slots": slots,
         "committed": committed,
-        "commit_failures": 0,
-        "dropped": 0,
-        "commit": {"count": done},
+        "commit_failures": server.metrics.commit_failures.count,
+        "dropped": server.metrics.dropped.count,
+        "commit": server.metrics.commit_latency.summary(),
     }
 
 

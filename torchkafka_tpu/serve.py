@@ -40,7 +40,7 @@ from torchkafka_tpu.models.generate import _attend_cached, _project_qkv, prefill
 from torchkafka_tpu.models.quant import embed_rows, load_weight
 from torchkafka_tpu.models.transformer import TransformerConfig, _rms_norm, _rope
 from torchkafka_tpu.source.records import Record
-from torchkafka_tpu.utils.metrics import Gauge, RateMeter
+from torchkafka_tpu.utils.metrics import Gauge, LatencyHistogram, RateMeter
 
 _logger = logging.getLogger(__name__)
 
@@ -57,6 +57,8 @@ class ServeMetrics:
         self.commit_failures = RateMeter()
         self.output_flush_failures = RateMeter()  # output topic not durable
         self.output_send_failures = RateMeter()  # sync send refusals (stall)
+        self.commit_latency = LatencyHistogram()  # full commit path: output
+        # flush + durability waits + offset commit (see _commit docstring)
         self.slot_occupancy = Gauge()  # active slots / pool size, last tick
 
     def reset(self) -> None:
@@ -79,6 +81,7 @@ class ServeMetrics:
             "commit_failures": self.commit_failures.count,
             "output_flush_failures": self.output_flush_failures.count,
             "output_send_failures": self.output_send_failures.count,
+            "commit": self.commit_latency.summary(),
             "slot_occupancy": round(self.slot_occupancy.value, 3),
         }
 
@@ -96,6 +99,8 @@ class ServeMetrics:
             ("commit_failures_total", "counter", s["commit_failures"]),
             ("output_flush_failures_total", "counter", s["output_flush_failures"]),
             ("output_send_failures_total", "counter", s["output_send_failures"]),
+            ("commit_latency_p50_milliseconds", "gauge", s["commit"]["p50_ms"]),
+            ("commit_latency_p99_milliseconds", "gauge", s["commit"]["p99_ms"]),
             ("completions_per_second", "gauge", s["completions_per_s"]),
             ("tokens_per_second", "gauge", s["tokens_per_s"]),
             ("slot_occupancy", "gauge", s["slot_occupancy"]),
@@ -484,7 +489,12 @@ class StreamingGenerator:
         TERMINAL per-record failure raises ``OutputDeliveryError`` —
         fail-stop equals crash-before-commit, so everything since the
         last commit re-delivers and regenerates rather than committing
-        past lost output."""
+        past lost output.
+
+        ``commit_latency`` observes the WHOLE commit path — output flush +
+        per-handle durability waits + the offset commit — so an
+        output-broker stall shows up in the p99 an operator watches."""
+        t0 = time.perf_counter()
         if self._output_producer is not None:
             try:
                 self._output_producer.flush()
@@ -508,6 +518,7 @@ class StreamingGenerator:
                     ) from exc
         try:
             self._consumer.commit(self._ledger.snapshot())
+            self.metrics.commit_latency.observe(time.perf_counter() - t0)
         except CommitFailedError:
             self.metrics.commit_failures.add(1)
             _logger.exception("offset commit failed; prompts will re-deliver")
