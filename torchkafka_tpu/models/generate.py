@@ -72,6 +72,11 @@ def _attend_cached(x, q, cache_k, cache_v, valid, layer, cfg):
     x = x + jnp.einsum("bshe,hed->bsd", attn, load_weight(layer["wo"], cfg.dtype))
     h = _rms_norm(x, layer["ln2"])
     if cfg.is_moe:
+        # Decode always routes EXACTLY (dense dispatch) regardless of
+        # cfg.moe_dispatch: capacity drops are a training
+        # throughput/regularization tradeoff; at inference every token
+        # gets its routed experts (standard MoE serving semantics — see
+        # the moe_dispatch config comment).
         mlp_out, _aux = _moe_mlp(h, layer, cfg)
         return x + mlp_out
     gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", h, load_weight(layer["w_gate"], cfg.dtype)))

@@ -74,6 +74,24 @@ class TransformerConfig:
     n_experts: int = 0
     expert_top_k: int = 2
     router_aux_coef: float = 0.01  # load-balance loss weight (0 disables)
+    # 'dense': exact one-hot combine, every ep shard computes all tokens
+    # for its local experts (no drops, E/ep-fold compute). 'capacity':
+    # Switch-style dispatch — each expert takes at most
+    # ceil(group·k/E · capacity_factor) tokens PER TOKEN GROUP, overflow
+    # drops, per-shard compute scales down E/ep-fold (the pod-scale path).
+    # TRAINING-ONLY knob: the KV-cache decode path (models/generate.py,
+    # serve.py) always routes exactly — capacity drops are a training
+    # throughput/regularization tradeoff, and decode-sized batches fit
+    # under any capacity anyway (standard MoE serving semantics).
+    moe_dispatch: str = "dense"
+    capacity_factor: float = 1.25
+    # Tokens dispatch within groups of (at most) this size — the actual
+    # group is the largest divisor of the token count ≤ this, so grouping
+    # never silently degrades to one giant group. The one-hot dispatch
+    # einsum costs n_g·E·C·D per group; ungrouped (n_g = all tokens) it
+    # grows QUADRATIC in tokens and dwarfs the expert MLP itself
+    # (measured 20x at 16k tokens); 256 keeps it a fraction of MLP cost.
+    moe_group_size: int = 256
     # Pipeline parallelism: with a 'pp' mesh axis of size > 1 the layer
     # stack runs as a GPipe schedule (ops/pipeline.py) with this many
     # microbatches (None = pipeline depth). The router aux loss is not
@@ -105,6 +123,15 @@ class TransformerConfig:
             raise ValueError("n_heads must divide by n_kv_heads")
         if self.n_experts and self.expert_top_k > self.n_experts:
             raise ValueError("expert_top_k cannot exceed n_experts")
+        if self.moe_dispatch not in ("dense", "capacity"):
+            raise ValueError(
+                f"moe_dispatch must be 'dense' or 'capacity', got "
+                f"{self.moe_dispatch!r}"
+            )
+        if self.capacity_factor <= 0:
+            raise ValueError("capacity_factor must be positive")
+        if self.moe_group_size < 1:
+            raise ValueError("moe_group_size must be >= 1")
 
 
 # --------------------------------------------------------------------- params
@@ -264,6 +291,101 @@ def _moe_mlp(
     return out, aux
 
 
+def moe_capacity(cfg: "TransformerConfig", n_tokens: int) -> int:
+    """Per-expert token slots under capacity dispatch: the even share of
+    (token, choice) assignments times ``capacity_factor``, padded to a
+    multiple of 8 (TPU sublane) with a floor of 8."""
+    even = n_tokens * cfg.expert_top_k / cfg.n_experts
+    cap = int(math.ceil(even * cfg.capacity_factor))
+    return max(8, -(-cap // 8) * 8)
+
+
+def _moe_mlp_capacity(
+    h: jax.Array, layer: Mapping[str, jax.Array], cfg: "TransformerConfig"
+) -> tuple[jax.Array, jax.Array]:
+    """Capacity-based token dispatch (the scale-up path): tokens are split
+    into contiguous groups of ``moe_group_size``; within each group every
+    expert accepts at most C = ceil(n_g·k/E · capacity_factor) tokens,
+    routed via one-hot dispatch/combine einsums (the Mesh-TensorFlow /
+    Switch MoE formulation — einsums, not gathers, so XLA shards the
+    [G, E, C, D] expert batches over the mesh's ``ep`` axis and inserts
+    the token-exchange collectives itself). Per-ep-shard MLP compute is
+    k·cf·tokens/ep slots instead of the dense path's ALL tokens × local
+    experts — the E/ep-fold saving the dense docstring calls out. Grouping
+    bounds the dispatch einsum at n_g·E·C·D per group; ungrouped it grows
+    quadratic in tokens and dominates (measured 20× the MLP at 16k
+    tokens).
+
+    Overflow beyond C (an uneven router within a group) is DROPPED,
+    Switch-style: the token's k-th choice contributes nothing and its
+    residual passes through; primary choices outrank secondary ones (the
+    k axis is ordered ahead of the token axis in the position cumsum).
+    Exactness: with ``capacity_factor`` high enough for zero drops this
+    matches ``_moe_mlp`` to float tolerance (differential-tested)."""
+    b, s, d = h.shape
+    n = b * s
+    e, k = cfg.n_experts, cfg.expert_top_k
+    # Contiguous groups of the largest divisor of n ≤ the configured size
+    # (never one giant group — that reinstates the quadratic dispatch).
+    n_g = next(
+        size for size in range(min(cfg.moe_group_size, n), 0, -1)
+        if n % size == 0
+    )
+    g = n // n_g
+    cap = moe_capacity(cfg, n_g)
+    x = h.reshape(n, d)
+    logits = jnp.einsum(
+        "nd,de->ne", x.astype(jnp.float32), layer["router"].astype(jnp.float32)
+    )
+    probs = jax.nn.softmax(logits, axis=-1)  # [N, E]
+    top_vals, top_idx = lax.top_k(probs, k)  # [N, K]
+    gates = top_vals / jnp.maximum(top_vals.sum(-1, keepdims=True), 1e-9)
+
+    # Assignment order per group [K, n_g]: all primary choices outrank all
+    # secondary ones, tokens in sequence order within a tier.
+    idx_g = top_idx.reshape(g, n_g, k).transpose(0, 2, 1).reshape(g, k * n_g)
+    onehot = jax.nn.one_hot(idx_g, e, dtype=jnp.float32)  # [G, K·n_g, E]
+    pos = jnp.cumsum(onehot, axis=1) - onehot  # slot within (group, expert)
+    keep = onehot * (pos < cap)  # overflow drops
+    # dispatch/combine [G, K·n_g, E, C]: one-hot in the slot dim where kept.
+    slot = jax.nn.one_hot(pos.astype(jnp.int32), cap, dtype=jnp.float32)
+    dispatch = keep[..., None] * slot
+    gates_g = gates.reshape(g, n_g, k).transpose(0, 2, 1).reshape(g, k * n_g)
+    combine = dispatch * gates_g[..., None, None]
+
+    # Expose the k axis to the einsums instead of tiling activations
+    # k-fold (a [K·N, D] copy that would survive into backward): the
+    # contraction indexes tokens once and sums k inside the einsum.
+    disp5 = dispatch.reshape(g, k, n_g, e, cap).astype(cfg.dtype)
+    comb5 = combine.reshape(g, k, n_g, e, cap).astype(cfg.dtype)
+    x_g = x.reshape(g, n_g, d)
+    expert_in = jnp.einsum(
+        "gknec,gnd->gecd", disp5, x_g
+    )  # [G, E, C, D] — E ep-sharded; XLA inserts the token exchange
+    gate_e = jax.nn.silu(
+        jnp.einsum(
+            "gecd,edf->gecf", expert_in, load_weight(layer["w_gate"], cfg.dtype)
+        )
+    )
+    up_e = jnp.einsum(
+        "gecd,edf->gecf", expert_in, load_weight(layer["w_up"], cfg.dtype)
+    )
+    out_e = jnp.einsum(
+        "gecf,efd->gecd", gate_e * up_e, load_weight(layer["w_down"], cfg.dtype)
+    )
+    # Combine sums over (k, e, c) in one contraction → [G, n_g, D].
+    out = jnp.einsum("gknec,gecd->gnd", comb5, out_e).reshape(b, s, d)
+
+    # Same Switch load-balance aux as the dense path (computed on the
+    # PRE-capacity routing — the balance loss exists to prevent the very
+    # imbalance that causes capacity drops).
+    routed = jnp.sum(
+        jax.nn.one_hot(top_idx, e, dtype=jnp.float32), axis=1
+    )  # [N, E]
+    aux = e * jnp.sum(routed.mean(axis=0) * probs.mean(axis=0))
+    return out, aux
+
+
 def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     """Rotary embedding. x: [B, S, H, D]; positions: [S] global positions
     shared across the batch, or [B, S] per-row positions (the continuous-
@@ -330,6 +452,8 @@ class Transformer:
     def _moe_mlp(
         self, h: jax.Array, layer: Mapping[str, jax.Array]
     ) -> tuple[jax.Array, jax.Array]:
+        if self.cfg.moe_dispatch == "capacity":
+            return _moe_mlp_capacity(h, layer, self.cfg)
         return _moe_mlp(h, layer, self.cfg)
 
     @staticmethod
