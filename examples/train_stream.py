@@ -91,7 +91,7 @@ def main() -> None:
         start = 0
 
     try:
-        with tk.KafkaStream(
+        with tk.ShutdownSignal() as stop, tk.KafkaStream(
             consumer,
             tk.fixed_width(SEQ, np.int32),
             batch_size=args.batch,
@@ -111,12 +111,22 @@ def main() -> None:
                 fut = token.commit_async(wait_for=loss)
                 if step % 10 == 0:
                     print(f"step {step}  loss {float(loss):.4f}")
-                if step and step % args.ckpt_every == 0:
+                # One read for both branches: a signal landing between two
+                # separate reads could break WITHOUT the checkpoint below.
+                draining = stop.requested
+                at_ckpt = step and step % args.ckpt_every == 0
+                if at_ckpt or draining:
                     fut.result()  # offsets for this state are durable
                     # Non-blocking: the write drains while training continues;
                     # save_async snapshots the state before returning.
                     ckpt.save_async(step, (params, opt_state), token.offsets)
                     print(f"checkpoint @ step {step} (async)")
+                if draining:
+                    # Cooperative preemption drain (SIGTERM grace window):
+                    # this step is committed + checkpointed, so the resume
+                    # replays NOTHING instead of a commit-cadence's worth.
+                    print(f"preempted: drained cleanly at step {step}")
+                    break
                 step += 1
                 if step - start >= args.steps:
                     break

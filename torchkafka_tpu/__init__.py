@@ -12,6 +12,7 @@ reference users (torchkafka_tpu.compat).
 """
 
 from torchkafka_tpu.checkpoint import StreamCheckpointer
+from torchkafka_tpu.utils import ShutdownSignal
 from torchkafka_tpu.commit import (
     CommitBarrier,
     CommitToken,
@@ -85,6 +86,7 @@ __all__ = [
     "seek_to_timestamp",
     "OffsetLedger",
     "Record",
+    "ShutdownSignal",
     "StreamCheckpointer",
     "TopicPartition",
     "TpuKafkaError",

@@ -1,3 +1,4 @@
 from torchkafka_tpu.utils.metrics import LatencyHistogram, RateMeter, StreamMetrics
+from torchkafka_tpu.utils.shutdown import ShutdownSignal
 
-__all__ = ["LatencyHistogram", "RateMeter", "StreamMetrics"]
+__all__ = ["LatencyHistogram", "RateMeter", "ShutdownSignal", "StreamMetrics"]
