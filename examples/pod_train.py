@@ -97,7 +97,7 @@ def train(args) -> None:
 
     consumer = make_consumer(tk, jax)
     local_batch = args.batch  # rows THIS process contributes per step
-    with tk.KafkaStream(
+    with tk.ShutdownSignal() as stop, tk.KafkaStream(
         consumer,
         tk.fixed_width(SEQ, np.int32),
         batch_size=local_batch,
@@ -118,6 +118,27 @@ def train(args) -> None:
                 print(f"step {step}  loss {float(loss):.4f}", flush=True)
             step += 1
             if step >= args.steps:
+                break
+            # Pod drain must be a GLOBAL decision: a slice preemption
+            # SIGTERMs every member, but the notices land at slightly
+            # different moments — a member that drained alone would leave
+            # the rest wedged in the next commit barrier (watchdog exit
+            # 42, the hard-kill path). All-gather the flags so every
+            # member breaks at the same step boundary.
+            if nproc > 1:
+                from jax.experimental import multihost_utils
+
+                drain = bool(
+                    multihost_utils.process_allgather(
+                        np.array([stop.requested])
+                    ).any()
+                )
+            else:
+                drain = stop.requested
+            if drain:
+                if pid == 0:
+                    print(f"preempted: pod drained cleanly at step {step}",
+                          flush=True)
                 break
     if pid == 0:
         print(f"done: {step} steps, metrics: {stream.metrics.summary()}")

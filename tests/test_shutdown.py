@@ -137,3 +137,16 @@ class TestGracefulDrain:
         assert consumed < 10_000
         durable = sum(v or 0 for v in result["committed"].values())
         assert durable == consumed
+
+
+class TestHandlerEdges:
+    def test_partial_install_rolls_back(self):
+        before = signal.getsignal(signal.SIGUSR2)
+        stop = tk.ShutdownSignal(signals=(signal.SIGUSR2, 99999))
+        with pytest.raises((ValueError, OSError)):
+            stop.__enter__()
+        # The successfully-installed handler was rolled back, and the
+        # instance is reusable.
+        assert signal.getsignal(signal.SIGUSR2) is before
+        with tk.ShutdownSignal(signals=(signal.SIGUSR2,)) as ok:
+            assert not ok.requested

@@ -68,10 +68,12 @@ class ShutdownSignal:
                 "second signal %d during drain; restoring default handler",
                 signum,
             )
-            # getsignal() returns None for handlers installed by non-Python
-            # code — map to SIG_DFL so the re-raise actually terminates.
-            saved = self._saved.get(signum) or _signal.SIG_DFL
-            _signal.signal(signum, saved)  # type: ignore[arg-type]
+            # UNCONDITIONALLY the default action — restoring a saved
+            # SIG_IGN (background jobs inherit SIGINT=SIG_IGN) would make
+            # the re-raise a no-op and the "kill a stuck drain" promise
+            # silently fail. __exit__ still restores the saved handler on
+            # the normal path.
+            _signal.signal(signum, _signal.SIG_DFL)
             _signal.raise_signal(signum)
             return
         self._received = signum
@@ -91,9 +93,16 @@ class ShutdownSignal:
         # PREVIOUS run's signal as an immediate drain request.
         self._event.clear()
         self._received = None
-        for s in self._signals:
-            self._saved[s] = _signal.getsignal(s)
-            _signal.signal(s, self._handle)
+        try:
+            for s in self._signals:
+                self._saved[s] = _signal.getsignal(s)
+                _signal.signal(s, self._handle)
+        except BaseException:
+            # Partial install (an invalid signal later in the tuple) must
+            # not leak handlers pointing at an orphaned instance — roll
+            # back what was installed, leave the instance reusable.
+            self.__exit__()
+            raise
         return self
 
     def __exit__(self, *exc) -> None:
