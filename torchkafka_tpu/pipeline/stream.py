@@ -88,6 +88,15 @@ class KafkaStream:
         for each record dropped by the 'drop' policy — wire it to a DLQ
         producer, a file, or a metrics sink. Exceptions it raises are
         logged and swallowed (a broken DLQ must not take down ingest).
+    buckets: length-bucket widths (e.g. ``(64, 128, 512)``) for RAGGED
+        record streams: the (per-record) processor returns variable-length
+        1-D rows; each lands in the smallest bucket that fits (longer than
+        the largest truncates, like ``fixed_width``) and batches emit as
+        ``{"tokens": [B, W], "length": [B]}`` per width — one static XLA
+        shape per bucket instead of padding everything to the maximum.
+        All buckets share the stream's ledger, so commits stay exact under
+        out-of-order emission across buckets (transform/bucket.py).
+    bucket_pad_value: fill value for intra-bucket padding.
     barrier: override the commit barrier. Default: a plain CommitBarrier
         single-process, and a BarrierWatchdog-wrapped one (exit 42 on
         timeout) on multi-process pods — a dead member must fail the pod
@@ -118,6 +127,8 @@ class KafkaStream:
         owns_consumer: bool = False,
         on_processor_error: str = "raise",
         dead_letter: Any | None = None,
+        buckets: Any | None = None,
+        bucket_pad_value: int = 0,
     ) -> None:
         if on_processor_error not in ("raise", "drop"):
             raise ValueError(
@@ -155,7 +166,23 @@ class KafkaStream:
             self._barrier = CommitBarrier()
         self.metrics = StreamMetrics()
         self._ledger = OffsetLedger()
-        self._batcher = Batcher(batch_size, self._ledger, pad_policy=pad_policy)
+        if buckets is not None:
+            if self._chunked:
+                raise ValueError(
+                    "buckets= requires a per-record processor returning "
+                    "variable-length 1-D rows; chunked processors emit "
+                    "fixed shapes already"
+                )
+            from torchkafka_tpu.transform.bucket import BucketBatcher
+
+            self._batcher = BucketBatcher(
+                batch_size, buckets, self._ledger, pad_policy=pad_policy,
+                pad_value=bucket_pad_value,
+            )
+        else:
+            self._batcher = Batcher(
+                batch_size, self._ledger, pad_policy=pad_policy
+            )
         self._sequencer = CommitSequencer()
         self._sync = prefetch == 0
         self._ready: list[Batch] = []  # sync mode: decoded-but-unyielded batches
@@ -308,8 +335,7 @@ class KafkaStream:
                 last_data = monotonic()
                 for out in self._process_chunk(records):
                     self._ship(out)
-            tail = self._batcher.flush()
-            if tail is not None:
+            for tail in self._batcher.flush_tails():
                 self._ship(tail)
         except BaseException as e:  # noqa: BLE001 - re-raised on the main thread
             self._error = e
@@ -356,11 +382,11 @@ class KafkaStream:
                 self._idle_timeout_ms is not None
                 and (now - self._idle_since) * 1000 >= self._idle_timeout_ms
             ):
-                tail = self._batcher.flush()
+                tails = self._batcher.flush_tails()
                 self._exhausted = True
-                if tail is None:
+                if not tails:
                     raise StopIteration
-                self._ready.append(tail)
+                self._ready.extend(tails)
         return self._mint(self._to_dev(self._ready.pop(0)))
 
     def __next__(self) -> tuple[Batch, CommitToken]:

@@ -1,6 +1,7 @@
 """Transform layer: per-record / per-chunk processors and fixed-shape batching."""
 
 from torchkafka_tpu.transform.batcher import Batch, Batcher
+from torchkafka_tpu.transform.bucket import BucketBatcher
 from torchkafka_tpu.transform.image import encode_png_rgb, png_images
 from torchkafka_tpu.transform.processor import (
     Processor,
@@ -17,6 +18,7 @@ from torchkafka_tpu.transform.processor import (
 __all__ = [
     "Batch",
     "Batcher",
+    "BucketBatcher",
     "Processor",
     "chunk_of",
     "chunked",

@@ -224,6 +224,12 @@ class Batcher:
             return None
         return self._emit()
 
+    def flush_tails(self) -> list["Batch"]:
+        """Uniform flush surface shared with BucketBatcher (which can hold
+        one tail per bucket)."""
+        tail = self.flush()
+        return [tail] if tail is not None else []
+
     def _emit(self) -> Batch:
         assert self._buffers is not None
         # Retire the buffered rows from the columnar identity arrays *before*
