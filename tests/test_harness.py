@@ -8,7 +8,7 @@ import pytest
 from torchkafka_tpu.harness import run_scenario
 
 
-@pytest.mark.parametrize("num", [1, 2, 3, 4, 5, 6, 7, 8])
+@pytest.mark.parametrize("num", [1, 2, 3, 4, 5, 6, 7, 8, 9])
 def test_scenario_runs_and_reports(num):
     out = run_scenario(num, "tiny")
     assert out["records"] > 0
@@ -32,6 +32,13 @@ def test_scenario_8_trains():
 def test_scenario_5_token_accounting():
     out = run_scenario(5, "tiny")
     assert out["generated_tokens"] == out["records"] * 8
+
+
+def test_scenario_9_buckets_and_efficiency():
+    out = run_scenario(9, "tiny")
+    assert 0 < out["bucket_efficiency"] < 1  # bucketing beat pad-to-max
+    assert set(out["rows_per_width"]) <= {16, 32, 64}
+    assert sum(out["rows_per_width"].values()) == out["records"]
 
 
 def test_bad_size_rejected():

@@ -11,6 +11,7 @@ each returning a metrics dict.
 | 6 | scenario 1 at batch 256 | isolates the reference's toy batch-4 choice |
 | 7 | continuous-batching serving (slot recycling, EOS) | none |
 | 8 | streaming CTR: DLRM train, tp-sharded embedding tables | none |
+| 9 | ragged text → length-bucketed batches → per-width train steps | none |
 
 Every scenario runs the full transactional loop (poll → transform → batch →
 device → step → barrier → commit) and reports ``records_per_s`` plus commit
@@ -581,6 +582,100 @@ def scenario_8(size: str = "tiny") -> dict:
     )
 
 
+def scenario_9(size: str = "tiny") -> dict:
+    """Ragged text topic → length-bucketed batches → per-width train steps,
+    commit-after-step. Demonstrates the static-shape answer to variable-
+    length streams (SURVEY §7 hard part (a)): one cached XLA compile per
+    bucket width instead of padding every record to the maximum, with
+    ``bucket_efficiency`` = (bucketed token volume) / (pad-to-max volume)
+    reporting the compute the bucketing avoided."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import torchkafka_tpu as tk
+    from torchkafka_tpu.models import TransformerConfig, make_train_step
+
+    n_dev = len(jax.devices())
+    mesh = tk.make_mesh({"data": n_dev})
+    buckets = (16, 32, 64) if size == "tiny" else (64, 128, 256, 512)
+    max_w = buckets[-1]
+    cfg = (
+        TransformerConfig(vocab_size=512, d_model=64, n_layers=2, n_heads=4,
+                          n_kv_heads=2, d_ff=128, max_seq_len=max_w,
+                          dtype=jnp.float32)
+        if size == "tiny"
+        else TransformerConfig(max_seq_len=max_w)
+    )
+    n = 256 if size == "tiny" else 6144
+    local_batch = 2 * n_dev if size == "tiny" else 8 * n_dev
+
+    broker = tk.InMemoryBroker()
+    parts = max(n_dev, 4)
+    broker.create_topic("t9", partitions=parts)
+    rng = np.random.default_rng(0)
+    # Zipf-ish length mix: mostly short, a long tail — the shape that makes
+    # pad-to-max wasteful and bucketing worthwhile.
+    lengths = np.minimum(
+        (rng.pareto(1.2, n) * 0.15 * max_w + 5).astype(int), max_w
+    )
+    broker.produce_many(
+        "t9",
+        (
+            rng.integers(0, cfg.vocab_size, k).astype(np.int32).tobytes()
+            for k in lengths
+        ),
+    )
+    consumer = tk.MemoryConsumer(
+        broker, "t9", group_id="s9",
+        assignment=tk.partitions_for_process("t9", parts, 0, 1),
+    )
+    init_fn, step_fn = make_train_step(cfg, mesh, optax.adamw(1e-3))
+    params, opt_state = init_fn(jax.random.key(0))
+    state = {"p": params, "o": opt_state, "losses": []}
+    rows_by_width: dict[int, int] = {}
+
+    def step(batch):
+        toks = jnp.asarray(batch.data["tokens"])
+        w = toks.shape[1]
+        rows_by_width[w] = rows_by_width.get(w, 0) + batch.valid_count
+        # Mask: real rows AND real (pre-pad) positions within each row.
+        ln = np.asarray(batch.data["length"])
+        mask = (np.arange(w)[None, :] < ln[:, None]) & batch.valid_mask()[:, None]
+        state["p"], state["o"], loss = step_fn(
+            state["p"], state["o"], toks, jnp.asarray(mask.astype(np.int32))
+        )
+        state["losses"].append(loss)
+        return loss
+
+    with tk.KafkaStream(
+        consumer,
+        lambda rec: np.frombuffer(rec.value, np.int32),
+        batch_size=local_batch,
+        buckets=buckets,
+        pad_policy="pad",
+        mesh=mesh,
+        idle_timeout_ms=2000,
+        owns_consumer=True,
+    ) as stream:
+        rows, elapsed = _drain(stream, step, n)
+    losses = [float(x) for x in state["losses"]]
+    bucketed_tokens = sum(w * r for w, r in rows_by_width.items())
+    return _result(
+        "9:ragged-bucketed-train", rows, elapsed, stream,
+        {
+            "mesh": dict(mesh.shape),
+            "buckets": list(buckets),
+            "rows_per_width": {
+                int(w): int(r) for w, r in sorted(rows_by_width.items())
+            },
+            "bucket_efficiency": round(bucketed_tokens / (rows * max_w), 3),
+            "first_loss": round(losses[0], 4),
+            "last_loss": round(losses[-1], 4),
+        },
+    )
+
+
 SCENARIOS = {
     1: scenario_1,
     2: scenario_2,
@@ -590,6 +685,7 @@ SCENARIOS = {
     6: scenario_6,
     7: scenario_7,
     8: scenario_8,
+    9: scenario_9,
 }
 
 
