@@ -15,7 +15,7 @@ import optax
 import pytest
 
 from torchkafka_tpu.models import Transformer, TransformerConfig, make_train_step
-from torchkafka_tpu.models.transformer import _moe_mlp
+from torchkafka_tpu.models.transformer import _moe_mlp, router_aux
 from torchkafka_tpu.parallel import make_mesh
 
 MOE_CFG = TransformerConfig(
@@ -34,7 +34,8 @@ class TestRouting:
             "w_up": jnp.asarray(rng.normal(size=(4, 32, 64)), jnp.float32) * 0.1,
             "w_down": jnp.asarray(rng.normal(size=(4, 64, 32)), jnp.float32) * 0.1,
         }
-        out, aux = _moe_mlp(h, layer, MOE_CFG)
+        out, stats = _moe_mlp(h, layer, MOE_CFG)
+        aux = router_aux(stats, 2 * 8)
         href = np.asarray(h)
         logits = href @ np.asarray(layer["router"])
         probs = np.exp(logits - logits.max(-1, keepdims=True))
@@ -130,12 +131,15 @@ class TestCapacityDispatch:
         # capacity_factor = E covers even an all-tokens-to-one-expert router.
         cfg = dataclasses.replace(MOE_CFG, moe_dispatch="capacity",
                                   capacity_factor=float(MOE_CFG.n_experts))
-        out_c, aux_c = _moe_mlp_capacity(h, layer, cfg)
-        out_d, aux_d = _moe_mlp(h, layer, MOE_CFG)
+        out_c, stats_c = _moe_mlp_capacity(h, layer, cfg)
+        out_d, stats_d = _moe_mlp(h, layer, MOE_CFG)
         np.testing.assert_allclose(
             np.asarray(out_c), np.asarray(out_d), atol=1e-5
         )
-        np.testing.assert_allclose(float(aux_c), float(aux_d), rtol=1e-6)
+        np.testing.assert_allclose(
+            float(router_aux(stats_c, 16)), float(router_aux(stats_d, 16)),
+            rtol=1e-6,
+        )
 
     def test_tight_capacity_drops_but_stays_finite(self, rng):
         """Starved capacity: outputs stay finite, dropped (token, choice)
@@ -147,7 +151,7 @@ class TestCapacityDispatch:
         starve = dataclasses.replace(MOE_CFG, moe_dispatch="capacity",
                                      capacity_factor=0.01)
         assert moe_capacity(starve, 16) == 8  # the floor engages
-        out_s, aux_s = _moe_mlp_capacity(h, layer, starve)
+        out_s, _ = _moe_mlp_capacity(h, layer, starve)
         assert np.all(np.isfinite(np.asarray(out_s)))
         ample = dataclasses.replace(starve, capacity_factor=float(MOE_CFG.n_experts))
         out_a, _ = _moe_mlp_capacity(h, layer, ample)
@@ -212,21 +216,46 @@ class TestCapacityDispatch:
             dataclasses.replace(MOE_CFG, moe_group_size=0)
 
     def test_nondividing_group_size_stays_grouped(self, rng):
-        """A token count that doesn't divide moe_group_size must use the
-        largest dividing group, NOT collapse to one giant group (which
-        reinstates the quadratic dispatch)."""
+        """A token count that doesn't divide moe_group_size pads the tail
+        group with masked rows — groups stay full-size, padding contributes
+        nothing, and ample capacity still matches the dense path."""
         from torchkafka_tpu.models.transformer import _moe_mlp_capacity
 
         layer = self._layer(rng)
-        # n = 2*12*? tokens: b=2, s=12 → n=24; group target 256 → largest
-        # divisor ≤ 24 is 24... use target 10 → divisor 8.
+        # b=2, s=12 → n=24; group target 10 → 3 groups of 10, 6 pad rows.
         h = jnp.asarray(rng.normal(size=(2, 12, 32)), jnp.float32)
         cfg = dataclasses.replace(
             MOE_CFG, moe_dispatch="capacity",
             capacity_factor=float(MOE_CFG.n_experts), moe_group_size=10,
         )
-        out_c, _ = _moe_mlp_capacity(h, layer, cfg)  # groups of 8
+        out_c, _ = _moe_mlp_capacity(h, layer, cfg)
         out_d, _ = _moe_mlp(h, layer, MOE_CFG)
         np.testing.assert_allclose(
             np.asarray(out_c), np.asarray(out_d), atol=1e-5
         )
+
+    def test_prime_token_count_no_degenerate_groups(self, rng):
+        """A PRIME token count larger than the group size (the ADVICE-r3
+        degeneracy: the old largest-divisor search collapsed to 1-token
+        groups) now pads into full groups: outputs match the dense path
+        under ample capacity (no silent mass drop) and the aux stats
+        exclude the padding."""
+        from torchkafka_tpu.models.transformer import _moe_mlp_capacity
+
+        layer = self._layer(rng)
+        h = jnp.asarray(rng.normal(size=(1, 13, 32)), jnp.float32)  # n=13
+        cfg = dataclasses.replace(
+            MOE_CFG, moe_dispatch="capacity",
+            capacity_factor=float(MOE_CFG.n_experts), moe_group_size=8,
+        )  # 13 prime → 2 groups of 8, 3 pad rows
+        out_c, stats_c = _moe_mlp_capacity(h, layer, cfg)
+        out_d, stats_d = _moe_mlp(h, layer, MOE_CFG)
+        np.testing.assert_allclose(
+            np.asarray(out_c), np.asarray(out_d), atol=1e-5
+        )
+        # Padding must not leak into the routing statistics: the routed
+        # count sums to exactly n·k real assignments.
+        np.testing.assert_allclose(
+            np.asarray(stats_c), np.asarray(stats_d), rtol=1e-6
+        )
+        assert float(stats_c[0].sum()) == 13 * MOE_CFG.expert_top_k

@@ -77,7 +77,7 @@ def _attend_cached(x, q, cache_k, cache_v, valid, layer, cfg):
         # throughput/regularization tradeoff; at inference every token
         # gets its routed experts (standard MoE serving semantics — see
         # the moe_dispatch config comment).
-        mlp_out, _aux = _moe_mlp(h, layer, cfg)
+        mlp_out, _stats = _moe_mlp(h, layer, cfg)
         return x + mlp_out
     gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", h, load_weight(layer["w_gate"], cfg.dtype)))
     up = jnp.einsum("bsd,df->bsf", h, load_weight(layer["w_up"], cfg.dtype))
@@ -132,7 +132,7 @@ def prefill(params, cfg: TransformerConfig, tokens: jax.Array, max_len: int):
         k = jnp.einsum("bsd,dke->bske", h, load_weight(layer["wk"], cfg.dtype))
         v = jnp.einsum("bsd,dke->bske", h, load_weight(layer["wv"], cfg.dtype))
         k = _rope(k, positions, cfg.rope_theta)
-        x, _aux = model._layer(x, layer)
+        x, _stats = model._layer(x, layer)
         return x, (k, v)
 
     x, (ks, vs) = lax.scan(capture, x, params["layers"])

@@ -85,17 +85,19 @@ class TransformerConfig:
     # under any capacity anyway (standard MoE serving semantics).
     moe_dispatch: str = "dense"
     capacity_factor: float = 1.25
-    # Tokens dispatch within groups of (at most) this size — the actual
-    # group is the largest divisor of the token count ≤ this, so grouping
-    # never silently degrades to one giant group. The one-hot dispatch
-    # einsum costs n_g·E·C·D per group; ungrouped (n_g = all tokens) it
-    # grows QUADRATIC in tokens and dwarfs the expert MLP itself
-    # (measured 20x at 16k tokens); 256 keeps it a fraction of MLP cost.
+    # Tokens dispatch within groups of exactly this size (the tail group is
+    # padded with masked rows, so ANY token count — including primes —
+    # keeps full groups). The one-hot dispatch einsum costs n_g·E·C·D per
+    # group; ungrouped (n_g = all tokens) it grows QUADRATIC in tokens and
+    # dwarfs the expert MLP itself (measured 20x at 16k tokens); 256 keeps
+    # it a fraction of MLP cost.
     moe_group_size: int = 256
     # Pipeline parallelism: with a 'pp' mesh axis of size > 1 the layer
     # stack runs as a GPipe schedule (ops/pipeline.py) with this many
-    # microbatches (None = pipeline depth). The router aux loss is not
-    # collected under pp (the router still trains through the main loss).
+    # microbatches (None = pipeline depth). The router aux loss IS
+    # collected under pp: per-microbatch routing statistics accumulate
+    # through the schedule and psum across stages into exactly the
+    # full-batch statistic (see ``router_aux``).
     pp_microbatches: int | None = None
     # Fused blocked cross-entropy (ops/xent.py): None = auto block size,
     # >0 = that sequence block, 0 = disable (always full-logits dense CE).
@@ -253,6 +255,21 @@ def _rms_norm(x: jax.Array, scale: jax.Array) -> jax.Array:
     return (xf * rms).astype(x.dtype) * scale.astype(x.dtype)
 
 
+def router_aux(stats: jax.Array, n_tokens: int | jax.Array) -> jax.Array:
+    """Switch load-balance loss from routing sufficient statistics.
+
+    stats: [2, E] f32 — row 0 = Σ_tokens routed-one-hot (how many of the
+    token·top-k assignments landed on each expert), row 1 = Σ_tokens router
+    softmax prob per expert. aux = E · Σ_e (routed_e/N) · (probs_e/N),
+    minimized at top_k when routing is uniform. Keeping token SUMS (not the
+    pre-reduced scalar) is what lets pipeline parallelism collect the loss:
+    per-microbatch sums add across microbatches/stages/sequence shards into
+    exactly the full-batch statistic, where a product-of-means scalar would
+    not (mean of products ≠ product of means)."""
+    e = stats.shape[-1]
+    return e * jnp.sum((stats[0] / n_tokens) * (stats[1] / n_tokens))
+
+
 def _moe_mlp(
     h: jax.Array, layer: Mapping[str, jax.Array], cfg: "TransformerConfig"
 ) -> tuple[jax.Array, jax.Array]:
@@ -262,7 +279,8 @@ def _moe_mlp(
     reduces across ``ep`` (a psum XLA inserts). Exact w.r.t. the routing —
     no capacity-factor token dropping — at the cost of E/ep-fold local MLP
     compute; an all_to_all token-routing dispatch is the scale-up path.
-    h: [B, S, D] → (output [B, S, D], load-balance aux loss scalar)."""
+    h: [B, S, D] → (output [B, S, D], router stats [2, E] for
+    ``router_aux``)."""
     logits = jnp.einsum(
         "bsd,de->bse", h.astype(jnp.float32), layer["router"].astype(jnp.float32)
     )
@@ -281,14 +299,12 @@ def _moe_mlp(
         "ebsf,efd->ebsd", gate_e * up_e, load_weight(layer["w_down"], cfg.dtype)
     )
     out = jnp.einsum("ebsd,bse->bsd", out_e, combine.astype(cfg.dtype))
-    # Switch-style load balance: E * Σ_e (token fraction on e) * (mean prob e).
+    # Load-balance sufficient stats: token-summed routed counts and probs.
     routed = jnp.sum(
         jax.nn.one_hot(top_idx, cfg.n_experts, dtype=jnp.float32), axis=2
     )
-    aux = cfg.n_experts * jnp.sum(
-        routed.mean(axis=(0, 1)) * probs.mean(axis=(0, 1))
-    )
-    return out, aux
+    stats = jnp.stack([routed.sum(axis=(0, 1)), probs.sum(axis=(0, 1))])
+    return out, stats
 
 
 def moe_capacity(cfg: "TransformerConfig", n_tokens: int) -> int:
@@ -325,32 +341,42 @@ def _moe_mlp_capacity(
     b, s, d = h.shape
     n = b * s
     e, k = cfg.n_experts, cfg.expert_top_k
-    # Contiguous groups of the largest divisor of n ≤ the configured size
-    # (never one giant group — that reinstates the quadratic dispatch).
-    n_g = next(
-        size for size in range(min(cfg.moe_group_size, n), 0, -1)
-        if n % size == 0
-    )
-    g = n // n_g
+    # Contiguous groups of exactly ``moe_group_size`` tokens, the tail group
+    # padded with masked rows. Padding (vs the old largest-divisor search)
+    # keeps groups full-size for ANY token count: a prime n used to
+    # degenerate to 1-token groups, whose per-group capacity floor of 8
+    # slots/expert blew the dispatch up 8·E-fold (ADVICE r3).
+    n_g = min(cfg.moe_group_size, n)
+    g = -(-n // n_g)
+    n_pad = g * n_g
     cap = moe_capacity(cfg, n_g)
     x = h.reshape(n, d)
+    if n_pad != n:
+        x = jnp.pad(x, ((0, n_pad - n), (0, 0)))
+    # 1.0 for real tokens, 0.0 for padding: padded rows claim no capacity
+    # slots, combine to zero output, and are excluded from the aux stats.
+    valid = (jnp.arange(n_pad) < n).astype(jnp.float32)
     logits = jnp.einsum(
         "nd,de->ne", x.astype(jnp.float32), layer["router"].astype(jnp.float32)
     )
-    probs = jax.nn.softmax(logits, axis=-1)  # [N, E]
-    top_vals, top_idx = lax.top_k(probs, k)  # [N, K]
+    probs = jax.nn.softmax(logits, axis=-1)  # [N_pad, E]
+    top_vals, top_idx = lax.top_k(probs, k)  # [N_pad, K]
     gates = top_vals / jnp.maximum(top_vals.sum(-1, keepdims=True), 1e-9)
 
-    # Assignment order per group [K, n_g]: all primary choices outrank all
-    # secondary ones, tokens in sequence order within a tier.
-    idx_g = top_idx.reshape(g, n_g, k).transpose(0, 2, 1).reshape(g, k * n_g)
-    onehot = jax.nn.one_hot(idx_g, e, dtype=jnp.float32)  # [G, K·n_g, E]
+    def to_group_major(t: jax.Array) -> jax.Array:
+        """[N_pad, K] → [G, K·n_g]: all primary choices outrank all
+        secondary ones, tokens in sequence order within a tier."""
+        return t.reshape(g, n_g, k).transpose(0, 2, 1).reshape(g, k * n_g)
+
+    idx_g = to_group_major(top_idx)
+    valid_g = to_group_major(jnp.broadcast_to(valid[:, None], (n_pad, k)))
+    onehot = jax.nn.one_hot(idx_g, e, dtype=jnp.float32) * valid_g[..., None]
     pos = jnp.cumsum(onehot, axis=1) - onehot  # slot within (group, expert)
     keep = onehot * (pos < cap)  # overflow drops
     # dispatch/combine [G, K·n_g, E, C]: one-hot in the slot dim where kept.
     slot = jax.nn.one_hot(pos.astype(jnp.int32), cap, dtype=jnp.float32)
     dispatch = keep[..., None] * slot
-    gates_g = gates.reshape(g, n_g, k).transpose(0, 2, 1).reshape(g, k * n_g)
+    gates_g = to_group_major(gates)
     combine = dispatch * gates_g[..., None, None]
 
     # Expose the k axis to the einsums instead of tiling activations
@@ -373,17 +399,21 @@ def _moe_mlp_capacity(
     out_e = jnp.einsum(
         "gecf,efd->gecd", gate_e * up_e, load_weight(layer["w_down"], cfg.dtype)
     )
-    # Combine sums over (k, e, c) in one contraction → [G, n_g, D].
-    out = jnp.einsum("gknec,gecd->gnd", comb5, out_e).reshape(b, s, d)
+    # Combine sums over (k, e, c) in one contraction → [G, n_g, D]; padded
+    # rows combine to zero and are sliced off.
+    out = jnp.einsum("gknec,gecd->gnd", comb5, out_e)
+    out = out.reshape(n_pad, d)[:n].reshape(b, s, d)
 
-    # Same Switch load-balance aux as the dense path (computed on the
+    # Same Switch load-balance stats as the dense path (computed on the
     # PRE-capacity routing — the balance loss exists to prevent the very
-    # imbalance that causes capacity drops).
+    # imbalance that causes capacity drops). Padded rows excluded.
     routed = jnp.sum(
         jax.nn.one_hot(top_idx, e, dtype=jnp.float32), axis=1
-    )  # [N, E]
-    aux = e * jnp.sum(routed.mean(axis=0) * probs.mean(axis=0))
-    return out, aux
+    ) * valid[:, None]  # [N_pad, E]
+    stats = jnp.stack(
+        [routed.sum(axis=0), (probs * valid[:, None]).sum(axis=0)]
+    )
+    return out, stats
 
 
 def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
@@ -470,6 +500,8 @@ class Transformer:
     def _layer(
         self, x: jax.Array, layer: Mapping[str, jax.Array]
     ) -> tuple[jax.Array, jax.Array]:
+        """One decoder layer. Returns (activation, router stats [2, E] for
+        MoE configs / [2, 1] zeros otherwise — see ``router_aux``)."""
         cfg = self.cfg
         positions = self._seq_positions(x.shape[1])
         h = _rms_norm(x, layer["ln1"])
@@ -492,12 +524,12 @@ class Transformer:
         x = x + jnp.einsum("bshe,hed->bsd", attn, load_weight(layer["wo"], cfg.dtype))
         h = _rms_norm(x, layer["ln2"])
         if cfg.is_moe:
-            mlp_out, aux = self._moe_mlp(h, layer)
-            return x + mlp_out, aux
+            mlp_out, stats = self._moe_mlp(h, layer)
+            return x + mlp_out, stats
         gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", h, load_weight(layer["w_gate"], cfg.dtype)))
         up = jnp.einsum("bsd,df->bsf", h, load_weight(layer["w_up"], cfg.dtype))
         x = x + jnp.einsum("bsf,fd->bsd", gate * up, load_weight(layer["w_down"], cfg.dtype))
-        return x, jnp.float32(0.0)
+        return x, jnp.zeros((2, 1), jnp.float32)
 
     def trunk(
         self, params: dict, tokens: jax.Array
@@ -508,10 +540,14 @@ class Transformer:
         blocked CE without ever materialising [B, S, V] logits."""
         cfg = self.cfg
         x = embed_rows(params["embed"], tokens, cfg.dtype)
+        n_tokens = tokens.shape[0] * tokens.shape[1]
 
         if self.mesh is not None and self.mesh.shape.get("pp", 1) > 1:
             # GPipe over the stacked layers; embed/head/norm stay outside the
-            # pipeline (replicated across pp). Aux losses are not collected.
+            # pipeline (replicated across pp). Router stats accumulate
+            # through the schedule (valid-tick masked) and psum across
+            # pp (and sp when manual) into full-batch sums — the aux here
+            # equals the pp=1 value up to summation order.
             # With sp>1 the stage also binds 'sp' manually so ring attention
             # runs its collectives directly inside the stage body.
             from jax.sharding import PartitionSpec as _P
@@ -524,28 +560,31 @@ class Transformer:
                     "a pp mesh with sp>1 requires sequence-parallel "
                     "attention (attn_impl='ring', 'ulysses', or 'auto')"
                 )
-            layer_fn = lambda a, layer: self._layer(a, layer)[0]  # noqa: E731
+            layer_fn = lambda a, layer: self._layer(a, layer)  # noqa: E731
             if cfg.remat:
                 layer_fn = jax.checkpoint(layer_fn)
-            x = gpipe(
+            x, stats = gpipe(
                 layer_fn, params["layers"], x,
                 mesh=self.mesh, axis="pp", microbatches=cfg.pp_microbatches,
                 extra_manual={"sp"} if sp_size > 1 else set(),
                 act_spec=_P(None, "sp", None) if sp_size > 1 else None,
+                collect_stats=True,
             )
-            auxes = jnp.zeros((cfg.n_layers,), jnp.float32)
         else:
             def body(x, layer):
-                x, aux = self._layer(x, layer)
-                return x, aux
+                x, stats = self._layer(x, layer)
+                return x, stats
 
             if cfg.remat:
                 body = jax.checkpoint(body)
             unroll = cfg.scan_unroll
             if unroll is None:
                 unroll = cfg.n_layers if cfg.n_layers <= 8 else 1
-            x, auxes = lax.scan(body, x, params["layers"], unroll=unroll)
-        return _rms_norm(x, params["ln_f"]), jnp.mean(auxes)
+            x, stats = lax.scan(body, x, params["layers"], unroll=unroll)
+        # stats: [L, 2, E] token-summed routing statistics; per-layer aux,
+        # averaged over layers (identical math in both branches).
+        aux = jnp.mean(jax.vmap(lambda s: router_aux(s, n_tokens))(stats))
+        return _rms_norm(x, params["ln_f"]), aux
 
     def __call__(
         self, params: dict, tokens: jax.Array, *, return_aux: bool = False
