@@ -83,6 +83,26 @@ class TestSaveRestore:
                 f"gc leaked dir {old}"
             )
 
+    def test_gc_waits_for_keep_complete_steps(self, tmp_path):
+        """ADVICE r3: while fewer than ``keep`` COMPLETE checkpoints exist,
+        GC must not run at all — a damaged dir older than the only complete
+        step stays on disk for forensics until the retention window truly
+        fills."""
+        ck = StreamCheckpointer(tmp_path / "ck", keep=2)
+        ck.save(1, _state(1), {TopicPartition("t", 0): 10})
+        with open(tmp_path / "ck" / "1" / "stream_offsets.json", "w") as f:
+            f.write("{truncated")  # step 1 now damaged (not in steps())
+        ck.save(2, _state(2), {TopicPartition("t", 0): 20})
+        assert ck.steps() == [2]  # one complete < keep=2 → no GC
+        assert (tmp_path / "ck" / "1").exists(), (
+            "damaged dir pruned before `keep` complete checkpoints existed"
+        )
+        ck.save(3, _state(3), {TopicPartition("t", 0): 30})
+        # Two complete steps now exist; the floor is step 2 and the damaged
+        # dir 1 ages out under the normal retention policy.
+        assert ck.steps() == [2, 3]
+        assert not (tmp_path / "ck" / "1").exists()
+
 
 class TestAsyncSave:
     def test_async_roundtrip(self, tmp_path):

@@ -312,13 +312,16 @@ class StreamCheckpointer:
         newer complete saves age it out. Deleting an aged-out damaged dir is
         the same retention policy as for healthy ones: had its offsets file
         been intact, age-based GC would prune the dir at this point anyway,
-        and ``keep`` newer complete checkpoints exist by construction."""
+        and ``keep`` newer complete checkpoints exist by construction —
+        GC runs ONLY once that many complete steps exist (ADVICE r3: the
+        early regime used the oldest complete step as the floor, pruning
+        forensic dirs sooner than this docstring promised)."""
         if not self._keep:
             return
         steps = self.steps()
-        if not steps:
+        if len(steps) < self._keep:
             return
-        keep_floor = steps[-self._keep] if len(steps) >= self._keep else steps[0]
+        keep_floor = steps[-self._keep]
         import shutil
 
         for name in os.listdir(self._root):
