@@ -416,6 +416,31 @@ class TestStreamingGenerator:
                 float(line.rsplit(" ", 1)[1])
         consumer.close()
 
+    def test_decode_roofline_accounting(self, model):
+        """decode_roofline must measure the real tick program (chained
+        dispatches) and report self-consistent byte/bandwidth accounting;
+        the server must stay usable afterwards (donated pool rebound)."""
+        cfg, params = model
+        broker = tk.InMemoryBroker()
+        _topic(broker, 4)
+        consumer = tk.MemoryConsumer(broker, "p", group_id="g")
+        server = StreamingGenerator(
+            consumer, params, cfg, slots=2, prompt_len=P, max_new=MAX_NEW,
+        )
+        server.warmup()
+        r = server.decode_roofline(iters=2, windows=2)
+        assert r["device_tick_ms"] > 0
+        assert r["device_tok_s"] == pytest.approx(
+            2 / (r["device_tick_ms"] / 1e3), rel=0.01
+        )
+        total = r["weight_bytes"] + r["kv_pool_bytes"]
+        assert r["roofline_tok_s"] == pytest.approx(
+            2 * r["peak_hbm_gbs"] * 1e9 / total, rel=0.01
+        )
+        # Still serves after the measurement.
+        got = list(server.run(max_records=4))
+        assert len(got) == 4
+
     def test_rejects_bad_config(self, model):
         cfg, params = model
         consumer = object()
@@ -518,6 +543,35 @@ class TestOutputTopic:
         assert committed < 6
         c2 = tk.MemoryConsumer(broker, "out", group_id="g2")
         assert len(c2.poll(max_records=100, timeout_ms=200)) == 5
+
+    def test_send_failure_streak_fail_stops(self, model):
+        """ADVICE r3: a PERSISTENTLY failing output send must not serve
+        forever behind a stalled watermark — after max_send_failure_streak
+        consecutive refusals the server raises OutputDeliveryError, the
+        same fail-stop signal as terminal async delivery failure, and
+        nothing past the stall commits."""
+        cfg, params = model
+        broker = tk.InMemoryBroker()
+        _topic(broker, 6)
+        broker.create_topic("out", partitions=1)
+        consumer = tk.MemoryConsumer(broker, "p", group_id="g")
+
+        class AlwaysDown(tk.MemoryProducer):
+            def send(self, topic, value, **kw):
+                raise RuntimeError("broker down")
+
+        server = StreamingGenerator(
+            consumer, params, cfg, slots=4, prompt_len=P, max_new=MAX_NEW,
+            commit_every=2, output_producer=AlwaysDown(broker),
+            output_topic="out", max_send_failure_streak=3,
+        )
+        with pytest.raises(tk.OutputDeliveryError, match="consecutive"):
+            list(server.run(max_records=6))
+        assert server.metrics.summary()["output_send_failures"] == 3
+        committed = sum(
+            broker.committed("g", tk.TopicPartition("p", p)) or 0 for p in (0, 1)
+        )
+        assert committed == 0  # every completion stayed uncommitted
 
     def test_terminal_delivery_failure_is_fatal(self, model):
         """A send that FAILED after the flush (async, terminal) must raise

@@ -44,6 +44,32 @@ from torchkafka_tpu.utils.metrics import Gauge, LatencyHistogram, RateMeter
 
 _logger = logging.getLogger(__name__)
 
+# v5e HBM peak; decode is bandwidth-bound, so this is the denominator of
+# every serving roofline in the repo (serve.decode_roofline, scenario 5).
+V5E_PEAK_HBM_GBS = 819.0
+
+
+def decode_tick_bytes(params, cfg: TransformerConfig, batch: int,
+                      max_len: int) -> tuple[int, int]:
+    """(weight_bytes, kv_bytes) streamed from HBM per decode tick.
+
+    Weights: every layer tensor and the lm_head are read in full (the
+    logits matmul contracts the whole [D, V] head), but the EMBEDDING
+    table is a gather of one row per slot — counting the full [V, D]
+    table would overstate bytes/tick ~5-7% at zoo scales. KV: both cache
+    halves across all layers at the STATIC pool length (attention reads
+    the whole buffer; masking discards, it does not skip)."""
+    from torchkafka_tpu.models.quant import quantized_nbytes
+
+    total = quantized_nbytes(params)
+    embed = quantized_nbytes(params["embed"])
+    embed_rows_read = batch * (embed // max(cfg.vocab_size, 1))
+    kv = (
+        2 * cfg.n_layers * batch * max_len * cfg.n_kv_heads * cfg.head_dim
+        * jnp.dtype(cfg.dtype).itemsize
+    )
+    return total - embed + embed_rows_read, kv
+
 
 class ServeMetrics:
     """Observability for the serving loop, mirroring StreamMetrics'
@@ -167,6 +193,7 @@ class StreamingGenerator:
         output_producer=None,
         output_topic: str | None = None,
         encode_output: Callable[[Record, np.ndarray], bytes] | None = None,
+        max_send_failure_streak: int = 64,
     ) -> None:
         """``ticks_per_sync``: decode ticks chained per device dispatch
         (and per host sync of the done mask). Higher amortises dispatch
@@ -184,7 +211,16 @@ class StreamingGenerator:
         flush SKIPS the commit (fail closed) — outputs are durable before
         the prompts that produced them commit, so a crash regenerates
         instead of losing completions (at-least-once end to end; the
-        output topic may see duplicates, keyed by the prompt's key)."""
+        output topic may see duplicates, keyed by the prompt's key).
+
+        ``max_send_failure_streak``: a SYNCHRONOUS send failure leaves its
+        record uncommitted (the watermark stalls there, it re-delivers on
+        restart) but serving continues — a transient output-broker blip
+        should not kill the server. After this many CONSECUTIVE sync
+        failures the output path is evidently down and every further
+        completion is un-committable replay work, so the server fail-stops
+        with ``OutputDeliveryError`` — the same signal the flush/get path
+        gives for terminal delivery failures (ADVICE r3)."""
         if prompt_len + max_new > cfg.max_seq_len:
             raise ValueError("prompt_len + max_new exceeds cfg.max_seq_len")
         if max_new < 2:
@@ -213,6 +249,10 @@ class StreamingGenerator:
         self._encode_output = encode_output or (
             lambda rec, toks: np.asarray(toks, np.int32).tobytes()
         )
+        if max_send_failure_streak < 1:
+            raise ValueError("max_send_failure_streak must be >= 1")
+        self._max_send_failure_streak = max_send_failure_streak
+        self._send_failure_streak = 0
         self._pending_outputs: list = []  # send handles since last commit
         self._ledger = OffsetLedger()
         self._max_len = prompt_len + max_new
@@ -220,7 +260,7 @@ class StreamingGenerator:
         self._build()
 
     def _build(self) -> None:
-        cfg, params = self._cfg, self._params
+        cfg = self._cfg
         B, P, M = self._slots, self._prompt_len, self._max_len
         nl, kh, dh = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
         temp = self._temperature
@@ -232,7 +272,7 @@ class StreamingGenerator:
                 jnp.int32
             )
 
-        def admit(caches, last_tok, pos, gen, prompts, admit_mask, key):
+        def admit(params, caches, last_tok, pos, gen, prompts, admit_mask, key):
             """Prefill the full [B, P] prompt batch; merge admitted rows in.
             prompts: [B, P] int32; admit_mask: [B] bool."""
             logits, fresh = prefill(params, cfg, prompts, M)
@@ -248,7 +288,7 @@ class StreamingGenerator:
 
         K = self._ticks_per_sync
 
-        def tick_block(caches, last_tok, pos, gen, active_in, key):
+        def tick_block(params, caches, last_tok, pos, gen, active_in, key):
             """K chained decode ticks in ONE dispatch (static K), with a
             LATCHED done mask: a slot that completes at inner tick j is
             masked out of ticks j+1..K, so its output cannot be clobbered.
@@ -313,8 +353,14 @@ class StreamingGenerator:
         # Donate the cache pool: admit/tick rebuild it every call, and
         # without donation each dispatch copies the full [L, B, M, K, Dh]
         # pair. The run loop rebinds the returned buffers immediately.
-        self._admit_fn = jax.jit(admit, donate_argnums=(0,))
-        self._tick_fn = jax.jit(tick_block, donate_argnums=(0,))
+        # Params travel as an ARGUMENT, not a closure: a closed-over param
+        # tree lowers as jaxpr constants, and at zoo scale (2.5-8 GB) that
+        # bloats lowering/compile memory and ships the weights inside the
+        # program instead of referencing the resident device buffers.
+        _admit = jax.jit(admit, donate_argnums=(1,))
+        _tick = jax.jit(tick_block, donate_argnums=(1,))
+        self._admit_fn = lambda *a: _admit(self._params, *a)
+        self._tick_fn = lambda *a: _tick(self._params, *a)
         self._caches = (
             jnp.zeros((nl, B, M, kh, dh), cfg.dtype),
             jnp.zeros((nl, B, M, kh, dh), cfg.dtype),
@@ -322,6 +368,67 @@ class StreamingGenerator:
         self._last_tok = jnp.zeros((B,), jnp.int32)
         self._pos = jnp.zeros((B,), jnp.int32)
         self._gen = jnp.zeros((B, self._max_new), jnp.int32)
+
+    def decode_roofline(
+        self, *, iters: int = 8, windows: int = 3,
+        peak_hbm_gbs: float = V5E_PEAK_HBM_GBS,
+    ) -> dict:
+        """Pure DEVICE decode speed with HBM-bandwidth roofline accounting.
+
+        Decode is weight/KV-streaming bound: every tick reads the full
+        parameter set plus the slot KV pool for one token per slot. This
+        measures the decode tick program alone — ``iters`` chained
+        dispatches per window (an in-order device queue keeps the chain
+        honest through high-latency transports, the same discipline as the
+        kernel benches), scalar fetch as completion proof, median of
+        ``windows`` — and reports achieved bytes/s against the chip's peak
+        (v5e: ~819 GB/s), the serving analog of training's MFU. The gap
+        between the run loop's end-to-end tokens/s and this number is
+        host/tunnel/admission overhead; the gap between this and 100%
+        roofline is the program's own inefficiency."""
+        cfg = self._cfg
+        B, K = self._slots, self._ticks_per_sync
+        active = jnp.ones((B,), bool)
+        key = jax.random.key(1)
+        # Every tick donates the cache pool, so rebind self state after
+        # EVERY dispatch: an exception mid-measurement (a transport blip on
+        # the tunneled targets this exists for) must not leave the server
+        # holding a donated, deleted buffer.
+        times = []
+        out = self._tick_fn(
+            self._caches, self._last_tok, self._pos, self._gen, active, key
+        )
+        self._caches, self._last_tok, self._pos, self._gen = out[:4]
+        int(np.asarray(jax.device_get(out[5]))[0])  # fence the warm call
+        for _ in range(windows):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = self._tick_fn(
+                    self._caches, self._last_tok, self._pos, self._gen,
+                    active, key,
+                )
+                self._caches, self._last_tok, self._pos, self._gen = out[:4]
+            int(np.asarray(jax.device_get(out[5]))[0])  # completion proof
+            times.append((time.perf_counter() - t0) / (iters * K))
+        tick_s = float(np.median(times))
+        w_bytes, kv_bytes = decode_tick_bytes(
+            self._params, cfg, B, self._max_len
+        )
+        bytes_per_tick = w_bytes + kv_bytes
+        achieved_gbs = bytes_per_tick / tick_s / 1e9
+        roofline_tok_s = B * peak_hbm_gbs * 1e9 / bytes_per_tick
+        return {
+            "device_tick_ms": round(tick_s * 1e3, 3),
+            "device_tok_s": round(B / tick_s, 1),
+            "weight_bytes": w_bytes,
+            "kv_pool_bytes": kv_bytes,
+            "weight_bytes_g": round(w_bytes / 1e9, 3),
+            "kv_pool_bytes_g": round(kv_bytes / 1e9, 3),
+            "achieved_hbm_gbs": round(achieved_gbs, 1),
+            "peak_hbm_gbs": peak_hbm_gbs,
+            "hbm_roofline_pct": round(100 * achieved_gbs / peak_hbm_gbs, 1),
+            "roofline_tok_s": round(roofline_tok_s, 1),
+        }
 
     def warmup(self) -> None:
         """Compile the admit and decode programs (no-op inputs) so the
@@ -454,14 +561,33 @@ class StreamingGenerator:
                                     key=rec.key,
                                 )
                             )
+                            self._send_failure_streak = 0
                         except Exception:  # noqa: BLE001 - fail closed per record
                             sent_ok = False
                             self.metrics.output_send_failures.add(1)
+                            self._send_failure_streak += 1
                             _logger.exception(
                                 "output send failed for %s@%d:%d; leaving "
                                 "it uncommitted to re-deliver",
                                 rec.topic, rec.partition, rec.offset,
                             )
+                            if (
+                                self._send_failure_streak
+                                >= self._max_send_failure_streak
+                            ):
+                                # The output path is down, not blinking:
+                                # every further completion would be
+                                # un-committable replay work behind a
+                                # permanently stalled watermark. Fail-stop
+                                # like the flush/get path so the operator
+                                # gets one signal for "output lost".
+                                raise OutputDeliveryError(
+                                    f"{self._send_failure_streak} "
+                                    "consecutive output send failures; "
+                                    "failing stop so uncommitted prompts "
+                                    "re-deliver instead of serving into a "
+                                    "stalled commit watermark"
+                                )
                     if sent_ok:
                         self._ledger.emitted(rec)
                         uncommitted += 1
