@@ -15,6 +15,15 @@ Modes:
           barriers must fail CLOSED (nothing committed for batch 3): either
           the BarrierWatchdog fires (exit 42) or the coordination service
           notices the dead peer and the barrier raises BarrierError (exit 43).
+  elastic — ELASTIC GROUP MODE across real processes: every process is a
+          group-managed member (pod_consumer(assignment=None)) of ONE
+          shared broker served by the parent over a BrokerServer socket
+          (<port> is the broker port, not a jax coordinator). Member
+          nproc-1 consumes two batches, commits only the first, and
+          LEAVES; the survivors' next group sync absorbs its partitions
+          and re-delivers exactly the uncommitted batch. No jax here on
+          purpose: elasticity is Kafka-protocol-side (per-host consumers),
+          and the subject is the group rebalance, not collectives.
   serve — each process runs the continuous-batching generation server over
           its own partition slice (replicated tiny model): pod serving is
           embarrassingly parallel per host, but the jax.distributed runtime
@@ -150,7 +159,126 @@ def ckpt_main(pid: int, nproc: int, outdir: str, mark) -> int:
     return 0
 
 
+ELASTIC_PARTITIONS = 4
+ELASTIC_RECORDS_PER_PARTITION = 50
+
+
+def _wait_for_marker(outdir: str, name: str, pids, timeout_s: float = 60.0) -> None:
+    import time as _time
+
+    deadline = _time.monotonic() + timeout_s
+    want = [os.path.join(outdir, f"{name}_{p}.json") for p in pids]
+    while not all(os.path.exists(p) for p in want):
+        if _time.monotonic() > deadline:
+            raise TimeoutError(f"markers {want} never appeared")
+        _time.sleep(0.02)
+
+
+def elastic_main(pid: int, nproc: int, broker_port: int, outdir: str, mark) -> int:
+    """One group-managed member of a SHARED cross-process consumer group.
+
+    All members gate consumption on everyone having joined (so membership—
+    and therefore the range assignment—is stable before the first fetch;
+    without the gate, a record consumed-uncommitted by an early member and
+    reassigned at a later join would legitimately re-deliver and poison the
+    parent's exactness assertions). Member nproc-1 then consumes two
+    batches from its partitions, commits only the first, and leaves.
+    """
+    import functools
+    import time as _time
+
+    import torchkafka_tpu as tk
+    from torchkafka_tpu.errors import CommitFailedError
+    from torchkafka_tpu.parallel.multihost import pod_consumer
+
+    client = tk.BrokerClient("127.0.0.1", broker_port)
+    consumer = pod_consumer(
+        "t",
+        ELASTIC_PARTITIONS,
+        "g",
+        transport=functools.partial(tk.MemoryConsumer, client),
+        assignment=None,  # ELASTIC: broker-side group membership
+        member_id=f"member-{pid}",
+    )
+    ids = lambda recs: [[r.partition, r.offset] for r in recs]  # noqa: E731
+
+    # Join is done (construction); gate until the whole group is in.
+    mark("joined")
+    _wait_for_marker(outdir, "joined", range(nproc))
+    pre_leave = sorted(
+        (tp.topic, tp.partition) for tp in consumer.assignment()
+    )
+    assert pre_leave, "every member must own partitions (4 > 3)"
+
+    if pid == nproc - 1:
+        # The leaver: batch 1 committed, batch 2 abandoned uncommitted.
+        batch1 = consumer.poll(max_records=20, timeout_ms=2000)
+        consumer.commit()
+        batch2 = consumer.poll(max_records=10, timeout_ms=2000)
+        mark("leaver", {"committed": ids(batch1), "uncommitted": ids(batch2)})
+        consumer.close()  # leave-group -> eager rebalance on the broker
+        client.close()
+        return 0
+
+    # Survivors: consume-and-commit until the leaver is gone and every
+    # owned partition is drained. Commits racing the rebalance may fail
+    # generation-checked — that is the at-least-once contract, not an
+    # error; the records simply re-deliver.
+    consumed: list[list[int]] = []
+    empty_after_leave = 0
+    post_leave_assignment = None
+    while True:
+        recs = consumer.poll(max_records=20, timeout_ms=200)
+        consumed.extend(ids(recs))
+        if recs:
+            try:
+                consumer.commit()
+            except CommitFailedError:
+                pass
+        left = os.path.exists(os.path.join(outdir, f"leaver_{nproc - 1}.json"))
+        if left and post_leave_assignment is None:
+            # Latch the snapshot when our assignment CHANGES from the
+            # gate-time one: with stable membership between the gate and
+            # the leave, any change proves the broker processed the leave
+            # (a length test alone is racy — a member's pre-leave share
+            # can already equal the post-leave share, and the marker is
+            # written moments before close() sends the leave). LATCHED at
+            # first observation: the other survivor finishing later
+            # triggers a further rebalance, which must not reopen the
+            # exit condition (deadlock) nor pollute recorded coverage.
+            assign_now = consumer.assignment()
+            if assign_now and sorted(
+                (tp.topic, tp.partition) for tp in assign_now
+            ) != pre_leave:
+                post_leave_assignment = [
+                    [tp.topic, tp.partition] for tp in assign_now
+                ]
+        if post_leave_assignment is not None and not recs:
+            if all(v == 0 for v in consumer.lag().values()):
+                empty_after_leave += 1
+                if empty_after_leave >= 3:
+                    break
+        else:
+            empty_after_leave = 0
+        _time.sleep(0.01)
+    mark("survivor", {"consumed": consumed, "assignment": post_leave_assignment})
+    consumer.close()
+    client.close()
+    return 0
+
+
 def main(pid: int, nproc: int, port: str, outdir: str, mode: str) -> int:
+    if mode == "elastic":
+
+        def mark_elastic(name: str, payload=None) -> None:
+            path = os.path.join(outdir, f"{name}_{pid}.json")
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(payload if payload is not None else {}, f)
+            os.replace(tmp, path)
+
+        return elastic_main(pid, nproc, int(port), outdir, mark_elastic)
+
     import jax
 
     def mark(name: str, payload=None) -> None:

@@ -1,0 +1,95 @@
+"""Socket-RPC broker transport: the same broker surface across connections.
+
+The unit tier for source/netbroker.py (the multi-PROCESS elastic test lives
+in tests/test_pod.py): protocol roundtrip, exception marshalling, and the
+property the transport exists for — two ``MemoryConsumer``s on separate
+client connections share ONE consumer group with real rebalances.
+"""
+
+import pytest
+
+import torchkafka_tpu as tk
+from torchkafka_tpu.errors import CommitFailedError, UnknownTopicError
+from torchkafka_tpu.source.records import TopicPartition
+
+
+@pytest.fixture()
+def server():
+    with tk.BrokerServer() as s:
+        yield s
+
+
+def _client(server):
+    return tk.BrokerClient(server.host, server.port)
+
+
+class TestBrokerRPC:
+    def test_produce_fetch_roundtrip(self, server):
+        with _client(server) as c:
+            c.create_topic("t", partitions=2)
+            rec = c.produce("t", b"payload", key=b"k", partition=1)
+            assert rec.offset == 0 and rec.partition == 1
+            got = c.fetch(TopicPartition("t", 1), 0, 10)
+            assert [r.value for r in got] == [b"payload"]
+            assert c.end_offset(TopicPartition("t", 1)) == 1
+            assert c.partitions_for("t") == 2
+
+    def test_exceptions_cross_the_wire(self, server):
+        with _client(server) as c:
+            with pytest.raises(UnknownTopicError):
+                c.partitions_for("nope")
+            c.create_topic("t")
+            c.join("g", "m0", frozenset({"t"}))
+            with pytest.raises(CommitFailedError, match="generation"):
+                # Stale generation: join bumped to 1, present 0.
+                c.commit("g", {TopicPartition("t", 0): 1},
+                         member_id="m0", generation=0)
+
+    def test_unknown_method_rejected(self, server):
+        with _client(server) as c:
+            with pytest.raises(ValueError, match="unknown method"):
+                c._call("_rebalance", None)
+
+    def test_commits_visible_across_clients(self, server):
+        with _client(server) as a, _client(server) as b:
+            a.create_topic("t")
+            a.commit("g", {TopicPartition("t", 0): 7})
+            assert b.committed("g", TopicPartition("t", 0)) == 7
+
+
+class TestSharedGroupAcrossConnections:
+    def test_two_consumers_one_group_rebalance(self, server):
+        """The headline property: UNCHANGED MemoryConsumers over separate
+        connections form one real group — join splits the partitions,
+        leave hands them back, uncommitted offsets re-deliver."""
+        server.broker.create_topic("t", partitions=2)
+        for p in (0, 1):
+            for i in range(4):
+                server.broker.produce("t", bytes([i]), partition=p)
+
+        c1 = tk.MemoryConsumer(_client(server), "t", group_id="g",
+                               member_id="m0")
+        assert len(c1.assignment()) == 2  # alone: owns both partitions
+        c2 = tk.MemoryConsumer(_client(server), "t", group_id="g",
+                               member_id="m1")
+        # The join rebalanced: one partition each.
+        assert len(c1.assignment()) == 1
+        assert len(c2.assignment()) == 1
+        assert {tp.partition for tp in c1.assignment()} | {
+            tp.partition for tp in c2.assignment()
+        } == {0, 1}
+
+        # c2 consumes 2 records, commits, consumes the rest uncommitted,
+        # then leaves; c1 absorbs the partition and re-delivers exactly
+        # the uncommitted tail.
+        (tp2,) = c2.assignment()
+        first = c2.poll(max_records=2)
+        c2.commit()
+        rest = c2.poll(max_records=10)
+        assert [r.offset for r in first] == [0, 1]
+        assert [r.offset for r in rest] == [2, 3]
+        c2.close()
+
+        assert len(c1.assignment()) == 2  # absorbed
+        redelivered = [r for r in c1.poll(max_records=100) if r.partition == tp2.partition]
+        assert [r.offset for r in redelivered] == [2, 3]

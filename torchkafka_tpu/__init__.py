@@ -32,6 +32,8 @@ from torchkafka_tpu.pipeline import KafkaStream, stream
 from torchkafka_tpu.source import (
     ChaosConsumer,
     Consumer,
+    BrokerClient,
+    BrokerServer,
     InMemoryBroker,
     KafkaConsumer,
     KafkaProducer,
@@ -71,6 +73,8 @@ __all__ = [
     "ChaosConsumer",
     "Consumer",
     "ConsumerClosedError",
+    "BrokerClient",
+    "BrokerServer",
     "InMemoryBroker",
     "KafkaConsumer",
     "KafkaProducer",

@@ -9,6 +9,7 @@ from torchkafka_tpu.source.kafka import (
     KafkaProducer,
 )
 from torchkafka_tpu.source.memory import InMemoryBroker, MemoryConsumer
+from torchkafka_tpu.source.netbroker import BrokerClient, BrokerServer
 from torchkafka_tpu.source.producer import (
     MemoryProducer,
     Producer,
@@ -18,6 +19,8 @@ from torchkafka_tpu.source.producer import (
 from torchkafka_tpu.source.records import Record, TopicPartition
 
 __all__ = [
+    "BrokerClient",
+    "BrokerServer",
     "ChaosConsumer",
     "Consumer",
     "HAVE_KAFKA_PYTHON",

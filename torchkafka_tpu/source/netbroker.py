@@ -1,0 +1,252 @@
+"""Socket-RPC transport for ``InMemoryBroker``: one broker, many processes.
+
+The in-memory broker (source/memory.py) implements the full consumer-group
+protocol — range assignment, generations, eager rebalance, generation-checked
+commits — but lives inside one Python process. Real elasticity questions
+("a member LEAVES mid-stream; do the survivors absorb its partitions and do
+its uncommitted records re-deliver?") are multi-PROCESS questions: each group
+member is its own OS process, exactly like the reference's per-DataLoader-
+worker consumers (/root/reference/src/kafka_dataset.py:208-233) and like one
+consumer per TPU pod host.
+
+``BrokerServer`` hosts an ``InMemoryBroker`` behind a localhost socket;
+``BrokerClient`` exposes the same *broker* surface over RPC. Because
+``MemoryConsumer`` talks only to that surface (join/leave/group_state/fetch/
+commit/...), the UNCHANGED consumer — including all its rebalance-sync logic
+— runs against a shared cross-process broker: the group protocol itself is
+what gets exercised, not a reimplementation of it.
+
+Scope: a hermetic test/pod-harness transport on a TRUSTED channel. Framing is
+length-prefixed pickle (the payloads are this package's own Record /
+TopicPartition values and broker exceptions); never expose the port beyond
+localhost or a trusted fabric — production traffic belongs to real Kafka via
+source/kafka.py.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from typing import Any
+
+from torchkafka_tpu.source.memory import InMemoryBroker
+
+_LEN = struct.Struct(">I")
+
+# The broker surface MemoryConsumer + tests use. An explicit allowlist: the
+# server dispatches nothing else (no arbitrary attribute access over the
+# wire).
+_METHODS = frozenset(
+    {
+        "create_topic",
+        "partitions_for",
+        "produce",
+        "end_offset",
+        "fetch",
+        "offset_for_time",
+        "join",
+        "leave",
+        "group_state",
+        "commit",
+        "committed",
+        "wait_for_data",
+    }
+)
+
+
+def _send(sock: socket.socket, obj: Any) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv(sock: socket.socket) -> Any:
+    header = _recv_exact(sock, _LEN.size)
+    if header is None:
+        raise ConnectionError("broker connection closed")
+    (n,) = _LEN.unpack(header)
+    payload = _recv_exact(sock, n)
+    if payload is None:
+        raise ConnectionError("broker connection closed mid-frame")
+    return pickle.loads(payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class BrokerServer:
+    """Serve an ``InMemoryBroker`` on a localhost socket.
+
+    One thread per connection: ``wait_for_data`` blocks server-side, so a
+    long-polling client must not starve others. The underlying broker is
+    already thread-safe (RLock).
+    """
+
+    def __init__(
+        self, broker: InMemoryBroker | None = None, host: str = "127.0.0.1",
+        port: int = 0,
+    ) -> None:
+        self.broker = broker if broker is not None else InMemoryBroker()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen()
+        self.host, self.port = self._sock.getsockname()
+        self._closing = False
+        self._conns: list[socket.socket] = []
+        self._lock = threading.Lock()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="broker-server-accept", daemon=True
+        )
+        self._accept_thread.start()
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return (self.host, self.port)
+
+    def _accept_loop(self) -> None:
+        while not self._closing:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # listener closed
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._lock:
+                self._conns.append(conn)
+            threading.Thread(
+                target=self._serve_conn, args=(conn,),
+                name="broker-server-conn", daemon=True,
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                try:
+                    method, args, kwargs = _recv(conn)
+                except (ConnectionError, OSError):
+                    return
+                if method not in _METHODS:
+                    _send(conn, ("err", ValueError(f"unknown method {method!r}")))
+                    continue
+                try:
+                    value = getattr(self.broker, method)(*args, **kwargs)
+                    _send(conn, ("ok", value))
+                except Exception as exc:  # noqa: BLE001 - marshalled to client
+                    _send(conn, ("err", exc))
+        finally:
+            conn.close()
+
+    def close(self) -> None:
+        self._closing = True
+        try:
+            self._sock.close()
+        finally:
+            with self._lock:
+                conns, self._conns = self._conns, []
+            for c in conns:
+                try:
+                    c.close()
+                except OSError:
+                    pass
+
+    def __enter__(self) -> "BrokerServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class BrokerClient:
+    """The ``InMemoryBroker`` surface, proxied over a ``BrokerServer`` socket.
+
+    Drop-in where a broker object is expected:
+    ``MemoryConsumer(BrokerClient(host, port), topic, group_id=...)`` gives a
+    group-managed consumer whose membership lives in the SERVER process —
+    several OS processes doing this share one real consumer group.
+
+    Thread-safe via a per-client request lock (one in-flight RPC per
+    client); a raising broker call re-raises the marshalled exception
+    (CommitFailedError and friends cross the wire intact).
+    """
+
+    def __init__(self, host: str, port: int, timeout_s: float = 30.0) -> None:
+        self._sock = socket.create_connection((host, port), timeout=timeout_s)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()
+        self._closed = False
+
+    def _call(self, method: str, *args: Any, **kwargs: Any) -> Any:
+        with self._lock:
+            if self._closed:
+                raise ConnectionError("broker client is closed")
+            _send(self._sock, (method, args, kwargs))
+            status, value = _recv(self._sock)
+        if status == "err":
+            raise value
+        return value
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._closed:
+                self._closed = True
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+
+    def __enter__(self) -> "BrokerClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ---- proxied broker surface (kept explicit: greppable + type-friendly)
+
+    def create_topic(self, topic, partitions=1):
+        return self._call("create_topic", topic, partitions)
+
+    def partitions_for(self, topic):
+        return self._call("partitions_for", topic)
+
+    def produce(self, topic, value, **kw):
+        return self._call("produce", topic, value, **kw)
+
+    def end_offset(self, tp):
+        return self._call("end_offset", tp)
+
+    def fetch(self, tp, offset, max_records):
+        return self._call("fetch", tp, offset, max_records)
+
+    def offset_for_time(self, tp, timestamp_ms):
+        return self._call("offset_for_time", tp, timestamp_ms)
+
+    def join(self, group_id, member_id, topics, pattern=None):
+        return self._call("join", group_id, member_id, topics, pattern=pattern)
+
+    def leave(self, group_id, member_id):
+        return self._call("leave", group_id, member_id)
+
+    def group_state(self, group_id, member_id):
+        return self._call("group_state", group_id, member_id)
+
+    def commit(self, group_id, offsets, member_id=None, generation=None):
+        return self._call(
+            "commit", group_id, offsets,
+            member_id=member_id, generation=generation,
+        )
+
+    def committed(self, group_id, tp):
+        return self._call("committed", group_id, tp)
+
+    def wait_for_data(self, timeout_s):
+        # Cap the server-side block below the socket timeout so a quiet
+        # broker never looks like a dead one.
+        return self._call("wait_for_data", min(timeout_s, 5.0))
