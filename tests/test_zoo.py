@@ -1,0 +1,98 @@
+"""Model zoo: named scales, benchmark-weight generation, byte accounting.
+
+The scale-real serving work (VERDICT r3 item 1) rests on two properties
+tested here cheaply (tiny shapes — the real scales only materialise on the
+bench chip): the zoo configs match their advertised parameter counts, and
+``random_serving_params(quantized=True)`` produces QTensor trees that (a)
+never materialise floats, (b) carry magnitudes matching the scaled-normal
+init, and (c) actually serve through the generate/serving stack.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchkafka_tpu.models.quant import QTensor
+from torchkafka_tpu.models.transformer import TransformerConfig
+from torchkafka_tpu.models.zoo import (
+    params_nbytes,
+    random_serving_params,
+    zoo_config,
+)
+
+TINY = TransformerConfig(
+    vocab_size=128, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+    d_ff=64, max_seq_len=24, dtype=jnp.float32,
+)
+
+
+def _analytic_params(cfg: TransformerConfig) -> int:
+    d, f, l, v = cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.vocab_size
+    attn = d * d * 2 + 2 * d * cfg.n_kv_heads * cfg.head_dim
+    return 2 * v * d + l * (attn + 3 * d * f + 2 * d) + d
+
+
+class TestZooConfigs:
+    @pytest.mark.parametrize(
+        "scale,lo,hi",
+        [("45m", 40e6, 50e6), ("1b", 1.0e9, 1.5e9), ("8b", 7.5e9, 8.5e9)],
+    )
+    def test_advertised_param_counts(self, scale, lo, hi):
+        n = _analytic_params(zoo_config(scale))
+        assert lo <= n <= hi, (scale, n)
+
+    def test_8b_is_llama3_shape(self):
+        cfg = zoo_config("8b")
+        assert (cfg.d_model, cfg.n_layers, cfg.n_heads, cfg.n_kv_heads,
+                cfg.d_ff, cfg.vocab_size) == (4096, 32, 32, 8, 14336, 128256)
+
+    def test_unknown_scale_rejected(self):
+        with pytest.raises(ValueError, match="unknown scale"):
+            zoo_config("70b")
+
+
+class TestRandomServingParams:
+    def test_quantized_tree_is_int8(self):
+        params = random_serving_params(jax.random.key(0), TINY, quantized=True)
+        for name in ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"):
+            leaf = params["layers"][name]
+            assert isinstance(leaf, QTensor)
+            assert leaf.q.dtype == jnp.int8
+        assert isinstance(params["embed"], QTensor)
+        assert isinstance(params["lm_head"], QTensor)
+        # int8 q dominates the bytes: the tree must be ~1 byte/param, not 4.
+        n = _analytic_params(TINY)
+        assert params_nbytes(params) < 2.2 * n
+
+    def test_dequantized_magnitude_matches_init(self):
+        """Benchmark weights must exercise realistic magnitudes: the
+        dequantized std tracks the trained path's 1/sqrt(fan_in)."""
+        params = random_serving_params(jax.random.key(0), TINY, quantized=True)
+        w = params["layers"]["w_gate"]
+        deq = np.asarray(w.q, np.float32) * np.asarray(w.scale)
+        assert deq.std() == pytest.approx(1.0 / np.sqrt(TINY.d_model), rel=0.15)
+
+    def test_moe_quantized_rejected(self):
+        cfg = dataclasses.replace(TINY, n_experts=4)
+        with pytest.raises(ValueError, match="MoE"):
+            random_serving_params(jax.random.key(0), cfg, quantized=True)
+
+    def test_quantized_params_generate(self):
+        """The benchmark weights must flow through the real serving path."""
+        from torchkafka_tpu.models.generate import generate
+
+        params = random_serving_params(jax.random.key(0), TINY, quantized=True)
+        prompt = jnp.asarray(
+            np.random.default_rng(0).integers(0, 128, (2, 8)), jnp.int32
+        )
+        out = generate(params, TINY, prompt, 4)
+        assert out.shape == (2, 4)
+        assert np.all((np.asarray(out) >= 0) & (np.asarray(out) < 128))
+
+    def test_unquantized_path_uses_param_dtype(self):
+        cfg = dataclasses.replace(TINY, param_dtype=jnp.bfloat16)
+        params = random_serving_params(jax.random.key(1), cfg, quantized=False)
+        assert params["layers"]["wq"].dtype == jnp.bfloat16

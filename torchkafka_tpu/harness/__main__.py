@@ -13,10 +13,18 @@ def main() -> None:
     ap.add_argument("--scenario", type=int, choices=sorted(SCENARIOS), default=None,
                     help="which BASELINE scenario; default: all")
     ap.add_argument("--size", choices=("tiny", "full"), default="tiny")
+    ap.add_argument("--model-scale", choices=("45m", "1b", "8b"), default=None,
+                    help="serving scenarios (5/7) only: serve the zoo model "
+                    "at this scale (8b = int8) with HBM roofline accounting")
     args = ap.parse_args()
-    nums = [args.scenario] if args.scenario else sorted(SCENARIOS)
+    if args.scenario:
+        nums = [args.scenario]
+    elif args.model_scale:
+        nums = [5, 7]  # the scenarios the flag applies to
+    else:
+        nums = sorted(SCENARIOS)
     for n in nums:
-        print(json.dumps(run_scenario(n, args.size)))
+        print(json.dumps(run_scenario(n, args.size, model_scale=args.model_scale)))
 
 
 if __name__ == "__main__":
