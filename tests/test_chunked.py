@@ -47,6 +47,37 @@ class TestFixedWidth:
         with pytest.raises(ValueError, match="uint16"):
             proc(rec)
 
+    def test_wire_bits_packs_and_unpacks_on_device(self):
+        """wire_bits ships rows as a dense bit stream (15-bit vocab = 15/16
+        of uint16 on the wire); the device-side unpack restores them."""
+        from torchkafka_tpu.native import packed_width
+        from torchkafka_tpu.ops.bitpack import unpack_bits
+
+        proc = fixed_width(4, dtype=np.int32, wire_bits=15)
+        stacked, keep = proc(_records(10))
+        assert keep is None
+        assert stacked.dtype == np.uint8
+        assert stacked.shape == (10, packed_width(4, 15))
+        np.testing.assert_array_equal(
+            np.asarray(unpack_bits(stacked, 15, 4))[3], [3, 3, 3, 3]
+        )
+
+    def test_wire_bits_overflow_rejected(self):
+        proc = fixed_width(1, dtype=np.int32, wire_bits=15)
+        rec = [Record("t", 0, 0, np.array([1 << 15], np.int32).tobytes())]
+        with pytest.raises(ValueError, match="bit"):
+            proc(rec)
+
+    def test_wire_bits_exclusive_with_wire_dtype(self):
+        with pytest.raises(ValueError, match="exclusive"):
+            fixed_width(4, wire_bits=15, wire_dtype=np.uint16)
+
+    def test_wire_bits_requires_integer_dtype(self):
+        # A float 3.7 would pass the [0, 2^bits) range guard and then
+        # truncate silently in the pack — reject at construction.
+        with pytest.raises(ValueError, match="integer"):
+            fixed_width(4, dtype=np.float32, wire_bits=15)
+
     def test_ragged_pads_and_truncates(self):
         proc = fixed_width(4, dtype=np.int32, pad_value=-1)
         recs = [

@@ -112,6 +112,44 @@ def gather_rows(
     return out
 
 
+# -------------------------------------------------------------- bit packing
+
+
+def packed_width(seq: int, bits: int) -> int:
+    """Bytes per packed row of ``seq`` values at ``bits`` bits each. The
+    device-side unpack (ops/bitpack.py) reads a 3-byte window per value
+    with tail indices clipped; no extra padding is needed — whenever a
+    value's bits spill past the second byte, that third byte necessarily
+    exists (the value's own bits occupy it), and a clipped duplicate byte
+    only ever contributes bit positions the mask discards."""
+    if not 1 <= bits <= 16:
+        raise ValueError("bits must be in [1, 16]")
+    return (seq * bits + 7) // 8
+
+
+def pack_bits(rows: np.ndarray, bits: int) -> np.ndarray:
+    """[n, s] non-negative ints < 2^bits → [n, packed_width] uint8, packed
+    as one little-endian bit stream per row. One C call per chunk when
+    native; NumPy packbits fallback with identical layout."""
+    n, s = rows.shape
+    w = packed_width(s, bits)
+    rows16 = np.ascontiguousarray(rows, dtype=np.uint16)
+    out = np.empty((n, w), dtype=np.uint8)
+    if n == 0:
+        return out
+    if _native is not None:
+        _native.pack_bits(rows16, out, bits, n, s, w)
+        return out
+    # Fallback: expand each value to its little-endian bits, pad the row's
+    # bit stream to w*8, and let packbits do the byte assembly.
+    bit_mat = (
+        (rows16[:, :, None] >> np.arange(bits, dtype=np.uint16)) & 1
+    ).astype(np.uint8).reshape(n, s * bits)
+    padded = np.zeros((n, w * 8), dtype=np.uint8)
+    padded[:, : s * bits] = bit_mat
+    return np.packbits(padded, axis=1, bitorder="little")
+
+
 # ---------------------------------------------------------------- json scan
 
 

@@ -354,9 +354,66 @@ PyObject* decode_png_rgb(PyObject*, PyObject* args) {
   Py_RETURN_NONE;
 }
 
+// ------------------------------------------------------------- bit packing
+//
+// Sub-byte wire codec for bounded-vocab token rows: values < 2^bits pack
+// into a little-endian bit stream per row (uint16 in, uint8 out). The
+// host packs (here, one C call per chunk); the accelerator unpacks with
+// vectorized shifts (ops/bitpack.py) — wire bytes are the ingest
+// pipeline's scarce resource, so a 15-bit vocab rides the wire at 15/16
+// of uint16.
+
+PyObject* pack_bits(PyObject*, PyObject* args) {
+  Py_buffer in;   // uint16, C-contiguous [n, s]
+  Py_buffer out;  // uint8, C-contiguous [n, w]
+  int bits;
+  Py_ssize_t n, s, w;
+  if (!PyArg_ParseTuple(args, "y*w*innn", &in, &out, &bits, &n, &s, &w)) {
+    return nullptr;
+  }
+  auto release = [&]() {
+    PyBuffer_Release(&in);
+    PyBuffer_Release(&out);
+  };
+  if (bits < 1 || bits > 16 ||
+      in.len != n * s * static_cast<Py_ssize_t>(sizeof(uint16_t)) ||
+      out.len != n * w || w * 8 < s * bits) {
+    release();
+    PyErr_SetString(PyExc_ValueError, "pack_bits buffer shape mismatch");
+    return nullptr;
+  }
+  const auto* src = static_cast<const uint16_t*>(in.buf);
+  auto* dst = static_cast<uint8_t*>(out.buf);
+  const uint32_t mask = (1u << bits) - 1u;
+  Py_BEGIN_ALLOW_THREADS;
+  for (Py_ssize_t r = 0; r < n; ++r) {
+    const uint16_t* row = src + r * s;
+    uint8_t* o = dst + r * w;
+    std::memset(o, 0, static_cast<size_t>(w));
+    uint32_t acc = 0;
+    int nbits = 0;
+    Py_ssize_t pos = 0;
+    for (Py_ssize_t i = 0; i < s; ++i) {
+      acc |= (static_cast<uint32_t>(row[i]) & mask) << nbits;
+      nbits += bits;
+      while (nbits >= 8) {
+        o[pos++] = static_cast<uint8_t>(acc & 0xFFu);
+        acc >>= 8;
+        nbits -= 8;
+      }
+    }
+    if (nbits > 0) o[pos] = static_cast<uint8_t>(acc & 0xFFu);
+  }
+  Py_END_ALLOW_THREADS;
+  release();
+  Py_RETURN_NONE;
+}
+
 PyMethodDef methods[] = {
     {"gather_rows", gather_rows, METH_VARARGS,
      "gather_rows(values, out_buffer, pad): pack bytes rows fixed-width"},
+    {"pack_bits", pack_bits, METH_VARARGS,
+     "pack_bits(in_u16, out_u8, bits, n, s, w): little-endian bit packing"},
     {"json_tokens", json_tokens, METH_VARARGS,
      "json_tokens(values, field, out_i32, keep_u8, pad_id): scan+tokenize"},
     {"decode_png_rgb", decode_png_rgb, METH_VARARGS,

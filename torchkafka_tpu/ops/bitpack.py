@@ -1,0 +1,53 @@
+"""Device-side unpack for the sub-byte wire codec (native.pack_bits).
+
+The ingest pipeline's scarce resource is host→device wire bytes (the
+fixed_width ``wire_dtype`` rationale); for a vocabulary that needs ``bits``
+< 16 bits, packing rows into a dense little-endian bit stream rides the
+wire at bits/16 of uint16. The host packs in C (one call per chunk); this
+op unpacks ON the accelerator — three gathers, a shift, and a mask, all
+vectorized and fused by XLA into whatever consumes the tokens (typically
+the embedding gather). TPU-native division of labour: compact bytes on the
+slow link, bit twiddling where the FLOPs are free.
+
+Layout contract (shared with native.pack_bits/packed_width): value i of a
+row occupies bit positions [i·bits, (i+1)·bits) of the row's little-endian
+bit stream. The 3-byte window read below clips its tail indices to the
+buffer; a clipped (duplicated) byte only ever contributes bit positions
+the final mask discards, so no row padding is needed.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from torchkafka_tpu.native import packed_width
+
+
+def unpack_bits(packed: jax.Array, bits: int, seq: int) -> jax.Array:
+    """[..., W] uint8 → [..., seq] int32 (W = packed_width(seq, bits)).
+
+    Jittable; differentiable nowhere (integer), used on the ingest path
+    before the embedding gather.
+    """
+    if not 1 <= bits <= 16:
+        raise ValueError("bits must be in [1, 16]")
+    w = packed.shape[-1]
+    expect = packed_width(seq, bits)
+    if w != expect:
+        raise ValueError(
+            f"packed width {w} != packed_width({seq}, {bits}) = {expect}"
+        )
+    start = jnp.arange(seq, dtype=jnp.int32) * bits
+    byte0 = start >> 3
+    shift = start & 7
+    b = packed.astype(jnp.int32)
+    # 3-byte little-endian window per value; packed_width guarantees the
+    # window is in bounds whenever its bits matter, and clipping the tail
+    # index only ever duplicates bytes the mask below discards.
+    last = w - 1
+    b0 = jnp.take(b, byte0, axis=-1)
+    b1 = jnp.take(b, jnp.minimum(byte0 + 1, last), axis=-1)
+    b2 = jnp.take(b, jnp.minimum(byte0 + 2, last), axis=-1)
+    window = b0 | (b1 << 8) | (b2 << 16)
+    return (window >> shift) & ((1 << bits) - 1)

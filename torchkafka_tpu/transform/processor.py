@@ -45,7 +45,8 @@ def is_chunked(fn: Callable) -> bool:
 
 
 def fixed_width(
-    seq_len: int, dtype=np.int32, pad_value: int = 0, wire_dtype=None
+    seq_len: int, dtype=np.int32, pad_value: int = 0, wire_dtype=None,
+    wire_bits: int | None = None,
 ) -> Callable:
     """Chunk processor for fixed-width binary records: each record value is
     ``seq_len`` items of ``dtype`` (the BASELINE token-stream shape). Exact-
@@ -59,12 +60,36 @@ def fixed_width(
     (HBM/PCIe/ICI all beat it); token ids under 65536 in ``uint16`` halve
     the wire bytes and gather into embeddings on-device without widening.
     The cast asserts the values fit (overflow would corrupt ids silently).
+
+    ``wire_bits``: go below byte granularity — rows pack into a dense
+    little-endian bit stream (native.pack_bits, one C call per chunk) and
+    travel as uint8[packed_width]; the consumer unpacks ON DEVICE with
+    ``ops.bitpack.unpack_bits(batch, wire_bits, seq_len)`` (three gathers
+    + shift + mask, fused into the embedding lookup). A 15-bit vocabulary
+    rides the wire at 15/16 of uint16. Exclusive with ``wire_dtype``;
+    requires non-negative values < 2^wire_bits (checked per chunk).
     """
+    if wire_bits is not None and wire_dtype is not None:
+        raise ValueError("wire_bits and wire_dtype are exclusive")
+    if wire_bits is not None and not 1 <= wire_bits <= 16:
+        raise ValueError("wire_bits must be in [1, 16]")
+    if wire_bits is not None and not np.issubdtype(np.dtype(dtype), np.integer):
+        # The range guard below cannot see fractional parts — a float 3.7
+        # passes [0, 2^bits) and then truncates silently in the pack.
+        raise ValueError("wire_bits requires an integer record dtype")
+
     @chunked
     def process(records: list[Record]):
         from torchkafka_tpu import native
 
         rows = native.gather_rows([r.value for r in records], seq_len, dtype, pad_value)
+        if wire_bits is not None:
+            if rows.size and (rows.min() < 0 or rows.max() >= 1 << wire_bits):
+                raise ValueError(
+                    f"record values outside [0, 2^{wire_bits}) — bit "
+                    "packing would corrupt them"
+                )
+            return native.pack_bits(rows, wire_bits), None
         if wire_dtype is not None:
             info = np.iinfo(wire_dtype)
             if rows.size and (rows.min() < info.min or rows.max() > info.max):
