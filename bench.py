@@ -323,6 +323,16 @@ def main() -> None:
     # Headline = MEDIAN over trials (robust to scheduler noise on this shared
     # box without crediting the best outlier); best and spread reported
     # alongside so the distribution is visible.
+    budget = [2 * trials + 6]
+    slices = max(1, int(os.environ.get("BENCH_SLICES", "4")))
+    n_o, n_b = N_OURS // slices, N_BASE // slices
+    # Untimed warmup slice per side, BEFORE the first wire probe (r3: the
+    # only losing pair was the FIRST — first-contact costs land there
+    # otherwise: broker fill + allocator growth, XLA compiles, transfer-
+    # route ramp, branch-cold Python; and the probe must sample pair 1's
+    # conditions, not pre-warmup conditions). Result discarded.
+    _one_trial(lambda: bench_ours(n_o), "ours-warmup", budget)
+    _one_trial(lambda: bench_reference_pattern(n_b), "ref-warmup", budget)
     try:
         wire = probe_wire_mb_s()
     except Exception as e:  # noqa: BLE001
@@ -332,7 +342,6 @@ def main() -> None:
     # minute-to-minute, so adjacent runs sample (nearly) the same transport
     # and the PER-PAIR ratio cancels the drift that swamps absolute numbers.
     # A wire probe before each pair records the conditions it ran under.
-    budget = [2 * trials + 6]
     ours_all: list[float] = []
     base_all: list[float] = []
     pair_ratios: list[float] = []
@@ -341,14 +350,6 @@ def main() -> None:
     # sides of a slice pair execute within seconds of each other, so the
     # per-trial ratio (sum of timed regions per side) samples near-identical
     # wire conditions even though the wire drifts several× across the run.
-    slices = max(1, int(os.environ.get("BENCH_SLICES", "4")))
-    n_o, n_b = N_OURS // slices, N_BASE // slices
-    # Untimed warmup slice per side (BENCH_r03: the only losing pair was the
-    # FIRST — first-contact costs land there otherwise: broker fill +
-    # allocator growth, XLA compiles, transfer-route ramp, branch-cold
-    # Python). Runs the exact slice workload, result discarded.
-    _one_trial(lambda: bench_ours(n_o), "ours-warmup", budget)
-    _one_trial(lambda: bench_reference_pattern(n_b), "ref-warmup", budget)
     for i in range(trials):
         if i > 0:
             try:

@@ -78,6 +78,12 @@ class TestFixedWidth:
         with pytest.raises(ValueError, match="integer"):
             fixed_width(4, dtype=np.float32, wire_bits=15)
 
+    def test_wire_bits_rejects_unpackable_pad(self):
+        # An out-of-range pad would otherwise fail per-chunk blaming the
+        # records instead of the configuration.
+        with pytest.raises(ValueError, match="pad_value"):
+            fixed_width(4, dtype=np.int32, wire_bits=15, pad_value=-1)
+
     def test_ragged_pads_and_truncates(self):
         proc = fixed_width(4, dtype=np.int32, pad_value=-1)
         recs = [

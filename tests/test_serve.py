@@ -429,10 +429,19 @@ class TestStreamingGenerator:
         )
         server.warmup()
         r = server.decode_roofline(iters=2, windows=2)
-        assert r["device_tick_ms"] > 0
-        assert r["device_tok_s"] == pytest.approx(
-            2 / (r["device_tick_ms"] / 1e3), rel=0.01
-        )
+        # The slope between the two windows can be ~0/negative for a toy
+        # model on CPU (both windows are dispatch noise); a degenerate
+        # slope must be FLAGGED (numeric fields None), never published as
+        # floored values.
+        if r["slope_ok"]:
+            assert r["device_tick_ms"] >= 0
+            if r["device_tick_ms"] > 1e-3:
+                assert r["device_tok_s"] == pytest.approx(
+                    2 / (r["device_tick_ms"] / 1e3), rel=0.01
+                )
+        else:
+            assert r["device_tick_ms"] is None
+            assert r["hbm_roofline_pct"] is None
         total = r["weight_bytes"] + r["kv_pool_bytes"]
         assert r["roofline_tok_s"] == pytest.approx(
             2 * r["peak_hbm_gbs"] * 1e9 / total, rel=0.01

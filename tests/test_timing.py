@@ -1,0 +1,28 @@
+"""Two-point slope timing: the shared dispatch-overhead-cancelling helper."""
+
+import pytest
+
+from torchkafka_tpu.utils.timing import two_point_slope
+
+
+class TestTwoPointSlope:
+    def test_cancels_constant_overhead(self):
+        # t(k) = 0.09 + 0.005*k: 90 ms dispatch + 5 ms/iter device work.
+        per_iter, overhead, ok = two_point_slope(
+            0.09 + 0.005 * 8, 0.09 + 0.005 * 40, 8, 40
+        )
+        assert ok
+        assert per_iter == pytest.approx(0.005)
+        assert overhead == pytest.approx(0.09)
+
+    def test_degenerate_slope_flagged(self):
+        # Transport sped up between windows: the long window came back
+        # FASTER than the short one. ok=False, floored value returned only
+        # so callers can avoid dividing by zero.
+        per_iter, _overhead, ok = two_point_slope(0.2, 0.15, 8, 40)
+        assert not ok
+        assert per_iter == 1e-9
+
+    def test_bad_chain_lengths_rejected(self):
+        with pytest.raises(ValueError, match="k_long"):
+            two_point_slope(0.1, 0.2, 8, 8)

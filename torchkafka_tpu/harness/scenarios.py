@@ -42,15 +42,24 @@ def _result(name: str, rows: int, elapsed: float, stream, extra: dict | None = N
     return out
 
 
-def _drain(stream, step: Callable[[Any], Any] | None, total: int) -> tuple[int, float]:
-    """Run the transactional loop (pipelined commits) until ``total`` rows
-    are consumed; the last commit is awaited inside the timed region."""
+def _drain(
+    stream, step: Callable[[Any], Any] | None, total: int,
+    sync_commit: bool = False,
+) -> tuple[int, float]:
+    """Run the transactional loop until ``total`` rows are consumed; the
+    last commit is durable inside the timed region. ``sync_commit`` commits
+    inline instead of through the FIFO commit thread — pair it with a
+    ``prefetch=0`` stream for latency-shaped loops (sub-ms batches), where
+    a per-batch executor handoff costs more than the commit itself."""
     rows = 0
     fut = None
     t0 = time.perf_counter()
     for batch, token in stream:
         wait = step(batch) if step is not None else None
-        fut = token.commit_async(wait_for=wait)
+        if sync_commit:
+            token.commit(wait_for=wait)
+        else:
+            fut = token.commit_async(wait_for=wait)
         rows += batch.valid_count
         if rows >= total:
             break
@@ -148,14 +157,27 @@ def scenario_1(size: str = "tiny", batch_size: int = 4, name: str = "1:single-pr
     consumer = tk.MemoryConsumer(
         broker, "t1", group_id="s1", assignment=[tk.TopicPartition("t1", 0)]
     )
+    # Batch 4 is latency-shaped: a per-batch thread handoff + commit-thread
+    # submit cost more than the 4-row batch itself, so small batches take
+    # the stream's documented synchronous mode (prefetch=0, inline commit)
+    # — symmetric with the reference pattern, which is also single-threaded.
+    # Large batches (scenario 6) keep the pipelined mode.
+    latency_shaped = batch_size < 64
+    stream_kw = dict(
+        to_device=False, idle_timeout_ms=1000, owns_consumer=True,
+        prefetch=0 if latency_shaped else 2,
+    )
     with tk.KafkaStream(
         consumer, tk.fixed_width(8, np.float32), batch_size=batch_size,
         # Host-only, like the reference it mirrors (its DataLoader yields CPU
         # torch tensors); shipping batch-of-4 arrays to an accelerator per
         # iteration would benchmark the transport, not the loop.
-        to_device=False, idle_timeout_ms=1000, owns_consumer=True,
+        **stream_kw,
     ) as stream:
-        rows, elapsed = _drain(stream, None, n // batch_size * batch_size)
+        rows, elapsed = _drain(
+            stream, None, n // batch_size * batch_size,
+            sync_commit=latency_shaped,
+        )
 
     def ours_slice(group_id: str, n_s: int):
         c = tk.MemoryConsumer(
@@ -164,9 +186,9 @@ def scenario_1(size: str = "tiny", batch_size: int = 4, name: str = "1:single-pr
         )
         with tk.KafkaStream(
             c, tk.fixed_width(8, np.float32), batch_size=batch_size,
-            to_device=False, idle_timeout_ms=1000, owns_consumer=True,
+            **stream_kw,
         ) as s:
-            return _drain(s, None, n_s)
+            return _drain(s, None, n_s, sync_commit=latency_shaped)
 
     paired = _paired_host_ratio(
         broker, "t1", 1, ours_slice,
@@ -430,54 +452,77 @@ def scenario_4(size: str = "tiny") -> dict:
     # Chained on-device iterations (VERDICT r3 item 2): the single-dispatch
     # number above bundles the transport round-trip with compute — honest
     # as "what one poll-to-answer costs" but useless for judging the conv
-    # stack. CHAIN forward passes run inside ONE dispatch, each iteration
-    # data-dependent on the last (the label sum perturbs the next input, so
-    # XLA cannot hoist or overlap them); per-iteration time is pure device
-    # compute, and conv MFU comes from the compiler's own FLOP count.
-    chain = 8
+    # stack. Two chain lengths run the forward in ONE dispatch each, every
+    # iteration data-dependent on the last (the label sum perturbs the next
+    # input, so XLA cannot hoist them); the SLOPE between the two timings
+    # cancels the constant dispatch+fetch overhead that otherwise floors
+    # any divide-by-K estimate (~90 ms/call here — 8 chained iterations
+    # still read ~12 ms/iter of pure overhead). Conv MFU uses the analytic
+    # ResNet-50 count (2·4.089 GFLOP/image at 224², scaled by resolution);
+    # XLA's cost analysis counts a fori_loop body once, not per trip.
+    def _chained(k):
+        def fn(imgs):
+            def body(_, carry):
+                s, _lab = carry
+                x = imgs + (s % 2).astype(imgs.dtype)
+                lab = jnp.argmax(
+                    resnet.forward(params, resnet.preprocess(x, out_size)),
+                    axis=-1,
+                ).astype(jnp.int32)
+                return jnp.sum(lab).astype(jnp.int32), lab
 
-    def _chained(imgs):
-        def body(_, carry):
-            s, _lab = carry
-            x = imgs + (s % 2).astype(imgs.dtype)
-            lab = jnp.argmax(
-                resnet.forward(params, resnet.preprocess(x, out_size)), axis=-1
-            ).astype(jnp.int32)
-            return jnp.sum(lab).astype(jnp.int32), lab
+            from jax import lax as _lax
 
-        from jax import lax as _lax
+            return _lax.fori_loop(
+                0, k, body,
+                (jnp.int32(0), jnp.zeros((imgs.shape[0],), jnp.int32)),
+            )[0]
 
-        return _lax.fori_loop(
-            0, chain, body,
-            (jnp.int32(0), jnp.zeros((imgs.shape[0],), jnp.int32)),
-        )[1]
+        return jax.jit(fn)
 
-    chained = jax.jit(_chained)
     extra_infer: dict = {}
     if jax.default_backend() == "tpu":
-        compiled = chained.lower(imgs_dev).compile()
-        int(compiled(imgs_dev)[0])  # warm
-        times = []
+        from torchkafka_tpu.utils.timing import two_point_slope
+
+        k_short, k_long = 8, 40
+        fns = {k: _chained(k) for k in (k_short, k_long)}
+        for fn in fns.values():
+            int(fn(imgs_dev))  # warm/compile both chain lengths first
+        # Interleave short/long timings so transport drift between the
+        # two chain lengths cannot flip the slope's sign.
+        shorts, longs = [], []
         for _ in range(3):
             t0 = _time.perf_counter()
-            int(compiled(imgs_dev)[0])
-            times.append((_time.perf_counter() - t0) / chain)
-        per_iter_s = float(np.median(times))
-        cost = compiled.cost_analysis() or {}
-        flops_per_call = float(cost.get("flops", 0.0))
-        mfu = (
-            flops_per_call / chain / per_iter_s / 197e12
-            if flops_per_call
-            else None
+            int(fns[k_short](imgs_dev))
+            shorts.append(_time.perf_counter() - t0)
+            t0 = _time.perf_counter()
+            int(fns[k_long](imgs_dev))
+            longs.append(_time.perf_counter() - t0)
+        per_iter_s, overhead_s, slope_ok = two_point_slope(
+            float(np.median(shorts)), float(np.median(longs)),
+            k_short, k_long,
         )
+        flops = 2 * 4.089e9 * batch * (out_size / 224) ** 2
         extra_infer = {
-            "device_infer_ms_chained": round(per_iter_s * 1e3, 2),
-            "tunnel_share_pct": round(
-                100 * (1 - per_iter_s * 1e3 / infer_ms), 1
-            ) if infer_ms else None,
-            "conv_flops_per_batch_g": round(flops_per_call / chain / 1e9, 1),
-            "conv_mfu_pct": round(100 * mfu, 1) if mfu is not None else None,
+            "slope_ok": slope_ok,
+            "dispatch_overhead_ms": round(overhead_s * 1e3, 1),
+            "conv_flops_per_batch_g": round(flops / 1e9, 1),
         }
+        if slope_ok:
+            extra_infer.update({
+                "device_infer_ms_chained": round(per_iter_s * 1e3, 2),
+                "tunnel_share_pct": round(
+                    100 * (1 - per_iter_s * 1e3 / infer_ms), 1
+                ) if infer_ms else None,
+                "conv_mfu_pct": round(100 * flops / per_iter_s / 197e12, 1),
+            })
+        else:
+            # Drift swamped the slope — flag, don't fabricate.
+            extra_infer.update({
+                "device_infer_ms_chained": None,
+                "tunnel_share_pct": None,
+                "conv_mfu_pct": None,
+            })
     return _result(
         "4:png-resnet-infer", rows, elapsed, stream,
         {

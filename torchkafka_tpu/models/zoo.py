@@ -107,18 +107,23 @@ def random_serving_params(
         "wo": (nl, h, dh, dm),
         "w_gate": (nl, dm, dff), "w_up": (nl, dm, dff), "w_down": (nl, dff, dm),
     }
-    keys = jax.random.split(rng, len(shapes) + 2)
-    layers: dict = {
-        "ln1": jnp.ones((nl, dm), jnp.float32),
-        "ln2": jnp.ones((nl, dm), jnp.float32),
-    }
-    for key, (name, shape) in zip(keys[2:], shapes.items()):
-        layers[name] = jax.jit(
-            lambda kk, s=shape, a=layer_axes[name]: _rand_q(kk, s, a)
-        )(key)
-    return {
-        "embed": jax.jit(lambda kk: _rand_q(kk, (v, dm), (1,)))(keys[0]),
-        "layers": layers,
-        "ln_f": jnp.ones((dm,), jnp.float32),
-        "lm_head": jax.jit(lambda kk: _rand_q(kk, (dm, v), (0,)))(keys[1]),
-    }
+
+    # ONE jitted program for the whole tree: per-leaf jits cost a separate
+    # compile each, and on remote-compile transports that is minutes of
+    # wall clock for what is seconds of device work.
+    def build(rng_key):
+        keys = jax.random.split(rng_key, len(shapes) + 2)
+        layers: dict = {
+            "ln1": jnp.ones((nl, dm), jnp.float32),
+            "ln2": jnp.ones((nl, dm), jnp.float32),
+        }
+        for key, (name, shape) in zip(keys[2:], shapes.items()):
+            layers[name] = _rand_q(key, shape, layer_axes[name])
+        return {
+            "embed": _rand_q(keys[0], (v, dm), (1,)),
+            "layers": layers,
+            "ln_f": jnp.ones((dm,), jnp.float32),
+            "lm_head": _rand_q(keys[1], (dm, v), (0,)),
+        }
+
+    return jax.jit(build)(rng)

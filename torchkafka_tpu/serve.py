@@ -377,11 +377,12 @@ class StreamingGenerator:
 
         Decode is weight/KV-streaming bound: every tick reads the full
         parameter set plus the slot KV pool for one token per slot. This
-        measures the decode tick program alone — ``iters`` chained
-        dispatches per window (an in-order device queue keeps the chain
-        honest through high-latency transports, the same discipline as the
-        kernel benches), scalar fetch as completion proof, median of
-        ``windows`` — and reports achieved bytes/s against the chip's peak
+        measures the decode tick program alone, as the SLOPE between two
+        window lengths (``iters`` and 3×``iters`` chained dispatches, each
+        fenced by one scalar fetch): the subtraction cancels the constant
+        dispatch/fetch overhead that otherwise floors a divide-by-N
+        estimate on high-latency transports (~90 ms/round-trip through the
+        dev tunnel). Reports achieved bytes/s against the chip's peak
         (v5e: ~819 GB/s), the serving analog of training's MFU. The gap
         between the run loop's end-to-end tokens/s and this number is
         host/tunnel/admission overhead; the gap between this and 100%
@@ -390,45 +391,69 @@ class StreamingGenerator:
         B, K = self._slots, self._ticks_per_sync
         active = jnp.ones((B,), bool)
         key = jax.random.key(1)
+
         # Every tick donates the cache pool, so rebind self state after
         # EVERY dispatch: an exception mid-measurement (a transport blip on
         # the tunneled targets this exists for) must not leave the server
         # holding a donated, deleted buffer.
-        times = []
-        out = self._tick_fn(
-            self._caches, self._last_tok, self._pos, self._gen, active, key
-        )
-        self._caches, self._last_tok, self._pos, self._gen = out[:4]
-        int(np.asarray(jax.device_get(out[5]))[0])  # fence the warm call
-        for _ in range(windows):
+        def window(n_dispatches: int) -> float:
             t0 = time.perf_counter()
-            for _ in range(iters):
+            for _ in range(n_dispatches):
                 out = self._tick_fn(
                     self._caches, self._last_tok, self._pos, self._gen,
                     active, key,
                 )
                 self._caches, self._last_tok, self._pos, self._gen = out[:4]
             int(np.asarray(jax.device_get(out[5]))[0])  # completion proof
-            times.append((time.perf_counter() - t0) / (iters * K))
-        tick_s = float(np.median(times))
+            return time.perf_counter() - t0
+
+        from torchkafka_tpu.utils.timing import two_point_slope
+
+        window(1)  # warm (compile + route)
+        # INTERLEAVED short/long windows: grouping all shorts before all
+        # longs lets a drifting transport flip the slope's sign.
+        shorts, longs = [], []
+        for _ in range(windows):
+            shorts.append(window(iters))
+            longs.append(window(3 * iters))
+        t_short, t_long = float(np.median(shorts)), float(np.median(longs))
+        tick_s, overhead_s, slope_ok = two_point_slope(
+            t_short, t_long, iters * K, 3 * iters * K
+        )
+        overhead_ms = overhead_s * 1e3
         w_bytes, kv_bytes = decode_tick_bytes(
             self._params, cfg, B, self._max_len
         )
         bytes_per_tick = w_bytes + kv_bytes
-        achieved_gbs = bytes_per_tick / tick_s / 1e9
         roofline_tok_s = B * peak_hbm_gbs * 1e9 / bytes_per_tick
-        return {
-            "device_tick_ms": round(tick_s * 1e3, 3),
-            "device_tok_s": round(B / tick_s, 1),
+        out = {
+            "slope_ok": slope_ok,
+            "dispatch_overhead_ms": round(overhead_ms, 1),
             "weight_bytes": w_bytes,
             "kv_pool_bytes": kv_bytes,
             "weight_bytes_g": round(w_bytes / 1e9, 3),
             "kv_pool_bytes_g": round(kv_bytes / 1e9, 3),
-            "achieved_hbm_gbs": round(achieved_gbs, 1),
             "peak_hbm_gbs": peak_hbm_gbs,
-            "hbm_roofline_pct": round(100 * achieved_gbs / peak_hbm_gbs, 1),
             "roofline_tok_s": round(roofline_tok_s, 1),
         }
+        if not slope_ok:
+            # The transport drifted more between windows than the device
+            # work separating them — publishing the floored values would
+            # fabricate numbers like 1e10 tok/s. Flag and return.
+            out.update({
+                "device_tick_ms": None, "device_tok_s": None,
+                "achieved_hbm_gbs": None, "hbm_roofline_pct": None,
+            })
+            return out
+        achieved_gbs = bytes_per_tick / tick_s / 1e9
+        out.update({
+            # 6 decimals: a toy model's tick is microseconds.
+            "device_tick_ms": round(tick_s * 1e3, 6),
+            "device_tok_s": round(B / tick_s, 1),
+            "achieved_hbm_gbs": round(achieved_gbs, 1),
+            "hbm_roofline_pct": round(100 * achieved_gbs / peak_hbm_gbs, 1),
+        })
+        return out
 
     def warmup(self) -> None:
         """Compile the admit and decode programs (no-op inputs) so the

@@ -77,6 +77,14 @@ def fixed_width(
         # The range guard below cannot see fractional parts — a float 3.7
         # passes [0, 2^bits) and then truncates silently in the pack.
         raise ValueError("wire_bits requires an integer record dtype")
+    if wire_bits is not None and not 0 <= pad_value < (1 << wire_bits):
+        # A short record padded with an out-of-range value would trip the
+        # per-chunk range guard with an error blaming the RECORDS; catch
+        # the misconfiguration where it lives, at construction.
+        raise ValueError(
+            f"pad_value {pad_value} outside [0, 2^{wire_bits}) — padded "
+            "rows could not be bit-packed"
+        )
 
     @chunked
     def process(records: list[Record]):

@@ -1,0 +1,34 @@
+"""Two-point slope timing for high-latency dispatch transports.
+
+Any timing of the form "run K device iterations, fetch, divide by K"
+carries the constant dispatch+fetch round trip in every estimate — ~90 ms
+through the dev tunnel, i.e. ~12 ms/iter of pure overhead at K=8, enough
+to bury the 4.7 ms quantity being measured (measured round 4, ResNet-50).
+Timing TWO chain lengths and taking the slope cancels the constant term
+exactly. One implementation, shared by serve.decode_roofline and the
+harness scenarios.
+"""
+
+from __future__ import annotations
+
+
+def two_point_slope(
+    t_short: float, t_long: float, k_short: int, k_long: int
+) -> tuple[float, float, bool]:
+    """(per_iteration_s, overhead_s, ok).
+
+    ``ok`` is False when the slope degenerates (t_long <= t_short): the
+    transport drifted between the two windows by more than the device work
+    separating them, and nothing numeric can honestly be derived — callers
+    must FLAG the measurement, not publish the floored values (a 1e-9
+    floor silently becomes "1.6e10 tok/s" downstream). The floored
+    per-iteration value is still returned so callers can avoid division
+    by zero while reporting the failure.
+    """
+    if k_long <= k_short:
+        raise ValueError("k_long must exceed k_short")
+    slope = (t_long - t_short) / (k_long - k_short)
+    ok = slope > 0
+    per_iter = max(slope, 1e-9)
+    overhead = max(t_short - k_short * per_iter, 0.0)
+    return per_iter, overhead, ok
