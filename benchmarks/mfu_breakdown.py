@@ -47,45 +47,61 @@ def train_flops_per_step(cfg: TransformerConfig, batch: int, seq: int) -> float:
 
 
 def timed(fn, *args, steps: int, fetch) -> float:
-    """Median of 3 timed windows of `steps` chained calls, strict fetch."""
+    """Two-point-slope over PYTHON-LOOP chains of jitted calls.
+
+    The slope cancels the constant fetch round trip but NOT the per-call
+    host dispatch cost (~10 ms/call through the dev tunnel), which scales
+    with the chain length: any piece whose device time is below the
+    dispatch cost reads as ~dispatch-rate here. Used only by
+    ``decompose``, whose output is presented as RELATIVE shares — for
+    honest device absolutes use ``utils.timing.device_step_seconds``
+    (fori-chained inside one jit), as ``run_config`` does."""
+    from torchkafka_tpu.utils.timing import two_point_slope
+
     outs = fn(*args)
     fetch(outs)  # compile + warmup
-    times = []
-    for _ in range(3):
+
+    def window(k: int) -> float:
         t0 = time.perf_counter()
-        o = outs
-        for _ in range(steps):
+        o = None
+        for _ in range(k):
             o = fn(*args)
         fetch(o)
-        times.append((time.perf_counter() - t0) / steps)
-    return float(np.median(times))
+        return time.perf_counter() - t0
+
+    shorts, longs = [], []
+    for _ in range(3):  # interleaved so drift can't flip the slope
+        shorts.append(window(steps))
+        longs.append(window(3 * steps))
+    per_iter, _ov, ok = two_point_slope(
+        float(np.median(shorts)), float(np.median(longs)), steps, 3 * steps
+    )
+    if not ok:
+        raise RuntimeError("transport drift swamped the timing slope; rerun")
+    return per_iter
 
 
 def run_config(cfg: TransformerConfig, batch: int, seq: int, steps: int) -> dict:
+    """Pure device step via the fori-chained slope (utils.timing): a
+    Python-loop chain of jitted calls on an RPC-dispatch transport
+    measures the HOST dispatch rate (~10 ms/call), not the device —
+    wall/step falls forever as the window grows instead of converging.
+    ``--steps`` sets the LONG window's loop length (short = a quarter)."""
+    from torchkafka_tpu.utils.timing import device_step_seconds
+
     mesh = jax.sharding.Mesh(np.array(jax.devices()[:1]), ("data",))
     init_fn, step_fn = make_train_step(cfg, mesh, optax.adamw(3e-4))
     params, opt_state = init_fn(jax.random.key(0))
     rng = np.random.default_rng(0)
     tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32)
     mask = jnp.ones((batch, seq), jnp.float32)
-
-    # step_fn donates params/opt_state; time with rebinding.
-    state = {"p": params, "o": opt_state}
-
-    def step():
-        state["p"], state["o"], loss = step_fn(state["p"], state["o"], tokens, mask)
-        return loss
-
-    loss = step()
-    float(loss)  # compile
-    times = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            loss = step()
-        float(loss)  # strict completion proof
-        times.append((time.perf_counter() - t0) / steps)
-    dt = float(np.median(times))
+    k_long = max(8, steps)
+    dt, ok = device_step_seconds(
+        step_fn, params, opt_state, tokens, mask,
+        k_short=max(2, k_long // 4), k_long=k_long,
+    )
+    if not ok:
+        raise RuntimeError("transport drift swamped the timing slope; rerun")
     fl = train_flops_per_step(cfg, batch, seq)
     return {"ms": dt * 1e3, "tflop": fl / 1e12, "mfu": fl / dt / V5E_BF16_PEAK}
 

@@ -330,48 +330,36 @@ def _train_mfu(cfg, state, step_fn, batch: int, seq: int, n_dev: int) -> dict:
 
     FLOPs/step ≈ 6·N_params·tokens (fwd+bwd matmul rule of thumb)
     + 6·L·d_model·B·S² (causal attention, fwd+bwd); peak = 197 TFLOP/s
-    bf16 per v5e chip × the mesh's device count. Timed as K chained
-    step_fn calls with a scalar fetch at the end — an in-order device
-    queue makes the chain honest even on transports where
-    block_until_ready returns early. The first (warmup) call is untimed:
-    these inputs' sharding differs from the training batches', so it may
-    trigger a fresh XLA compile that must not land in the timed region.
-    step_fn donates params/opt, so the chained values are rebound into
-    ``state`` to keep its buffers valid for later use."""
-    import time as _time
-
+    bf16 per v5e chip × the mesh's device count. Timed with
+    ``utils.timing.device_step_seconds`` — the step chained inside ONE
+    jitted fori_loop, sloped over two loop lengths. On RPC-dispatch
+    transports a Python-loop chain of jitted calls measures the HOST's
+    dispatch rate (~10 ms/call here), not the device: wall/step keeps
+    falling as the window grows and never converges."""
     import jax
     import jax.numpy as jnp
 
     from torchkafka_tpu.models.transformer import count_params
+    from torchkafka_tpu.utils.timing import device_step_seconds
 
     if jax.default_backend() != "tpu":
         return {}
     n_params = count_params(state["params"])
     tokens = jnp.zeros((batch, seq), jnp.int32)
     mask = jnp.ones((batch, seq), jnp.int32)
-    params, opt = state["params"], state["opt"]
-    params, opt, loss = step_fn(params, opt, tokens, mask)  # warmup/compile
-    float(loss)
-    # Median of 3 windows of 8 chained steps: a single short window through
-    # the tunnel draws several-ms of dispatch jitter into the mean.
-    k, windows = 8, []
-    for _ in range(3):
-        t0 = _time.perf_counter()
-        for _ in range(k):
-            params, opt, loss = step_fn(params, opt, tokens, mask)
-        float(loss)
-        windows.append((_time.perf_counter() - t0) / k)
-    step_s = float(np.median(windows))
-    # Donated buffers were invalidated along the chain; rebind the live ones.
-    state["params"], state["opt"] = params, opt
+    step_s, slope_ok = device_step_seconds(
+        step_fn, state["params"], state["opt"], tokens, mask
+    )
+    if not slope_ok:
+        return {"params_m": round(n_params / 1e6, 1), "slope_ok": False}
     flops = 6 * n_params * batch * seq + 6 * cfg.n_layers * cfg.d_model * batch * seq**2
     mfu = flops / step_s / (197e12 * n_dev)
     return {
         "params_m": round(n_params / 1e6, 1),
-        "step_ms": round(step_s * 1e3, 1),
+        "step_ms": round(step_s * 1e3, 2),
         "flops_per_step_g": round(flops / 1e9, 1),
         "mfu_pct": round(mfu * 100, 2),
+        "slope_ok": True,
     }
 
 
@@ -855,30 +843,19 @@ def scenario_8(size: str = "tiny") -> dict:
 
     # Ingest-vs-step decomposition (VERDICT r2): an end-to-end number that
     # can't state its split can't guide optimization. (a) PURE train step:
-    # chained calls on fixed device inputs, scalar fetch (honest through
-    # the tunnel). (b) PURE ingest: re-read the same broker under a fresh
-    # group with no device step.
-    import time as _time
-
+    # the fori-chained device slope. (b) PURE ingest: re-read the same
+    # broker under a fresh group with no device step.
     dense0 = jnp.zeros((local_batch, cfg.dense_dim), jnp.float32)
     cats0 = jnp.zeros((local_batch, len(cfg.vocab_sizes)), jnp.int32)
     label0 = jnp.zeros((local_batch,), jnp.float32)
     mask0 = jnp.ones((local_batch,), jnp.float32)
-    p, o = state["params"], state["opt"]
-    p, o, l0 = step_fn(p, o, dense0, cats0, label0, mask0)  # compile/warm
-    float(l0)
-    # Median of 3 windows of 4 chained steps (same scaffold rationale as
-    # _train_mfu: one short window through the tunnel draws several ms of
-    # dispatch jitter into a ~27 ms quantity).
-    k, windows = 4, []
-    for _ in range(3):
-        t0 = _time.perf_counter()
-        for _ in range(k):
-            p, o, l0 = step_fn(p, o, dense0, cats0, label0, mask0)
-        float(l0)
-        windows.append((_time.perf_counter() - t0) / k)
-    step_s = float(np.median(windows))
-    state["params"], state["opt"] = p, o  # donation: rebind live buffers
+    # Pure device step via the shared fori-chained slope (see _train_mfu's
+    # docstring for why Python-loop chains measure dispatch, not device).
+    from torchkafka_tpu.utils.timing import device_step_seconds
+
+    step_s, step_slope_ok = device_step_seconds(
+        step_fn, state["params"], state["opt"], dense0, cats0, label0, mask0
+    )
     c2 = tk.MemoryConsumer(
         broker, "ctr", group_id="s8-ingest",
         assignment=tk.partitions_for_process("ctr", parts, 0, 1),
@@ -926,12 +903,15 @@ def scenario_8(size: str = "tiny") -> dict:
             "mesh": dict(mesh.shape),
             "record_bytes": record_nbytes(cfg),
             "params_m": round(count_params(state["params"]) / 1e6, 1),
-            "step_ms_pure": round(step_s * 1e3, 1),
+            # Degenerate slope (transport drift) → flag, never publish the
+            # floored value (two_point_slope's contract).
+            "step_slope_ok": step_slope_ok,
+            "step_ms_pure": round(step_s * 1e3, 2) if step_slope_ok else None,
             "ingest_only_rows_per_s": round(ingest_rps, 1),
             **paired,
             "step_share_pct": round(
                 100 * (steps * step_s) / elapsed, 1
-            ) if elapsed else None,
+            ) if (elapsed and step_slope_ok) else None,
             "first_loss": round(losses[0], 4),
             "last_loss": round(losses[-1], 4),
             # Every step sees a FRESH batch (true streaming), so single-step

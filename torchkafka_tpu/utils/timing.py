@@ -12,6 +12,59 @@ harness scenarios.
 from __future__ import annotations
 
 
+def device_step_seconds(
+    step_fn, params, opt_state, *batch_args,
+    k_short: int = 2, k_long: int = 8, repeats: int = 3,
+) -> tuple[float, bool]:
+    """Pure DEVICE seconds per train step: (step_s, ok).
+
+    Chains the step INSIDE one jitted ``lax.fori_loop`` (so the host
+    dispatches once per window, not once per step) and slopes two loop
+    lengths. This matters on RPC-dispatch transports where each dispatch
+    costs ~10 ms of host work: a Python-loop chain of jitted calls there
+    measures the host's dispatch rate, not the device — wall/step keeps
+    FALLING as the window grows and never converges to the device time.
+
+    ``step_fn(params, opt, *batch_args) -> (params, opt, loss)`` (the
+    make_train_step / make_dlrm_train_step shape; donation inside the
+    outer jit is inert, which is fine — buffer reuse across loop
+    iterations is XLA's job here).
+    """
+    import time
+
+    import jax
+    import numpy as np
+    from jax import lax
+
+    # k is a TRACED loop bound (one compile serves both window lengths —
+    # a static bound would compile the full step loop twice, minutes each
+    # on remote-compile transports).
+    @jax.jit
+    def run(k, p, o, *args):
+        def body(_, carry):
+            p, o = carry
+            p, o, _loss = step_fn(p, o, *args)
+            return (p, o)
+
+        p, o = lax.fori_loop(0, k, body, (p, o))
+        # Scalar fence transitively dependent on every iteration.
+        return jax.tree_util.tree_leaves(p)[0].ravel()[0]
+
+    float(run(k_short, params, opt_state, *batch_args))  # compile + warm
+    shorts, longs = [], []
+    for _ in range(repeats):  # interleaved: drift can't flip the slope
+        t0 = time.perf_counter()
+        float(run(k_short, params, opt_state, *batch_args))
+        shorts.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        float(run(k_long, params, opt_state, *batch_args))
+        longs.append(time.perf_counter() - t0)
+    step_s, _overhead, ok = two_point_slope(
+        float(np.median(shorts)), float(np.median(longs)), k_short, k_long
+    )
+    return step_s, ok
+
+
 def two_point_slope(
     t_short: float, t_long: float, k_short: int, k_long: int
 ) -> tuple[float, float, bool]:
