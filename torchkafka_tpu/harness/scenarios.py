@@ -718,9 +718,16 @@ def scenario_7(size: str = "tiny", model_scale: str | None = None) -> dict:
     server = StreamingGenerator(
         consumer, params, cfg, slots=slots, prompt_len=prompt_len,
         max_new=max_new, eos_id=eos_id, commit_every=slots,
-        # One dispatch per half-generation: dispatch + sync latency dominate
-        # per-token syncing on tunneled transports.
-        ticks_per_sync=max(1, max_new // 2),
+        # Dispatch + sync latency dominate per-token syncing on tunneled
+        # transports. With EOS on, half-generation blocks balance sync cost
+        # against completed slots idling; at scale EOS is off (every slot
+        # runs full max_new), so ONE dispatch per generation is strictly
+        # better. max_new - 1: prefill emits token 0, so a generation
+        # completes after max_new - 1 decode ticks — a max_new-tick block
+        # would spend its last tick fully done-latched (a dead model pass).
+        ticks_per_sync=(
+            max(1, max_new - 1) if eos_id is None else max(1, max_new // 2)
+        ),
     )
     import sys
     import time as _wt
