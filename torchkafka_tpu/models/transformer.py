@@ -579,7 +579,21 @@ class Transformer:
                 body = jax.checkpoint(body)
             unroll = cfg.scan_unroll
             if unroll is None:
-                unroll = cfg.n_layers if cfg.n_layers <= 8 else 1
+                # Auto-unroll only when no mesh axis shards the WEIGHTS.
+                # The ~15% unroll win (PERF.md) was measured single-chip;
+                # under tp/fsdp the unrolled backward's per-layer grad
+                # intermediates make SPMD fall back to replicate-then-
+                # repartition ("[SPMD] Involuntary full rematerialization"
+                # — reproduced on a data2×fsdp2×tp2 mesh, gone at
+                # unroll=1), which costs far more than the unroll saves.
+                weight_sharded = self.mesh is not None and any(
+                    self.mesh.shape.get(ax, 1) > 1
+                    for ax in ("tp", "fsdp", "ep")
+                )
+                unroll = (
+                    cfg.n_layers if cfg.n_layers <= 8 and not weight_sharded
+                    else 1
+                )
             x, stats = lax.scan(body, x, params["layers"], unroll=unroll)
         # stats: [L, 2, E] token-summed routing statistics; per-layer aux,
         # averaged over layers (identical math in both branches).
@@ -688,6 +702,15 @@ def make_train_step(
         tokens = jax.lax.with_sharding_constraint(tokens, tok_sharding)
         mask = jax.lax.with_sharding_constraint(mask, mask_sharding)
         loss, grads = jax.value_and_grad(model.loss)(params, tokens, mask)
+        # Pin grads to the param layout at the AD boundary. Without this,
+        # SPMD is free to pick a layout for the backward's grad-accumulation
+        # intermediates from the (batch-sharded) contraction operands, then
+        # discovers at the optimizer that the param layout differs and falls
+        # back to replicate-then-repartition ("[SPMD] Involuntary full
+        # rematerialization" on fsdp×tp meshes) — wasted HBM and ICI every
+        # step. Constraining here lets the wanted layout propagate back
+        # into the transpose instead.
+        grads = jax.lax.with_sharding_constraint(grads, p_shardings)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
         params = jax.lax.with_sharding_constraint(params, p_shardings)
