@@ -55,3 +55,81 @@ class TestGenerate:
         c = generate(params, CFG, prompt, 5, temperature=1.0, rng=jax.random.key(2))
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
         assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+
+class TestMeshShardedGenerate:
+    """Model-sharded decode (generate.py ``mesh=``): BASELINE config 5
+    names an 8-chip slice; the sharded path must be token-exact vs the
+    single-chip one — same weights, same greedy argmax, XLA collectives
+    inserted from the layouts alone."""
+
+    # vocab divisible by tp (device_put requires even shards, as training
+    # does); kv heads divide tp=2.
+    SCFG = TransformerConfig(
+        vocab_size=96, d_model=48, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=96, max_seq_len=64, dtype=jnp.float32,
+    )
+
+    @pytest.fixture(scope="class")
+    def ssetup(self):
+        params = Transformer(self.SCFG).init(jax.random.key(0))
+        prompt = jnp.asarray(
+            np.random.default_rng(1).integers(0, 96, (4, 8)), jnp.int32
+        )
+        base = np.asarray(
+            jax.jit(lambda p, t: generate(p, self.SCFG, t, 6))(params, prompt)
+        )
+        return params, prompt, base
+
+    @pytest.mark.parametrize(
+        "axes", [{"data": 4, "tp": 2}, {"data": 2, "fsdp": 2, "tp": 2}],
+        ids=["dp-tp", "dp-fsdp-tp"],
+    )
+    def test_sharded_tokens_identical(self, ssetup, axes):
+        from torchkafka_tpu.models.generate import serving_shardings
+        from torchkafka_tpu.parallel import make_mesh
+
+        params, prompt, base = ssetup
+        mesh = make_mesh(axes)
+        sharded = jax.device_put(
+            params, serving_shardings(self.SCFG, mesh, params)
+        )
+        out = np.asarray(
+            jax.jit(lambda p, t: generate(p, self.SCFG, t, 6, mesh=mesh))(
+                sharded, prompt
+            )
+        )
+        np.testing.assert_array_equal(out, base)
+
+    def test_quantized_sharded_tokens_identical(self, ssetup):
+        """int8 QTensor trees shard too (quantize_specs keeps scale dims
+        unsharded) — the 8B-class int8 path on a tp mesh."""
+        from torchkafka_tpu.models.generate import serving_shardings
+        from torchkafka_tpu.models.quant import quantize_params
+        from torchkafka_tpu.parallel import make_mesh
+
+        params, prompt, _ = ssetup
+        qp = quantize_params(params, self.SCFG)
+        base = np.asarray(
+            jax.jit(lambda p, t: generate(p, self.SCFG, t, 6))(qp, prompt)
+        )
+        mesh = make_mesh({"data": 2, "fsdp": 2, "tp": 2})
+        sq = jax.device_put(qp, serving_shardings(self.SCFG, mesh, qp))
+        out = np.asarray(
+            jax.jit(lambda p, t: generate(p, self.SCFG, t, 6, mesh=mesh))(
+                sq, prompt
+            )
+        )
+        np.testing.assert_array_equal(out, base)
+
+    def test_mesh_guards(self, ssetup):
+        """tp must divide the head counts; slots/batch must divide data."""
+        from torchkafka_tpu.models.generate import check_serving_mesh
+        from torchkafka_tpu.parallel import make_mesh
+
+        mesh = make_mesh({"data": 2, "tp": 4})
+        with pytest.raises(ValueError, match="n_kv_heads"):
+            check_serving_mesh(self.SCFG, mesh)  # kv=2 cannot split 4 ways
+        mesh2 = make_mesh({"data": 8})
+        with pytest.raises(ValueError, match="slots"):
+            check_serving_mesh(self.SCFG, mesh2, batch=6)

@@ -622,3 +622,52 @@ class TestOutputTopic:
                 consumer, params, cfg, slots=2, prompt_len=P, max_new=MAX_NEW,
                 output_producer=tk.MemoryProducer(broker),
             )
+
+
+class TestMeshShardedServing:
+    """Explicit-mesh serving (serve.py ``mesh=``): kv heads over tp, slots
+    over data, weights tp/fsdp — token-exact vs mesh-less serving, with the
+    same per-completion commit accounting."""
+
+    def _run(self, cfg, params, mesh):
+        broker = tk.InMemoryBroker()
+        prompts = _topic(broker, 10)
+        consumer = tk.MemoryConsumer(broker, "p", group_id="gmesh")
+        server = StreamingGenerator(
+            consumer, params, cfg, slots=4, prompt_len=P, max_new=MAX_NEW,
+            mesh=mesh, commit_every=1,
+        )
+        out = {}
+        for rec, toks in server.run(max_records=10):
+            out[2 * rec.offset + rec.partition] = np.asarray(toks)
+        server.close()
+        committed = {
+            pt: broker.committed("gmesh", tk.TopicPartition("p", pt))
+            for pt in (0, 1)
+        }
+        consumer.close()
+        return prompts, out, committed
+
+    def test_sharded_serving_token_exact_and_commits(self):
+        cfg = TransformerConfig(
+            vocab_size=VOCAB, d_model=32, n_layers=2, n_heads=2, n_kv_heads=2,
+            d_ff=64, max_seq_len=P + MAX_NEW, dtype=jnp.float32,
+        )
+        params = init_params(jax.random.key(0), cfg)
+        from torchkafka_tpu.parallel import make_mesh
+
+        prompts, base, committed0 = self._run(cfg, params, None)
+        assert committed0 == {0: 5, 1: 5}
+        expected = _expected(cfg, params, prompts)
+        for idx, toks in base.items():
+            np.testing.assert_array_equal(toks, expected[idx])
+        for axes in ({"data": 2, "fsdp": 2, "tp": 2}, {"data": 4, "tp": 2}):
+            _, out, committed = self._run(cfg, params, make_mesh(axes))
+            assert set(out) == set(base)
+            for idx in base:
+                np.testing.assert_array_equal(
+                    out[idx], base[idx], err_msg=f"{axes} prompt {idx}"
+                )
+            # Every completion committed (commit_every=1): watermarks cover
+            # exactly the 5 prompts per partition.
+            assert committed == {0: 5, 1: 5}, (axes, committed)

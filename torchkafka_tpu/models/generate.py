@@ -14,6 +14,14 @@ sampling.
 The prefill math intentionally reuses the exact layer code of
 ``Transformer.__call__`` (one implementation, no drift); only the
 single-token decode step is specialised here.
+
+Model-sharded decode: pass ``mesh`` (and commit params to
+``serving_shardings``) to run tp/fsdp/data-sharded inference — kv heads
+shard over tp, the batch over data, and weights keep their training
+layouts, so anything too big for one chip (bf16 8B+, long KV budgets)
+serves across a slice. BASELINE config 5 names Llama-3-8B on v5e-8; the
+multichip dryrun (``__graft_entry__.dryrun_multichip``) proves this path
+end-to-end on a virtual mesh.
 """
 
 from __future__ import annotations
@@ -24,20 +32,100 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from torchkafka_tpu.models.quant import embed_rows, load_weight
+from torchkafka_tpu.models.quant import QTensor, embed_rows, load_weight, quantize_specs
 from torchkafka_tpu.models.transformer import (
     Transformer,
     TransformerConfig,
     _moe_mlp,
     _rms_norm,
     _rope,
+    param_specs,
+    shardings_for_mesh,
 )
 
 
 class KVCache(NamedTuple):
     k: jax.Array  # [L, B, max_len, K, Dh]
     v: jax.Array  # [L, B, max_len, K, Dh]
+
+
+# ------------------------------------------------------------ mesh-sharded
+# Model-sharded decode (BASELINE config 5 names an 8-chip v5e slice): the
+# same tp/fsdp layouts training uses (param_specs) carry into inference,
+# the KV cache shards its kv-head axis over tp (each shard attends over its
+# own heads' cache — attention is head-local until wo's psum), and the
+# batch/slot axis shards over data. XLA inserts the megatron collectives
+# (psum after wo and w_down, logit all-gather) from the layouts alone —
+# no hand-written collectives, same design rule as the train step.
+
+
+def check_serving_mesh(cfg: TransformerConfig, mesh: Mesh, *, batch: int | None = None) -> None:
+    """Divisibility guards for model-sharded decode, covering every dim the
+    ``serving_shardings`` layouts split: device_put requires EVEN shards,
+    so each sharded dim must divide its axis or the placement fails deep in
+    JAX internals instead of here. tp shards heads (wq's H, the cache's K),
+    the vocab (embed rows / lm_head columns) and d_ff (w_gate/w_down); fsdp
+    shards d_model; ep shards experts; data shards the batch/slot axis."""
+    tp = mesh.shape.get("tp", 1)
+    if tp > 1 and (cfg.n_heads % tp or cfg.n_kv_heads % tp):
+        raise ValueError(
+            f"tp={tp} must divide n_heads={cfg.n_heads} and "
+            f"n_kv_heads={cfg.n_kv_heads} for sharded decode"
+        )
+    if tp > 1 and (cfg.vocab_size % tp or cfg.d_ff % tp):
+        raise ValueError(
+            f"tp={tp} must divide vocab_size={cfg.vocab_size} and "
+            f"d_ff={cfg.d_ff} (embed/lm_head/MLP shard those dims over tp)"
+        )
+    fsdp = mesh.shape.get("fsdp", 1)
+    if fsdp > 1 and cfg.d_model % fsdp:
+        raise ValueError(
+            f"fsdp={fsdp} must divide d_model={cfg.d_model} "
+            "(weight fan-in dims shard over fsdp)"
+        )
+    ep = mesh.shape.get("ep", 1)
+    if ep > 1 and cfg.is_moe and cfg.n_experts % ep:
+        raise ValueError(
+            f"ep={ep} must divide n_experts={cfg.n_experts}"
+        )
+    dp = mesh.shape.get("data", 1)
+    if batch is not None and dp > 1 and batch % dp:
+        raise ValueError(
+            f"batch/slots={batch} must divide by the data axis ({dp})"
+        )
+
+
+def serving_shardings(cfg: TransformerConfig, mesh: Mesh, params) -> dict:
+    """NamedShardings for a serving param tree — plain (bf16/f32) or
+    int8-quantized (QTensor leaves get quantize_specs' scale handling).
+    The layouts are exactly the training ``param_specs``: a checkpoint
+    trained tp/fsdp-sharded serves in place."""
+    specs = param_specs(cfg)
+    if isinstance(params["lm_head"], QTensor):
+        specs = quantize_specs(specs, cfg)
+    return shardings_for_mesh(mesh, specs)
+
+
+def kv_sharding(mesh: Mesh) -> NamedSharding:
+    """KVCache [L, B, M, K, Dh]: slots/batch over data, kv heads over tp."""
+    return shardings_for_mesh(mesh, P(None, "data", None, "tp", None))
+
+
+def slot_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """Per-slot vectors [B, ...] (tokens, positions, masks): over data."""
+    return shardings_for_mesh(mesh, P("data", *([None] * (ndim - 1))))
+
+
+def _constrain_cache(cache: KVCache, mesh: Mesh | None) -> KVCache:
+    if mesh is None:
+        return cache
+    s = kv_sharding(mesh)
+    return KVCache(
+        lax.with_sharding_constraint(cache.k, s),
+        lax.with_sharding_constraint(cache.v, s),
+    )
 
 
 def _attend_cached(x, q, cache_k, cache_v, valid, layer, cfg):
@@ -107,21 +195,37 @@ def _layer_step(x, layer, cache_k, cache_v, pos, cfg):
     return x, cache_k, cache_v
 
 
-def prefill(params, cfg: TransformerConfig, tokens: jax.Array, max_len: int):
+def prefill(
+    params, cfg: TransformerConfig, tokens: jax.Array, max_len: int,
+    mesh: Mesh | None = None,
+):
     """Full forward over the prompt, capturing k/v into static caches.
 
     tokens: [B, S] → (last-position logits [B, V], KVCache with [0,S) filled).
     Uses Transformer.__call__ for the logits (single source of truth) and an
     auxiliary scan to capture per-layer k/v.
+
+    With ``mesh``, the prompt batch is constrained over data and the cache
+    over (data, tp) — weights are assumed committed to ``serving_shardings``
+    layouts. Attention takes the dense XLA body under a mesh: the Pallas
+    flash kernel is opaque to GSPMD (it cannot be partitioned over a
+    sharded batch), and a prompt-length dense attention is a bounded cost
+    next to the decode loop this path exists for.
     """
-    # Inference is mesh-less here: a training config that requested a
-    # sequence-parallel attn_impl ('ring'/'ulysses') must still be servable
-    # from its checkpoint, so fall back to the adaptive spelling rather than
-    # tripping the constructor's misconfigured-mesh guard.
-    if cfg.attn_impl in ("ring", "ulysses"):
+    # A training config that requested a sequence-parallel attn_impl
+    # ('ring'/'ulysses') must still be servable from its checkpoint, so
+    # fall back to the adaptive spelling rather than tripping the
+    # constructor's misconfigured-mesh guard.
+    if mesh is not None:
+        model = Transformer(dataclasses.replace(cfg, attn_impl="dense"))
+    elif cfg.attn_impl in ("ring", "ulysses"):
         model = Transformer(dataclasses.replace(cfg, attn_impl="auto"))
     else:
         model = Transformer(cfg)
+    if mesh is not None:
+        tokens = lax.with_sharding_constraint(
+            tokens, slot_sharding(mesh, tokens.ndim)
+        )
     batch, seq = tokens.shape
     x = embed_rows(params["embed"], tokens, cfg.dtype)
     positions = jnp.arange(seq)
@@ -146,10 +250,13 @@ def prefill(params, cfg: TransformerConfig, tokens: jax.Array, max_len: int):
     cache_v = jnp.zeros((nl, batch, max_len, kh, dh), cfg.dtype)
     cache_k = lax.dynamic_update_slice(cache_k, ks.astype(cfg.dtype), (0, 0, 0, 0, 0))
     cache_v = lax.dynamic_update_slice(cache_v, vs.astype(cfg.dtype), (0, 0, 0, 0, 0))
-    return logits, KVCache(cache_k, cache_v)
+    return logits, _constrain_cache(KVCache(cache_k, cache_v), mesh)
 
 
-def _decode_one(params, cfg, cache: KVCache, token: jax.Array, pos: jax.Array):
+def _decode_one(
+    params, cfg, cache: KVCache, token: jax.Array, pos: jax.Array,
+    mesh: Mesh | None = None,
+):
     """token: [B] → logits [B, V], updated cache. pos: scalar position."""
     x = embed_rows(params["embed"], token, cfg.dtype)[:, None, :]  # [B,1,D]
 
@@ -164,7 +271,7 @@ def _decode_one(params, cfg, cache: KVCache, token: jax.Array, pos: jax.Array):
         "bd,dv->bv", x[:, 0], load_weight(params["lm_head"], cfg.dtype),
         preferred_element_type=jnp.float32,
     )
-    return logits, KVCache(ck, cv)
+    return logits, _constrain_cache(KVCache(ck, cv), mesh)
 
 
 def generate(
@@ -175,12 +282,22 @@ def generate(
     *,
     temperature: float = 0.0,
     rng: jax.Array | None = None,
+    mesh: Mesh | None = None,
 ):
     """prompt: [B, S] int32 → generated [B, max_new] int32 (greedy when
-    temperature == 0). Jit-friendly: static prompt length and max_new."""
+    temperature == 0). Jit-friendly: static prompt length and max_new.
+
+    ``mesh``: model-sharded decode — params must be committed to
+    ``serving_shardings`` layouts (kv heads shard over tp, batch over
+    data); token-exact vs the mesh-less path (differential-tested)."""
     batch, seq = prompt.shape
+    if mesh is not None:
+        check_serving_mesh(cfg, mesh, batch=batch)
+        params = lax.with_sharding_constraint(
+            params, serving_shardings(cfg, mesh, params)
+        )
     max_len = seq + max_new
-    logits, cache = prefill(params, cfg, prompt, max_len)
+    logits, cache = prefill(params, cfg, prompt, max_len, mesh)
     if rng is None:
         rng = jax.random.key(0)
 
@@ -194,7 +311,7 @@ def generate(
     def step(carry, i):
         token, cache, key = carry
         key, sub = jax.random.split(key)
-        logits, cache = _decode_one(params, cfg, cache, token, seq + i)
+        logits, cache = _decode_one(params, cfg, cache, token, seq + i, mesh)
         nxt = pick(logits, sub)
         return (nxt, cache, key), token
 

@@ -36,7 +36,15 @@ from jax import lax
 
 from torchkafka_tpu.commit.ledger import OffsetLedger
 from torchkafka_tpu.errors import CommitFailedError, OutputDeliveryError
-from torchkafka_tpu.models.generate import _attend_cached, _project_qkv, prefill
+from torchkafka_tpu.models.generate import (
+    _attend_cached,
+    _project_qkv,
+    check_serving_mesh,
+    kv_sharding,
+    prefill,
+    serving_shardings,
+    slot_sharding,
+)
 from torchkafka_tpu.models.quant import embed_rows, load_weight
 from torchkafka_tpu.models.transformer import TransformerConfig, _rms_norm, _rope
 from torchkafka_tpu.source.records import Record
@@ -194,6 +202,7 @@ class StreamingGenerator:
         output_topic: str | None = None,
         encode_output: Callable[[Record, np.ndarray], bytes] | None = None,
         max_send_failure_streak: int = 64,
+        mesh=None,
     ) -> None:
         """``ticks_per_sync``: decode ticks chained per device dispatch
         (and per host sync of the done mask). Higher amortises dispatch
@@ -213,6 +222,14 @@ class StreamingGenerator:
         instead of losing completions (at-least-once end to end; the
         output topic may see duplicates, keyed by the prompt's key).
 
+        ``mesh``: model-sharded serving (``jax.sharding.Mesh``) — params
+        are committed to the training ``param_specs`` layouts (tp/fsdp,
+        quantize-aware), the KV slot pool shards kv heads over ``tp`` and
+        slots over ``data``, and XLA inserts the megatron collectives.
+        This is what serves anything one chip cannot hold (bf16 8B+, long
+        KV budgets). Token-exact vs mesh-less serving
+        (differential-tested); the multichip dryrun proves the path.
+
         ``max_send_failure_streak``: a SYNCHRONOUS send failure leaves its
         record uncommitted (the watermark stalls there, it re-delivers on
         restart) but serving continues — a transient output-broker blip
@@ -228,6 +245,10 @@ class StreamingGenerator:
         if ticks_per_sync < 1:
             raise ValueError("ticks_per_sync must be >= 1")
         self._consumer = consumer
+        self._mesh = mesh
+        if mesh is not None:
+            check_serving_mesh(cfg, mesh, batch=slots)
+            params = jax.device_put(params, serving_shardings(cfg, mesh, params))
         self._params = params
         self._cfg = cfg
         self._slots = slots
@@ -264,6 +285,22 @@ class StreamingGenerator:
         B, P, M = self._slots, self._prompt_len, self._max_len
         nl, kh, dh = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
         temp = self._temperature
+        mesh = self._mesh
+
+        def pin_state(caches, last_tok, pos, gen):
+            """Pin the slot state's layouts inside the jitted programs so
+            the donate-and-rebind round trip keeps kv heads on tp and
+            slots on data, instead of whatever GSPMD first guesses."""
+            if mesh is None:
+                return caches, last_tok, pos, gen
+            kv = kv_sharding(mesh)
+            row = slot_sharding(mesh)
+            return (
+                tuple(lax.with_sharding_constraint(c, kv) for c in caches),
+                lax.with_sharding_constraint(last_tok, row),
+                lax.with_sharding_constraint(pos, row),
+                lax.with_sharding_constraint(gen, slot_sharding(mesh, 2)),
+            )
 
         def pick(logits, key):
             if temp == 0.0:
@@ -275,7 +312,8 @@ class StreamingGenerator:
         def admit(params, caches, last_tok, pos, gen, prompts, admit_mask, key):
             """Prefill the full [B, P] prompt batch; merge admitted rows in.
             prompts: [B, P] int32; admit_mask: [B] bool."""
-            logits, fresh = prefill(params, cfg, prompts, M)
+            caches, last_tok, pos, gen = pin_state(caches, last_tok, pos, gen)
+            logits, fresh = prefill(params, cfg, prompts, M, mesh)
             sel = admit_mask[None, :, None, None, None]  # over [L, B, M, K, Dh]
             ck = jnp.where(sel, fresh.k, caches[0])
             cv = jnp.where(sel, fresh.v, caches[1])
@@ -295,6 +333,7 @@ class StreamingGenerator:
             One host sync per K tokens — per-token syncing costs a full
             host↔device round trip per generated token, which is the whole
             serving budget on high-latency transports."""
+            caches, last_tok, pos, gen = pin_state(caches, last_tok, pos, gen)
 
             def one(carry, _):
                 caches, last_tok, pos, gen, done_latch, n_out, key = carry
@@ -368,6 +407,15 @@ class StreamingGenerator:
         self._last_tok = jnp.zeros((B,), jnp.int32)
         self._pos = jnp.zeros((B,), jnp.int32)
         self._gen = jnp.zeros((B, self._max_new), jnp.int32)
+        if mesh is not None:
+            # Place the initial pool in its serving layout so the first
+            # dispatch doesn't start from replicated buffers.
+            kv = kv_sharding(mesh)
+            row = slot_sharding(mesh)
+            self._caches = tuple(jax.device_put(c, kv) for c in self._caches)
+            self._last_tok = jax.device_put(self._last_tok, row)
+            self._pos = jax.device_put(self._pos, row)
+            self._gen = jax.device_put(self._gen, slot_sharding(mesh, 2))
 
     def decode_roofline(
         self, *, iters: int = 8, windows: int = 3,
