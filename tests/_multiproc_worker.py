@@ -209,6 +209,15 @@ def elastic_main(pid: int, nproc: int, broker_port: int, outdir: str, mark) -> i
         (tp.topic, tp.partition) for tp in consumer.assignment()
     )
     assert pre_leave, "every member must own partitions (4 > 3)"
+    # Arm gate (ADVICE r4): the 'joined' gate alone does NOT order the
+    # leaver's close() after the survivors' pre_leave snapshots — a slow
+    # survivor could capture the POST-leave assignment as pre_leave, its
+    # "assignment changed" latch then never fires, and the loop below never
+    # exits (reproduced as a 300 s wedge). Each member marks 'armed' after
+    # snapshotting; the leaver waits for ALL armed markers before its first
+    # poll, so every snapshot predates the rebalance.
+    mark("armed")
+    _wait_for_marker(outdir, "armed", range(nproc))
 
     if pid == nproc - 1:
         # The leaver: batch 1 committed, batch 2 abandoned uncommitted.

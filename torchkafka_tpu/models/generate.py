@@ -90,6 +90,13 @@ def check_serving_mesh(cfg: TransformerConfig, mesh: Mesh, *, batch: int | None 
         raise ValueError(
             f"ep={ep} must divide n_experts={cfg.n_experts}"
         )
+    pp = mesh.shape.get("pp", 1)
+    if pp > 1 and cfg.n_layers % pp:
+        raise ValueError(
+            f"pp={pp} must divide n_layers={cfg.n_layers} (layer-stacked "
+            "weights shard over pp; decode is layer-sharded storage, not a "
+            "pipelined schedule)"
+        )
     dp = mesh.shape.get("data", 1)
     if batch is not None and dp > 1 and batch % dp:
         raise ValueError(

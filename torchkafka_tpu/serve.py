@@ -25,6 +25,7 @@ generalised to out-of-order completions via the OffsetLedger.
 
 from __future__ import annotations
 
+import functools
 import logging
 import time
 from typing import Callable, Iterator
@@ -398,6 +399,8 @@ class StreamingGenerator:
         # program instead of referencing the resident device buffers.
         _admit = jax.jit(admit, donate_argnums=(1,))
         _tick = jax.jit(tick_block, donate_argnums=(1,))
+        # Raw (un-jitted) body for decode_roofline's fori-chained windows.
+        self._tick_block_raw = tick_block
         self._admit_fn = lambda *a: _admit(self._params, *a)
         self._tick_fn = lambda *a: _tick(self._params, *a)
         self._caches = (
@@ -426,11 +429,15 @@ class StreamingGenerator:
         Decode is weight/KV-streaming bound: every tick reads the full
         parameter set plus the slot KV pool for one token per slot. This
         measures the decode tick program alone, as the SLOPE between two
-        window lengths (``iters`` and 3×``iters`` chained dispatches, each
-        fenced by one scalar fetch): the subtraction cancels the constant
-        dispatch/fetch overhead that otherwise floors a divide-by-N
-        estimate on high-latency transports (~90 ms/round-trip through the
-        dev tunnel). Reports achieved bytes/s against the chip's peak
+        window lengths (``iters`` and 3×``iters`` tick blocks chained
+        INSIDE one jitted ``fori_loop``, fenced by one scalar fetch): ONE
+        dispatch per window — which the slope then cancels exactly. A
+        Python loop of jitted calls here would only amortise the
+        per-dispatch host cost (~overhead/K per tick), so in host-bound
+        regimes (small models, high per-call RPC latency) it reports the
+        host dispatch rate while slope_ok stays True — the exact failure
+        mode ``device_step_seconds``' fori-chaining exists to avoid
+        (ADVICE r4). Reports achieved bytes/s against the chip's peak
         (v5e: ~819 GB/s), the serving analog of training's MFU. The gap
         between the run loop's end-to-end tokens/s and this number is
         host/tunnel/admission overhead; the gap between this and 100%
@@ -439,20 +446,37 @@ class StreamingGenerator:
         B, K = self._slots, self._ticks_per_sync
         active = jnp.ones((B,), bool)
         key = jax.random.key(1)
+        tick_block = self._tick_block_raw
 
-        # Every tick donates the cache pool, so rebind self state after
-        # EVERY dispatch: an exception mid-measurement (a transport blip on
-        # the tunneled targets this exists for) must not leave the server
-        # holding a donated, deleted buffer.
+        # n is a TRACED loop bound: one compile serves both window lengths.
+        # The cache pool is DONATED like the serving tick's dispatch: at
+        # the 8B-class scales this path exists for, an un-donated window
+        # would hold input + output pools at once (multiple GB) and could
+        # OOM mid-benchmark.
+        @functools.partial(jax.jit, donate_argnums=(2,))
+        def run(n, params, caches, last_tok, pos, gen):
+            def body(_, carry):
+                caches, last_tok, pos, gen = carry
+                caches, last_tok, pos, gen, _done, _n_out = tick_block(
+                    params, caches, last_tok, pos, gen, active, key
+                )
+                return (caches, last_tok, pos, gen)
+
+            out = lax.fori_loop(0, n, body, (caches, last_tok, pos, gen))
+            # Scalar fence transitively dependent on every iteration.
+            return out, out[1].ravel()[0]
+
+        # Rebind self state after EVERY window: an exception mid-
+        # measurement (a transport blip on the tunneled targets this
+        # exists for) must not leave the server holding stale buffers.
         def window(n_dispatches: int) -> float:
             t0 = time.perf_counter()
-            for _ in range(n_dispatches):
-                out = self._tick_fn(
-                    self._caches, self._last_tok, self._pos, self._gen,
-                    active, key,
-                )
-                self._caches, self._last_tok, self._pos, self._gen = out[:4]
-            int(np.asarray(jax.device_get(out[5]))[0])  # completion proof
+            out, fence = run(
+                n_dispatches, self._params, self._caches, self._last_tok,
+                self._pos, self._gen,
+            )
+            self._caches, self._last_tok, self._pos, self._gen = out
+            int(np.asarray(jax.device_get(fence)))  # completion proof
             return time.perf_counter() - t0
 
         from torchkafka_tpu.utils.timing import two_point_slope

@@ -133,14 +133,23 @@ class BrokerServer:
                     method, args, kwargs = _recv(conn)
                 except (ConnectionError, OSError):
                     return
-                if method not in _METHODS:
-                    _send(conn, ("err", ValueError(f"unknown method {method!r}")))
-                    continue
+                # A client that disconnects mid-request makes the reply
+                # _send raise (EBADF/EPIPE); without this guard the "ok"
+                # send's failure would route into the except branch whose
+                # _send raises AGAIN and escapes the handler thread
+                # (ADVICE r4). A vanished client just closes its handler.
                 try:
-                    value = getattr(self.broker, method)(*args, **kwargs)
-                    _send(conn, ("ok", value))
-                except Exception as exc:  # noqa: BLE001 - marshalled to client
-                    _send(conn, ("err", exc))
+                    if method not in _METHODS:
+                        _send(conn, ("err", ValueError(f"unknown method {method!r}")))
+                        continue
+                    try:
+                        value = getattr(self.broker, method)(*args, **kwargs)
+                        reply = ("ok", value)
+                    except Exception as exc:  # noqa: BLE001 - marshalled to client
+                        reply = ("err", exc)
+                    _send(conn, reply)
+                except (ConnectionError, OSError):
+                    return
         finally:
             conn.close()
 
