@@ -174,6 +174,32 @@ def _wait_for_marker(outdir: str, name: str, pids, timeout_s: float = 60.0) -> N
         _time.sleep(0.02)
 
 
+def _ids(recs) -> list[list[int]]:
+    return [[r.partition, r.offset] for r in recs]
+
+
+def _group_consumer(client, pid: int):
+    """One ELASTIC (broker-side group membership) member over the shared
+    socket broker — the single construction both elastic modes use."""
+    import functools
+
+    import torchkafka_tpu as tk
+    from torchkafka_tpu.parallel.multihost import pod_consumer
+
+    return pod_consumer(
+        "t",
+        ELASTIC_PARTITIONS,
+        "g",
+        transport=functools.partial(tk.MemoryConsumer, client),
+        assignment=None,
+        member_id=f"member-{pid}",
+    )
+
+
+def _assignment_snapshot(consumer) -> list[tuple[str, int]]:
+    return sorted((tp.topic, tp.partition) for tp in consumer.assignment())
+
+
 def elastic_main(pid: int, nproc: int, broker_port: int, outdir: str, mark) -> int:
     """One group-managed member of a SHARED cross-process consumer group.
 
@@ -184,30 +210,19 @@ def elastic_main(pid: int, nproc: int, broker_port: int, outdir: str, mark) -> i
     parent's exactness assertions). Member nproc-1 then consumes two
     batches from its partitions, commits only the first, and leaves.
     """
-    import functools
     import time as _time
 
     import torchkafka_tpu as tk
     from torchkafka_tpu.errors import CommitFailedError
-    from torchkafka_tpu.parallel.multihost import pod_consumer
 
     client = tk.BrokerClient("127.0.0.1", broker_port)
-    consumer = pod_consumer(
-        "t",
-        ELASTIC_PARTITIONS,
-        "g",
-        transport=functools.partial(tk.MemoryConsumer, client),
-        assignment=None,  # ELASTIC: broker-side group membership
-        member_id=f"member-{pid}",
-    )
-    ids = lambda recs: [[r.partition, r.offset] for r in recs]  # noqa: E731
+    consumer = _group_consumer(client, pid)
+    ids = _ids
 
     # Join is done (construction); gate until the whole group is in.
     mark("joined")
     _wait_for_marker(outdir, "joined", range(nproc))
-    pre_leave = sorted(
-        (tp.topic, tp.partition) for tp in consumer.assignment()
-    )
+    pre_leave = _assignment_snapshot(consumer)
     assert pre_leave, "every member must own partitions (4 > 3)"
     # Arm gate (ADVICE r4): the 'joined' gate alone does NOT order the
     # leaver's close() after the survivors' pre_leave snapshots — a slow
@@ -276,8 +291,100 @@ def elastic_main(pid: int, nproc: int, broker_port: int, outdir: str, mark) -> i
     return 0
 
 
+def elastic_join_main(pid: int, nproc: int, broker_port: int, outdir: str, mark) -> int:
+    """Scale-UP counterpart of ``elastic_main``: members 0..nproc-2 join
+    first, consume-and-commit at least one batch each, then member nproc-1
+    JOINS the live group mid-stream. The broker rebalance must hand the
+    joiner partitions, nothing committed before the join may re-deliver to
+    it, and the whole topic must drain to a fully-committed watermark.
+
+    Interleaving is made deterministic with markers: early members commit
+    one batch → mark 'early_progress' → WAIT for the joiner's 'joining'
+    marker before polling again, so the join always lands mid-stream with
+    records left to rebalance (not after an accidental full drain).
+    """
+    import time as _time
+
+    import torchkafka_tpu as tk
+    from torchkafka_tpu.errors import CommitFailedError
+
+    client = tk.BrokerClient("127.0.0.1", broker_port)
+    ids = _ids
+
+    def drain(consumer, consumed, committed):
+        """Consume-and-commit until the group's partitions are fully
+        drained. Commits racing a rebalance may fail generation-checked —
+        at-least-once, not an error. An EMPTY assignment (more members
+        than partitions) counts as drained: lag() is {} there, and
+        requiring a non-empty lag would spin until the parent's timeout."""
+        empty = 0
+        while True:
+            recs = consumer.poll(max_records=20, timeout_ms=200)
+            consumed.extend(ids(recs))
+            if recs:
+                try:
+                    consumer.commit()
+                    committed.extend(ids(recs))
+                except CommitFailedError:
+                    pass
+            if not recs:
+                if all(v == 0 for v in consumer.lag().values()):
+                    empty += 1
+                    if empty >= 3:
+                        return
+            else:
+                empty = 0
+            _time.sleep(0.01)
+
+    if pid == nproc - 1:
+        # THE JOINER: let the early group make committed progress first.
+        _wait_for_marker(outdir, "early_progress", range(nproc - 1))
+        consumer = _group_consumer(client, pid)  # join -> eager rebalance
+        mark("joining")
+        consumed: list[list[int]] = []
+        committed: list[list[int]] = []
+        # First poll syncs the assignment; its records count like any other.
+        consumed.extend(ids(consumer.poll(max_records=1, timeout_ms=500)))
+        post_join = _assignment_snapshot(consumer)
+        drain(consumer, consumed, committed)
+        mark("joiner", {
+            "consumed": consumed, "committed": committed,
+            "assignment": [list(t) for t in post_join],
+        })
+        consumer.close()
+        client.close()
+        return 0
+
+    # EARLY MEMBERS: join, gate on full early membership, one committed
+    # batch, then hold until the joiner is in.
+    consumer = _group_consumer(client, pid)
+    mark("joined_early")
+    _wait_for_marker(outdir, "joined_early", range(nproc - 1))
+    pre_join = _assignment_snapshot(consumer)
+    assert pre_join, "every early member must own partitions"
+    consumed: list[list[int]] = []
+    committed: list[list[int]] = []
+    while not consumed:
+        recs = consumer.poll(max_records=20, timeout_ms=500)
+        consumed.extend(ids(recs))
+    consumer.commit()  # must succeed: membership is stable pre-join
+    committed.extend(consumed)
+    mark("early_progress")
+    _wait_for_marker(outdir, "joining", [nproc - 1])
+    drain(consumer, consumed, committed)
+    post_join = _assignment_snapshot(consumer)
+    mark("early", {
+        "consumed": consumed, "committed": committed,
+        "pre_join": [list(t) for t in pre_join],
+        "assignment": [list(t) for t in post_join],
+    })
+    consumer.close()
+    client.close()
+    return 0
+
+
 def main(pid: int, nproc: int, port: str, outdir: str, mode: str) -> int:
-    if mode == "elastic":
+    if mode in ("elastic", "elastic_join"):
 
         def mark_elastic(name: str, payload=None) -> None:
             path = os.path.join(outdir, f"{name}_{pid}.json")
@@ -286,7 +393,8 @@ def main(pid: int, nproc: int, port: str, outdir: str, mode: str) -> int:
                 json.dump(payload if payload is not None else {}, f)
             os.replace(tmp, path)
 
-        return elastic_main(pid, nproc, int(port), outdir, mark_elastic)
+        fn = elastic_main if mode == "elastic" else elastic_join_main
+        return fn(pid, nproc, int(port), outdir, mark_elastic)
 
     import jax
 

@@ -531,7 +531,8 @@ def _serving_model(size: str, model_scale: str | None, prompt_len: int,
     the VERDICT-r3 scale flag: None keeps the historical tiny/45m configs
     (comparable across rounds); '45m' | '1b' | '8b' draws from the model
     zoo at true serving bytes — '8b' in int8 (the only way 8B fits one
-    16 GB chip), the rest bf16/f32 masters."""
+    16 GB chip), the rest bf16 params (so counted bytes == streamed
+    bytes in the rooflines)."""
     import jax
     import jax.numpy as jnp
 
