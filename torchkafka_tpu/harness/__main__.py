@@ -16,6 +16,11 @@ def main() -> None:
     ap.add_argument("--model-scale", choices=("45m", "1b", "8b"), default=None,
                     help="serving scenarios (5/7) only: serve the zoo model "
                     "at this scale (8b = int8) with HBM roofline accounting")
+    ap.add_argument("--serve-eos", action="store_true",
+                    help="scenario 7 at a model scale: EOS ON with 8-tick "
+                    "blocks — the continuous-batching row (slots readmit "
+                    "mid-stream); default at scale is EOS off, one dispatch "
+                    "per generation (the throughput ceiling)")
     args = ap.parse_args()
     if args.scenario:
         nums = [args.scenario]
@@ -24,7 +29,10 @@ def main() -> None:
     else:
         nums = sorted(SCENARIOS)
     for n in nums:
-        print(json.dumps(run_scenario(n, args.size, model_scale=args.model_scale)))
+        print(json.dumps(run_scenario(
+            n, args.size, model_scale=args.model_scale,
+            serve_eos=args.serve_eos,
+        )))
 
 
 if __name__ == "__main__":

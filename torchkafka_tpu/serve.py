@@ -88,6 +88,9 @@ class ServeMetrics:
         self.completions = RateMeter()
         self.tokens = RateMeter()
         self.truncated = RateMeter()  # stopped by EOS before max_new
+        self.readmissions = RateMeter()  # slots refilled MID-STREAM (while
+        # other generations were in flight) — continuous batching's defining
+        # behavior; 0 in lockstep-equivalent runs
         self.dropped = RateMeter()  # undecodable prompts retired
         self.commit_failures = RateMeter()
         self.output_flush_failures = RateMeter()  # output topic not durable
@@ -101,7 +104,7 @@ class ServeMetrics:
         time (minutes on remote-compile transports) doesn't dilute rates."""
         for m in (
             self.completions, self.tokens, self.truncated,
-            self.dropped, self.commit_failures,
+            self.readmissions, self.dropped, self.commit_failures,
         ):
             m.reset()
 
@@ -112,6 +115,7 @@ class ServeMetrics:
             "tokens": self.tokens.count,
             "tokens_per_s": self.tokens.rate(),
             "truncated_by_eos": self.truncated.count,
+            "readmissions": self.readmissions.count,
             "dropped": self.dropped.count,
             "commit_failures": self.commit_failures.count,
             "output_flush_failures": self.output_flush_failures.count,
@@ -130,6 +134,7 @@ class ServeMetrics:
             ("completions_total", "counter", s["completions"]),
             ("tokens_total", "counter", s["tokens"]),
             ("truncated_by_eos_total", "counter", s["truncated_by_eos"]),
+            ("slot_readmissions_total", "counter", s["readmissions"]),
             ("dropped_prompts_total", "counter", s["dropped"]),
             ("commit_failures_total", "counter", s["commit_failures"]),
             ("output_flush_failures_total", "counter", s["output_flush_failures"]),
@@ -605,6 +610,11 @@ class StreamingGenerator:
                     active[i] = True
                     budget -= 1
                 if admit_mask.any():
+                    if in_flight > 0:
+                        # Slots refilled while other generations were mid-
+                        # flight: the observable that distinguishes
+                        # continuous batching from lockstep waves.
+                        self.metrics.readmissions.add(int(admit_mask.sum()))
                     self._rng, sub = jax.random.split(self._rng)
                     caches, last_tok, pos, gen = self._admit_fn(
                         caches, last_tok, pos, gen,
