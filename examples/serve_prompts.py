@@ -43,7 +43,21 @@ def main() -> int:
     ap.add_argument("--max-new", type=int, default=16)
     ap.add_argument("--eos", type=int, default=None,
                     help="optional EOS token id (slots recycle early)")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="model-sharded serving: kv heads over a tp axis "
+                    "of this size, remaining devices on data (slots). "
+                    "Try --cpu-devices 8 --tp 2 anywhere.")
+    ap.add_argument("--cpu-devices", type=int, default=None,
+                    help="force a virtual CPU mesh of this many devices "
+                    "(env vars are too late where jax is pre-imported; "
+                    "this uses jax.config before first device use)")
     args = ap.parse_args()
+    if args.cpu_devices:
+        try:
+            jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+        except RuntimeError:
+            pass  # backend already live; use whatever devices exist
 
     broker = tk.InMemoryBroker()
     broker.create_topic(TOPIC, partitions=2)
@@ -61,12 +75,19 @@ def main() -> int:
         d_ff=256, max_seq_len=PROMPT_LEN + args.max_new,
     )
     params = init_params(jax.random.key(0), cfg)
+    mesh = None
+    if args.tp > 1:
+        n_dev = len(jax.devices())
+        if n_dev % args.tp:
+            raise SystemExit(f"--tp {args.tp} does not divide {n_dev} devices")
+        mesh = tk.make_mesh({"data": n_dev // args.tp, "tp": args.tp})
+        print(f"serving model-sharded over {dict(mesh.shape)}", file=sys.stderr)
     consumer = tk.MemoryConsumer(broker, TOPIC, group_id="serve-demo")
     producer = tk.MemoryProducer(broker)
     with StreamingGenerator(
         consumer, params, cfg,
         slots=args.slots, prompt_len=PROMPT_LEN, max_new=args.max_new,
-        eos_id=args.eos, commit_every=args.slots,
+        eos_id=args.eos, commit_every=args.slots, mesh=mesh,
         # consume→generate→produce: completions become durable on their
         # topic BEFORE the prompts that produced them commit.
         output_producer=producer, output_topic="completions",

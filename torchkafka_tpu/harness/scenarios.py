@@ -645,7 +645,6 @@ def scenario_5(size: str = "tiny", model_scale: str | None = None) -> dict:
             int(jax.device_get(out[0, 0]))
             gen_times.append(_time.perf_counter() - t0)
         pf_s, gen_s = float(np.median(pf_times)), float(np.median(gen_times))
-        decode_s = max(gen_s - pf_s, 1e-9)
         from torchkafka_tpu.serve import V5E_PEAK_HBM_GBS, decode_tick_bytes
 
         w_bytes, kv_bytes = decode_tick_bytes(
@@ -654,14 +653,36 @@ def scenario_5(size: str = "tiny", model_scale: str | None = None) -> dict:
         roofline_tok_s = (
             batch * V5E_PEAK_HBM_GBS * 1e9 / (w_bytes + kv_bytes)
         )
-        decode_tok_s = batch * max_new / decode_s
         extra.update({
             "device_prefill_ms": round(pf_s * 1e3, 1),
             "device_generate_ms": round(gen_s * 1e3, 1),
-            "device_decode_tok_s": round(decode_tok_s, 1),
             "roofline_tok_s": round(roofline_tok_s, 1),
-            "hbm_roofline_pct": round(100 * decode_tok_s / roofline_tok_s, 1),
         })
+        decode_s = gen_s - pf_s
+        if decode_s <= 0.25 * gen_s:
+            # Both timings are single dispatches through the tunnel whose
+            # wall is max(round-trip, device work) — NOT their sum — so
+            # the difference carries no information once the device work
+            # sits under the ~60-140 ms round trip (the 45M scale: both
+            # walls read ≈RTT and the delta is jitter; observed readings
+            # of 2e12 and 2.3e6 tok/s in consecutive runs). Flag unless
+            # decode dominates the generate wall, like two_point_slope's
+            # slope_ok — scenario 7's fori-chained decode_roofline is the
+            # robust decode number at every scale.
+            extra.update({
+                "split_ok": False,
+                "device_decode_tok_s": None,
+                "hbm_roofline_pct": None,
+            })
+        else:
+            decode_tok_s = batch * max_new / decode_s
+            extra.update({
+                "split_ok": True,
+                "device_decode_tok_s": round(decode_tok_s, 1),
+                "hbm_roofline_pct": round(
+                    100 * decode_tok_s / roofline_tok_s, 1
+                ),
+            })
     return _result("5:generate", rows, elapsed, stream, extra)
 
 
@@ -1014,11 +1035,13 @@ def scenario_9(size: str = "tiny") -> dict:
         params, opt_state = init_fn(jax.random.key(0))
         state = {"p": params, "o": opt_state, "losses": []}
         rows_by_width: dict[int, int] = {}
+        batches_by_width: dict[int, int] = {}
 
         def step(batch):
             toks = jnp.asarray(batch.data["tokens"])
             w = toks.shape[1]
             rows_by_width[w] = rows_by_width.get(w, 0) + batch.valid_count
+            batches_by_width[w] = batches_by_width.get(w, 0) + 1
             # Mask: real rows AND real (pre-pad) positions within each row.
             ln = np.asarray(batch.data["length"])
             mask = (
@@ -1047,41 +1070,104 @@ def scenario_9(size: str = "tiny") -> dict:
         ) as stream:
             rows, elapsed = _drain(stream, step, n)
         losses = [float(x) for x in state["losses"]]
-        return rows, elapsed, losses, rows_by_width, stream
+        return rows, elapsed, losses, rows_by_width, batches_by_width, stream
 
     # Warmup pass (untimed-in-the-ratio; first-contact compiles land here),
     # then bucketed and pad-to-max back-to-back — both sides sample the
     # same minutes of box weather, bench.py's pairing discipline.
     run_pass("warm", bucketed=True)
-    rows, elapsed, losses, rows_by_width, stream = run_pass(
+    rows, elapsed, losses, rows_by_width, batches_by_width, stream = run_pass(
         "bucketed", bucketed=True
     )
-    p_rows, p_elapsed, p_losses, _p_widths, _ = run_pass(
+    p_rows, p_elapsed, p_losses, _pw, p_batches, _ = run_pass(
         "padmax", bucketed=False
     )
     assert p_rows == rows, (p_rows, rows)
     bucketed_tokens = sum(w * r for w, r in rows_by_width.items())
-    return _result(
-        "9:ragged-bucketed-train", rows, elapsed, stream,
-        {
-            "mesh": dict(mesh.shape),
-            "buckets": list(buckets),
-            "rows_per_width": {
-                int(w): int(r) for w, r in sorted(rows_by_width.items())
-            },
-            "bucket_efficiency": round(bucketed_tokens / (rows * max_w), 3),
-            # MEASURED same-invocation ratio: pad-to-max elapsed over
-            # bucketed elapsed on identical records and model (>1 =
-            # bucketing wins end-to-end).
-            "vs_padmax": round(p_elapsed / elapsed, 2) if elapsed else None,
-            "padmax_records_per_s": (
-                round(p_rows / p_elapsed, 1) if p_elapsed else None
-            ),
-            "first_loss": round(losses[0], 4),
-            "last_loss": round(losses[-1], 4),
-            "padmax_last_loss": round(p_losses[-1], 4),
+    extra = {
+        "mesh": dict(mesh.shape),
+        "buckets": list(buckets),
+        "rows_per_width": {
+            int(w): int(r) for w, r in sorted(rows_by_width.items())
         },
-    )
+        "bucket_efficiency": round(bucketed_tokens / (rows * max_w), 3),
+        # MEASURED same-invocation ratio: pad-to-max elapsed over
+        # bucketed elapsed on identical records and model (>1 =
+        # bucketing wins end-to-end). On dispatch-bound transports (this
+        # tunnel: both sides run ~the same batch count through ~100 ms
+        # round trips) this reads ≈1 regardless of the device saving —
+        # the device-level ratio below is the number that transfers.
+        "vs_padmax": round(p_elapsed / elapsed, 2) if elapsed else None,
+        "padmax_records_per_s": (
+            round(p_rows / p_elapsed, 1) if p_elapsed else None
+        ),
+        "first_loss": round(losses[0], 4),
+        "last_loss": round(losses[-1], 4),
+        "padmax_last_loss": round(p_losses[-1], 4),
+    }
+    if jax.default_backend() == "tpu":
+        # DEVICE-level paired step cost: fori-chained slope per width
+        # (utils.timing.device_step_seconds — one dispatch per window, the
+        # only timing that converges on this transport), weighted by the
+        # batch counts the bucketed pass ACTUALLY ran vs every batch at
+        # the top width. This is the measured train-step ratio the
+        # analytic bucket_efficiency predicts.
+        from torchkafka_tpu.utils.timing import device_step_seconds
+
+        dp, do = init_fn(jax.random.key(1))
+        rng2 = np.random.default_rng(5)
+        step_s: dict[int, float] = {}
+        # TWO rounds per width, keep the min of the rounds whose SLOPE
+        # HELD: the first measurement after the e2e passes absorbs
+        # queue-drain/cache cold-start (observed: a width-64 step reading
+        # 10.3 ms while width-128 read 4.3 in the same run), min-of-rounds
+        # is the standard de-noise for step walls on a drifting chip, and
+        # a degenerate round (ok=False → floored 1e-9) must be DISCARDED,
+        # not min'd in — two_point_slope's contract is flag-don't-publish.
+        for _ in range(2):
+            for w in buckets:
+                toks = jnp.asarray(
+                    rng2.integers(0, cfg.vocab_size, (local_batch, w)),
+                    jnp.int32,
+                )
+                msk = jnp.ones((local_batch, w), jnp.int32)
+                s, ok = device_step_seconds(step_fn, dp, do, toks, msk)
+                if ok:
+                    step_s[w] = min(step_s.get(w, float("inf")), s)
+        slopes_ok = len(step_s) == len(buckets)
+        extra.update({
+            "device_step_ms_per_width": {
+                int(w): round(s * 1e3, 2) for w, s in sorted(step_s.items())
+            },
+            "batches_per_width": {
+                int(w): int(b) for w, b in sorted(batches_by_width.items())
+            },
+            "device_slopes_ok": slopes_ok,
+        })
+        if slopes_ok:
+            bucketed_dev = sum(
+                step_s[w] * b for w, b in batches_by_width.items()
+            )
+            # The padmax side's own batch count (bucket fragmentation
+            # gives the bucketed pass a couple more part-full batches).
+            padmax_dev = step_s[max_w] * sum(p_batches.values())
+            extra.update({
+                "bucketed_device_step_s": round(bucketed_dev, 2),
+                "padmax_device_step_s": round(padmax_dev, 2),
+                "vs_padmax_device": (
+                    round(padmax_dev / bucketed_dev, 2)
+                    if bucketed_dev else None
+                ),
+            })
+        else:
+            # No valid slope for some width in either round: publishing a
+            # ratio built on floored values would fabricate the headline.
+            extra.update({
+                "bucketed_device_step_s": None,
+                "padmax_device_step_s": None,
+                "vs_padmax_device": None,
+            })
+    return _result("9:ragged-bucketed-train", rows, elapsed, stream, extra)
 
 
 SCENARIOS = {
