@@ -97,6 +97,13 @@ def check_serving_mesh(cfg: TransformerConfig, mesh: Mesh, *, batch: int | None 
             "weights shard over pp; decode is layer-sharded storage, not a "
             "pipelined schedule)"
         )
+    if mesh.shape.get("sp", 1) > 1:
+        raise ValueError(
+            "serving meshes must not carry an sp axis: decode is one token "
+            "per step (nothing to sequence-shard) and prefill under sp "
+            "would engage ring attention against an unsharded prompt — "
+            "shard kv heads over tp and slots over data instead"
+        )
     dp = mesh.shape.get("data", 1)
     if batch is not None and dp > 1 and batch % dp:
         raise ValueError(
@@ -214,21 +221,22 @@ def prefill(
 
     With ``mesh``, the prompt batch is constrained over data and the cache
     over (data, tp) — weights are assumed committed to ``serving_shardings``
-    layouts. Attention takes the dense XLA body under a mesh: the Pallas
-    flash kernel is opaque to GSPMD (it cannot be partitioned over a
-    sharded batch), and a prompt-length dense attention is a bounded cost
-    next to the decode loop this path exists for.
+    layouts. Prefill attention under a mesh dispatches through the model's
+    own rules: on TPU the Pallas flash kernels run under shard_map
+    (``flash_attention_sharded`` — a Pallas call is opaque to GSPMD, but
+    batch/head-parallel attention needs no collectives), falling back to
+    the dense XLA body off-TPU or when the batch/heads don't split evenly.
     """
     # A training config that requested a sequence-parallel attn_impl
     # ('ring'/'ulysses') must still be servable from its checkpoint, so
     # fall back to the adaptive spelling rather than tripping the
-    # constructor's misconfigured-mesh guard.
-    if mesh is not None:
-        model = Transformer(dataclasses.replace(cfg, attn_impl="dense"))
-    elif cfg.attn_impl in ("ring", "ulysses"):
-        model = Transformer(dataclasses.replace(cfg, attn_impl="auto"))
+    # constructor's misconfigured-mesh guard. An explicit 'dense' or
+    # 'flash' passes through unchanged — a deliberate kernel opt-out (or
+    # opt-in) is the user's call, mesh or not.
+    if cfg.attn_impl in ("ring", "ulysses"):
+        model = Transformer(dataclasses.replace(cfg, attn_impl="auto"), mesh)
     else:
-        model = Transformer(cfg)
+        model = Transformer(cfg, mesh)
     if mesh is not None:
         tokens = lax.with_sharding_constraint(
             tokens, slot_sharding(mesh, tokens.ndim)

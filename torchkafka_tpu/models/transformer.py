@@ -458,6 +458,30 @@ class Transformer:
             cfg.attn_impl == "flash"
             or (cfg.attn_impl == "auto" and jax.default_backend() == "tpu")
         )
+        # Flash on a multi-device auto-sharded mesh must go through
+        # shard_map (a Pallas call is opaque to GSPMD — it cannot split
+        # the kernel the way it splits einsums): batch over (data, fsdp),
+        # heads over tp, zero collectives. Head counts must divide tp for
+        # even shards; otherwise the dense path serves (GSPMD partitions
+        # plain einsums fine). Batch divisibility is checked per call.
+        self._flash_shard_mesh = None
+        if (
+            self._use_flash
+            and mesh is not None
+            # NOT under pipeline parallelism: pp>1 runs the layers inside
+            # gpipe's manual-over-pp shard_map region, where a nested
+            # shard_map over the full mesh trips a context-mesh mismatch
+            # — there the kernel stays plain, as before this gate.
+            and mesh.shape.get("pp", 1) == 1
+            and any(
+                mesh.shape.get(a, 1) > 1 for a in ("data", "fsdp", "tp")
+            )
+        ):
+            tp_sz = mesh.shape.get("tp", 1)
+            if cfg.n_heads % tp_sz or cfg.n_kv_heads % tp_sz:
+                self._use_flash = False
+            else:
+                self._flash_shard_mesh = mesh
 
     def init(self, rng: jax.Array) -> dict:
         return init_params(rng, self.cfg)
@@ -474,8 +498,23 @@ class Transformer:
                 use_flash=self.cfg.ring_use_flash,
             )
         if self._use_flash:
-            from torchkafka_tpu.ops.flash import flash_attention
+            from torchkafka_tpu.ops.flash import (
+                flash_attention,
+                flash_attention_sharded,
+            )
 
+            if self._flash_shard_mesh is not None:
+                m = self._flash_shard_mesh
+                n_b = m.shape.get("data", 1) * m.shape.get("fsdp", 1)
+                if q.shape[0] % n_b == 0:
+                    return flash_attention_sharded(q, k, v, m, causal=True)
+                # Batch does not split evenly (e.g. a small serving slot
+                # pool on a wide mesh): dense body, repeating GQA kv here
+                # because the flash path skipped _layer's repeat.
+                from torchkafka_tpu.ops.flash import _repeat_kv
+
+                k, v = _repeat_kv(q, k, v)
+                return mha(q, k, v, causal=True)
             return flash_attention(q, k, v, True)
         return mha(q, k, v, causal=True)
 

@@ -525,3 +525,55 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, g):
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh,
+    causal: bool = True,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Flash attention under an auto-sharded {data, fsdp, tp} mesh.
+
+    A Pallas call is OPAQUE to GSPMD: inside a jit with sharded operands
+    the partitioner cannot split the kernel the way it splits einsums, so
+    plain ``flash_attention`` on a multi-device mesh either replicates the
+    work or fails to partition. But batch/head-parallel attention needs NO
+    communication — each (batch-shard, head-shard) attends over its own
+    full sequence independently — so this wraps the kernel in
+    ``shard_map``: batch over (data, fsdp), q heads AND kv heads over tp
+    (the GQA group ratio is preserved per shard). Differentiable like the
+    unsharded kernel (shard_map composes with the custom VJP).
+
+    Requirements (the caller gates on these — Transformer falls back to
+    the dense path otherwise): B divisible by data·fsdp, H and K by tp.
+    Per-shard sequences that don't tile fall back to dense INSIDE the
+    shard, same math. Mesh axes not named here (sp/pp/ep) see the inputs
+    replicated, matching what GSPMD would do.
+    """
+    # jax >= 0.4.35: top-level shard_map with axis_names/check_vma. No
+    # experimental-module fallback — that API takes check_rep/auto and
+    # would TypeError on these kwargs anyway.
+    shard_map = jax.shard_map
+    from jax.sharding import PartitionSpec as P
+
+    batch_axes = tuple(a for a in ("data", "fsdp") if a in mesh.shape)
+    tp = "tp" if "tp" in mesh.shape else None
+    spec = P(batch_axes if batch_axes else None, None, tp, None)
+    manual = frozenset(batch_axes) | (frozenset({tp}) if tp else frozenset())
+    fn = shard_map(
+        functools.partial(
+            flash_attention, causal=causal, interpret=interpret
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        # Manual over ONLY the batch/head axes; any other mesh axes
+        # (sp/pp/ep) stay auto-sharded for GSPMD to manage around the
+        # kernel, matching the ring/ulysses wrappers' style.
+        axis_names=manual,
+        check_vma=False,
+    )
+    return fn(q, k, v)
