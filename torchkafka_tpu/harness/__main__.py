@@ -21,6 +21,10 @@ def main() -> None:
                     "blocks — the continuous-batching row (slots readmit "
                     "mid-stream); default at scale is EOS off, one dispatch "
                     "per generation (the throughput ceiling)")
+    ap.add_argument("--quantized", action="store_true", default=None,
+                    help="serve the zoo scale weight-only int8 (default: "
+                    "only 8b; decode is bytes-bound, so int8 halves the "
+                    "streamed bytes vs bf16)")
     args = ap.parse_args()
     if args.scenario:
         nums = [args.scenario]
@@ -31,7 +35,7 @@ def main() -> None:
     for n in nums:
         print(json.dumps(run_scenario(
             n, args.size, model_scale=args.model_scale,
-            serve_eos=args.serve_eos,
+            serve_eos=args.serve_eos, quantized=args.quantized,
         )))
 
 

@@ -526,19 +526,29 @@ def scenario_4(size: str = "tiny") -> dict:
 
 
 def _serving_model(size: str, model_scale: str | None, prompt_len: int,
-                   max_new: int):
+                   max_new: int, quantized: bool | None = None):
     """(cfg, params, label) for the serving scenarios. ``model_scale`` is
     the VERDICT-r3 scale flag: None keeps the historical tiny/45m configs
     (comparable across rounds); '45m' | '1b' | '8b' draws from the model
     zoo at true serving bytes — '8b' in int8 (the only way 8B fits one
     16 GB chip), the rest bf16 params (so counted bytes == streamed
-    bytes in the rooflines)."""
+    bytes in the rooflines). ``quantized`` overrides the per-scale
+    default (--quantized serves ANY scale weight-only int8 — decode is
+    bytes-bound, so halving bytes vs bf16 raises the roofline
+    ceiling); it requires a model_scale, and '8b' cannot un-quantize
+    (validated here so direct scenario_5/7 calls get the same guards as
+    the CLI)."""
     import jax
     import jax.numpy as jnp
 
     from torchkafka_tpu.models import TransformerConfig
     from torchkafka_tpu.models.transformer import init_params
 
+    if quantized is not None and model_scale is None:
+        raise ValueError(
+            "quantized requires a model_scale (the tiny/default configs "
+            "ignore dtype knobs; accepting it would silently serve bf16)"
+        )
     if model_scale is None:
         cfg = (
             TransformerConfig(vocab_size=512, d_model=64, n_layers=2,
@@ -555,20 +565,32 @@ def _serving_model(size: str, model_scale: str | None, prompt_len: int,
     from torchkafka_tpu.models.zoo import random_serving_params, zoo_config
 
     cfg = zoo_config(model_scale, max_seq_len=prompt_len + max_new)
+    if quantized is None:
+        quantized = model_scale == "8b"
+    elif model_scale == "8b" and not quantized:
+        raise ValueError(
+            "8b serves int8 only: bf16 8B params are ~16 GB and cannot fit "
+            "one 16 GB chip next to the KV pool (and '8b' labels int8 in "
+            "every published table)"
+        )
     t0 = _time.perf_counter()
     params = random_serving_params(
-        jax.random.key(0), cfg, quantized=(model_scale == "8b")
+        jax.random.key(0), cfg, quantized=quantized
     )
     jax.block_until_ready(params)
+    label = f"{model_scale}-int8" if quantized and model_scale != "8b" else model_scale
     print(
-        f"[scale {model_scale}] params materialised in "
+        f"[scale {label}] params materialised in "
         f"{_time.perf_counter() - t0:.1f}s",
         file=sys.stderr, flush=True,
     )
-    return cfg, params, model_scale
+    return cfg, params, label
 
 
-def scenario_5(size: str = "tiny", model_scale: str | None = None) -> dict:
+def scenario_5(
+    size: str = "tiny", model_scale: str | None = None,
+    quantized: bool | None = None,
+) -> dict:
     """Prompt topic → KV-cache generation → commit offsets only after the
     whole generation retires (BASELINE config 5; no reference analog).
     ``model_scale`` (45m | 1b | 8b) serves the zoo models at true HBM
@@ -590,7 +612,9 @@ def scenario_5(size: str = "tiny", model_scale: str | None = None) -> dict:
         n, batch = 128, 16
     elif model_scale == "8b":
         n, batch = 48, 16
-    cfg, params, label = _serving_model(size, model_scale, prompt_len, max_new)
+    cfg, params, label = _serving_model(
+        size, model_scale, prompt_len, max_new, quantized
+    )
     broker = tk.InMemoryBroker()
     broker.create_topic("t5", partitions=2)
     rng = np.random.default_rng(0)
@@ -688,7 +712,7 @@ def scenario_5(size: str = "tiny", model_scale: str | None = None) -> dict:
 
 def scenario_7(
     size: str = "tiny", model_scale: str | None = None,
-    serve_eos: bool = False,
+    serve_eos: bool = False, quantized: bool | None = None,
 ) -> dict:
     """Continuous-batching serving (serve.StreamingGenerator): same prompt
     topic shape as scenario 5, but slots recycle as generations hit EOS —
@@ -721,7 +745,9 @@ def scenario_7(
         n, slots = 128, 16
     elif model_scale == "8b":
         n, slots = 48, 16
-    cfg, params, label = _serving_model(size, model_scale, prompt_len, max_new)
+    cfg, params, label = _serving_model(
+        size, model_scale, prompt_len, max_new, quantized
+    )
     broker = tk.InMemoryBroker()
     broker.create_topic("t7", partitions=2)
     rng = np.random.default_rng(0)
@@ -1185,18 +1211,21 @@ SCENARIOS = {
 
 def run_scenario(
     num: int, size: str = "tiny", *, model_scale: str | None = None,
-    serve_eos: bool = False,
+    serve_eos: bool = False, quantized: bool | None = None,
 ) -> dict:
     if size not in _SIZES:
         raise ValueError(f"size must be one of {_SIZES}")
     if serve_eos and (num != 7 or model_scale is None):
         raise ValueError("--serve-eos applies to scenario 7 at a model scale")
+    if quantized is not None and (model_scale is None or num not in (5, 7)):
+        raise ValueError("--quantized applies to scenarios 5/7 at a model scale")
     if model_scale is not None:
         if num not in (5, 7):
             raise ValueError("model_scale applies to scenarios 5 and 7 only")
         if num == 7:
             return SCENARIOS[7](
-                size, model_scale=model_scale, serve_eos=serve_eos
+                size, model_scale=model_scale, serve_eos=serve_eos,
+                quantized=quantized,
             )
-        return SCENARIOS[5](size, model_scale=model_scale)
+        return SCENARIOS[5](size, model_scale=model_scale, quantized=quantized)
     return SCENARIOS[num](size)
