@@ -71,7 +71,11 @@ def build_broker(tk, pid: int):
 
 def serve_main(pid: int, outdir: str, mark) -> int:
     """Pod serving: this host's slice of the prompt topic through the
-    continuous-batching server with a replicated tiny model."""
+    continuous-batching server, MODEL-SHARDED tp=2 over the host's two
+    local devices — dp across hosts (disjoint partitions) × tp within a
+    host, the v5e-pod serving topology. Each host's mesh holds only its
+    addressable devices, so the decode collectives ride intra-host links
+    and never cross the pod."""
     import jax
     import numpy as np
 
@@ -81,7 +85,7 @@ def serve_main(pid: int, outdir: str, mark) -> int:
 
     P, MAX_NEW, N = 8, 4, 8
     cfg = TransformerConfig(
-        vocab_size=64, d_model=16, n_layers=1, n_heads=2, n_kv_heads=1,
+        vocab_size=64, d_model=16, n_layers=1, n_heads=2, n_kv_heads=2,
         d_ff=32, max_seq_len=P + MAX_NEW, dtype=jax.numpy.float32,
     )
     params = init_params(jax.random.key(0), cfg)
@@ -92,15 +96,29 @@ def serve_main(pid: int, outdir: str, mark) -> int:
         broker.produce(
             "prompts", rng.integers(0, 64, P, dtype=np.int32).tobytes()
         )
+    mesh = tk.make_mesh({"tp": 2}, devices=jax.local_devices())
     consumer = tk.MemoryConsumer(broker, "prompts", group_id="gs")
     server = StreamingGenerator(
         consumer, params, cfg, slots=2, prompt_len=P, max_new=MAX_NEW,
-        commit_every=2,
+        commit_every=2, mesh=mesh,
     )
+    # The kv pool must actually be HEAD-SHARDED over this host's devices:
+    # check the per-device shard's kv-head extent (axis 3 of
+    # [L, B, M, K, Dh]) is K/tp — a replicated pool would have the same
+    # device_set, so a devices-only check could not catch the sharding
+    # silently degrading to replication.
+    kv = server._caches[0]
+    kv_devices = {d.id for d in kv.sharding.device_set}
+    assert kv_devices == {d.id for d in jax.local_devices()}, kv_devices
+    shard_k = kv.addressable_shards[0].data.shape[3]
+    assert shard_k == cfg.n_kv_heads // 2, (shard_k, kv.sharding)
     served = sum(1 for _ in server.run(max_records=N))
     committed = broker.committed("gs", tk.TopicPartition("prompts", 0))
     consumer.close()
-    mark("served", {"served": served, "committed": committed})
+    mark("served", {
+        "served": served, "committed": committed,
+        "tp_devices": sorted(kv_devices),
+    })
     jax.distributed.shutdown()
     return 0
 

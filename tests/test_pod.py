@@ -149,15 +149,21 @@ class TestPodCommit:
 
     def test_pod_serving(self, tmp_path):
         """Each pod process serves its own partition slice through the
-        continuous-batching server under a live jax.distributed runtime —
-        pod serving is per-host-parallel, but it must coexist with the
-        distributed client and keep per-host commit accounting exact."""
+        continuous-batching server under a live jax.distributed runtime,
+        MODEL-SHARDED tp=2 over its two local devices (r5) — dp across
+        hosts × tp within a host, with per-host commit accounting exact
+        and the kv pool actually head-sharded on each host's devices."""
         procs = _spawn_pod(2, str(tmp_path), "serve")
         codes = _wait_all(procs, str(tmp_path), timeout_s=420)
         assert codes == [0, 0], _diagnose(procs, str(tmp_path))
+        seen_devices = []
         for pid in (0, 1):
             served = _read(str(tmp_path), "served", pid)
-            assert served == {"served": 8, "committed": 8}, served
+            assert served["served"] == 8 and served["committed"] == 8, served
+            assert len(served["tp_devices"]) == 2, served
+            seen_devices.append(tuple(served["tp_devices"]))
+        # Each host sharded over ITS OWN two devices, not a shared pair.
+        assert seen_devices[0] != seen_devices[1], seen_devices
 
     def test_pod_checkpoint_roundtrip(self, tmp_path):
         """Multi-host checkpoint: Orbax's coordinated sharded write (no
