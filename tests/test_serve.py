@@ -671,3 +671,115 @@ class TestMeshShardedServing:
             # Every completion committed (commit_every=1): watermarks cover
             # exactly the 5 prompts per partition.
             assert committed == {0: 5, 1: 5}, (axes, committed)
+
+
+class TestInt8KV:
+    """Opt-in int8 slot pool (kv_dtype='int8'): pool bytes ~halve, commits
+    stay exact, quantization error is bounded — token-exactness vs the
+    bf16 path is deliberately given up (documented)."""
+
+    def test_quant_roundtrip_error_bound(self):
+        from torchkafka_tpu.serve import _quant_kv
+
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(4, 32, 2, 16)) * 3.0, jnp.float32)
+        q, s = _quant_kv(x)
+        assert q.dtype == jnp.int8 and s.shape == x.shape[:-1]
+        back = np.asarray(q * s[..., None])
+        # Symmetric absmax: error <= scale/2 = absmax/254 per group.
+        bound = np.asarray(s)[..., None] / 2 + 1e-7
+        assert (np.abs(back - np.asarray(x)) <= bound).all()
+
+    def test_serves_and_commits_exactly(self, model):
+        cfg, params = model
+        broker = tk.InMemoryBroker()
+        _topic(broker, 10)
+        consumer = tk.MemoryConsumer(broker, "p", group_id="gkv8")
+        server = StreamingGenerator(
+            consumer, params, cfg, slots=4, prompt_len=P, max_new=MAX_NEW,
+            commit_every=1, kv_dtype="int8",
+        )
+        # Pool layout: int8 payloads + f32 scales, ~ (1 + 4/Dh) bytes per
+        # element vs the f32 fixture's 4 (bf16 zoo models: vs 2).
+        pool_bytes = sum(int(c.nbytes) for c in server._caches)
+        dense_bytes = 2 * cfg.n_layers * 4 * (P + MAX_NEW) * (
+            cfg.n_kv_heads * cfg.head_dim
+        ) * 4
+        assert pool_bytes < dense_bytes / 2, (pool_bytes, dense_bytes)
+        served = 0
+        for _rec, toks in server.run(max_records=10):
+            assert 1 <= len(toks) <= MAX_NEW
+            assert (np.asarray(toks) >= 0).all() and (
+                np.asarray(toks) < VOCAB
+            ).all()
+            served += 1
+        server.close()
+        assert served == 10
+        committed = {
+            pt: broker.committed("gkv8", tk.TopicPartition("p", pt))
+            for pt in (0, 1)
+        }
+        assert committed == {0: 5, 1: 5}, committed
+        consumer.close()
+
+    def test_rejects_bad_kv_dtype(self, model):
+        cfg, params = model
+        broker = tk.InMemoryBroker()
+        broker.create_topic("p", partitions=1)
+        consumer = tk.MemoryConsumer(broker, "p", group_id="gbad")
+        with pytest.raises(ValueError, match="kv_dtype"):
+            StreamingGenerator(
+                consumer, params, cfg,
+                slots=2, prompt_len=P, max_new=MAX_NEW, kv_dtype="fp8",
+            )
+        consumer.close()
+
+    def test_mesh_sharded_int8_pool(self):
+        """int8 pool + mesh: the 4-tuple (payload, scale, payload, scale)
+        survives the donate-and-rebind round trip with payloads sharded
+        (kv heads over tp, slots over data — asserted by per-device shard
+        extents, not just device membership) and scales on the matching
+        4D layout; serves all prompts with exact commits, token-identical
+        to single-device int8 (f32 model)."""
+        from torchkafka_tpu.parallel import make_mesh
+
+        cfg = TransformerConfig(
+            vocab_size=VOCAB, d_model=32, n_layers=2, n_heads=2, n_kv_heads=2,
+            d_ff=64, max_seq_len=P + MAX_NEW, dtype=jnp.float32,
+        )
+        params = init_params(jax.random.key(0), cfg)
+
+        def run(mesh):
+            broker = tk.InMemoryBroker()
+            prompts = _topic(broker, 10)
+            consumer = tk.MemoryConsumer(broker, "p", group_id="gkvm")
+            server = StreamingGenerator(
+                consumer, params, cfg, slots=4, prompt_len=P,
+                max_new=MAX_NEW, commit_every=1, kv_dtype="int8", mesh=mesh,
+            )
+            if mesh is not None:
+                assert len(server._caches) == 4
+                kq, ks = server._caches[0], server._caches[1]
+                # [L, B, M, K, Dh]: B/data=2, K/tp=1 per shard.
+                assert kq.addressable_shards[0].data.shape[1] == 4 // 2
+                assert kq.addressable_shards[0].data.shape[3] == 2 // 2
+                # Scales [L, B, M, K] on the same axes.
+                assert ks.addressable_shards[0].data.shape[1] == 4 // 2
+                assert ks.addressable_shards[0].data.shape[3] == 2 // 2
+            out = {}
+            for rec, toks in server.run(max_records=10):
+                out[2 * rec.offset + rec.partition] = np.asarray(toks)
+            server.close()
+            committed = {
+                pt: broker.committed("gkvm", tk.TopicPartition("p", pt))
+                for pt in (0, 1)
+            }
+            consumer.close()
+            assert committed == {0: 5, 1: 5}, committed
+            return out
+
+        base = run(None)
+        sharded = run(make_mesh({"data": 2, "tp": 2, "fsdp": 2}))
+        assert set(sharded) == set(base)
+        for idx in base:
+            np.testing.assert_array_equal(sharded[idx], base[idx])

@@ -25,6 +25,11 @@ def main() -> None:
                     help="serve the zoo scale weight-only int8 (default: "
                     "only 8b; decode is bytes-bound, so int8 halves the "
                     "streamed bytes vs bf16)")
+    ap.add_argument("--kv-int8", action="store_true",
+                    help="scenario 7: int8 slot pool (capacity lever — "
+                    "~52%% of bf16 pool bytes; measured ~24%% slower at "
+                    "equal slots but serves slot/context budgets bf16 "
+                    "cannot fit — see PERF.md)")
     args = ap.parse_args()
     if args.scenario:
         nums = [args.scenario]
@@ -36,6 +41,7 @@ def main() -> None:
         print(json.dumps(run_scenario(
             n, args.size, model_scale=args.model_scale,
             serve_eos=args.serve_eos, quantized=args.quantized,
+            kv_int8=args.kv_int8,
         )))
 
 

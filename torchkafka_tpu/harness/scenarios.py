@@ -713,6 +713,7 @@ def scenario_5(
 def scenario_7(
     size: str = "tiny", model_scale: str | None = None,
     serve_eos: bool = False, quantized: bool | None = None,
+    kv_int8: bool = False,
 ) -> dict:
     """Continuous-batching serving (serve.StreamingGenerator): same prompt
     topic shape as scenario 5, but slots recycle as generations hit EOS —
@@ -779,6 +780,7 @@ def scenario_7(
     server = StreamingGenerator(
         consumer, params, cfg, slots=slots, prompt_len=prompt_len,
         max_new=max_new, eos_id=eos_id, commit_every=slots,
+        kv_dtype="int8" if kv_int8 else None,
         # Dispatch + sync latency dominate per-token syncing on tunneled
         # transports. With EOS off at scale, ONE dispatch per generation is
         # strictly better (max_new - 1: prefill emits token 0, so a
@@ -833,6 +835,7 @@ def scenario_7(
         "readmissions": server.metrics.readmissions.count,
         "eos_mode": "on" if eos_id is not None else "off(one-dispatch)",
         "ticks_per_sync": ticks_per_sync,
+        "kv_dtype": "int8" if kv_int8 else "compute",
         "slots": slots,
         "committed": committed,
         "commit_failures": server.metrics.commit_failures.count,
@@ -1212,6 +1215,7 @@ SCENARIOS = {
 def run_scenario(
     num: int, size: str = "tiny", *, model_scale: str | None = None,
     serve_eos: bool = False, quantized: bool | None = None,
+    kv_int8: bool = False,
 ) -> dict:
     if size not in _SIZES:
         raise ValueError(f"size must be one of {_SIZES}")
@@ -1219,13 +1223,17 @@ def run_scenario(
         raise ValueError("--serve-eos applies to scenario 7 at a model scale")
     if quantized is not None and (model_scale is None or num not in (5, 7)):
         raise ValueError("--quantized applies to scenarios 5/7 at a model scale")
+    if kv_int8 and num != 7:
+        raise ValueError("--kv-int8 applies to scenario 7 (the slot pool)")
     if model_scale is not None:
         if num not in (5, 7):
             raise ValueError("model_scale applies to scenarios 5 and 7 only")
         if num == 7:
             return SCENARIOS[7](
                 size, model_scale=model_scale, serve_eos=serve_eos,
-                quantized=quantized,
+                quantized=quantized, kv_int8=kv_int8,
             )
         return SCENARIOS[5](size, model_scale=model_scale, quantized=quantized)
+    if kv_int8:
+        return SCENARIOS[7](size, kv_int8=True)
     return SCENARIOS[num](size)

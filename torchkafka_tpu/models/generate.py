@@ -127,6 +127,12 @@ def kv_sharding(mesh: Mesh) -> NamedSharding:
     return shardings_for_mesh(mesh, P(None, "data", None, "tp", None))
 
 
+def kv_scale_sharding(mesh: Mesh) -> NamedSharding:
+    """int8-KV scale tensors [L, B, M, K] (the payload layout minus the
+    head_dim axis): slots over data, kv heads over tp."""
+    return shardings_for_mesh(mesh, P(None, "data", None, "tp"))
+
+
 def slot_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
     """Per-slot vectors [B, ...] (tokens, positions, masks): over data."""
     return shardings_for_mesh(mesh, P("data", *([None] * (ndim - 1))))

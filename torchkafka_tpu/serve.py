@@ -41,6 +41,7 @@ from torchkafka_tpu.models.generate import (
     _attend_cached,
     _project_qkv,
     check_serving_mesh,
+    kv_scale_sharding,
     kv_sharding,
     prefill,
     serving_shardings,
@@ -59,7 +60,7 @@ V5E_PEAK_HBM_GBS = 819.0
 
 
 def decode_tick_bytes(params, cfg: TransformerConfig, batch: int,
-                      max_len: int) -> tuple[int, int]:
+                      max_len: int, kv_int8: bool = False) -> tuple[int, int]:
     """(weight_bytes, kv_bytes) streamed from HBM per decode tick.
 
     Weights: every layer tensor and the lm_head are read in full (the
@@ -67,17 +68,68 @@ def decode_tick_bytes(params, cfg: TransformerConfig, batch: int,
     table is a gather of one row per slot — counting the full [V, D]
     table would overstate bytes/tick ~5-7% at zoo scales. KV: both cache
     halves across all layers at the STATIC pool length (attention reads
-    the whole buffer; masking discards, it does not skip)."""
+    the whole buffer; masking discards, it does not skip); ``kv_int8``
+    counts the quantized pool (1 byte/element + one f32 scale per
+    (layer, slot, position, head) group)."""
     from torchkafka_tpu.models.quant import quantized_nbytes
 
     total = quantized_nbytes(params)
     embed = quantized_nbytes(params["embed"])
     embed_rows_read = batch * (embed // max(cfg.vocab_size, 1))
-    kv = (
-        2 * cfg.n_layers * batch * max_len * cfg.n_kv_heads * cfg.head_dim
-        * jnp.dtype(cfg.dtype).itemsize
-    )
+    groups = 2 * cfg.n_layers * batch * max_len * cfg.n_kv_heads
+    if kv_int8:
+        kv = groups * (cfg.head_dim + 4)  # int8 payload + f32 scale
+    else:
+        kv = groups * cfg.head_dim * jnp.dtype(cfg.dtype).itemsize
     return total - embed + embed_rows_read, kv
+
+
+def _quant_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Symmetric absmax int8 over the last (head_dim) axis:
+    [..., Dh] → (int8 [..., Dh], f32 scale [...])."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.maximum(amax / 127.0, 1e-12)
+    q = jnp.clip(
+        jnp.round(x.astype(jnp.float32) / scale[..., None]), -127, 127
+    ).astype(jnp.int8)
+    return q, scale
+
+
+def _slot_layer_step_q(x, layer, ck_q, ck_s, cv_q, cv_s, pos_b, cfg):
+    """int8-KV variant of ``_slot_layer_step``: the pool stores int8
+    payloads + per-(position, head) f32 absmax scales over Dh —
+    (Dh+4)/(2·Dh) ≈ 52% of bf16 pool bytes at Dh=128 — dequantized at
+    the attention read. This is a CAPACITY lever, not a bandwidth win:
+    measured on v5e at 8B, XLA does NOT fuse the broadcast dequant
+    multiply into the attention einsum's HBM read (unlike weight dequant
+    into matmuls), so equal-slot throughput is ~24% lower than bf16 KV
+    (PERF.md) while the halved pool serves slot/context budgets the bf16
+    pool cannot fit. Quantization error is bounded by absmax/127 per
+    group; this stays OPT-IN because token-exactness vs the bf16 path is
+    deliberately given up."""
+    q, k, v = _project_qkv(x, layer, cfg)
+    q = _rope(q, pos_b[:, None], cfg.rope_theta)
+    k = _rope(k, pos_b[:, None], cfg.rope_theta)
+    kq, ks = _quant_kv(k[:, 0])  # [B, K, Dh] int8, [B, K]
+    vq, vs = _quant_kv(v[:, 0])
+    upd3 = jax.vmap(
+        lambda c, row, p: lax.dynamic_update_slice(c, row[None], (p, 0, 0))
+    )
+    upd2 = jax.vmap(
+        lambda c, row, p: lax.dynamic_update_slice(c, row[None], (p, 0))
+    )
+    ck_q = upd3(ck_q, kq, pos_b)
+    ck_s = upd2(ck_s, ks, pos_b)
+    cv_q = upd3(cv_q, vq, pos_b)
+    cv_s = upd2(cv_s, vs, pos_b)
+    valid = jnp.arange(ck_q.shape[1])[None, :] <= pos_b[:, None]  # [B, M]
+    # Dequantize in the COMPUTE dtype (int8→bf16 · bf16 scale): an
+    # int8·f32 product would materialise an f32 [B, M, K, Dh] intermediate
+    # (4 bytes/element where the whole point is 1) before the cast.
+    kk = ck_q.astype(cfg.dtype) * ck_s[..., None].astype(cfg.dtype)
+    vv = cv_q.astype(cfg.dtype) * cv_s[..., None].astype(cfg.dtype)
+    x = _attend_cached(x, q, kk, vv, valid, layer, cfg)
+    return x, ck_q, ck_s, cv_q, cv_s
 
 
 class ServeMetrics:
@@ -209,6 +261,7 @@ class StreamingGenerator:
         encode_output: Callable[[Record, np.ndarray], bytes] | None = None,
         max_send_failure_streak: int = 64,
         mesh=None,
+        kv_dtype: str | None = None,
     ) -> None:
         """``ticks_per_sync``: decode ticks chained per device dispatch
         (and per host sync of the done mask). Higher amortises dispatch
@@ -235,6 +288,15 @@ class StreamingGenerator:
         This is what serves anything one chip cannot hold (bf16 8B+, long
         KV budgets). Token-exact vs mesh-less serving
         (differential-tested); the multichip dryrun proves the path.
+
+        ``kv_dtype``: None = the compute dtype (token-exact vs
+        ``generate``); ``"int8"`` = quantized slot pool (int8 payload +
+        per-(position, head) f32 absmax scale, ≈52% of bf16 pool bytes at
+        head_dim 128) — the memory headroom that buys more concurrent
+        slots at the 8B-class scales (measured: 192 slots run where bf16
+        OOMs, but equal-slot throughput is ~24% lower — see PERF.md), at
+        the cost of bounded quantization error (opt-in precisely because
+        token-exactness is given up).
 
         ``max_send_failure_streak``: a SYNCHRONOUS send failure leaves its
         record uncommitted (the watermark stalls there, it re-delivers on
@@ -278,6 +340,9 @@ class StreamingGenerator:
         )
         if max_send_failure_streak < 1:
             raise ValueError("max_send_failure_streak must be >= 1")
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(f"kv_dtype must be None or 'int8', got {kv_dtype!r}")
+        self._kv_int8 = kv_dtype == "int8"
         self._max_send_failure_streak = max_send_failure_streak
         self._send_failure_streak = 0
         self._pending_outputs: list = []  # send handles since last commit
@@ -293,16 +358,24 @@ class StreamingGenerator:
         temp = self._temperature
         mesh = self._mesh
 
+        kv_int8 = self._kv_int8
+
         def pin_state(caches, last_tok, pos, gen):
             """Pin the slot state's layouts inside the jitted programs so
             the donate-and-rebind round trip keeps kv heads on tp and
-            slots on data, instead of whatever GSPMD first guesses."""
+            slots on data, instead of whatever GSPMD first guesses. int8
+            pools carry 4D scale tensors [L, B, M, K] between the 5D
+            payloads — same axes minus head_dim."""
             if mesh is None:
                 return caches, last_tok, pos, gen
             kv = kv_sharding(mesh)
+            kvs = kv_scale_sharding(mesh)
             row = slot_sharding(mesh)
             return (
-                tuple(lax.with_sharding_constraint(c, kv) for c in caches),
+                tuple(
+                    lax.with_sharding_constraint(c, kv if c.ndim == 5 else kvs)
+                    for c in caches
+                ),
                 lax.with_sharding_constraint(last_tok, row),
                 lax.with_sharding_constraint(pos, row),
                 lax.with_sharding_constraint(gen, slot_sharding(mesh, 2)),
@@ -321,14 +394,27 @@ class StreamingGenerator:
             caches, last_tok, pos, gen = pin_state(caches, last_tok, pos, gen)
             logits, fresh = prefill(params, cfg, prompts, M, mesh)
             sel = admit_mask[None, :, None, None, None]  # over [L, B, M, K, Dh]
-            ck = jnp.where(sel, fresh.k, caches[0])
-            cv = jnp.where(sel, fresh.v, caches[1])
+            if kv_int8:
+                fkq, fks = _quant_kv(fresh.k)
+                fvq, fvs = _quant_kv(fresh.v)
+                sel4 = admit_mask[None, :, None, None]  # over [L, B, M, K]
+                caches = (
+                    jnp.where(sel, fkq, caches[0]),
+                    jnp.where(sel4, fks, caches[1]),
+                    jnp.where(sel, fvq, caches[2]),
+                    jnp.where(sel4, fvs, caches[3]),
+                )
+            else:
+                caches = (
+                    jnp.where(sel, fresh.k, caches[0]),
+                    jnp.where(sel, fresh.v, caches[1]),
+                )
             tok0 = pick(logits, key)  # [B]
             last_tok = jnp.where(admit_mask, tok0, last_tok)
             pos = jnp.where(admit_mask, P, pos)
             gen = jnp.where(admit_mask[:, None], 0, gen)
             gen = gen.at[:, 0].set(jnp.where(admit_mask, tok0, gen[:, 0]))
-            return (ck, cv), last_tok, pos, gen
+            return caches, last_tok, pos, gen
 
         K = self._ticks_per_sync
 
@@ -347,15 +433,23 @@ class StreamingGenerator:
                 act = active_in & ~done_latch
                 x = embed_rows(params["embed"], last_tok, cfg.dtype)[:, None, :]
 
-                def body(x, inputs):
-                    layer, ck, cv = inputs
-                    x, ck, cv = _slot_layer_step(x, layer, ck, cv, pos, cfg)
-                    return x, (ck, cv)
+                if kv_int8:
+                    def body(x, inputs):
+                        layer, ckq, cks, cvq, cvs = inputs
+                        x, ckq, cks, cvq, cvs = _slot_layer_step_q(
+                            x, layer, ckq, cks, cvq, cvs, pos, cfg
+                        )
+                        return x, (ckq, cks, cvq, cvs)
+                else:
+                    def body(x, inputs):
+                        layer, ck, cv = inputs
+                        x, ck, cv = _slot_layer_step(x, layer, ck, cv, pos, cfg)
+                        return x, (ck, cv)
 
-                x, (ck, cv) = lax.scan(
-                    body, x, (params["layers"], caches[0], caches[1])
+                x, new_caches = lax.scan(
+                    body, x, (params["layers"], *caches)
                 )
-                caches = (ck, cv)
+                caches = new_caches
                 x = _rms_norm(x, params["ln_f"])
                 logits = jnp.einsum(
                     "bd,dv->bv", x[:, 0], load_weight(params["lm_head"], cfg.dtype),
@@ -408,10 +502,18 @@ class StreamingGenerator:
         self._tick_block_raw = tick_block
         self._admit_fn = lambda *a: _admit(self._params, *a)
         self._tick_fn = lambda *a: _tick(self._params, *a)
-        self._caches = (
-            jnp.zeros((nl, B, M, kh, dh), cfg.dtype),
-            jnp.zeros((nl, B, M, kh, dh), cfg.dtype),
-        )
+        if kv_int8:
+            self._caches = (
+                jnp.zeros((nl, B, M, kh, dh), jnp.int8),
+                jnp.zeros((nl, B, M, kh), jnp.float32),
+                jnp.zeros((nl, B, M, kh, dh), jnp.int8),
+                jnp.zeros((nl, B, M, kh), jnp.float32),
+            )
+        else:
+            self._caches = (
+                jnp.zeros((nl, B, M, kh, dh), cfg.dtype),
+                jnp.zeros((nl, B, M, kh, dh), cfg.dtype),
+            )
         self._last_tok = jnp.zeros((B,), jnp.int32)
         self._pos = jnp.zeros((B,), jnp.int32)
         self._gen = jnp.zeros((B, self._max_new), jnp.int32)
@@ -419,8 +521,12 @@ class StreamingGenerator:
             # Place the initial pool in its serving layout so the first
             # dispatch doesn't start from replicated buffers.
             kv = kv_sharding(mesh)
+            kvs = kv_scale_sharding(mesh)
             row = slot_sharding(mesh)
-            self._caches = tuple(jax.device_put(c, kv) for c in self._caches)
+            self._caches = tuple(
+                jax.device_put(c, kv if c.ndim == 5 else kvs)
+                for c in self._caches
+            )
             self._last_tok = jax.device_put(self._last_tok, row)
             self._pos = jax.device_put(self._pos, row)
             self._gen = jax.device_put(self._gen, slot_sharding(mesh, 2))
@@ -499,7 +605,7 @@ class StreamingGenerator:
         )
         overhead_ms = overhead_s * 1e3
         w_bytes, kv_bytes = decode_tick_bytes(
-            self._params, cfg, B, self._max_len
+            self._params, cfg, B, self._max_len, kv_int8=self._kv_int8
         )
         bytes_per_tick = w_bytes + kv_bytes
         roofline_tok_s = B * peak_hbm_gbs * 1e9 / bytes_per_tick
