@@ -9,6 +9,11 @@ sharded batch → pjit step → barrier → commit — is demonstrated and bench
 against real MXU-shaped compute, not a stub.
 """
 
+# NOTE: the `generate` FUNCTION is deliberately NOT re-exported here —
+# binding it at package level would shadow the `models.generate` SUBMODULE
+# attribute (import torchkafka_tpu.models.generate would yield the
+# function), breaking module-style access to prefill/serving helpers.
+from torchkafka_tpu.models.generate import check_serving_mesh, serving_shardings
 from torchkafka_tpu.models.recsys import DLRMConfig, make_dlrm_train_step
 from torchkafka_tpu.models.transformer import (
     Transformer,
@@ -20,6 +25,8 @@ __all__ = [
     "DLRMConfig",
     "Transformer",
     "TransformerConfig",
+    "check_serving_mesh",
     "make_dlrm_train_step",
     "make_train_step",
+    "serving_shardings",
 ]
