@@ -148,18 +148,35 @@ def _constrain_cache(cache: KVCache, mesh: Mesh | None) -> KVCache:
     )
 
 
-def _attend_cached(x, q, cache_k, cache_v, valid, layer, cfg):
+def _attend_cached(
+    x, q, cache_k, cache_v, valid, layer, cfg,
+    k_scale=None, v_scale=None,
+):
     """Shared decode tail: grouped-query attention over the kv cache,
     masked softmax, output projection and the MLP residual. x: [B, 1, D];
     q: [B, 1, H, Dh]; caches [B, M, K, Dh]; valid: [B, M] or [M] bool mask
     of readable cache positions. Single source of truth for both the
     lockstep decode (scalar position, generate.py) and the continuous-
-    batching server's per-slot decode (serve.py).
+    batching server's per-slot decode (serve.py), in BOTH cache dtypes.
 
     GQA runs as a grouped einsum — q reshaped [B, S, K, rep, Dh] contracts
     directly against the [B, M, K, Dh] cache. Decode is cache-bandwidth
     bound, so never materialising a repeated H-head cache copy is the
-    difference between reading K heads and reading H heads per token."""
+    difference between reading K heads and reading H heads per token.
+
+    ``k_scale``/``v_scale`` ([B, M, K] f32): int8-KV mode — the caches
+    hold int8 payloads and the per-position scales are FOLDED onto the
+    small score/prob tensors (exact: scales are constant along the Dh
+    contraction), so the big operands carry only an int8→compute cast:
+
+        scores[..., m] = (q · k_int8[m]) · k_scale[m]
+        out            = (probs · v_scale) @ v_int8
+
+    Measured caveat (PERF.md): on v5e XLA still materialises the
+    converted operand as a buffer rather than fusing the cast into the
+    dot's HBM read, so int8 KV trades ~20% equal-slot throughput for
+    ~2× pool capacity; a Pallas decode kernel streaming int8 directly
+    is the known fix."""
     b, s, h, dh = q.shape
     kk = cache_k.astype(cfg.dtype)
     vv = cache_v.astype(cfg.dtype)
@@ -168,15 +185,28 @@ def _attend_cached(x, q, cache_k, cache_v, valid, layer, cfg):
     qg = q.reshape(b, s, n_kv, rep, dh)
     scores = jnp.einsum(
         "bskre,bmke->bkrsm", qg, kk, preferred_element_type=jnp.float32
-    ) / jnp.sqrt(jnp.float32(cfg.head_dim))
+    )
+    if k_scale is not None:
+        # [B, M, K] → [B, K, 1, 1, M] over [B, K, rep, S, M] scores.
+        scores = scores * k_scale.transpose(0, 2, 1)[:, :, None, None, :]
+    scores = scores / jnp.sqrt(jnp.float32(cfg.head_dim))
     if valid.ndim == 1:
         valid = valid[None, :]
     scores = jnp.where(valid[:, None, None, None, :], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
+    if v_scale is not None:
+        probs = probs * v_scale.transpose(0, 2, 1)[:, :, None, None, :]
     attn = jnp.einsum(
         "bkrsm,bmke->bskre", probs.astype(cfg.dtype), vv,
         preferred_element_type=jnp.float32,
     ).astype(cfg.dtype).reshape(b, s, h, dh)
+    return _attn_tail(x, attn, layer, cfg)
+
+
+def _attn_tail(x, attn, layer, cfg):
+    """Post-attention residual: output projection + the MLP block. Shared
+    by the bf16 cache read (``_attend_cached``) and the int8-KV read
+    (serve._attend_cached_q8), so the layer math has one definition."""
     x = x + jnp.einsum("bshe,hed->bsd", attn, load_weight(layer["wo"], cfg.dtype))
     h = _rms_norm(x, layer["ln2"])
     if cfg.is_moe:

@@ -98,14 +98,14 @@ def _quant_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
 def _slot_layer_step_q(x, layer, ck_q, ck_s, cv_q, cv_s, pos_b, cfg):
     """int8-KV variant of ``_slot_layer_step``: the pool stores int8
     payloads + per-(position, head) f32 absmax scales over Dh —
-    (Dh+4)/(2·Dh) ≈ 52% of bf16 pool bytes at Dh=128 — dequantized at
-    the attention read. This is a CAPACITY lever, not a bandwidth win:
-    measured on v5e at 8B, XLA does NOT fuse the broadcast dequant
-    multiply into the attention einsum's HBM read (unlike weight dequant
-    into matmuls), so equal-slot throughput is ~24% lower than bf16 KV
-    (PERF.md) while the halved pool serves slot/context budgets the bf16
-    pool cannot fit. Quantization error is bounded by absmax/127 per
-    group; this stays OPT-IN because token-exactness vs the bf16 path is
+    (Dh+4)/(2·Dh) ≈ 52% of bf16 pool bytes at Dh=128 — read through
+    ``_attend_cached``'s scale-folded mode (scales land on the small
+    score/prob tensors; the big operands carry only a cast). This is a
+    CAPACITY lever, not a bandwidth win: measured ~20% lower equal-slot
+    throughput than bf16 KV (XLA materialises the converted operand
+    instead of fusing the cast into the dot read — PERF.md) for ~2× the
+    slot/context headroom. Quantization error is bounded by absmax/127
+    per group; OPT-IN because token-exactness vs the bf16 path is
     deliberately given up."""
     q, k, v = _project_qkv(x, layer, cfg)
     q = _rope(q, pos_b[:, None], cfg.rope_theta)
@@ -123,12 +123,9 @@ def _slot_layer_step_q(x, layer, ck_q, ck_s, cv_q, cv_s, pos_b, cfg):
     cv_q = upd3(cv_q, vq, pos_b)
     cv_s = upd2(cv_s, vs, pos_b)
     valid = jnp.arange(ck_q.shape[1])[None, :] <= pos_b[:, None]  # [B, M]
-    # Dequantize in the COMPUTE dtype (int8→bf16 · bf16 scale): an
-    # int8·f32 product would materialise an f32 [B, M, K, Dh] intermediate
-    # (4 bytes/element where the whole point is 1) before the cast.
-    kk = ck_q.astype(cfg.dtype) * ck_s[..., None].astype(cfg.dtype)
-    vv = cv_q.astype(cfg.dtype) * cv_s[..., None].astype(cfg.dtype)
-    x = _attend_cached(x, q, kk, vv, valid, layer, cfg)
+    x = _attend_cached(
+        x, q, ck_q, cv_q, valid, layer, cfg, k_scale=ck_s, v_scale=cv_s
+    )
     return x, ck_q, ck_s, cv_q, cv_s
 
 
@@ -294,7 +291,7 @@ class StreamingGenerator:
         per-(position, head) f32 absmax scale, ≈52% of bf16 pool bytes at
         head_dim 128) — the memory headroom that buys more concurrent
         slots at the 8B-class scales (measured: 192 slots run where bf16
-        OOMs, but equal-slot throughput is ~24% lower — see PERF.md), at
+        OOMs, but equal-slot throughput is ~20% lower — see PERF.md), at
         the cost of bounded quantization error (opt-in precisely because
         token-exactness is given up).
 
