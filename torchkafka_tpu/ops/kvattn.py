@@ -1,0 +1,144 @@
+"""Pallas int8-KV decode attention — EXPERIMENTAL, measured SLOWER than
+the XLA scale-folded read on v5e; kept as the tested scaffold for a
+DMA-pipelined successor, off by default.
+
+The hypothesis this kernel tested (PERF.md, int8-KV section): the XLA
+spelling of the int8-KV attention read materialises an int8→bf16
+converted copy of the cache instead of fusing the convert into the dot's
+HBM read, costing ~20% equal-slot throughput vs a bf16 cache — so a
+kernel that streams int8 tiles HBM→VMEM directly (the in-VMEM convert is
+on-core work) should win the bytes back. MEASURED RESULT (8B int8
+weights, 96 slots, 192-token budget): this kernel runs the tick at
+85.1 ms vs the XLA read's 46.8 ms — 1.8× SLOWER. Why: decode attention
+is batched GEMV — the per-(slot, head) [rep≤4, Dh]×[Dh, M] dots occupy
+~3% of the MXU's rows, and the (B,)-grid's one-small-DMA-per-slot
+structure pipelines poorly, so the saved HBM bytes are swamped by
+serialized on-core work. The fix is a redesign (M-blocked grid with
+overlapped DMA and head-packed dots), not a tweak — recorded so the next
+attempt starts there. Correctness is pinned by a differential test
+against the scale-folded XLA read (exact to f32 reduction order).
+
+Grid: (B,) — every slot's program is independent
+(``dimension_semantics=("parallel",)``); Mosaic's block rules shape the
+layout: the [B, M, K, Dh] cache blocks as (1, M, K, Dh) (the trailing
+(K, Dh) pair must match the array dims), and its batched-dot positional
+constraint forces the per-head static loop in the body.
+
+Net-new vs the reference (no kernels in its tree, SURVEY.md §2).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+from torchkafka_tpu.ops.flash import _default_interpret
+
+_NEG_INF = -1e30
+
+
+def _kvattn_kernel(
+    q_ref, kq_ref, ks_ref, vq_ref, vs_ref, mask_ref, o_ref, *,
+    inv_sqrt_dh: float,
+):
+    q = q_ref[0]  # [K, rep, Dh] compute dtype
+    # int8 tiles were DMA'd into VMEM at 1 byte/element — the convert
+    # below is on-core work, not HBM traffic (the thing the kernel
+    # exists to halve).
+    kq = kq_ref[0].astype(q.dtype)  # [M, K, Dh]
+    vq = vq_ref[0].astype(q.dtype)
+    ks = ks_ref[0]  # [M, K] f32
+    vs = vs_ref[0]
+    mask = mask_ref[0, 0][None, :]  # [1, M]
+    # STATIC loop over kv heads (K is small — 8 at the 8B shapes):
+    # Mosaic's batched dot requires equal batch-dim positions, which the
+    # [M, K, Dh] cache layout doesn't give; per-head 2-D dots sidestep it
+    # and unroll fully at trace time.
+    outs = []
+    for k in range(q.shape[0]):
+        s = jax.lax.dot_general(
+            q[k], kq[:, k, :], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [rep, M]
+        s = s * ks[:, k][None, :] * inv_sqrt_dh
+        s = jnp.where(mask, s, _NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        pw = (p * vs[:, k][None, :]).astype(q.dtype)
+        outs.append(jax.lax.dot_general(
+            pw, vq[:, k, :], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ))  # [rep, Dh]
+    o_ref[0] = jnp.stack(outs).astype(o_ref.dtype)
+
+
+def kernel_applicable(head_dim: int, max_len: int) -> bool:
+    """Compiled-Mosaic tiling constraints: lane-aligned head_dim and
+    sublane-aligned pool length (the (M, K)-trailing scale blocks need
+    M % 8; Dh is the lane dim of the payload blocks). Interpret mode
+    accepts anything; tests force it."""
+    return head_dim % 128 == 0 and max_len % 8 == 0
+
+
+def int8_decode_attention(
+    q: jax.Array,
+    ck_q: jax.Array,
+    ck_s: jax.Array,
+    cv_q: jax.Array,
+    cv_s: jax.Array,
+    valid: jax.Array,
+    *,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """q [B, 1, H, Dh] (compute dtype) against an int8 cache
+    ck_q/cv_q [B, M, K, Dh] with scales ck_s/cv_s [B, M, K] (f32) and a
+    readable-position mask valid [B, M] (bool) → attn [B, 1, H, Dh].
+
+    Exact w.r.t. the scale-folded XLA read (``_attend_cached`` with
+    k_scale/v_scale) up to f32 reduction order — differential-tested.
+    """
+    b, s, h, dh = q.shape
+    if s != 1:
+        raise ValueError(f"decode attention is one token per slot, got S={s}")
+    m, n_kv = ck_q.shape[1], ck_q.shape[2]
+    rep = h // n_kv
+    if interpret is None:
+        interpret = _default_interpret()
+    qg = q[:, 0].reshape(b, n_kv, rep, dh)  # k-major head grouping
+    mask3 = valid[:, None, :]  # [B, 1, M] — (1, M) trailing block dims
+    kw = {}
+    if pltpu is not None and not interpret:
+        params_cls = getattr(pltpu, "CompilerParams", None) or getattr(
+            pltpu, "TPUCompilerParams"
+        )
+        kw["compiler_params"] = params_cls(
+            dimension_semantics=("parallel",)
+        )
+    out = pl.pallas_call(
+        functools.partial(
+            _kvattn_kernel, inv_sqrt_dh=float(1.0 / np.sqrt(dh))
+        ),
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, n_kv, rep, dh), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((1, m, n_kv, dh), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((1, m, n_kv), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, m, n_kv, dh), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((1, m, n_kv), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1, m), lambda i: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, n_kv, rep, dh), lambda i: (i, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, n_kv, rep, dh), q.dtype),
+        interpret=interpret,
+        **kw,
+    )(qg, ck_q, ck_s.astype(jnp.float32), cv_q, cv_s.astype(jnp.float32),
+      mask3)
+    return out.reshape(b, 1, h, dh)

@@ -39,6 +39,7 @@ from torchkafka_tpu.commit.ledger import OffsetLedger
 from torchkafka_tpu.errors import CommitFailedError, OutputDeliveryError
 from torchkafka_tpu.models.generate import (
     _attend_cached,
+    _attn_tail,
     _project_qkv,
     check_serving_mesh,
     kv_scale_sharding,
@@ -95,7 +96,9 @@ def _quant_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
     return q, scale
 
 
-def _slot_layer_step_q(x, layer, ck_q, ck_s, cv_q, cv_s, pos_b, cfg):
+def _slot_layer_step_q(
+    x, layer, ck_q, ck_s, cv_q, cv_s, pos_b, cfg, use_kernel=False,
+):
     """int8-KV variant of ``_slot_layer_step``: the pool stores int8
     payloads + per-(position, head) f32 absmax scales over Dh —
     (Dh+4)/(2·Dh) ≈ 52% of bf16 pool bytes at Dh=128 — read through
@@ -123,9 +126,20 @@ def _slot_layer_step_q(x, layer, ck_q, ck_s, cv_q, cv_s, pos_b, cfg):
     cv_q = upd3(cv_q, vq, pos_b)
     cv_s = upd2(cv_s, vs, pos_b)
     valid = jnp.arange(ck_q.shape[1])[None, :] <= pos_b[:, None]  # [B, M]
-    x = _attend_cached(
-        x, q, ck_q, cv_q, valid, layer, cfg, k_scale=ck_s, v_scale=cv_s
-    )
+    if use_kernel:
+        # Pallas int8 decode attention (ops/kvattn.py): int8 tiles feed
+        # the MXU's mixed dot directly — no dequantized cache copy, which
+        # is the byte traffic the XLA spelling cannot avoid. Caller gates
+        # on single-device + tiling shapes (a Pallas call is opaque to
+        # GSPMD, the flash_attention_sharded lesson).
+        from torchkafka_tpu.ops.kvattn import int8_decode_attention
+
+        attn = int8_decode_attention(q, ck_q, ck_s, cv_q, cv_s, valid)
+        x = _attn_tail(x, attn, layer, cfg)
+    else:
+        x = _attend_cached(
+            x, q, ck_q, cv_q, valid, layer, cfg, k_scale=ck_s, v_scale=cv_s
+        )
     return x, ck_q, ck_s, cv_q, cv_s
 
 
@@ -259,6 +273,7 @@ class StreamingGenerator:
         max_send_failure_streak: int = 64,
         mesh=None,
         kv_dtype: str | None = None,
+        kv_kernel: bool = False,
     ) -> None:
         """``ticks_per_sync``: decode ticks chained per device dispatch
         (and per host sync of the done mask). Higher amortises dispatch
@@ -339,7 +354,12 @@ class StreamingGenerator:
             raise ValueError("max_send_failure_streak must be >= 1")
         if kv_dtype not in (None, "int8"):
             raise ValueError(f"kv_dtype must be None or 'int8', got {kv_dtype!r}")
+        if kv_kernel and kv_dtype != "int8":
+            raise ValueError("kv_kernel requires kv_dtype='int8'")
         self._kv_int8 = kv_dtype == "int8"
+        # Experimental Pallas decode kernel — measured SLOWER (see
+        # ops/kvattn.py); exists for benchmarking successors.
+        self._kv_kernel_opt = kv_kernel
         self._max_send_failure_streak = max_send_failure_streak
         self._send_failure_streak = 0
         self._pending_outputs: list = []  # send handles since last commit
@@ -356,6 +376,34 @@ class StreamingGenerator:
         mesh = self._mesh
 
         kv_int8 = self._kv_int8
+        # The experimental Pallas int8 decode kernel (ops/kvattn.py) is
+        # OPT-IN and OFF: measured 1.8× slower than the scale-folded XLA
+        # read at the 8B shapes (batched-GEMV MXU starvation — see the
+        # kernel's module docstring). Flip via _kv_kernel_opt only to
+        # benchmark a successor; requires single-device (Pallas is opaque
+        # to GSPMD) and tiling shapes.
+        if kv_int8 and self._kv_kernel_opt:
+            from torchkafka_tpu.ops.kvattn import kernel_applicable
+
+            kv_kernel = (
+                mesh is None
+                and jax.default_backend() == "tpu"
+                and kernel_applicable(cfg.head_dim, M)
+            )
+            if not kv_kernel:
+                # The flag exists ONLY for benchmarking: silently falling
+                # back to the XLA read would misattribute its numbers to
+                # the kernel.
+                raise ValueError(
+                    "kv_kernel=True cannot be honored here: it needs a "
+                    "single-device TPU backend (Pallas is opaque to "
+                    f"GSPMD; mesh={'set' if mesh is not None else 'None'})"
+                    f" and tiling shapes (head_dim={cfg.head_dim} % 128, "
+                    f"pool_len={M} % 8)"
+                )
+        else:
+            kv_kernel = False
+        self._kv_kernel = kv_kernel
 
         def pin_state(caches, last_tok, pos, gen):
             """Pin the slot state's layouts inside the jitted programs so
@@ -434,7 +482,8 @@ class StreamingGenerator:
                     def body(x, inputs):
                         layer, ckq, cks, cvq, cvs = inputs
                         x, ckq, cks, cvq, cvs = _slot_layer_step_q(
-                            x, layer, ckq, cks, cvq, cvs, pos, cfg
+                            x, layer, ckq, cks, cvq, cvs, pos, cfg,
+                            use_kernel=kv_kernel,
                         )
                         return x, (ckq, cks, cvq, cvs)
                 else:
