@@ -304,6 +304,137 @@ class TestInt8DecodeAttentionKernel:
             np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
         )
 
+    def test_kmajor_matches_scale_folded_xla_read(self):
+        """v2 (K-major pool, K-batched dots) — the shipped kernel — at
+        every slot_block, against the same scale-folded reference."""
+        import jax.numpy as jnp
+
+        from torchkafka_tpu.ops.kvattn import int8_decode_attention_kmajor
+        from torchkafka_tpu.serve import _quant_kv
+
+        rng = np.random.default_rng(1)
+        B, M, K, rep, Dh = 4, 24, 2, 2, 16
+        H = K * rep
+        q = jnp.asarray(rng.normal(size=(B, 1, H, Dh)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(B, M, K, Dh)) * 2, jnp.float32)
+        v = jnp.asarray(rng.normal(size=(B, M, K, Dh)) * 2, jnp.float32)
+        kq, ks = _quant_kv(k)
+        vq, vs = _quant_kv(v)
+        pos = jnp.asarray([5, 12, 23, 0])
+        valid = jnp.arange(M)[None, :] <= pos[:, None]
+        qg = q[:, 0].reshape(B, K, rep, Dh)
+        scores = jnp.einsum("bkre,bmke->bkrm", qg, kq.astype(jnp.float32))
+        scores = scores * ks.transpose(0, 2, 1)[:, :, None, :] / np.sqrt(Dh)
+        scores = jnp.where(valid[:, None, None, :], scores, -1e30)
+        p = jax.nn.softmax(scores, axis=-1)
+        pw = p * vs.transpose(0, 2, 1)[:, :, None, :]
+        ref = jnp.einsum(
+            "bkrm,bmke->bkre", pw, vq.astype(jnp.float32)
+        ).reshape(B, 1, H, Dh)
+        kqT, vqT = (jnp.swapaxes(a, 1, 2) for a in (kq, vq))
+        ksT, vsT = (jnp.swapaxes(a, 1, 2) for a in (ks, vs))
+        for bb in (1, 2, 4):
+            out = int8_decode_attention_kmajor(
+                q, kqT, ksT, vqT, vsT, valid, slot_block=bb, interpret=True
+            )
+            np.testing.assert_allclose(
+                np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5,
+                err_msg=f"slot_block={bb}",
+            )
+
+    def test_kmajor_slot_block_must_divide(self):
+        import jax.numpy as jnp
+
+        from torchkafka_tpu.ops.kvattn import int8_decode_attention_kmajor
+
+        B, M, K, Dh = 3, 8, 2, 16
+        q = jnp.zeros((B, 1, 4, Dh))
+        c = jnp.zeros((B, K, M, Dh), jnp.int8)
+        s = jnp.zeros((B, K, M))
+        valid = jnp.ones((B, M), bool)
+        with pytest.raises(ValueError, match="must divide"):
+            int8_decode_attention_kmajor(
+                q, c, s, c, s, valid, slot_block=2, interpret=True
+            )
+
+    def test_kernel_serving_end_to_end(self):
+        """kv_kernel=True serves over the K-major pool (interpret mode on
+        CPU): completions count, per-completion commits, and tokens agree
+        with the XLA int8 read (f32 model — identical quantized math, the
+        only divergence channel is f32 reduction order)."""
+        import jax.numpy as jnp
+
+        import torchkafka_tpu as tk
+        from torchkafka_tpu.models.transformer import (
+            TransformerConfig, init_params,
+        )
+        from torchkafka_tpu.serve import StreamingGenerator
+
+        cfg = TransformerConfig(
+            vocab_size=64, d_model=256, n_layers=2, n_heads=2, n_kv_heads=2,
+            d_ff=64, max_seq_len=16, dtype=jnp.float32,
+        )
+        assert cfg.head_dim == 128  # kernel_applicable needs lane-aligned Dh
+        params = init_params(jax.random.key(0), cfg)
+        rng = np.random.default_rng(7)
+        prompts = rng.integers(0, 64, (6, 8), dtype=np.int32)
+
+        def serve(kv_kernel):
+            broker = tk.InMemoryBroker()
+            broker.create_topic("p", partitions=1)
+            for row in prompts:
+                broker.produce("p", row.tobytes())
+            consumer = tk.MemoryConsumer(broker, "p", group_id="gkm")
+            srv = StreamingGenerator(
+                consumer, params, cfg, slots=2, prompt_len=8, max_new=8,
+                kv_dtype="int8", kv_kernel=kv_kernel, commit_every=1,
+            )
+            got = {
+                rec.offset: np.asarray(toks)
+                for rec, toks in srv.run(max_records=len(prompts))
+            }
+            committed = broker.committed("gkm", tk.TopicPartition("p", 0))
+            srv.close()
+            consumer.close()
+            return got, committed
+
+        got_k, committed_k = serve(True)
+        got_x, committed_x = serve(False)
+        assert committed_k == committed_x == len(prompts)
+        assert len(got_k) == len(got_x) == len(prompts)
+        for off in got_x:
+            np.testing.assert_array_equal(got_k[off], got_x[off])
+
+    def test_kernel_vmem_feasibility_gate(self):
+        """Past the VMEM budget even slot_block=1 fails Mosaic compile,
+        so kernel_feasible bounds the pool from above and kv_kernel=True
+        raises instead of engaging a kernel that cannot compile."""
+        import jax.numpy as jnp
+
+        import torchkafka_tpu as tk
+        from torchkafka_tpu.models.transformer import (
+            TransformerConfig, init_params,
+        )
+        from torchkafka_tpu.ops.kvattn import kernel_feasible
+        from torchkafka_tpu.serve import StreamingGenerator
+
+        assert kernel_feasible(8, 2048, 128)       # measured-good point
+        assert not kernel_feasible(8, 4096, 128)   # measured compile-fail
+        cfg = TransformerConfig(
+            vocab_size=64, d_model=1024, n_layers=1, n_heads=8,
+            n_kv_heads=8, d_ff=64, max_seq_len=4096, dtype=jnp.float32,
+        )
+        params = init_params(jax.random.key(0), cfg)
+        broker = tk.InMemoryBroker()
+        broker.create_topic("p", partitions=1)
+        consumer = tk.MemoryConsumer(broker, "p", group_id="gvf")
+        with pytest.raises(ValueError, match="kernel_feasible"):
+            StreamingGenerator(
+                consumer, params, cfg, slots=2, prompt_len=4064,
+                max_new=32, kv_dtype="int8", kv_kernel=True,
+            )
+        consumer.close()
+
     def test_kernel_opt_in_gate(self):
         """kv_kernel requires kv_dtype='int8' and defaults OFF."""
         import jax.numpy as jnp

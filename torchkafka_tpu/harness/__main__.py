@@ -30,6 +30,12 @@ def main() -> None:
                     "~52%% of bf16 pool bytes; measured ~24%% slower at "
                     "equal slots but serves slot/context budgets bf16 "
                     "cannot fit — see PERF.md)")
+    ap.add_argument("--kv-kernel", choices=("auto", "on", "off"),
+                    default="auto",
+                    help="scenario 7 with --kv-int8: the Pallas K-major "
+                    "decode-attention kernel for the pool read (auto = on "
+                    "when honorable; on = require, raise otherwise; off = "
+                    "XLA scale-folded read — the paired control)")
     args = ap.parse_args()
     if args.scenario:
         nums = [args.scenario]
@@ -42,6 +48,7 @@ def main() -> None:
             n, args.size, model_scale=args.model_scale,
             serve_eos=args.serve_eos, quantized=args.quantized,
             kv_int8=args.kv_int8,
+            kv_kernel={"auto": "auto", "on": True, "off": False}[args.kv_kernel],
         )))
 
 

@@ -713,7 +713,7 @@ def scenario_5(
 def scenario_7(
     size: str = "tiny", model_scale: str | None = None,
     serve_eos: bool = False, quantized: bool | None = None,
-    kv_int8: bool = False,
+    kv_int8: bool = False, kv_kernel: bool | str = "auto",
 ) -> dict:
     """Continuous-batching serving (serve.StreamingGenerator): same prompt
     topic shape as scenario 5, but slots recycle as generations hit EOS —
@@ -781,6 +781,7 @@ def scenario_7(
         consumer, params, cfg, slots=slots, prompt_len=prompt_len,
         max_new=max_new, eos_id=eos_id, commit_every=slots,
         kv_dtype="int8" if kv_int8 else None,
+        kv_kernel=kv_kernel,
         # Dispatch + sync latency dominate per-token syncing on tunneled
         # transports. With EOS off at scale, ONE dispatch per generation is
         # strictly better (max_new - 1: prefill emits token 0, so a
@@ -836,6 +837,7 @@ def scenario_7(
         "eos_mode": "on" if eos_id is not None else "off(one-dispatch)",
         "ticks_per_sync": ticks_per_sync,
         "kv_dtype": "int8" if kv_int8 else "compute",
+        "kv_kernel": server._kv_kernel,
         "slots": slots,
         "committed": committed,
         "commit_failures": server.metrics.commit_failures.count,
@@ -1215,7 +1217,7 @@ SCENARIOS = {
 def run_scenario(
     num: int, size: str = "tiny", *, model_scale: str | None = None,
     serve_eos: bool = False, quantized: bool | None = None,
-    kv_int8: bool = False,
+    kv_int8: bool = False, kv_kernel: bool | str = "auto",
 ) -> dict:
     if size not in _SIZES:
         raise ValueError(f"size must be one of {_SIZES}")
@@ -1231,9 +1233,9 @@ def run_scenario(
         if num == 7:
             return SCENARIOS[7](
                 size, model_scale=model_scale, serve_eos=serve_eos,
-                quantized=quantized, kv_int8=kv_int8,
+                quantized=quantized, kv_int8=kv_int8, kv_kernel=kv_kernel,
             )
         return SCENARIOS[5](size, model_scale=model_scale, quantized=quantized)
     if kv_int8:
-        return SCENARIOS[7](size, kv_int8=True)
+        return SCENARIOS[7](size, kv_int8=True, kv_kernel=kv_kernel)
     return SCENARIOS[num](size)

@@ -410,6 +410,24 @@ def _default_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def tpu_compiler_params(dimension_semantics: tuple) -> dict:
+    """``{"compiler_params": ...}`` kwargs for a compiled-Mosaic
+    pallas_call, or ``{}`` when the TPU module is unavailable. One home
+    for the CompilerParams/TPUCompilerParams rename fallback (the class
+    was named TPUCompilerParams before jax 0.7) — shared by the flash,
+    qmatmul, and kvattn kernels."""
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+    except ImportError:  # pragma: no cover
+        return {}
+    params_cls = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams"
+    )
+    return {
+        "compiler_params": params_cls(dimension_semantics=dimension_semantics)
+    }
+
+
 def _resolve(s: int, block_q: int | None, block_k: int | None, interpret):
     block_q = _auto_block(s) if block_q is None else min(block_q, s)
     block_k = _auto_block(s) if block_k is None else min(block_k, s)

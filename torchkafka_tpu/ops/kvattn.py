@@ -1,28 +1,49 @@
-"""Pallas int8-KV decode attention — EXPERIMENTAL, measured SLOWER than
-the XLA scale-folded read on v5e; kept as the tested scaffold for a
-DMA-pipelined successor, off by default.
+"""Pallas int8-KV decode attention kernels.
 
-The hypothesis this kernel tested (PERF.md, int8-KV section): the XLA
-spelling of the int8-KV attention read materialises an int8→bf16
-converted copy of the cache instead of fusing the convert into the dot's
-HBM read, costing ~20% equal-slot throughput vs a bf16 cache — so a
-kernel that streams int8 tiles HBM→VMEM directly (the in-VMEM convert is
-on-core work) should win the bytes back. MEASURED RESULT (8B int8
-weights, 96 slots, 192-token budget): this kernel runs the tick at
-85.1 ms vs the XLA read's 46.8 ms — 1.8× SLOWER. Why: decode attention
-is batched GEMV — the per-(slot, head) [rep≤4, Dh]×[Dh, M] dots occupy
-~3% of the MXU's rows, and the (B,)-grid's one-small-DMA-per-slot
-structure pipelines poorly, so the saved HBM bytes are swamped by
-serialized on-core work. The fix is a redesign (M-blocked grid with
-overlapped DMA and head-packed dots), not a tweak — recorded so the next
-attempt starts there. Correctness is pinned by a differential test
-against the scale-folded XLA read (exact to f32 reduction order).
+Two generations live here, both correctness-pinned by differential
+tests against the scale-folded XLA read (exact to f32 reduction order):
 
-Grid: (B,) — every slot's program is independent
-(``dimension_semantics=("parallel",)``); Mosaic's block rules shape the
-layout: the [B, M, K, Dh] cache blocks as (1, M, K, Dh) (the trailing
-(K, Dh) pair must match the array dims), and its batched-dot positional
-constraint forces the per-head static loop in the body.
+**v1 ``int8_decode_attention`` (M-major cache [B, M, K, Dh]) — measured
+SLOWER, kept as the recorded negative result.** The hypothesis it
+tested (PERF.md, int8-KV section): the XLA spelling of the int8-KV
+attention read materialises an int8→bf16 converted copy of the cache
+instead of fusing the convert into the dot's HBM read, costing ~20%
+equal-slot throughput vs a bf16 cache — so a kernel that streams int8
+tiles HBM→VMEM directly (the in-VMEM convert is on-core work) should
+win the bytes back. MEASURED (8B int8 weights, 96 slots, 192-token
+budget): 85.1 ms/tick vs the XLA read's 46.8 — 1.8× SLOWER. Diagnosis:
+the M-major layout puts the kv-head axis in the middle, so every
+per-head slice ``cache[:, k, :]`` is strided and Mosaic's batched-dot
+positional rule forces a per-head static loop of tiny [rep≤4, Dh]
+dots over relaid-out operands — serialized on-core work that swamps
+the saved HBM bytes.
+
+**v2 ``int8_decode_attention_kmajor`` (K-major cache [B, K, M, Dh]) —
+the redesign v1's postmortem called for.** Storing the pool K-major
+makes every head's [M, Dh] tile a contiguous leading-axis slice, and
+both dots collapse into ONE K-batched ``dot_general`` whose batch dims
+sit at position 0 on each operand (Mosaic's requirement), so there is
+no per-head loop and no in-VMEM relayout. A ``slot_block`` parameter
+processes several slots per grid step — their (slot, head) axes merge
+into the batch dim by a layout-free leading reshape — so each grid
+step issues one large DMA (bb·K·M·Dh bytes) instead of v1's
+one-small-DMA-per-slot structure, and Pallas double-buffers it across
+the (B/bb,)-parallel grid.
+
+MEASURED (v5e, 8B shapes). Isolated pool read, fori-chained slope over
+alternating cache pairs: the kernel beats the XLA scale-folded read at
+every shape tried — 59.4 µs vs 66.0 (1.11×, 655 GB/s) at B=96/M=192,
+92.4 vs 120.8 µs (1.31×, 749 GB/s = 91% of peak) at B=16/M=2048. Full
+serving tick (the number that matters): the win survives only at LONG
+pools — M=2048 31.6→30.7 ms and M=1024 36.1→35.6 ms (exactly the
+isolated delta), but M=192 REGRESSES 16.7→17.3 (B=16) and 46.7→49.2 ms
+(B=96): the K-major update path plus the fusion break around a Pallas
+call cost ~2.5 ms/tick regardless of pool length. Hence serve.py's
+``kv_kernel="auto"`` engages the kernel only at pool length ≥ 1024;
+v1's "XLA materialises a converted copy" diagnosis also did not
+reproduce in-tick on this XLA version (the in-tick XLA read streams at
+the isolated rate), so the remaining known upside is a dynamic-length
+read (skip DMA beyond each slot's position — inexpressible in XLA).
 
 Net-new vs the reference (no kernels in its tree, SURVEY.md §2).
 """
@@ -41,7 +62,7 @@ try:
 except ImportError:  # pragma: no cover
     pltpu = None
 
-from torchkafka_tpu.ops.flash import _default_interpret
+from torchkafka_tpu.ops.flash import _default_interpret, tpu_compiler_params
 
 _NEG_INF = -1e30
 
@@ -88,6 +109,123 @@ def kernel_applicable(head_dim: int, max_len: int) -> bool:
     return head_dim % 128 == 0 and max_len % 8 == 0
 
 
+# Per-grid-step int8 in-block byte budget. Measured on v5e (Mosaic
+# compile + run): 4.2 MB of int8 in-blocks per step compiles and runs at
+# full rate (M=2048 bb=1, M=1024 bb=2); 8.4 MB fails to compile. Set just
+# above the known-good point.
+_SLOT_BLOCK_BUDGET = 4_718_592
+
+
+def kernel_feasible(n_kv: int, max_len: int, head_dim: int) -> bool:
+    """True iff SOME slot block fits the VMEM budget — bb=1 is the floor,
+    so feasibility is one slot's k+v int8 bytes within budget. Callers
+    gate on this before engaging the kernel: past it, every slot_block
+    choice (including 1) produces the in-block size that fails Mosaic
+    compilation (see _SLOT_BLOCK_BUDGET)."""
+    return 2 * n_kv * max_len * head_dim <= _SLOT_BLOCK_BUDGET
+
+
+def _pick_slot_block(batch: int, n_kv: int, max_len: int, head_dim: int) -> int:
+    """Largest slot block (≤8, dividing B) whose per-step working set —
+    two int8 payload blocks, their bf16 converts, and double-buffered
+    input windows — fits the measured VMEM budget. Larger bb is FASTER
+    where it fits (M=192: bb=8 59 µs vs bb=1 80 µs — fewer grid steps
+    amortize the per-step DMA issue cost)."""
+    per_slot = 2 * n_kv * max_len * head_dim  # k+v int8 bytes
+    for bb in (8, 4, 2, 1):
+        if batch % bb == 0 and bb * per_slot <= _SLOT_BLOCK_BUDGET:
+            return bb
+    return 1
+
+
+def _kvattn_kmajor_kernel(
+    q_ref, kq_ref, ks_ref, vq_ref, vs_ref, mask_ref, o_ref, *,
+    inv_sqrt_dh: float,
+):
+    bb, n_kv, rep, dh = q_ref.shape
+    m = kq_ref.shape[2]
+    g = bb * n_kv
+    # Leading-axis merges are layout-free (the trailing sublane/lane pair
+    # is untouched): (bb, K, ·, ·) → (bb·K, ·, ·) costs nothing.
+    q = q_ref[...].reshape(g, rep, dh)
+    kq = kq_ref[...].reshape(g, m, dh).astype(q.dtype)
+    # ONE batched dot over all (slot, head) pairs — batch dims at
+    # position 0 on both operands, Mosaic's batched-dot rule.
+    s = jax.lax.dot_general(
+        q, kq, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )  # [G, rep, M]
+    s = s.reshape(bb, n_kv, rep, m)
+    ks = ks_ref[...]  # [bb, K, M] f32
+    s = s * ks[:, :, None, :] * inv_sqrt_dh
+    mask = mask_ref[...]  # [bb, 1, M]
+    s = jnp.where(mask[:, :, None, :], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    vs = vs_ref[...]
+    pw = (p * vs[:, :, None, :]).astype(q.dtype).reshape(g, rep, m)
+    vq = vq_ref[...].reshape(g, m, dh).astype(q.dtype)
+    o = jax.lax.dot_general(
+        pw, vq, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )  # [G, rep, Dh]
+    o_ref[...] = o.reshape(bb, n_kv, rep, dh).astype(o_ref.dtype)
+
+
+def int8_decode_attention_kmajor(
+    q: jax.Array,
+    ck_q: jax.Array,
+    ck_s: jax.Array,
+    cv_q: jax.Array,
+    cv_s: jax.Array,
+    valid: jax.Array,
+    *,
+    slot_block: int | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """q [B, 1, H, Dh] (compute dtype) against a K-MAJOR int8 cache
+    ck_q/cv_q [B, K, M, Dh] with scales ck_s/cv_s [B, K, M] (f32) and a
+    readable-position mask valid [B, M] (bool) → attn [B, 1, H, Dh].
+
+    Exact w.r.t. the scale-folded XLA read (``_attend_cached`` with
+    k_scale/v_scale, modulo the cache transpose) up to f32 reduction
+    order — differential-tested. ``slot_block``: slots per grid step
+    (must divide B); default auto-picks for VMEM fit.
+    """
+    b, s, h, dh = q.shape
+    if s != 1:
+        raise ValueError(f"decode attention is one token per slot, got S={s}")
+    n_kv, m = ck_q.shape[1], ck_q.shape[2]
+    rep = h // n_kv
+    bb = slot_block or _pick_slot_block(b, n_kv, m, dh)
+    if b % bb:
+        raise ValueError(f"slot_block={bb} must divide batch={b}")
+    if interpret is None:
+        interpret = _default_interpret()
+    qg = q[:, 0].reshape(b, n_kv, rep, dh)  # k-major head grouping
+    mask3 = valid[:, None, :]  # [B, 1, M]
+    kw = {} if interpret else tpu_compiler_params(("parallel",))
+    out = pl.pallas_call(
+        functools.partial(
+            _kvattn_kmajor_kernel, inv_sqrt_dh=float(1.0 / np.sqrt(dh))
+        ),
+        grid=(b // bb,),
+        in_specs=[
+            pl.BlockSpec((bb, n_kv, rep, dh), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((bb, n_kv, m, dh), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((bb, n_kv, m), lambda i: (i, 0, 0)),
+            pl.BlockSpec((bb, n_kv, m, dh), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((bb, n_kv, m), lambda i: (i, 0, 0)),
+            pl.BlockSpec((bb, 1, m), lambda i: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((bb, n_kv, rep, dh), lambda i: (i, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, n_kv, rep, dh), q.dtype),
+        interpret=interpret,
+        **kw,
+    )(qg, ck_q, ck_s.astype(jnp.float32), cv_q, cv_s.astype(jnp.float32),
+      mask3)
+    return out.reshape(b, 1, h, dh)
+
+
 def int8_decode_attention(
     q: jax.Array,
     ck_q: jax.Array,
@@ -114,14 +252,7 @@ def int8_decode_attention(
         interpret = _default_interpret()
     qg = q[:, 0].reshape(b, n_kv, rep, dh)  # k-major head grouping
     mask3 = valid[:, None, :]  # [B, 1, M] — (1, M) trailing block dims
-    kw = {}
-    if pltpu is not None and not interpret:
-        params_cls = getattr(pltpu, "CompilerParams", None) or getattr(
-            pltpu, "TPUCompilerParams"
-        )
-        kw["compiler_params"] = params_cls(
-            dimension_semantics=("parallel",)
-        )
+    kw = {} if interpret else tpu_compiler_params(("parallel",))
     out = pl.pallas_call(
         functools.partial(
             _kvattn_kernel, inv_sqrt_dh=float(1.0 / np.sqrt(dh))

@@ -40,7 +40,11 @@ try:
 except ImportError:  # pragma: no cover
     pltpu = None
 
-from torchkafka_tpu.ops.flash import _default_interpret, _scratch
+from torchkafka_tpu.ops.flash import (
+    _default_interpret,
+    _scratch,
+    tpu_compiler_params,
+)
 
 
 def _qmm_kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, nk: int, mixed: bool):
@@ -128,18 +132,14 @@ def quantized_matmul(
     ok = bool(bk and bn and bm and k % bk == 0 and n % bn == 0 and m % bm == 0)
     if not ok:
         return _xla_fallback(x2, q, scale, x.dtype).reshape(*lead, n)
-    kw = {}
-    if pltpu is not None and not interpret:
-        # Without parallel semantics Mosaic serializes the whole grid
-        # (measured 60x slower) — m/n blocks are independent; only the k
-        # (accumulation) dim carries state. (CompilerParams was named
-        # TPUCompilerParams before jax 0.7.)
-        params_cls = getattr(pltpu, "CompilerParams", None) or getattr(
-            pltpu, "TPUCompilerParams"
-        )
-        kw["compiler_params"] = params_cls(
-            dimension_semantics=("parallel", "parallel", "arbitrary")
-        )
+    # Without parallel semantics Mosaic serializes the whole grid
+    # (measured 60x slower) — m/n blocks are independent; only the k
+    # (accumulation) dim carries state.
+    kw = (
+        {}
+        if interpret
+        else tpu_compiler_params(("parallel", "parallel", "arbitrary"))
+    )
     out2 = pl.pallas_call(
         functools.partial(_qmm_kernel, nk=k // bk, mixed=not interpret),
         grid=(m // bm, n // bn, k // bk),

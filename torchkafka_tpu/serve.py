@@ -59,6 +59,12 @@ _logger = logging.getLogger(__name__)
 # every serving roofline in the repo (serve.decode_roofline, scenario 5).
 V5E_PEAK_HBM_GBS = 819.0
 
+# Pool length at/above which kv_kernel="auto" engages the Pallas K-major
+# read: the kernel's advantage grows with pool length (more contiguous
+# bytes per head tile) while its fixed in-tick cost does not — measured
+# win at 1024/2048, measured loss at 192 (full matrix in _build).
+_KV_KERNEL_AUTO_MIN_POOL = 1024
+
 
 def decode_tick_bytes(params, cfg: TransformerConfig, batch: int,
                       max_len: int, kv_int8: bool = False) -> tuple[int, int]:
@@ -115,26 +121,43 @@ def _slot_layer_step_q(
     k = _rope(k, pos_b[:, None], cfg.rope_theta)
     kq, ks = _quant_kv(k[:, 0])  # [B, K, Dh] int8, [B, K]
     vq, vs = _quant_kv(v[:, 0])
-    upd3 = jax.vmap(
-        lambda c, row, p: lax.dynamic_update_slice(c, row[None], (p, 0, 0))
-    )
-    upd2 = jax.vmap(
-        lambda c, row, p: lax.dynamic_update_slice(c, row[None], (p, 0))
-    )
+    if use_kernel:
+        # K-MAJOR pool ([B, K, M, Dh] / [B, K, M] per layer): each head's
+        # [M, Dh] tile is a contiguous slice, which is what lets the
+        # kernel batch its dots over (slot, head) with no relayout — the
+        # v1 postmortem's fix (ops/kvattn.py docstring).
+        upd3 = jax.vmap(
+            lambda c, row, p: lax.dynamic_update_slice(
+                c, row[:, None], (0, p, 0)
+            )
+        )
+        upd2 = jax.vmap(
+            lambda c, row, p: lax.dynamic_update_slice(c, row[:, None], (0, p))
+        )
+        pool_len = ck_q.shape[2]
+    else:
+        upd3 = jax.vmap(
+            lambda c, row, p: lax.dynamic_update_slice(c, row[None], (p, 0, 0))
+        )
+        upd2 = jax.vmap(
+            lambda c, row, p: lax.dynamic_update_slice(c, row[None], (p, 0))
+        )
+        pool_len = ck_q.shape[1]
     ck_q = upd3(ck_q, kq, pos_b)
     ck_s = upd2(ck_s, ks, pos_b)
     cv_q = upd3(cv_q, vq, pos_b)
     cv_s = upd2(cv_s, vs, pos_b)
-    valid = jnp.arange(ck_q.shape[1])[None, :] <= pos_b[:, None]  # [B, M]
+    valid = jnp.arange(pool_len)[None, :] <= pos_b[:, None]  # [B, M]
     if use_kernel:
-        # Pallas int8 decode attention (ops/kvattn.py): int8 tiles feed
-        # the MXU's mixed dot directly — no dequantized cache copy, which
-        # is the byte traffic the XLA spelling cannot avoid. Caller gates
-        # on single-device + tiling shapes (a Pallas call is opaque to
-        # GSPMD, the flash_attention_sharded lesson).
-        from torchkafka_tpu.ops.kvattn import int8_decode_attention
+        # Pallas K-major int8 decode attention (ops/kvattn.py v2): int8
+        # tiles stream HBM→VMEM once and feed K-batched dots — a net
+        # tick win at long pools (the regime "auto" selects; measured
+        # matrix in _build/PERF.md). Caller gates on single-device +
+        # tiling shapes (a Pallas call is opaque to GSPMD, the
+        # flash_attention_sharded lesson).
+        from torchkafka_tpu.ops.kvattn import int8_decode_attention_kmajor
 
-        attn = int8_decode_attention(q, ck_q, ck_s, cv_q, cv_s, valid)
+        attn = int8_decode_attention_kmajor(q, ck_q, ck_s, cv_q, cv_s, valid)
         x = _attn_tail(x, attn, layer, cfg)
     else:
         x = _attend_cached(
@@ -273,7 +296,7 @@ class StreamingGenerator:
         max_send_failure_streak: int = 64,
         mesh=None,
         kv_dtype: str | None = None,
-        kv_kernel: bool = False,
+        kv_kernel: bool | str = "auto",
     ) -> None:
         """``ticks_per_sync``: decode ticks chained per device dispatch
         (and per host sync of the done mask). Higher amortises dispatch
@@ -309,6 +332,24 @@ class StreamingGenerator:
         OOMs, but equal-slot throughput is ~20% lower — see PERF.md), at
         the cost of bounded quantization error (opt-in precisely because
         token-exactness is given up).
+
+        ``kv_kernel``: the Pallas K-major int8 decode-attention kernel
+        (``ops.kvattn.int8_decode_attention_kmajor``) for the pool read.
+        The isolated read beats the XLA scale-folded spelling everywhere
+        (1.10× at a 192 pool to 1.31× at 2048 — 91% of peak HBM), but
+        in-tick integration costs ~2.5 ms at short pools, so the kernel
+        is a net win only at LONG budgets (measured matrix in _build).
+        ``"auto"`` (default): engage it exactly in that regime — int8
+        pool ≥ 1024 tokens, no mesh (a Pallas call is opaque to GSPMD),
+        TPU backend, tiling shapes — else the XLA read. ``True``:
+        REQUIRE the kernel at any pool length; raises if mesh/shapes
+        can't honor it (so a benchmark never misattributes the XLA
+        read's numbers to the kernel); off-TPU it runs in Pallas
+        interpret mode — correct but slow, for tests. ``False``: always
+        the XLA read. In kernel mode the pool is stored K-major
+        ([L, B, K, M, Dh]) so every head's tile is a contiguous slice —
+        the layout lesson from the v1 kernel's negative result
+        (ops/kvattn.py docstring).
 
         ``max_send_failure_streak``: a SYNCHRONOUS send failure leaves its
         record uncommitted (the watermark stalls there, it re-delivers on
@@ -354,11 +395,13 @@ class StreamingGenerator:
             raise ValueError("max_send_failure_streak must be >= 1")
         if kv_dtype not in (None, "int8"):
             raise ValueError(f"kv_dtype must be None or 'int8', got {kv_dtype!r}")
-        if kv_kernel and kv_dtype != "int8":
+        if kv_kernel not in (True, False, "auto"):
+            raise ValueError(
+                f"kv_kernel must be True, False or 'auto', got {kv_kernel!r}"
+            )
+        if kv_kernel is True and kv_dtype != "int8":
             raise ValueError("kv_kernel requires kv_dtype='int8'")
         self._kv_int8 = kv_dtype == "int8"
-        # Experimental Pallas decode kernel — measured SLOWER (see
-        # ops/kvattn.py); exists for benchmarking successors.
         self._kv_kernel_opt = kv_kernel
         self._max_send_failure_streak = max_send_failure_streak
         self._send_failure_streak = 0
@@ -376,31 +419,51 @@ class StreamingGenerator:
         mesh = self._mesh
 
         kv_int8 = self._kv_int8
-        # The experimental Pallas int8 decode kernel (ops/kvattn.py) is
-        # OPT-IN and OFF: measured 1.8× slower than the scale-folded XLA
-        # read at the 8B shapes (batched-GEMV MXU starvation — see the
-        # kernel's module docstring). Flip via _kv_kernel_opt only to
-        # benchmark a successor; requires single-device (Pallas is opaque
-        # to GSPMD) and tiling shapes.
+        # The K-major Pallas decode kernel (ops/kvattn.py v2). Measured
+        # on v5e, 8B int8 weights, full-tick pairs (kernel on vs off):
+        # M=192/B=16 16.7→17.3 ms (LOSS), M=192/B=96 46.7→49.2 ms
+        # (LOSS), M=1024/B=32 36.1→35.6 ms (win), M=2048/B=16 31.6→30.7
+        # ms (win) — the isolated pool read wins everywhere (1.10× at
+        # M=192 to 1.31× at M=2048, 91% of peak HBM) but the in-tick
+        # integration (K-major update path + broken fusion around the
+        # Pallas call) costs ~2.5 ms at short budgets. "auto" therefore
+        # engages the kernel only in its measured-win regime: long pools
+        # (M >= _KV_KERNEL_AUTO_MIN_POOL) on the TPU backend. Requires
+        # single-device (a Pallas call is opaque to GSPMD) and tiling
+        # shapes either way.
         if kv_int8 and self._kv_kernel_opt:
-            from torchkafka_tpu.ops.kvattn import kernel_applicable
-
-            kv_kernel = (
-                mesh is None
-                and jax.default_backend() == "tpu"
-                and kernel_applicable(cfg.head_dim, M)
+            from torchkafka_tpu.ops.kvattn import (
+                kernel_applicable, kernel_feasible,
             )
-            if not kv_kernel:
-                # The flag exists ONLY for benchmarking: silently falling
-                # back to the XLA read would misattribute its numbers to
-                # the kernel.
-                raise ValueError(
-                    "kv_kernel=True cannot be honored here: it needs a "
-                    "single-device TPU backend (Pallas is opaque to "
-                    f"GSPMD; mesh={'set' if mesh is not None else 'None'})"
-                    f" and tiling shapes (head_dim={cfg.head_dim} % 128, "
-                    f"pool_len={M} % 8)"
+
+            honorable = (
+                mesh is None
+                and kernel_applicable(cfg.head_dim, M)
+                # Upper bound too: past the VMEM budget even slot_block=1
+                # fails Mosaic compilation, so very long pools (e.g. 4096
+                # at 8B's K=8/Dh=128) must take the XLA read.
+                and kernel_feasible(kh, M, dh)
+            )
+            if self._kv_kernel_opt == "auto":
+                kv_kernel = (
+                    honorable
+                    and jax.default_backend() == "tpu"
+                    and M >= _KV_KERNEL_AUTO_MIN_POOL
                 )
+            else:  # explicit True: never fall back silently — a benchmark
+                # must not misattribute the XLA read's numbers to the kernel.
+                if not honorable:
+                    raise ValueError(
+                        "kv_kernel=True cannot be honored here: it needs a "
+                        "single device (Pallas is opaque to GSPMD; "
+                        f"mesh={'set' if mesh is not None else 'None'}), "
+                        f"tiling shapes (head_dim={cfg.head_dim} % 128, "
+                        f"pool_len={M} % 8), and a per-slot cache within "
+                        "the kernel's VMEM budget (ops.kvattn."
+                        f"kernel_feasible({kh}, {M}, {dh}) = "
+                        f"{kernel_feasible(kh, M, dh)})"
+                    )
+                kv_kernel = True
         else:
             kv_kernel = False
         self._kv_kernel = kv_kernel
@@ -442,7 +505,17 @@ class StreamingGenerator:
             if kv_int8:
                 fkq, fks = _quant_kv(fresh.k)
                 fvq, fvs = _quant_kv(fresh.v)
-                sel4 = admit_mask[None, :, None, None]  # over [L, B, M, K]
+                if kv_kernel:
+                    # Kernel mode stores the pool K-major: transpose the
+                    # freshly-quantized [L, B, M, K, ·] prefill capture
+                    # once per admit (bytes ∝ one pool sweep; the per-tick
+                    # read path this layout accelerates runs max_new times
+                    # per admit).
+                    fkq, fvq = (jnp.swapaxes(a, 2, 3) for a in (fkq, fvq))
+                    fks, fvs = (jnp.swapaxes(a, 2, 3) for a in (fks, fvs))
+                    sel4 = admit_mask[None, :, None, None]  # [L, B, K, M]
+                else:
+                    sel4 = admit_mask[None, :, None, None]  # [L, B, M, K]
                 caches = (
                     jnp.where(sel, fkq, caches[0]),
                     jnp.where(sel4, fks, caches[1]),
@@ -548,7 +621,15 @@ class StreamingGenerator:
         self._tick_block_raw = tick_block
         self._admit_fn = lambda *a: _admit(self._params, *a)
         self._tick_fn = lambda *a: _tick(self._params, *a)
-        if kv_int8:
+        if kv_int8 and kv_kernel:
+            # K-major pool for the Pallas read (see _slot_layer_step_q).
+            self._caches = (
+                jnp.zeros((nl, B, kh, M, dh), jnp.int8),
+                jnp.zeros((nl, B, kh, M), jnp.float32),
+                jnp.zeros((nl, B, kh, M, dh), jnp.int8),
+                jnp.zeros((nl, B, kh, M), jnp.float32),
+            )
+        elif kv_int8:
             self._caches = (
                 jnp.zeros((nl, B, M, kh, dh), jnp.int8),
                 jnp.zeros((nl, B, M, kh), jnp.float32),
