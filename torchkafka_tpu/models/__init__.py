@@ -15,6 +15,7 @@ against real MXU-shaped compute, not a stub.
 # function), breaking module-style access to prefill/serving helpers.
 from torchkafka_tpu.models.generate import check_serving_mesh, serving_shardings
 from torchkafka_tpu.models.recsys import DLRMConfig, make_dlrm_train_step
+from torchkafka_tpu.models.spec_decode import SpecStats, speculative_generate
 from torchkafka_tpu.models.transformer import (
     Transformer,
     TransformerConfig,
@@ -23,10 +24,12 @@ from torchkafka_tpu.models.transformer import (
 
 __all__ = [
     "DLRMConfig",
+    "SpecStats",
     "Transformer",
     "TransformerConfig",
     "check_serving_mesh",
     "make_dlrm_train_step",
     "make_train_step",
     "serving_shardings",
+    "speculative_generate",
 ]

@@ -153,11 +153,14 @@ def _attend_cached(
     k_scale=None, v_scale=None,
 ):
     """Shared decode tail: grouped-query attention over the kv cache,
-    masked softmax, output projection and the MLP residual. x: [B, 1, D];
-    q: [B, 1, H, Dh]; caches [B, M, K, Dh]; valid: [B, M] or [M] bool mask
-    of readable cache positions. Single source of truth for both the
-    lockstep decode (scalar position, generate.py) and the continuous-
-    batching server's per-slot decode (serve.py), in BOTH cache dtypes.
+    masked softmax, output projection and the MLP residual. x: [B, S, D];
+    q: [B, S, H, Dh]; caches [B, M, K, Dh]; valid: [M], [B, M], or
+    [B, S, M] (per-query masks — the multi-query verify step of
+    speculative decoding) bool mask of readable cache positions. Single
+    source of truth for the lockstep decode (scalar position,
+    generate.py), the continuous-batching server's per-slot decode
+    (serve.py), and spec decode's verify (spec_decode.py), in BOTH
+    cache dtypes.
 
     GQA runs as a grouped einsum — q reshaped [B, S, K, rep, Dh] contracts
     directly against the [B, M, K, Dh] cache. Decode is cache-bandwidth
@@ -192,7 +195,11 @@ def _attend_cached(
     scores = scores / jnp.sqrt(jnp.float32(cfg.head_dim))
     if valid.ndim == 1:
         valid = valid[None, :]
-    scores = jnp.where(valid[:, None, None, None, :], scores, -1e30)
+    if valid.ndim == 2:  # [B, M]: one mask for every query position
+        vmask = valid[:, None, None, None, :]
+    else:  # [B, S, M]: per-query masks (multi-query verify, spec decode)
+        vmask = valid[:, None, None, :, :]
+    scores = jnp.where(vmask, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
     if v_scale is not None:
         probs = probs * v_scale.transpose(0, 2, 1)[:, :, None, None, :]

@@ -237,7 +237,9 @@ def _slot_layer_step(x, layer, cache_k, cache_v, pos_b, cfg):
     """One decode token through one layer with a DIFFERENT position per
     slot. x: [B, 1, D]; caches [B, M, K, Dh]; pos_b: [B]. Only the rope and
     the cache write differ from the lockstep ``generate._layer_step``; the
-    attention/MLP tail is the shared ``_attend_cached``."""
+    attention/MLP tail is the shared ``_attend_cached``. (Sibling:
+    spec_decode._multi_step generalizes this to S queries per row —
+    update in step if the write/mask discipline changes.)"""
     q, k, v = _project_qkv(x, layer, cfg)
     q = _rope(q, pos_b[:, None], cfg.rope_theta)
     k = _rope(k, pos_b[:, None], cfg.rope_theta)
