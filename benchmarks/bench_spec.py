@@ -52,10 +52,10 @@ from torchkafka_tpu.utils.timing import two_point_slope
 PROMPT = 32
 
 
-def _time_tokens(fn, n_short: int, n_long: int, batch: int, repeats: int = 3):
-    """Seconds/token-row via slope over two max_new lengths. fn(max_new)
-    must run the whole generation and block. Returns (s_per_tick, ok) —
-    a 'tick' being one token across the whole batch."""
+def _time_tokens(fn, n_short: int, n_long: int, repeats: int = 3):
+    """Seconds per TICK (one token across the whole batch — no per-row
+    division) via slope over two max_new lengths. fn(max_new) must run
+    the whole generation and block. Returns (s_per_tick, ok)."""
     fn(n_short)  # compile+warm both lengths
     fn(n_long)
     shorts, longs = [], []
@@ -102,7 +102,7 @@ def main() -> None:
         }
         per, ok = _time_tokens(
             lambda n: np.asarray(calls[n](params, prompt)),
-            args.short, args.long, B,
+            args.short, args.long,
         )
         plain_t[name] = per
         plain_ok[name] = ok
@@ -132,7 +132,7 @@ def main() -> None:
             out, stats = spec_run(dp, dc, n)
             stats_box[(label, n)] = jax.device_get(stats)
             return out
-        per, ok = _time_tokens(run, args.short, args.long, B)
+        per, ok = _time_tokens(run, args.short, args.long)
         st = stats_box[(label, args.long)]
         alpha = float(st.accepted) / max(float(st.proposed), 1.0)
         print(

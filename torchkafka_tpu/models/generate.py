@@ -230,7 +230,8 @@ def _attn_tail(x, attn, layer, cfg):
 
 
 def _project_qkv(x, layer, cfg):
-    """RMSNorm + q/k/v projections for one decode token. x: [B, 1, D]."""
+    """RMSNorm + q/k/v projections for decode queries. x: [B, S, D] —
+    S=1 for a decode tick, S=k+1 for spec decode's multi-query verify."""
     h = _rms_norm(x, layer["ln1"])
     q = jnp.einsum("bsd,dhe->bshe", h, load_weight(layer["wq"], cfg.dtype))
     k = jnp.einsum("bsd,dke->bske", h, load_weight(layer["wk"], cfg.dtype))

@@ -89,16 +89,19 @@ def _multi_step(params, cfg, cache: KVCache, tokens, pos_b):
     x = embed_rows(params["embed"], tokens, cfg.dtype)  # [B, S, D]
     positions = pos_b[:, None] + jnp.arange(s)[None, :]  # [B, S]
 
+    rows = jnp.arange(b)[:, None]  # [B, 1] against positions [B, S]
+
     def body(x, inputs):
         layer, ck, cv = inputs
         q, k, v = _project_qkv(x, layer, cfg)
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
-        upd = jax.vmap(
-            lambda c, rows, p: lax.dynamic_update_slice(c, rows, (p, 0, 0))
-        )
-        ck = upd(ck, k.astype(ck.dtype), pos_b)
-        cv = upd(cv, v.astype(cv.dtype), pos_b)
+        # Scatter writes (serve._slot_layer_step's r5 note: the vmapped
+        # dynamic_update_slice lowering rewrites the whole pool per
+        # layer; the scatter writes S rows per slot — measured +41%
+        # tok/s on the 1B serving tick).
+        ck = ck.at[rows, positions].set(k.astype(ck.dtype))
+        cv = cv.at[rows, positions].set(v.astype(cv.dtype))
         valid = (
             jnp.arange(ck.shape[1])[None, None, :] <= positions[:, :, None]
         )  # [B, S, M] per-query causal masks
@@ -194,8 +197,10 @@ def speculative_generate(
         corr = jnp.take_along_axis(tga, n_acc[:, None], axis=1)[:, 0]  # [B]
 
         # Emit d[:, :n_acc] then the correction/bonus — a static loop of
-        # one-hot row writes (scatter lowers poorly on TPU, serve.py's
-        # lesson), masked per row by j <= n_acc and activity.
+        # one-hot row writes over the tiny [B, buf] buffer (measured at
+        # parity with scatter on buffers this size — serve.py's gen
+        # write; the POOL writes above use scatters, where it matters),
+        # masked per row by j <= n_acc and activity.
         idx = jnp.arange(buf)[None, :]
         for j in range(k + 1):
             tok_j = d[:, j] if j < k else corr
