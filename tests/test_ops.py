@@ -405,21 +405,65 @@ class TestInt8DecodeAttentionKernel:
         for off in got_x:
             np.testing.assert_array_equal(got_k[off], got_x[off])
 
-    def test_kernel_vmem_feasibility_gate(self):
-        """Past the VMEM budget even slot_block=1 fails Mosaic compile,
-        so kernel_feasible bounds the pool from above and kv_kernel=True
-        raises instead of engaging a kernel that cannot compile."""
+    def test_dynlen_matches_kmajor_read(self):
+        """v3 (dynamic-length, online softmax over M-blocks) against the
+        v2 full read restricted to each slot's watermark, at several
+        block sizes including watermarks mid-block and at pool edges."""
+        import jax.numpy as jnp
+
+        from torchkafka_tpu.ops.kvattn import (
+            int8_decode_attention_dynlen, int8_decode_attention_kmajor,
+        )
+        from torchkafka_tpu.serve import _quant_kv
+
+        rng = np.random.default_rng(2)
+        B, M, K, rep, Dh = 4, 32, 2, 2, 16
+        H = K * rep
+        q = jnp.asarray(rng.normal(size=(B, 1, H, Dh)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(B, M, K, Dh)) * 2, jnp.float32)
+        v = jnp.asarray(rng.normal(size=(B, M, K, Dh)) * 2, jnp.float32)
+        kq, ks = _quant_kv(k)
+        vq, vs = _quant_kv(v)
+        kqT, vqT = (jnp.swapaxes(a, 1, 2) for a in (kq, vq))
+        ksT, vsT = (jnp.swapaxes(a, 1, 2) for a in (ks, vs))
+        pos = jnp.asarray([0, 7, 15, 31])  # empty-ish, block edges, full
+        valid = jnp.arange(M)[None, :] <= pos[:, None]
+        ref = int8_decode_attention_kmajor(
+            q, kqT, ksT, vqT, vsT, valid, interpret=True
+        )
+        for mb in (8, 16, 32):
+            out = int8_decode_attention_dynlen(
+                q, kqT, ksT, vqT, vsT, pos, block=mb, interpret=True
+            )
+            np.testing.assert_allclose(
+                np.asarray(out), np.asarray(ref), atol=3e-5, rtol=3e-5,
+                err_msg=f"block={mb}",
+            )
+
+    def test_kernel_gates(self):
+        """v3's scratch is block-sized, so LONG pools are supported (the
+        v2 VMEM bound is gone from serving); pools that only tile at
+        tiny blocks are refused on TPU but accepted off-TPU (interpret
+        correctness path). kernel_feasible stays as the v2 record."""
         import jax.numpy as jnp
 
         import torchkafka_tpu as tk
         from torchkafka_tpu.models.transformer import (
             TransformerConfig, init_params,
         )
-        from torchkafka_tpu.ops.kvattn import kernel_feasible
+        from torchkafka_tpu.ops.kvattn import (
+            dynlen_block, kernel_feasible,
+        )
         from torchkafka_tpu.serve import StreamingGenerator
 
-        assert kernel_feasible(8, 2048, 128)       # measured-good point
-        assert not kernel_feasible(8, 4096, 128)   # measured compile-fail
+        assert dynlen_block(2048) == 512
+        assert dynlen_block(4096) == 512
+        assert dynlen_block(1032) == 8     # tiles, but tiny → TPU-gated
+        assert dynlen_block(1030) == 0     # does not tile at all
+        assert kernel_feasible(8, 2048, 128)      # v2's measured-good
+        assert not kernel_feasible(8, 4096, 128)  # v2's measured-fail
+        # M=4096 now ACCEPTED with the explicit kernel (v3; off-TPU it
+        # honors via interpret — ctor only, no decode executed here).
         cfg = TransformerConfig(
             vocab_size=64, d_model=1024, n_layers=1, n_heads=8,
             n_kv_heads=8, d_ff=64, max_seq_len=4096, dtype=jnp.float32,
@@ -428,11 +472,12 @@ class TestInt8DecodeAttentionKernel:
         broker = tk.InMemoryBroker()
         broker.create_topic("p", partitions=1)
         consumer = tk.MemoryConsumer(broker, "p", group_id="gvf")
-        with pytest.raises(ValueError, match="kernel_feasible"):
-            StreamingGenerator(
-                consumer, params, cfg, slots=2, prompt_len=4064,
-                max_new=32, kv_dtype="int8", kv_kernel=True,
-            )
+        srv = StreamingGenerator(
+            consumer, params, cfg, slots=2, prompt_len=4064,
+            max_new=32, kv_dtype="int8", kv_kernel=True,
+        )
+        assert srv._kv_kernel is True
+        srv.close()
         consumer.close()
 
     def test_kernel_opt_in_gate(self):

@@ -283,6 +283,12 @@ class StreamCheckpointer:
         step: int,
         offsets: Mapping[TopicPartition, int],
     ) -> None:
+        # The tmp dir normally exists because the orbax save targeted
+        # tmp/state — but that is orbax's internal layout, not a
+        # contract, and AsyncCheckpointer has been observed (under a
+        # loaded suite) to defer materialising it past this point.
+        # Create it explicitly; exist_ok covers the normal case.
+        os.makedirs(tmp, exist_ok=True)
         with open(os.path.join(tmp, _offsets_file(pid, multi)), "w") as f:
             json.dump(
                 {

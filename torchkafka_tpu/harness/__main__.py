@@ -32,7 +32,7 @@ def main() -> None:
                     "cannot fit — see PERF.md)")
     ap.add_argument("--kv-kernel", choices=("auto", "on", "off"),
                     default="auto",
-                    help="scenario 7 with --kv-int8: the Pallas K-major "
+                    help="scenario 7 with --kv-int8: the Pallas dynamic-length "
                     "decode-attention kernel for the pool read (auto = on "
                     "when honorable; on = require, raise otherwise; off = "
                     "XLA scale-folded read — the paired control)")

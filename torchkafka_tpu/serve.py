@@ -144,19 +144,23 @@ def _slot_layer_step_q(
     ck_s = upd(ck_s, ks)
     cv_q = upd(cv_q, vq)
     cv_s = upd(cv_s, vs)
-    valid = jnp.arange(pool_len)[None, :] <= pos_b[:, None]  # [B, M]
     if use_kernel:
-        # Pallas K-major int8 decode attention (ops/kvattn.py v2): int8
-        # tiles stream HBM→VMEM once and feed K-batched dots — a net
-        # tick win at long pools (the regime "auto" selects; measured
-        # matrix in _build/PERF.md). Caller gates on single-device +
-        # tiling shapes (a Pallas call is opaque to GSPMD, the
-        # flash_attention_sharded lesson).
-        from torchkafka_tpu.ops.kvattn import int8_decode_attention_kmajor
+        # Pallas DYNAMIC-LENGTH int8 decode attention (ops/kvattn.py
+        # v3): per-slot watermarks are scalar-prefetched and the kernel
+        # manually DMAs M-blocks with cross-program double buffering, so
+        # HBM traffic scales with each slot's ACTUAL fill instead of the
+        # pool size — inexpressible in XLA, where every read is
+        # pool-shaped. Net tick win at long pools (the regime "auto"
+        # selects; measured matrix in _build/PERF.md); fills < ~90%
+        # (the continuous-batching norm) widen it. Caller gates on
+        # single-device + tiling shapes (a Pallas call is opaque to
+        # GSPMD, the flash_attention_sharded lesson).
+        from torchkafka_tpu.ops.kvattn import int8_decode_attention_dynlen
 
-        attn = int8_decode_attention_kmajor(q, ck_q, ck_s, cv_q, cv_s, valid)
+        attn = int8_decode_attention_dynlen(q, ck_q, ck_s, cv_q, cv_s, pos_b)
         x = _attn_tail(x, attn, layer, cfg)
     else:
+        valid = jnp.arange(pool_len)[None, :] <= pos_b[:, None]  # [B, M]
         x = _attend_cached(
             x, q, ck_q, cv_q, valid, layer, cfg, k_scale=ck_s, v_scale=cv_s
         )
@@ -334,15 +338,19 @@ class StreamingGenerator:
         the cost of bounded quantization error (opt-in precisely because
         token-exactness is given up).
 
-        ``kv_kernel``: the Pallas K-major int8 decode-attention kernel
-        (``ops.kvattn.int8_decode_attention_kmajor``) for the pool read.
-        The isolated read beats the XLA scale-folded spelling everywhere
-        (1.10× at a 192 pool to 1.31× at 2048 — 91% of peak HBM), but
-        in-tick integration costs ~2.5 ms at short pools, so the kernel
-        is a net win only at LONG budgets (measured matrix in _build).
-        ``"auto"`` (default): engage it exactly in that regime — int8
-        pool ≥ 1024 tokens, no mesh (a Pallas call is opaque to GSPMD),
-        TPU backend, tiling shapes — else the XLA read. ``True``:
+        ``kv_kernel``: the Pallas DYNAMIC-LENGTH int8 decode-attention
+        kernel (``ops.kvattn.int8_decode_attention_dynlen``) for the
+        pool read: per-slot watermarks are scalar-prefetched and only
+        positions [0, pos] are DMA'd per slot, so HBM traffic scales
+        with each slot's actual fill instead of the pool size —
+        inexpressible in XLA, where every read is pool-shaped. Measured
+        at 8B shapes, M=2048 (paired, interleaved): 1.92× the XLA read
+        at half fill, 1.57× at mixed fills, 0.94× at exactly-full — and
+        continuous batching lives at partial fills. In-tick integration
+        still costs ~flat ms at short pools, so ``"auto"`` (default)
+        engages the kernel only at int8 pools ≥ 1024 tokens (no mesh —
+        a Pallas call is opaque to GSPMD — TPU backend, tiling shapes,
+        pool tiling at a ≥ 256 block); else the XLA read. ``True``:
         REQUIRE the kernel at any pool length; raises if mesh/shapes
         can't honor it (so a benchmark never misattributes the XLA
         read's numbers to the kernel); off-TPU it runs in Pallas
@@ -350,7 +358,8 @@ class StreamingGenerator:
         the XLA read. In kernel mode the pool is stored K-major
         ([L, B, K, M, Dh]) so every head's tile is a contiguous slice —
         the layout lesson from the v1 kernel's negative result
-        (ops/kvattn.py docstring).
+        (ops/kvattn.py docstring); note the tick time is then
+        FILL-DEPENDENT (see ``decode_roofline``'s ``fill``).
 
         ``max_send_failure_streak``: a SYNCHRONOUS send failure leaves its
         record uncommitted (the watermark stalls there, it re-delivers on
@@ -420,30 +429,37 @@ class StreamingGenerator:
         mesh = self._mesh
 
         kv_int8 = self._kv_int8
-        # The K-major Pallas decode kernel (ops/kvattn.py v2). Measured
-        # on v5e, 8B int8 weights, full-tick pairs (kernel on vs off):
-        # M=192/B=16 16.7→17.3 ms (LOSS), M=192/B=96 46.7→49.2 ms
-        # (LOSS), M=1024/B=32 36.1→35.6 ms (win), M=2048/B=16 31.6→30.7
-        # ms (win) — the isolated pool read wins everywhere (1.10× at
-        # M=192 to 1.31× at M=2048, 91% of peak HBM) but the in-tick
-        # integration (K-major update path + broken fusion around the
-        # Pallas call) costs ~2.5 ms at short budgets. "auto" therefore
-        # engages the kernel only in its measured-win regime: long pools
-        # (M >= _KV_KERNEL_AUTO_MIN_POOL) on the TPU backend. Requires
-        # single-device (a Pallas call is opaque to GSPMD) and tiling
-        # shapes either way.
+        # The Pallas decode kernels (ops/kvattn.py). Full-tick pairs on
+        # v5e, 8B int8 weights, kernel off vs on: short pools LOSE
+        # (M=192/B=16 13.0→13.5 ms with scatter writes) — flat
+        # integration cost (K-major layout handling + the fusion break
+        # around a Pallas call) — while long pools WIN and the win
+        # grows with pool bytes (v2 K-major read: M=2048 33.95→27.24
+        # ms, +25% tok/s). "auto" therefore engages the kernel only in
+        # the measured-win regime: long pools (M >=
+        # _KV_KERNEL_AUTO_MIN_POOL) on the TPU backend. The shipped
+        # kernel is v3 (dynamic-length): same K-major read, plus per-
+        # slot watermark-bounded DMA — 1.92×/1.57× the XLA read at
+        # half/mixed fills, 0.94× at exactly-full (paired micro).
+        # Requires single-device (a Pallas call is opaque to GSPMD) and
+        # tiling shapes either way.
         if kv_int8 and self._kv_kernel_opt:
             from torchkafka_tpu.ops.kvattn import (
-                kernel_applicable, kernel_feasible,
+                dynlen_block, kernel_applicable,
             )
 
+            on_tpu = jax.default_backend() == "tpu"
             honorable = (
                 mesh is None
                 and kernel_applicable(cfg.head_dim, M)
-                # Upper bound too: past the VMEM budget even slot_block=1
-                # fails Mosaic compilation, so very long pools (e.g. 4096
-                # at 8B's K=8/Dh=128) must take the XLA read.
-                and kernel_feasible(kh, M, dh)
+                # The dynamic-length kernel's scratch is block-sized, not
+                # pool-sized (no VMEM upper bound on M), but a pool that
+                # only tiles at tiny blocks would drown in per-block
+                # recurrence overhead — require a >= 256 block for
+                # compiled (TPU) use. Off-TPU runs are the interpret-mode
+                # correctness path (tests), where any tiling block is
+                # acceptable.
+                and dynlen_block(M) >= (256 if on_tpu else 8)
             )
             if self._kv_kernel_opt == "auto":
                 kv_kernel = (
@@ -459,10 +475,9 @@ class StreamingGenerator:
                         "single device (Pallas is opaque to GSPMD; "
                         f"mesh={'set' if mesh is not None else 'None'}), "
                         f"tiling shapes (head_dim={cfg.head_dim} % 128, "
-                        f"pool_len={M} % 8), and a per-slot cache within "
-                        "the kernel's VMEM budget (ops.kvattn."
-                        f"kernel_feasible({kh}, {M}, {dh}) = "
-                        f"{kernel_feasible(kh, M, dh)})"
+                        f"pool_len={M} % 8), and a pool length tiling "
+                        "at a >= 256 block on TPU (ops.kvattn."
+                        f"dynlen_block({M}) = {dynlen_block(M)})"
                     )
                 kv_kernel = True
         else:
@@ -665,7 +680,7 @@ class StreamingGenerator:
 
     def decode_roofline(
         self, *, iters: int = 8, windows: int = 3,
-        peak_hbm_gbs: float = V5E_PEAK_HBM_GBS,
+        peak_hbm_gbs: float = V5E_PEAK_HBM_GBS, fill: str = "mid",
     ) -> dict:
         """Pure DEVICE decode speed with HBM-bandwidth roofline accounting.
 
@@ -690,12 +705,40 @@ class StreamingGenerator:
         active = jnp.ones((B,), bool)
         key = jax.random.key(1)
         tick_block = self._tick_block_raw
+        # ``fill``: the slot positions the measurement starts from. With
+        # the dynamic-length kernel the tick reads only [0, pos] per
+        # slot, so tick time is FILL-DEPENDENT and measuring from empty
+        # pools (pos=0) would overstate throughput. "mid" (default)
+        # pins every slot to the steady-state midpoint (prompt +
+        # max_new/2); "live" keeps whatever state the server is in
+        # (the pre-v3 behavior — fill-independent paths measure the
+        # same either way, within noise).
+        if fill not in ("mid", "live"):
+            raise ValueError(f"fill must be 'mid' or 'live', got {fill!r}")
+        if fill == "mid":
+            target = min(
+                self._prompt_len + self._max_new // 2, self._max_len - 1
+            )
+            self._pos = jnp.full((B,), target, jnp.int32)
+        # The fill the window ACTUALLY measures: positions advance one
+        # per tick inside a K-tick block (re-pinned only between blocks)
+        # until the done latch freezes them at prompt + max_new - 2, so
+        # with a large ticks_per_sync the block's mean fill sits above
+        # the pinned start. Report the analytic per-tick mean, not the
+        # start value.
+        cap = self._prompt_len + self._max_new - 2
+        start = np.asarray(self._pos)
+        per_tick = np.minimum(start[None, :] + np.arange(K)[:, None], cap)
+        measured_fill = float((per_tick + 1).mean()) / self._max_len
 
         # n is a TRACED loop bound: one compile serves both window lengths.
         # The cache pool is DONATED like the serving tick's dispatch: at
         # the 8B-class scales this path exists for, an un-donated window
         # would hold input + output pools at once (multiple GB) and could
         # OOM mid-benchmark.
+        pin_fill = fill == "mid"
+        pos0 = self._pos
+
         @functools.partial(jax.jit, donate_argnums=(2,))
         def run(n, params, caches, last_tok, pos, gen):
             def body(_, carry):
@@ -703,6 +746,14 @@ class StreamingGenerator:
                 caches, last_tok, pos, gen, _done, _n_out = tick_block(
                     params, caches, last_tok, pos, gen, active, key
                 )
+                if pin_fill:
+                    # Constant-fill measurement: ticks advance (and then
+                    # done-latch-freeze) positions, which would drift the
+                    # fill toward pool-full across a long window; re-pin
+                    # between tick blocks so a fill-dependent read (the
+                    # dynamic-length kernel) is measured AT the stated
+                    # fill (drift within one K-tick block only).
+                    pos = pos0
                 return (caches, last_tok, pos, gen)
 
             out = lax.fori_loop(0, n, body, (caches, last_tok, pos, gen))
@@ -743,6 +794,8 @@ class StreamingGenerator:
         roofline_tok_s = B * peak_hbm_gbs * 1e9 / bytes_per_tick
         out = {
             "slope_ok": slope_ok,
+            "fill": fill,
+            "measured_fill_frac": round(measured_fill, 3),
             "dispatch_overhead_ms": round(overhead_ms, 1),
             "weight_bytes": w_bytes,
             "kv_pool_bytes": kv_bytes,
