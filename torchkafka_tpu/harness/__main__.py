@@ -26,10 +26,11 @@ def main() -> None:
                     "only 8b; decode is bytes-bound, so int8 halves the "
                     "streamed bytes vs bf16)")
     ap.add_argument("--kv-int8", action="store_true",
-                    help="scenario 7: int8 slot pool (capacity lever — "
-                    "~52%% of bf16 pool bytes; measured ~24%% slower at "
-                    "equal slots but serves slot/context budgets bf16 "
-                    "cannot fit — see PERF.md)")
+                    help="scenario 7: int8 slot pool — ~52%% of bf16 "
+                    "pool bytes, serves slot/context budgets bf16 "
+                    "cannot fit, and with scatter writes equal-slot "
+                    "throughput is neutral-to-better than bf16 KV "
+                    "(see PERF.md)")
     ap.add_argument("--kv-kernel", choices=("auto", "on", "off"),
                     default="auto",
                     help="scenario 7 with --kv-int8: the Pallas dynamic-length "

@@ -109,13 +109,14 @@ def _slot_layer_step_q(
     payloads + per-(position, head) f32 absmax scales over Dh —
     (Dh+4)/(2·Dh) ≈ 52% of bf16 pool bytes at Dh=128 — read through
     ``_attend_cached``'s scale-folded mode (scales land on the small
-    score/prob tensors; the big operands carry only a cast). This is a
-    CAPACITY lever, not a bandwidth win: measured ~20% lower equal-slot
-    throughput than bf16 KV (XLA materialises the converted operand
-    instead of fusing the cast into the dot read — PERF.md) for ~2× the
-    slot/context headroom. Quantization error is bounded by absmax/127
-    per group; OPT-IN because token-exactness vs the bf16 path is
-    deliberately given up."""
+    score/prob tensors; the big operands carry only a cast). A capacity
+    lever that, with scatter writes, also measures neutral-to-BETTER
+    equal-slot throughput than bf16 KV (+7% at 8B/96 slots — the
+    pre-scatter ~20% deficit was the select-rewrite of the pool's four
+    tensors, not the read; PERF.md) for ~2× the slot/context headroom.
+    Quantization error is bounded by absmax/127 per group; OPT-IN
+    because token-exactness vs the bf16 path is deliberately given
+    up."""
     q, k, v = _project_qkv(x, layer, cfg)
     q = _rope(q, pos_b[:, None], cfg.rope_theta)
     k = _rope(k, pos_b[:, None], cfg.rope_theta)
@@ -334,8 +335,9 @@ class StreamingGenerator:
         per-(position, head) f32 absmax scale, ≈52% of bf16 pool bytes at
         head_dim 128) — the memory headroom that buys more concurrent
         slots at the 8B-class scales (measured: 192 slots run where bf16
-        OOMs, but equal-slot throughput is ~20% lower — see PERF.md), at
-        the cost of bounded quantization error (opt-in precisely because
+        OOMs; with scatter writes equal-slot throughput is neutral-to-
+        BETTER than bf16 KV, +7% at 8B/96 slots — see PERF.md), at the
+        cost of bounded quantization error (opt-in precisely because
         token-exactness is given up).
 
         ``kv_kernel``: the Pallas DYNAMIC-LENGTH int8 decode-attention
