@@ -114,3 +114,40 @@ class TestSpeculativeGenerate:
             speculative_generate(params, cfg, params, cfg, prompt, 8, k=0)
         with pytest.raises(ValueError, match="max_new"):
             speculative_generate(params, cfg, params, cfg, prompt, 1)
+
+
+class TestTruncatedDraft:
+    def test_truncated_draft_exact_and_valid(self):
+        """Self-speculative draft: first-n-layers truncation shares the
+        target's embed/head, and the exactness contract holds like any
+        other draft."""
+        from torchkafka_tpu.models.spec_decode import truncated_draft
+
+        cfg = _cfg(n_layers=3)
+        params = init_params(jax.random.key(4), cfg)
+        dparams, dcfg = truncated_draft(params, cfg, 1)
+        assert dcfg.n_layers == 1
+        leaf = jax.tree_util.tree_leaves(dparams["layers"])[0]
+        assert leaf.shape[0] == 1
+        prompt = _prompts(cfg, 2, 6, seed=4)
+        max_new = 9
+        expect = np.asarray(
+            jax.jit(lambda p, t: generate(p, cfg, t, max_new))(params, prompt)
+        )
+        got, stats = jax.jit(
+            lambda tp, dp, t: speculative_generate(
+                tp, cfg, dp, dcfg, t, max_new, k=2
+            )
+        )(params, dparams, prompt)
+        np.testing.assert_array_equal(np.asarray(got), expect)
+        assert int(stats.proposed) > 0
+
+    def test_truncated_draft_bounds(self):
+        from torchkafka_tpu.models.spec_decode import truncated_draft
+
+        cfg = _cfg(n_layers=2)
+        params = init_params(jax.random.key(0), cfg)
+        with pytest.raises(ValueError, match="n_layers must be"):
+            truncated_draft(params, cfg, 0)
+        with pytest.raises(ValueError, match="n_layers must be"):
+            truncated_draft(params, cfg, 3)

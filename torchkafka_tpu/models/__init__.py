@@ -15,7 +15,11 @@ against real MXU-shaped compute, not a stub.
 # function), breaking module-style access to prefill/serving helpers.
 from torchkafka_tpu.models.generate import check_serving_mesh, serving_shardings
 from torchkafka_tpu.models.recsys import DLRMConfig, make_dlrm_train_step
-from torchkafka_tpu.models.spec_decode import SpecStats, speculative_generate
+from torchkafka_tpu.models.spec_decode import (
+    SpecStats,
+    speculative_generate,
+    truncated_draft,
+)
 from torchkafka_tpu.models.transformer import (
     Transformer,
     TransformerConfig,
@@ -32,4 +36,5 @@ __all__ = [
     "make_train_step",
     "serving_shardings",
     "speculative_generate",
+    "truncated_draft",
 ]

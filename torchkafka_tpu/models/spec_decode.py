@@ -44,6 +44,7 @@ net-new TPU capability extending BASELINE config 5's generate consumer.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import NamedTuple
 
 import jax
@@ -62,6 +63,26 @@ from torchkafka_tpu.models.transformer import (
     _rms_norm,
     _rope,
 )
+
+
+def truncated_draft(params, cfg: TransformerConfig, n_layers: int):
+    """(draft_params, draft_cfg): the standard self-speculative cheap
+    draft — the target's FIRST ``n_layers`` layers with its own
+    embedding/final-norm/lm_head (all shared by reference, no copy).
+    For a trained checkpoint this is the classic layer-skip draft
+    (early layers carry most next-token signal); with random weights
+    its acceptance is chance-level like any other draft — the
+    exactness contract holds either way. Layer params are stacked
+    [L, ...] leaves, so truncation is a leading-axis slice."""
+    if not (1 <= n_layers <= cfg.n_layers):
+        raise ValueError(
+            f"n_layers must be in [1, {cfg.n_layers}], got {n_layers}"
+        )
+    draft_params = dict(params)
+    draft_params["layers"] = jax.tree_util.tree_map(
+        lambda x: x[:n_layers], params["layers"]
+    )
+    return draft_params, dataclasses.replace(cfg, n_layers=n_layers)
 
 
 class SpecStats(NamedTuple):
