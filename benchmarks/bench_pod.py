@@ -51,8 +51,9 @@ def worker(
 ) -> None:
     import jax
 
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)
+    from torchkafka_tpu.utils.devices import force_cpu_devices
+
+    force_cpu_devices(2)
     if nproc > 1:
         jax.distributed.initialize(
             coordinator_address=f"localhost:{port}",

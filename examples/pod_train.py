@@ -69,8 +69,9 @@ def train(args) -> None:
     import jax
 
     if args.coordinator:  # self-spawned worker: join the local pod
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", 2)
+        from torchkafka_tpu.utils.devices import force_cpu_devices
+
+        force_cpu_devices(2)
         jax.distributed.initialize(
             coordinator_address=args.coordinator,
             num_processes=args.nproc,

@@ -54,8 +54,9 @@ def main() -> int:
     args = ap.parse_args()
     if args.cpu_devices:
         try:
-            jax.config.update("jax_platforms", "cpu")
-            jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+            from torchkafka_tpu.utils.devices import force_cpu_devices
+
+            force_cpu_devices(args.cpu_devices)
         except RuntimeError:
             pass  # backend already live; use whatever devices exist
 

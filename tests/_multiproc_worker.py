@@ -514,8 +514,7 @@ def main(pid: int, nproc: int, port: str, outdir: str, mode: str) -> int:
 
 
 if __name__ == "__main__":
-    import jax
+    from torchkafka_tpu.utils.devices import force_cpu_devices
 
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)
+    force_cpu_devices(2)
     sys.exit(main(int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4], sys.argv[5]))

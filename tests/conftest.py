@@ -10,10 +10,21 @@ too late. jax.config.update still works because backends only initialize on
 first device use — which conftest reaches before any test.
 """
 
+import os
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # jax 0.4.x has no jax_num_cpu_devices option; the XLA flag is the
+    # same knob and is read at backend init, which hasn't happened yet
+    # (backends only initialize on first device use — see above).
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

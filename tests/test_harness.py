@@ -44,3 +44,26 @@ def test_scenario_9_buckets_and_efficiency():
 def test_bad_size_rejected():
     with pytest.raises(ValueError):
         run_scenario(1, "huge")
+
+
+def test_scenario_7_spec_smoke():
+    """Fast dryrun of the --spec serving path (CI guard: the flag must not
+    rot outside the benchmarked path). Token accounting and commit
+    exactness hold, and the measured-acceptance counters are live."""
+    out = run_scenario(7, "tiny", spec=True, spec_k=2)
+    assert out["scenario"] == "7:continuous-serve+spec"
+    assert out["records"] > 0
+    assert out["committed"] == out["records"]
+    assert out["commit_failures"] == 0
+    st = out["spec"]
+    assert st["k"] == 2
+    assert st["proposed"] > 0
+    assert 0 <= st["accepted"] <= st["proposed"]
+    assert st["acceptance"] is not None
+
+
+def test_spec_flag_scoping():
+    with pytest.raises(ValueError, match="--spec"):
+        run_scenario(5, "tiny", spec=True)
+    with pytest.raises(ValueError, match="kv-int8|kv_int8|compute-dtype"):
+        run_scenario(7, "tiny", spec=True, kv_int8=True)

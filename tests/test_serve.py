@@ -460,6 +460,38 @@ class TestStreamingGenerator:
         with pytest.raises(ValueError, match="max_new"):
             StreamingGenerator(consumer, params, cfg, prompt_len=P, max_new=1)
 
+    @pytest.mark.parametrize("bad", [1, 0, "on"])
+    def test_rejects_non_bool_kv_kernel(self, model, bad):
+        """ADVICE r5 #3: ``in (True, False, 'auto')`` accepted 1/0 via
+        bool-int equality and then treated them inconsistently (``is
+        True`` guards never fired) — identity validation must reject
+        them outright."""
+        cfg, params = model
+        with pytest.raises(ValueError, match="kv_kernel"):
+            StreamingGenerator(
+                object(), params, cfg, prompt_len=P, max_new=MAX_NEW,
+                kv_dtype="int8", kv_kernel=bad,
+            )
+
+    def test_decode_roofline_restores_pos(self, model):
+        """ADVICE r5 #2: the 'mid' fill probe overwrote self._pos for
+        every slot and never put it back, corrupting in-flight
+        generations — the probe must restore the entry positions."""
+        cfg, params = model
+        broker = tk.InMemoryBroker()
+        _topic(broker, 4)
+        consumer = tk.MemoryConsumer(broker, "p", group_id="grp")
+        server = StreamingGenerator(
+            consumer, params, cfg, slots=2, prompt_len=P, max_new=MAX_NEW,
+        )
+        server.warmup()
+        before = np.asarray(server._pos).copy()
+        server.decode_roofline(iters=1, windows=1)
+        np.testing.assert_array_equal(np.asarray(server._pos), before)
+        # And still serves correctly afterwards.
+        assert len(list(server.run(max_records=4))) == 4
+        consumer.close()
+
 
 class TestOutputTopic:
     def test_completions_published_before_commit(self, model):

@@ -1,0 +1,285 @@
+"""Speculative continuous-batching server (torchkafka_tpu/serve_spec.py).
+
+The two load-bearing contracts:
+
+1. TOKEN EXACTNESS: greedy speculative serving emits exactly the plain
+   ``StreamingGenerator``'s completions for the same prompt stream — the
+   draft model only sets the speed (spec_decode's contract, lifted into
+   the slot server).
+2. COMMIT EXACTNESS: speculation never changes which offsets commit —
+   including under injected ``ChaosConsumer`` commit failures, where both
+   engines must land the identical committed watermark.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import torchkafka_tpu as tk
+from torchkafka_tpu.models.transformer import TransformerConfig, init_params
+from torchkafka_tpu.serve import StreamingGenerator
+from torchkafka_tpu.serve_spec import SpecStreamingGenerator
+from torchkafka_tpu.source.chaos import ChaosConsumer
+
+P, MAX_NEW, VOCAB = 8, 8, 64
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = TransformerConfig(
+        vocab_size=VOCAB, d_model=32, n_layers=2, n_heads=2, n_kv_heads=1,
+        d_ff=64, max_seq_len=P + MAX_NEW, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+def _topic(broker, n, topic="p"):
+    broker.create_topic(topic, partitions=2)
+    rng = np.random.default_rng(7)
+    prompts = rng.integers(0, VOCAB, (n, P), dtype=np.int32)
+    for i in range(n):
+        broker.produce(topic, prompts[i].tobytes(), partition=i % 2)
+    return prompts
+
+
+def _serve(cls, cfg, params, n, *, eos_id=None, slots=4, commit_every=4,
+           chaos=None, **kw):
+    """One full serving pass over a fresh broker: returns (outputs by
+    prompt index, committed offsets per partition, server, consumer)."""
+    broker = tk.InMemoryBroker()
+    _topic(broker, n)
+    consumer = tk.MemoryConsumer(broker, "p", group_id="g")
+    if chaos is not None:
+        consumer = ChaosConsumer(consumer, **chaos)
+    server = cls(
+        consumer, params, cfg, slots=slots, prompt_len=P, max_new=MAX_NEW,
+        commit_every=commit_every, eos_id=eos_id, **kw,
+    )
+    out = {}
+    for rec, toks in server.run(max_records=n):
+        out[2 * rec.offset + rec.partition] = np.asarray(toks)
+    committed = {
+        pt: broker.committed("g", tk.TopicPartition("p", pt)) or 0
+        for pt in (0, 1)
+    }
+    consumer.close()
+    return out, committed, server, broker
+
+
+class TestSpecTokenExactness:
+    def test_matches_plain_server(self, model):
+        """Same prompt stream through both engines (greedy, fixed seed):
+        token-identical completions, identical commits, and the spec
+        counters prove real speculation happened."""
+        cfg, params = model
+        base, bcomm, _, _ = _serve(StreamingGenerator, cfg, params, 12)
+        spec, scomm, server, _ = _serve(
+            SpecStreamingGenerator, cfg, params, 12, k=3
+        )
+        assert set(spec) == set(base) and len(base) == 12
+        for idx in base:
+            np.testing.assert_array_equal(
+                spec[idx], base[idx], err_msg=f"prompt {idx}"
+            )
+        assert scomm == bcomm
+        st = server.spec_stats()
+        assert st["proposed"] > 0
+        assert 0 <= st["accepted"] <= st["proposed"]
+        assert st["rounds"] > 0
+
+    def test_matches_plain_server_with_eos(self, model):
+        """EOS truncation must land on the same token index in both
+        engines even when the spec round emits several tokens past it
+        internally (the static stop mask discards them)."""
+        cfg, params = model
+        # Probe an EOS id that provably fires mid-generation (the
+        # test_serve recipe).
+        probe, _, _, _ = _serve(StreamingGenerator, cfg, params, 12)
+        eos_id = None
+        for row in probe.values():
+            if len(set(row[1:].tolist())) > 1:
+                eos_id = int(row[2])
+                break
+        assert eos_id is not None
+        base, bcomm, _, _ = _serve(
+            StreamingGenerator, cfg, params, 12, eos_id=eos_id
+        )
+        spec, scomm, _, _ = _serve(
+            SpecStreamingGenerator, cfg, params, 12, eos_id=eos_id, k=3
+        )
+        assert any(len(v) < MAX_NEW for v in base.values()), (
+            "chosen eos never fired: test is vacuous"
+        )
+        for idx in base:
+            np.testing.assert_array_equal(
+                spec[idx], base[idx], err_msg=f"prompt {idx}"
+            )
+        assert scomm == bcomm
+
+    @pytest.mark.parametrize("ticks", [1, 3])
+    def test_rounds_per_sync_variants(self, model, ticks):
+        """Multiple speculative rounds chained per dispatch (done latch
+        inside the block) stay token-exact — including a block length
+        that overshoots the remaining budget."""
+        cfg, params = model
+        base, _, _, _ = _serve(StreamingGenerator, cfg, params, 6)
+        spec, _, _, _ = _serve(
+            SpecStreamingGenerator, cfg, params, 6, k=2,
+            ticks_per_sync=ticks,
+        )
+        for idx in base:
+            np.testing.assert_array_equal(
+                spec[idx], base[idx], err_msg=f"prompt {idx}"
+            )
+
+    def test_perfect_draft_full_acceptance(self, model):
+        """draft == target: every proposal accepted (α = 1 in f32), the
+        bonus path carries whole rounds, outputs still exact."""
+        cfg, params = model
+        base, _, _, _ = _serve(StreamingGenerator, cfg, params, 6)
+        spec, _, server, _ = _serve(
+            SpecStreamingGenerator, cfg, params, 6,
+            draft_params=params, draft_cfg=cfg, k=3,
+        )
+        for idx in base:
+            np.testing.assert_array_equal(spec[idx], base[idx])
+        st = server.spec_stats()
+        assert st["accepted"] == st["proposed"] > 0
+        assert st["acceptance"] == 1.0
+
+    def test_deeper_self_draft(self, model):
+        """draft_layers covering ALL target layers = the perfect draft in
+        self-truncated spelling (truncation at n_layers is the identity):
+        exact and fully accepted."""
+        cfg, params = model
+        base, _, _, _ = _serve(StreamingGenerator, cfg, params, 4)
+        spec, _, server, _ = _serve(
+            SpecStreamingGenerator, cfg, params, 4,
+            draft_layers=cfg.n_layers, k=2,
+        )
+        for idx in base:
+            np.testing.assert_array_equal(spec[idx], base[idx])
+        assert server.spec_stats()["acceptance"] == 1.0
+
+
+class TestSpecCommitExactness:
+    @pytest.mark.parametrize("seed", [0, 3])
+    def test_chaos_commit_parity(self, model, seed):
+        """Injected commit failures (ChaosConsumer, fixed seed): both
+        engines must commit the IDENTICAL offsets. slots=1 +
+        commit_every=1 pins the completion (and therefore commit-call)
+        order to the admission order, so the chaos schedule hits the
+        same records in both runs — any divergence is speculation
+        changing commit behavior, the exact regression this guards."""
+        cfg, params = model
+        chaos = dict(seed=seed, commit_failure_rate=0.5)
+
+        def run(cls, **kw):
+            out, committed, server, _ = _serve(
+                cls, cfg, params, 8, slots=1, commit_every=1,
+                chaos=chaos, **kw,
+            )
+            return out, committed, server
+
+        base, bcomm, bserver = run(StreamingGenerator)
+        spec, scomm, sserver = run(SpecStreamingGenerator, k=3)
+        assert bserver._consumer.injected_commit_failures > 0, (
+            "chaos never fired: test is vacuous"
+        )
+        assert (
+            bserver._consumer.injected_commit_failures
+            == sserver._consumer.injected_commit_failures
+        )
+        assert scomm == bcomm
+        for idx in base:
+            np.testing.assert_array_equal(spec[idx], base[idx])
+
+    def test_chaos_survivability_and_redelivery(self, model):
+        """Poll hiccups + commit failures: the spec server serves every
+        prompt, never commits past its emissions, and exactly the
+        uncommitted prompts re-deliver to a restarted owner."""
+        cfg, params = model
+        out, committed, server, broker = _serve(
+            SpecStreamingGenerator, cfg, params, 8, k=2,
+            commit_every=2,
+            chaos=dict(seed=1, commit_failure_rate=0.4, poll_empty_rate=0.3),
+        )
+        assert len(out) == 8
+        total_committed = sum(committed.values())
+        assert total_committed <= 8
+        consumer2 = tk.MemoryConsumer(broker, "p", group_id="g")
+        redelivered = []
+        while True:
+            recs = consumer2.poll(max_records=64, timeout_ms=50)
+            if not recs:
+                break
+            redelivered.extend(recs)
+        assert len(redelivered) == 8 - total_committed
+        consumer2.close()
+
+
+class TestSpecValidation:
+    def test_rejects_bad_config(self, model):
+        cfg, params = model
+        consumer = object()
+        kw = dict(slots=2, prompt_len=P, max_new=MAX_NEW)
+        with pytest.raises(ValueError, match="greedy-only"):
+            SpecStreamingGenerator(
+                consumer, params, cfg, temperature=0.5, **kw
+            )
+        with pytest.raises(ValueError, match="int8"):
+            SpecStreamingGenerator(
+                consumer, params, cfg, kv_dtype="int8", **kw
+            )
+        with pytest.raises(ValueError, match="kv_kernel"):
+            SpecStreamingGenerator(
+                consumer, params, cfg, kv_kernel=True, **kw
+            )
+        with pytest.raises(ValueError, match="k must be"):
+            SpecStreamingGenerator(consumer, params, cfg, k=0, **kw)
+        with pytest.raises(ValueError, match="together"):
+            SpecStreamingGenerator(
+                consumer, params, cfg, draft_params=params, **kw
+            )
+        with pytest.raises(ValueError, match="draft_layers"):
+            SpecStreamingGenerator(
+                consumer, params, cfg, draft_params=params, draft_cfg=cfg,
+                draft_layers=1, **kw,
+            )
+        other = TransformerConfig(
+            vocab_size=VOCAB // 2, d_model=32, n_layers=1, n_heads=2,
+            n_kv_heads=1, d_ff=64, max_seq_len=P + MAX_NEW,
+            dtype=jnp.float32,
+        )
+        with pytest.raises(ValueError, match="share a vocab"):
+            SpecStreamingGenerator(
+                consumer, params, cfg,
+                draft_params=init_params(jax.random.key(1), other),
+                draft_cfg=other, **kw,
+            )
+
+    def test_rejects_mesh(self, model):
+        from torchkafka_tpu.parallel import make_mesh
+
+        cfg, params = model
+        with pytest.raises(ValueError, match="single-device"):
+            SpecStreamingGenerator(
+                object(), params, cfg, slots=2, prompt_len=P,
+                max_new=MAX_NEW, mesh=make_mesh({"data": 8}),
+            )
+
+    def test_stats_empty_before_serving(self, model):
+        cfg, params = model
+        broker = tk.InMemoryBroker()
+        broker.create_topic("p", partitions=1)
+        consumer = tk.MemoryConsumer(broker, "p", group_id="g0")
+        server = SpecStreamingGenerator(
+            consumer, params, cfg, slots=2, prompt_len=P, max_new=MAX_NEW,
+        )
+        server.warmup()  # all-inactive rounds must not count as proposals
+        st = server.spec_stats()
+        assert st["proposed"] == 0 and st["acceptance"] is None
+        consumer.close()

@@ -69,9 +69,8 @@ class TestShutdownSignal:
 DRAIN_SCRIPT = textwrap.dedent(
     """
     import json, signal, sys, time
-    import jax
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)
+    from torchkafka_tpu.utils.devices import force_cpu_devices
+    force_cpu_devices(2)
     import numpy as np
     import torchkafka_tpu as tk
 

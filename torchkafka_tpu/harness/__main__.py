@@ -37,6 +37,18 @@ def main() -> None:
                     "decode-attention kernel for the pool read (auto = on "
                     "when honorable; on = require, raise otherwise; off = "
                     "XLA scale-folded read — the paired control)")
+    ap.add_argument("--spec", action="store_true",
+                    help="scenario 7: speculative continuous-batching "
+                    "serving (SpecStreamingGenerator) — the layer-truncated "
+                    "self-draft proposes k tokens per slot, one multi-query "
+                    "verify advances each slot by its accepted length; "
+                    "token-exact vs the plain path, reports MEASURED "
+                    "acceptance")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="--spec: draft tokens proposed per verify round")
+    ap.add_argument("--spec-draft-layers", type=int, default=None,
+                    help="--spec: layers in the truncated self-draft "
+                    "(default: half the target's)")
     args = ap.parse_args()
     if args.scenario:
         nums = [args.scenario]
@@ -50,6 +62,8 @@ def main() -> None:
             serve_eos=args.serve_eos, quantized=args.quantized,
             kv_int8=args.kv_int8,
             kv_kernel={"auto": "auto", "on": True, "off": False}[args.kv_kernel],
+            spec=args.spec, spec_k=args.spec_k,
+            spec_draft_layers=args.spec_draft_layers,
         )))
 
 

@@ -178,10 +178,23 @@ def make_dlrm_train_step(
     repl = NamedSharding(mesh, P())
 
     @jax.jit
-    def init_fn(rng):
+    def _init(rng):
         params = init_params(rng, cfg)
         params = jax.lax.with_sharding_constraint(params, p_shardings)
         return params, optimizer.init(params)
+
+    # Pin the optimizer mirrors' layout on BOTH sides of the donated step
+    # (jax 0.4.x: optimizer.init returns them replicated despite the param
+    # constraint, and inferred step outputs need not match the input —
+    # either way donation aliasing dies; see transformer.opt_shardings_like).
+    from torchkafka_tpu.models.transformer import opt_shardings_like
+
+    p_shapes, o_shapes = jax.eval_shape(_init, jax.random.key(0))
+    o_shardings = opt_shardings_like(o_shapes, p_shapes, p_shardings, repl)
+
+    def init_fn(rng):
+        params, opt_state = _init(rng)
+        return params, jax.device_put(opt_state, o_shardings)
 
     def _step(params, opt_state, dense, cats, labels, mask):
         dense = jax.lax.with_sharding_constraint(dense, mat)
@@ -197,7 +210,8 @@ def make_dlrm_train_step(
         return params, opt_state, loss
 
     step_fn = jax.jit(
-        _step, donate_argnums=(0, 1), out_shardings=(p_shardings, None, repl)
+        _step, donate_argnums=(0, 1),
+        out_shardings=(p_shardings, o_shardings, repl),
     )
     return init_fn, step_fn
 

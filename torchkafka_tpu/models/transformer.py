@@ -530,9 +530,9 @@ class Transformer:
         """Global RoPE positions. Inside a manual region over 'sp' (a
         pipeline stage) the layer sees only its sequence shard, so offset by
         the shard index; in the auto-sharded path jit sees the global view."""
-        from jax.sharding import get_abstract_mesh
+        from torchkafka_tpu.ops._compat import axis_is_manual
 
-        if "sp" in getattr(get_abstract_mesh(), "manual_axes", ()):
+        if axis_is_manual("sp"):
             return lax.axis_index("sp") * local_len + jnp.arange(local_len)
         return jnp.arange(local_len)
 
@@ -707,6 +707,39 @@ def batch_spec(mesh: Mesh) -> P:
     return P(daxes if daxes else None, "sp" if "sp" in mesh.shape else None)
 
 
+def opt_shardings_like(opt_state, params, p_shardings, repl):
+    """Sharding tree for an optax state: a leaf that MIRRORS a param
+    (its tree path ends with the param's full path and the shapes match
+    — adam's mu/nu, sgd's trace, any chain wrapping them) takes that
+    param's sharding; everything else (step counts, scalars) replicates.
+
+    Exists for jax 0.4.x, where a with_sharding_constraint on params
+    inside a jitted init commits the PARAMS' output layout but
+    ``optimizer.init``'s mirrors still come back replicated — which then
+    breaks the train step's donation aliasing (input sharding !=
+    out_shardings, an XLA INTERNAL error). On newer jax the constraint
+    propagates and committing to the same layout is a no-op."""
+    from jax.tree_util import tree_flatten_with_path, tree_unflatten
+
+    p_leaves, _ = tree_flatten_with_path(params)
+    s_leaves, _ = tree_flatten_with_path(p_shardings)
+    by_path = {
+        tuple(str(k) for k in path): (leaf.shape, sh)
+        for (path, leaf), (_, sh) in zip(p_leaves, s_leaves)
+    }
+
+    def pick(path, leaf):
+        key = tuple(str(k) for k in path)
+        for i in range(len(key)):
+            hit = by_path.get(key[i:])
+            if hit is not None and hit[0] == getattr(leaf, "shape", None):
+                return hit[1]
+        return repl
+
+    o_leaves, treedef = tree_flatten_with_path(opt_state)
+    return tree_unflatten(treedef, [pick(p, l) for p, l in o_leaves])
+
+
 def make_train_step(
     cfg: TransformerConfig,
     mesh: Mesh,
@@ -731,8 +764,19 @@ def make_train_step(
         opt_state = optimizer.init(params)
         return params, opt_state
 
+    # Pin the optimizer state's layout EXPLICITLY on both sides of the
+    # donated step (see opt_shardings_like): jax 0.4.x neither propagates
+    # the param constraint into optimizer.init's output nor infers the
+    # step's opt output layout consistently with its input — either
+    # mismatch is an XLA INTERNAL donation-aliasing error. eval_shape
+    # gives the opt tree without materialising it.
+    p_shapes, o_shapes = jax.eval_shape(_init, jax.random.key(0))
+    o_shardings = opt_shardings_like(o_shapes, p_shapes, p_shardings, repl)
+
     def init_fn(rng: jax.Array):
-        return _init(rng)
+        params, opt_state = _init(rng)
+        opt_state = jax.device_put(opt_state, o_shardings)
+        return params, opt_state
 
     def _step(params, opt_state, tokens, mask):
         # Constrain inside the jit (rather than via in_shardings) so callers
@@ -758,7 +802,7 @@ def make_train_step(
     step_fn = jax.jit(
         _step,
         donate_argnums=(0, 1),
-        out_shardings=(p_shardings, None, repl),
+        out_shardings=(p_shardings, o_shardings, repl),
     )
     return init_fn, step_fn
 

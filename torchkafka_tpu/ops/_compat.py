@@ -1,0 +1,65 @@
+"""jax API compat for the ops kernels.
+
+``shard_map``: jax >= 0.5 exposes it top-level with ``axis_names`` (the
+manual axes) and ``check_vma``; jax 0.4.x only has
+``jax.experimental.shard_map.shard_map`` whose equivalents are ``auto``
+(the COMPLEMENT of the manual axes) and ``check_rep``. Every call site in
+this package uses the new keyword spelling; this adapter translates it so
+one spelling serves both jax generations instead of three modules each
+binding ``jax.shard_map`` and dying at import on 0.4.x.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def _shard_map_via_experimental(
+    f, *, mesh, in_specs, out_specs, axis_names, check_vma=False,
+):
+    from jax.experimental.shard_map import shard_map as _sm
+
+    # The faithful translation of axis_names is auto = (mesh axes -
+    # axis_names), but partial-manual regions hard-ABORT XLA compile on
+    # the 0.4.x CPU backend (SIGABRT, killing the process — not a
+    # catchable error). Run FULL manual instead: the call sites' specs
+    # mention only the manual axes, so under full manual the remaining
+    # axes see replicated views — same math, redundant compute across
+    # those axes. Acceptable for the 0.4.x fallback only; current jax
+    # takes the partial-manual fast path above.
+    return _sm(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=bool(check_vma),
+    )
+
+
+shard_map = getattr(jax, "shard_map", None) or _shard_map_via_experimental
+
+def axis_is_manual(name: str) -> bool:
+    """True when tracing inside a shard_map manual region over ``name`` —
+    the guard the ring/ulysses wrappers and RoPE positioning use to avoid
+    nesting a second shard_map on a bound axis. Current jax reports this
+    on the abstract mesh (``manual_axes``); 0.4.x tracks manual axes only
+    in the trace-time axis env, which ``core.axis_frame`` probes."""
+    if name in getattr(get_abstract_mesh(), "manual_axes", ()):
+        return True
+    try:  # jax 0.4.x
+        from jax._src import core
+
+        core.axis_frame(name)
+        return True
+    except Exception:  # noqa: BLE001 - unbound axis / API moved: not manual
+        return False
+
+
+try:
+    from jax.sharding import get_abstract_mesh
+except ImportError:  # jax 0.4.x: private module, or absent entirely
+    try:
+        from jax._src.mesh import get_abstract_mesh  # type: ignore
+    except ImportError:
+        def get_abstract_mesh():  # type: ignore
+            """No abstract-mesh tracking on this jax: callers getattr()
+            ``manual_axes`` with a default, so None degrades to 'not in a
+            manual region' (global-view positions)."""
+            return None

@@ -34,10 +34,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-# jax >= 0.4.35: top-level shard_map with axis_names/check_vma. No
-# experimental-module fallback — that API takes check_rep/auto and the
-# call sites below would TypeError on it anyway.
-shard_map = jax.shard_map
+from torchkafka_tpu.ops._compat import shard_map  # noqa: E402
 
 _NEG_INF = -1e30  # finite sentinel: avoids -inf - -inf = nan in the recurrence
 
@@ -347,14 +344,13 @@ def ulysses_attention(
             f"{k.shape[2]} must both be divisible by it — use "
             "ring_attention for indivisible head counts"
         )
-    from jax.sharding import get_abstract_mesh
+    from torchkafka_tpu.ops._compat import axis_is_manual
 
-    ctx = get_abstract_mesh()
     body = functools.partial(
         _ulysses_local, axis_name=axis_name, axis_size=axis_size,
         causal=causal, use_flash=use_flash,
     )
-    if axis_name in getattr(ctx, "manual_axes", ()):
+    if axis_is_manual(axis_name):
         return body(q, k, v)
     spec = P(None, axis_name, None, None)
     return shard_map(
@@ -402,10 +398,9 @@ def ring_attention(
     axis_size = mesh.shape[axis_name]
     if axis_size == 1:
         return mha(q, k, v, causal=causal)
-    from jax.sharding import get_abstract_mesh
+    from torchkafka_tpu.ops._compat import axis_is_manual
 
-    ctx = get_abstract_mesh()
-    if axis_name in getattr(ctx, "manual_axes", ()):
+    if axis_is_manual(axis_name):
         # Already inside a manual region over axis_name (e.g. a pipeline
         # stage that bound 'sp' alongside 'pp'): q/k/v are local shards and
         # the collectives can run directly — nesting a second shard_map on

@@ -571,10 +571,7 @@ def flash_attention_sharded(
     shard, same math. Mesh axes not named here (sp/pp/ep) see the inputs
     replicated, matching what GSPMD would do.
     """
-    # jax >= 0.4.35: top-level shard_map with axis_names/check_vma. No
-    # experimental-module fallback — that API takes check_rep/auto and
-    # would TypeError on these kwargs anyway.
-    shard_map = jax.shard_map
+    from torchkafka_tpu.ops._compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     batch_axes = tuple(a for a in ("data", "fsdp") if a in mesh.shape)

@@ -27,10 +27,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-# jax >= 0.4.35: top-level shard_map with axis_names/check_vma. No
-# experimental-module fallback — that API takes check_rep/auto and the
-# call sites below would TypeError on it anyway.
-shard_map = jax.shard_map
+from torchkafka_tpu.ops._compat import shard_map  # noqa: E402
 
 
 def gpipe(

@@ -407,7 +407,10 @@ class StreamingGenerator:
             raise ValueError("max_send_failure_streak must be >= 1")
         if kv_dtype not in (None, "int8"):
             raise ValueError(f"kv_dtype must be None or 'int8', got {kv_dtype!r}")
-        if kv_kernel not in (True, False, "auto"):
+        # Identity checks, not ``in (True, False, 'auto')``: bool-int
+        # equality would accept 1/0 here and then treat them inconsistently
+        # downstream (``kv_kernel is True`` guards would not fire for 1).
+        if not (kv_kernel is True or kv_kernel is False or kv_kernel == "auto"):
             raise ValueError(
                 f"kv_kernel must be True, False or 'auto', got {kv_kernel!r}"
             )
@@ -701,7 +704,16 @@ class StreamingGenerator:
         (v5e: ~819 GB/s), the serving analog of training's MFU. The gap
         between the run loop's end-to-end tokens/s and this number is
         host/tunnel/admission overhead; the gap between this and 100%
-        roofline is the program's own inefficiency."""
+        roofline is the program's own inefficiency.
+
+        Slot positions are saved and RESTORED around the probe (the
+        'mid' fill pins them, and the probe ticks advance them either
+        way); the probe still writes probe kv/tokens through the real
+        tick program, so call it while no generations are in flight for
+        full state safety. With the dynamic-length kernel engaged, the
+        per-tick KV bytes are scaled by the measured fill fraction
+        (``kv_read_bytes``) — the kernel only reads live positions, and
+        pool-shaped accounting could report >100% of physical peak."""
         cfg = self._cfg
         B, K = self._slots, self._ticks_per_sync
         active = jnp.ones((B,), bool)
@@ -717,6 +729,16 @@ class StreamingGenerator:
         # same either way, within noise).
         if fill not in ("mid", "live"):
             raise ValueError(f"fill must be 'mid' or 'live', got {fill!r}")
+        # The probe ticks advance (and 'mid' first overwrites) self._pos;
+        # without restoring it, a probe taken mid-serving would leave every
+        # in-flight slot at a fabricated position and corrupt its remaining
+        # generation (ADVICE r5 #2). Restored in the finally below. NOTE
+        # the probe still runs real ticks: it writes probe kv/tokens into
+        # the pool and gen buffer, so for full safety call it while no
+        # generations are in flight (scenario 7 probes after warmup,
+        # before serving) — the pos restore makes the IDLE case exact and
+        # bounds the damage in the in-flight case.
+        pos_saved = self._pos
         if fill == "mid":
             target = min(
                 self._prompt_len + self._max_new // 2, self._max_len - 1
@@ -777,13 +799,19 @@ class StreamingGenerator:
 
         from torchkafka_tpu.utils.timing import two_point_slope
 
-        window(1)  # warm (compile + route)
-        # INTERLEAVED short/long windows: grouping all shorts before all
-        # longs lets a drifting transport flip the slope's sign.
-        shorts, longs = [], []
-        for _ in range(windows):
-            shorts.append(window(iters))
-            longs.append(window(3 * iters))
+        try:
+            window(1)  # warm (compile + route)
+            # INTERLEAVED short/long windows: grouping all shorts before all
+            # longs lets a drifting transport flip the slope's sign.
+            shorts, longs = [], []
+            for _ in range(windows):
+                shorts.append(window(iters))
+                longs.append(window(3 * iters))
+        finally:
+            # Probe over (or died mid-window): put the real per-slot
+            # positions back — pos is never donated, so the saved handle
+            # is still alive.
+            self._pos = pos_saved
         t_short, t_long = float(np.median(shorts)), float(np.median(longs))
         tick_s, overhead_s, slope_ok = two_point_slope(
             t_short, t_long, iters * K, 3 * iters * K
@@ -792,7 +820,17 @@ class StreamingGenerator:
         w_bytes, kv_bytes = decode_tick_bytes(
             self._params, cfg, B, self._max_len, kv_int8=self._kv_int8
         )
-        bytes_per_tick = w_bytes + kv_bytes
+        # The v3 dynamic-length kernel DMAs only [0, pos] per slot, so the
+        # KV bytes a tick actually READS scale with the measured fill —
+        # counting the full pool there would let achieved GB/s (and the
+        # roofline %) exceed physical peak at partial fills (ADVICE r5
+        # #1). The XLA read is pool-shaped either way, so kv_read ==
+        # kv_pool without the kernel.
+        kv_read = (
+            int(round(kv_bytes * measured_fill)) if self._kv_kernel
+            else kv_bytes
+        )
+        bytes_per_tick = w_bytes + kv_read
         roofline_tok_s = B * peak_hbm_gbs * 1e9 / bytes_per_tick
         out = {
             "slope_ok": slope_ok,
@@ -801,6 +839,7 @@ class StreamingGenerator:
             "dispatch_overhead_ms": round(overhead_ms, 1),
             "weight_bytes": w_bytes,
             "kv_pool_bytes": kv_bytes,
+            "kv_read_bytes": kv_read,
             "weight_bytes_g": round(w_bytes / 1e9, 3),
             "kv_pool_bytes_g": round(kv_bytes / 1e9, 3),
             "peak_hbm_gbs": peak_hbm_gbs,
@@ -913,6 +952,15 @@ class StreamingGenerator:
                         caches, last_tok, pos, gen,
                         jnp.asarray(prompts), jnp.asarray(admit_mask), sub,
                     )
+                    # Rebind self state after every dispatch: admit/tick
+                    # DONATE the pool, so the old self._caches handles are
+                    # dead buffers — without this, anything reading server
+                    # state after run() (a second run, decode_roofline,
+                    # SpecStreamingGenerator.spec_stats) holds deleted
+                    # arrays.
+                    self._caches, self._last_tok, self._pos, self._gen = (
+                        caches, last_tok, pos, gen
+                    )
             if not active.any():
                 if max_records is not None and served >= max_records:
                     break
@@ -925,6 +973,9 @@ class StreamingGenerator:
             self._rng, sub = jax.random.split(self._rng)
             caches, last_tok, pos, gen, done, n_out = self._tick_fn(
                 caches, last_tok, pos, gen, jnp.asarray(active), sub
+            )
+            self._caches, self._last_tok, self._pos, self._gen = (
+                caches, last_tok, pos, gen
             )
             # ONE host sync per tick block: done/n_out/gen fetched together
             # (separate np.asarray calls are separate round trips on

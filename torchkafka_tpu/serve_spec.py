@@ -1,0 +1,344 @@
+"""Speculative continuous-batching serving: spec decode inside the slot server.
+
+Composes the repo's two flagship inference features, which had never met:
+``models/spec_decode.py`` (draft k tokens, verify all k+1 positions in ONE
+multi-query target dispatch, accept the longest matching prefix) and
+``serve.py``'s ``StreamingGenerator`` (fixed slot pool over a Kafka prompt
+topic, per-completion offset retirement through the interval ledger). The
+result is the combination every production server runs — continuous
+batching + speculation — as a drop-in server: ``SpecStreamingGenerator``
+replaces one class name and everything else (admission loop, commit
+cadence, output topic, chaos behavior, metrics) is inherited UNCHANGED.
+
+How the composition works: ``StreamingGenerator.run()`` treats the slot
+state as an OPAQUE tuple threaded through ``self._admit_fn`` /
+``self._tick_fn``. This subclass only overrides ``_build`` to install a
+speculative admit/tick pair whose state tuple carries (target pool, draft
+pool, acceptance counters); the run loop cannot tell the difference. One
+"tick" becomes one SPECULATIVE ROUND per active slot:
+
+1. the draft proposes k greedy tokens autoregressively (k+1 cheap
+   single-query steps — the last only ingests proposal k so the draft
+   cache stays contiguous across full-accept rounds, spec_decode's rule);
+2. the target scores all k+1 positions in one ``_multi_step`` verify
+   (per-row start positions — exactly the serving tick generalised to
+   S = k+1 queries);
+3. per slot, the longest draft prefix matching the target's own argmax is
+   accepted and the target's correction/bonus token appended — every
+   emitted token is the TARGET's greedy choice, so the server is
+   token-exact vs the plain ``StreamingGenerator`` (greedy) and the draft
+   sets only the speed (differential-tested in tests/test_serve_spec.py).
+
+Static shapes throughout, the serving discipline: the round emits a
+DYNAMIC per-slot count (1..k+1) but it lives in position bookkeeping —
+``pos`` advances by the per-slot accepted length, the gen buffer takes a
+static k+1-step masked one-hot write, EOS stops emission mid-round via a
+static cumulative mask. Rollback is free exactly as in spec_decode: both
+pools are written speculatively and rejected positions become stale
+entries beyond the per-slot watermark, overwritten write-before-attend by
+the next round (the pool carries a k-position overshoot margin).
+
+Commit semantics are untouched BY CONSTRUCTION: completions retire
+offsets through the same ledger calls in the inherited ``run()``, so
+at-least-once-per-prompt and commit-watermark exactness hold under
+speculation — including under injected commit failures (chaos-tested:
+speculation never changes which offsets commit).
+
+Greedy-only (temperature=0): the exactness contract is what makes the
+draft a pure speed knob. Single-device, compute-dtype KV (no mesh /
+int8-pool / Pallas-kernel composition yet — each is validated out with a
+clear error rather than silently misbehaving).
+
+Measured acceptance is a first-class output: the state tuple carries
+device-side (rounds, proposed, accepted) counters and ``spec_stats()``
+reports them, so harness scenario 7 ``--spec`` and
+``benchmarks/bench_spec.py --serve`` publish the MEASURED α of a real
+checkpoint, not a hypothetical point on the i.i.d. curve.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from torchkafka_tpu.models.generate import KVCache, prefill
+from torchkafka_tpu.models.spec_decode import _multi_step, truncated_draft
+from torchkafka_tpu.serve import StreamingGenerator
+
+
+class SpecStreamingGenerator(StreamingGenerator):
+    """Continuous-batching server that decodes speculatively per slot.
+
+    ``draft_params``/``draft_cfg``: any same-vocab draft model (given
+    together), or omit both to build the self-speculative layer-skip
+    draft — ``truncated_draft(params, cfg, draft_layers)`` — from the
+    target itself (``draft_layers`` defaults to half the target's
+    layers). ``k``: draft tokens proposed per verify dispatch.
+    ``ticks_per_sync`` now counts speculative ROUNDS per device dispatch
+    (each round advances an active slot by 1..k+1 tokens, vs exactly 1
+    for a plain tick).
+    """
+
+    def __init__(
+        self,
+        consumer,
+        params,
+        cfg,
+        *,
+        draft_params=None,
+        draft_cfg=None,
+        draft_layers: int | None = None,
+        k: int = 4,
+        **kwargs,
+    ) -> None:
+        if kwargs.get("temperature", 0.0) != 0.0:
+            raise ValueError(
+                "speculative serving is greedy-only: the accept rule "
+                "compares the draft against the target's argmax, which is "
+                "what buys token-exactness vs plain serving (sampled "
+                "speculation needs the rejection-sampling rule — not "
+                "implemented)"
+            )
+        if kwargs.get("mesh") is not None:
+            raise ValueError(
+                "speculative serving is single-device for now: the verify "
+                "step's per-row multi-query writes have no sharded "
+                "spelling here yet — serve with mesh=None"
+            )
+        if kwargs.get("kv_dtype") is not None:
+            raise ValueError(
+                "speculative serving keeps the compute-dtype slot pool: "
+                "int8 KV gives up token-exactness, the one contract "
+                "speculation is built on"
+            )
+        if kwargs.get("kv_kernel", "auto") is True:
+            raise ValueError(
+                "kv_kernel=True cannot be honored: the Pallas decode "
+                "kernel reads one query per slot, not the k+1-query verify"
+            )
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        if (draft_params is None) != (draft_cfg is None):
+            raise ValueError(
+                "draft_params and draft_cfg must be given together "
+                "(or neither, for the layer-truncated self-draft)"
+            )
+        if draft_params is None:
+            if draft_layers is None:
+                draft_layers = max(1, cfg.n_layers // 2)
+            draft_params, draft_cfg = truncated_draft(params, cfg, draft_layers)
+        elif draft_layers is not None:
+            raise ValueError(
+                "draft_layers applies to the self-truncated draft only — "
+                "an explicit draft_params/draft_cfg pair already fixes "
+                "the draft's depth"
+            )
+        if draft_cfg.vocab_size != cfg.vocab_size:
+            raise ValueError(
+                f"draft and target must share a vocab: "
+                f"{draft_cfg.vocab_size} != {cfg.vocab_size}"
+            )
+        self._k = int(k)
+        self._draft_params = draft_params
+        self._draft_cfg = draft_cfg
+        super().__init__(consumer, params, cfg, **kwargs)
+
+    def _build(self) -> None:
+        cfg, dcfg, k = self._cfg, self._draft_cfg, self._k
+        B, P = self._slots, self._prompt_len
+        max_new = self._max_new
+        eos_id = self._eos_id
+        # Overshoot margin: a round starting at the per-slot watermark
+        # ``pos`` (<= P + max_new - 2 for a slot still active) writes
+        # verify k/v at [pos, pos + k] — stale beyond the accepted length,
+        # overwritten write-before-attend next round, but the pool must
+        # hold them. (RoPE beyond cfg.max_seq_len is extrapolation only
+        # for those never-attended stale tails.)
+        self._max_len = M = P + max_new + k
+        self._kv_kernel = False  # the base flag; never engaged here
+
+        def admit(params_pair, state, last_tok, pos, gen, prompts,
+                  admit_mask, key):
+            """Prefill BOTH models on the full [B, P] batch; merge admitted
+            rows into both pools. Token 0 comes from the TARGET's logits
+            (greedy) — identical to the plain server's admit, so the two
+            servers' completions start from the same token."""
+            tparams, dparams = params_pair
+            t_k, t_v, d_k, d_v, acc, prop, rounds = state
+            t_logits, t_fresh = prefill(tparams, cfg, prompts, M)
+            _d_logits, d_fresh = prefill(dparams, dcfg, prompts, M)
+            sel = admit_mask[None, :, None, None, None]
+            t_k = jnp.where(sel, t_fresh.k, t_k)
+            t_v = jnp.where(sel, t_fresh.v, t_v)
+            d_k = jnp.where(sel, d_fresh.k, d_k)
+            d_v = jnp.where(sel, d_fresh.v, d_v)
+            tok0 = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+            last_tok = jnp.where(admit_mask, tok0, last_tok)
+            pos = jnp.where(admit_mask, P, pos)
+            gen = jnp.where(admit_mask[:, None], 0, gen)
+            gen = gen.at[:, 0].set(jnp.where(admit_mask, tok0, gen[:, 0]))
+            return (t_k, t_v, d_k, d_v, acc, prop, rounds), last_tok, pos, gen
+
+        K = self._ticks_per_sync
+
+        def tick_block(params_pair, state, last_tok, pos, gen, active_in, key):
+            """K speculative rounds in one dispatch, done mask latched like
+            the plain tick block. Invariant per slot: ``pos`` is the
+            sequence position of ``last_tok`` (whose k/v is written by the
+            NEXT verify), and gen[0 .. pos - P] holds the emitted tokens."""
+            tparams, dparams = params_pair
+
+            def one(carry, _):
+                state, last_tok, pos, gen, done_latch, n_out = carry
+                t_k, t_v, d_k, d_v, acc, prop, rounds = state
+                act = active_in & ~done_latch
+
+                # k+1 draft steps for k proposals — the last step only
+                # INGESTS proposal k so the draft cache has an entry at
+                # every accepted position after a full-accept round
+                # (spec_decode's contiguity rule; see its body comment).
+                def dbody(c, j):
+                    dc, tok = c
+                    logits, dc = _multi_step(
+                        dparams, dcfg, dc, tok[:, None], pos + j
+                    )
+                    nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+                    return (dc, nxt), nxt
+
+                (dc, _), d_toks = lax.scan(
+                    dbody, (KVCache(d_k, d_v), last_tok), jnp.arange(k + 1)
+                )
+                d_k, d_v = dc.k, dc.v
+                d = jnp.transpose(d_toks[:k])  # [B, k]
+
+                # One multi-query verify at per-slot start positions: the
+                # serving tick generalised to S = k+1 (same write/mask
+                # discipline — spec_decode._multi_step IS the sibling the
+                # serve docstrings point at).
+                v_in = jnp.concatenate([last_tok[:, None], d], axis=1)
+                t_logits, tc = _multi_step(
+                    tparams, cfg, KVCache(t_k, t_v), v_in, pos
+                )
+                t_k, t_v = tc.k, tc.v
+                tga = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+
+                match = tga[:, :k] == d
+                n_acc = jnp.sum(
+                    jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1
+                )
+                corr = jnp.take_along_axis(tga, n_acc[:, None], axis=1)[:, 0]
+
+                # Emit accepted drafts then the correction/bonus — static
+                # k+1-step masked one-hot writes over [B, max_new], like
+                # the plain tick's gen write. Three static stop rules per
+                # candidate j: past the accepted length (j > n_acc), past
+                # the buffer (j >= rem), or after an earlier EOS in this
+                # round (alive latch). Every candidate is a TARGET-greedy
+                # token, so emission order equals plain serving's.
+                emitted_before = pos - P + 1
+                rem = max_new - emitted_before
+                idxbuf = jnp.arange(max_new)[None, :]
+                alive = act
+                n_emit = jnp.zeros_like(pos)
+                new_last = last_tok
+                eos_hit = jnp.zeros_like(act)
+                for j in range(k + 1):
+                    tok_j = d[:, j] if j < k else corr
+                    tok_j = jnp.where(j < n_acc, tok_j, corr)
+                    emit = alive & (j <= n_acc) & (j < rem)
+                    sel = (
+                        idxbuf == (emitted_before + j)[:, None]
+                    ) & emit[:, None]
+                    gen = jnp.where(sel, tok_j[:, None], gen)
+                    n_emit = n_emit + emit.astype(jnp.int32)
+                    new_last = jnp.where(emit, tok_j, new_last)
+                    if eos_id is not None:
+                        # Same rule as the plain server: EOS counts on
+                        # decode outputs only (gen index >= 1 — always
+                        # true here since emitted_before >= 1), and the
+                        # EOS token itself is emitted.
+                        hit = emit & (tok_j == eos_id)
+                        eos_hit = eos_hit | hit
+                        alive = alive & ~hit
+                emitted_after = emitted_before + n_emit
+                done_now = act & (eos_hit | (emitted_after >= max_new))
+                n_out = jnp.where(done_now, emitted_after, n_out)
+                pos = jnp.where(act & ~done_now, pos + n_emit, pos)
+                last_tok = jnp.where(act, new_last, last_tok)
+
+                # Acceptance counters (device-side; spec_stats() fetches):
+                # α = accepted / proposed over every live round — the
+                # measured number PERF.md's speedup row is built on.
+                n_act = jnp.sum(act.astype(jnp.int32))
+                acc = acc + jnp.sum(jnp.where(act, n_acc, 0))
+                prop = prop + k * n_act
+                rounds = rounds + (n_act > 0).astype(jnp.int32)
+                done_latch = done_latch | done_now
+                state = (t_k, t_v, d_k, d_v, acc, prop, rounds)
+                return (state, last_tok, pos, gen, done_latch, n_out), None
+
+            done0 = jnp.zeros((B,), bool)
+            n0 = jnp.zeros((B,), jnp.int32)
+            (state, last_tok, pos, gen, done, n_out), _ = lax.scan(
+                one, (state, last_tok, pos, gen, done0, n0), None, length=K
+            )
+            return state, last_tok, pos, gen, done, n_out
+
+        # Same dispatch shape as the base: donate the state tuple, pass
+        # BOTH param trees as arguments (a closed-over tree lowers as
+        # jaxpr constants — the base _build's note).
+        _admit = jax.jit(admit, donate_argnums=(1,))
+        _tick = jax.jit(tick_block, donate_argnums=(1,))
+        self._admit_fn = lambda *a: _admit(
+            (self._params, self._draft_params), *a
+        )
+        self._tick_fn = lambda *a: _tick(
+            (self._params, self._draft_params), *a
+        )
+        # decode_roofline's raw hook passes only the target tree; close
+        # over the draft (a 45M-class self-draft — small enough that the
+        # constant-lowering cost the base avoids for 8B trees is fine).
+        # NOTE its byte accounting stays target-only: the reported
+        # roofline % under-counts the draft's extra reads.
+        self._tick_block_raw = (
+            lambda params, *a: tick_block((params, self._draft_params), *a)
+        )
+
+        nl, kh, dh = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+        dl, dkh, ddh = dcfg.n_layers, dcfg.n_kv_heads, dcfg.head_dim
+        self._caches = (
+            jnp.zeros((nl, B, M, kh, dh), cfg.dtype),
+            jnp.zeros((nl, B, M, kh, dh), cfg.dtype),
+            jnp.zeros((dl, B, M, dkh, ddh), dcfg.dtype),
+            jnp.zeros((dl, B, M, dkh, ddh), dcfg.dtype),
+            # accepted / proposed / rounds — three DISTINCT buffers (the
+            # state tuple is donated; one buffer donated thrice is an
+            # XLA error).
+            jnp.zeros((), jnp.int32).copy(),
+            jnp.zeros((), jnp.int32).copy(),
+            jnp.zeros((), jnp.int32).copy(),
+        )
+        self._last_tok = jnp.zeros((B,), jnp.int32)
+        self._pos = jnp.zeros((B,), jnp.int32)
+        self._gen = jnp.zeros((B, max_new), jnp.int32)
+
+    def spec_stats(self) -> dict:
+        """Measured speculation counters since construction (one device
+        fetch). ``acceptance`` is the realized α — the workload-dependent
+        number the i.i.d. speedup curve must be evaluated at. Warmup's
+        all-inactive rounds don't count (no active slot → no proposals);
+        a ``decode_roofline`` probe DOES run live rounds, so measure α
+        from a server that hasn't probed (the harness probes a separate
+        instance)."""
+        acc, prop, rounds = (
+            int(jax.device_get(x)) for x in self._caches[4:7]
+        )
+        return {
+            "rounds": rounds,
+            "proposed": prop,
+            "accepted": acc,
+            "acceptance": round(acc / prop, 4) if prop else None,
+            "k": self._k,
+            "draft_layers": self._draft_cfg.n_layers,
+        }

@@ -1,4 +1,11 @@
+from torchkafka_tpu.utils.devices import force_cpu_devices
 from torchkafka_tpu.utils.metrics import LatencyHistogram, RateMeter, StreamMetrics
 from torchkafka_tpu.utils.shutdown import ShutdownSignal
 
-__all__ = ["LatencyHistogram", "RateMeter", "ShutdownSignal", "StreamMetrics"]
+__all__ = [
+    "LatencyHistogram",
+    "RateMeter",
+    "ShutdownSignal",
+    "StreamMetrics",
+    "force_cpu_devices",
+]

@@ -1,0 +1,32 @@
+"""Virtual-device configuration that works on every supported jax.
+
+One spelling for "give me a CPU backend with N virtual devices" (the
+multi-chip test/dryrun substrate): jax >= 0.6 has the
+``jax_num_cpu_devices`` config option; jax 0.4.x only honors the
+``--xla_force_host_platform_device_count`` XLA flag, which is read at
+backend initialization — so either spelling must run BEFORE first device
+use (backends initialize lazily; importing jax is safe, touching
+``jax.devices()`` is not).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_devices(n: int) -> None:
+    """Force the CPU backend with ``n`` virtual devices. Call before any
+    device use; raises RuntimeError (from jax) if the backend is already
+    initialized with the config-option path, and silently has no effect
+    in the XLA_FLAGS path (the flag is simply never re-read) — callers
+    that can proceed on fewer devices should verify ``jax.devices()``."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:  # jax 0.4.x: no such option — use the XLA flag
+        flags = os.environ.get("XLA_FLAGS", "")
+        opt = f"--xla_force_host_platform_device_count={n}"
+        if opt not in flags:
+            os.environ["XLA_FLAGS"] = f"{flags} {opt}".strip()
