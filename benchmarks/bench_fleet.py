@@ -1,0 +1,118 @@
+"""Fleet-throughput curve: 1 vs 2 vs 4 replicas on the in-memory broker.
+
+Paired same-window runs: replica counts alternate round-robin over
+``--slices`` rounds against freshly-built identical broker content, so
+box contention hits every configuration equally and the per-round RATIOS
+(R replicas vs 1) are the stable signal — the same pairing discipline
+bench.py and the harness pairs use. Absolute rows/s on a contended CPU
+box swing widely; the ratio answers the question the fleet exists for:
+does work actually spread across replicas?
+
+The model is deliberately tiny on CPU (the point is the SCHEDULING path:
+group assignment, QoS admission, per-replica commits — not the decode
+FLOPs; a real fleet puts each replica on its own accelerator, where pump
+cost is device-bound and replicas scale across chips).
+
+Usage: python benchmarks/bench_fleet.py [--replicas 1,2,4] [--prompts 96]
+       [--slices 3]
+Prints one markdown row per replica count plus a JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def build(tk, cfg, params, n_prompts: int, replicas: int, vocab: int,
+          prompt_len: int):
+    import numpy as np
+
+    broker = tk.InMemoryBroker()
+    parts = max(4, replicas)
+    broker.create_topic("bench", partitions=parts)
+    rng = np.random.default_rng(0)
+    for i in range(n_prompts):
+        broker.produce(
+            "bench",
+            rng.integers(0, vocab, prompt_len, dtype=np.int32).tobytes(),
+            partition=i % parts,
+        )
+    from torchkafka_tpu.fleet import ServingFleet
+
+    return broker, ServingFleet(
+        lambda rid: tk.MemoryConsumer(broker, "bench", group_id="bench"),
+        params, cfg, replicas=replicas, prompt_len=prompt_len,
+        max_new=16, slots=8, commit_every=8,
+    )
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--replicas", default="1,2,4")
+    ap.add_argument("--prompts", type=int, default=96)
+    ap.add_argument("--slices", type=int, default=3)
+    args = ap.parse_args()
+    counts = [int(x) for x in args.replicas.split(",")]
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from torchkafka_tpu.utils.devices import force_cpu_devices
+
+    force_cpu_devices(8)
+    import torchkafka_tpu as tk
+    from torchkafka_tpu.models.transformer import (
+        TransformerConfig, init_params,
+    )
+
+    prompt_len, vocab = 16, 512
+    cfg = TransformerConfig(
+        vocab_size=vocab, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=prompt_len + 16, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.key(0), cfg)
+
+    # Warm the jit cache once so slice 0 of the first config doesn't pay
+    # compile while the others hit the cache (pairing would be broken).
+    _, fleet = build(tk, cfg, params, 8, 1, vocab, prompt_len)
+    fleet.warmup()
+    fleet.serve_all(max_records=8)
+    fleet.close()
+
+    per: dict[int, list[float]] = {r: [] for r in counts}
+    for s in range(args.slices):
+        for r in counts:  # interleaved: every config samples every window
+            broker, fleet = build(
+                tk, cfg, params, args.prompts, r, vocab, prompt_len
+            )
+            t0 = time.perf_counter()
+            done = len(fleet.serve_all(max_records=args.prompts))
+            dt = time.perf_counter() - t0
+            fleet.close()
+            assert done == args.prompts, (r, done)
+            per[r].append(args.prompts / dt)
+            print(f"slice {s} replicas {r}: {per[r][-1]:,.1f} prompts/s",
+                  file=sys.stderr)
+
+    base = [per[counts[0]][i] for i in range(args.slices)]
+    print("| replicas | prompts/s (median) | ratio vs "
+          f"{counts[0]} (median of paired) |")
+    print("|---|---|---|")
+    out = {}
+    for r in counts:
+        rates = per[r]
+        ratios = [rates[i] / base[i] for i in range(args.slices)]
+        med = float(np.median(rates))
+        med_ratio = float(np.median(ratios))
+        out[r] = {"prompts_per_s": med, "ratio": med_ratio,
+                  "slices": [round(x, 1) for x in rates]}
+        print(f"| {r} | {med:,.1f} | {med_ratio:.2f}× |")
+    print(json.dumps(out), file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

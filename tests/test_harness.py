@@ -8,7 +8,7 @@ import pytest
 from torchkafka_tpu.harness import run_scenario
 
 
-@pytest.mark.parametrize("num", [1, 2, 3, 4, 5, 6, 7, 8, 9])
+@pytest.mark.parametrize("num", [1, 2, 3, 4, 5, 6, 7, 8, 9, 10])
 def test_scenario_runs_and_reports(num):
     out = run_scenario(num, "tiny")
     assert out["records"] > 0
@@ -67,3 +67,39 @@ def test_spec_flag_scoping():
         run_scenario(5, "tiny", spec=True)
     with pytest.raises(ValueError, match="kv-int8|kv_int8|compute-dtype"):
         run_scenario(7, "tiny", spec=True, kv_int8=True)
+
+
+def test_scenario_10_fleet_smoke():
+    """The tier-1 fleet smoke (fast, 'not slow'): scenario 10 exercises
+    QoS admission (a provably-throttled tenant, both lanes) AND graceful
+    drain (mid-run drain, restart, zero replayed completions) without a
+    long run."""
+    out = run_scenario(10, "tiny")
+    assert out["scenario"] == "10:serving-fleet"
+    assert out["replicas"] == 2
+    assert out["drained_states"] == ["done", "done"]
+    assert out["drains"] == 2
+    assert out["coverage_complete"] is True
+    assert out["zero_replayed_after_drain"] is True
+    tenants = out["tenants"]
+    assert tenants["throttled"]["throttled"] > 0
+    assert tenants["open"]["throttled"] == 0
+    assert set(out["lanes"]) == {"interactive", "batch"}
+
+
+def test_scenario_7_sampled_serving():
+    """--temperature/--top-k through the harness: the sampled serving row
+    completes with exact commits and reports its sampling knobs."""
+    out = run_scenario(7, "tiny", temperature=0.8, top_k=8, top_p=0.95)
+    assert out["records"] > 0
+    assert out["commit_failures"] == 0
+    assert out["sampling"] == {
+        "temperature": 0.8, "top_k": 8, "top_p": 0.95,
+    }
+
+
+def test_sampling_flag_scoping():
+    with pytest.raises(ValueError, match="temperature"):
+        run_scenario(5, "tiny", temperature=0.5)
+    with pytest.raises(ValueError, match="greedy-only"):
+        run_scenario(7, "tiny", spec=True, top_k=4)

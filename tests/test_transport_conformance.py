@@ -1,0 +1,347 @@
+"""Transport-conformance suite: ONE parametrized module proving every
+Consumer transport honors the same protocol surface the framework builds
+on — poll ordering, commit/committed/resume, seek, rebalance generations,
+pause/resume (exactly the surface the fleet's backpressure drives), and
+the close contract.
+
+Transports:
+
+- ``memory``: MemoryConsumer over an in-process InMemoryBroker.
+- ``netbroker``: the SAME MemoryConsumer over a BrokerClient socket proxy
+  (the cross-process fleet/pod transport) — group state lives server-side.
+- ``kafka``: the kafka-python adapter, auto-included when the library is
+  importable; the broker-dependent cases additionally need
+  ``KAFKA_BOOTSTRAP`` (a live broker) and skip cleanly without it.
+
+A transport passes by behaving identically under every case — the suite
+is the executable definition of "implements Consumer".
+"""
+
+from __future__ import annotations
+
+import os
+import uuid
+
+import pytest
+
+import torchkafka_tpu as tk
+from torchkafka_tpu.errors import (
+    CommitFailedError,
+    ConsumerClosedError,
+    NotAssignedError,
+)
+from torchkafka_tpu.source.records import TopicPartition
+
+try:
+    import kafka as _kafka_lib  # noqa: F401
+
+    HAVE_KAFKA = True
+except ImportError:
+    HAVE_KAFKA = False
+KAFKA_BOOTSTRAP = os.environ.get("KAFKA_BOOTSTRAP")
+
+TRANSPORTS = ["memory", "netbroker"] + (["kafka"] if HAVE_KAFKA else [])
+
+
+class _Env:
+    """One transport-backed topic environment: produce + consumer factory."""
+
+    supports_group_introspection = True  # broker.committed() readable
+
+    def __init__(self, topic: str, partitions: int):
+        self.topic = topic
+        self.partitions = partitions
+
+    def produce(self, value: bytes, partition: int, key: bytes | None = None):
+        raise NotImplementedError
+
+    def consumer(self, group: str, **kw):
+        raise NotImplementedError
+
+    def committed_by_broker(self, group: str, p: int) -> int | None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class _MemoryEnv(_Env):
+    def __init__(self, topic, partitions):
+        super().__init__(topic, partitions)
+        self.broker = tk.InMemoryBroker()
+        self.broker.create_topic(topic, partitions=partitions)
+
+    def produce(self, value, partition, key=None):
+        self.broker.produce(self.topic, value, partition=partition, key=key)
+
+    def consumer(self, group, **kw):
+        return tk.MemoryConsumer(self.broker, self.topic, group_id=group, **kw)
+
+    def committed_by_broker(self, group, p):
+        return self.broker.committed(group, TopicPartition(self.topic, p))
+
+
+class _NetbrokerEnv(_Env):
+    def __init__(self, topic, partitions):
+        super().__init__(topic, partitions)
+        self.broker = tk.InMemoryBroker()
+        self.broker.create_topic(topic, partitions=partitions)
+        self.server = tk.BrokerServer(self.broker)
+        self._clients: list = []
+
+    def produce(self, value, partition, key=None):
+        self.broker.produce(self.topic, value, partition=partition, key=key)
+
+    def consumer(self, group, **kw):
+        client = tk.BrokerClient(self.server.host, self.server.port)
+        self._clients.append(client)
+        return tk.MemoryConsumer(client, self.topic, group_id=group, **kw)
+
+    def committed_by_broker(self, group, p):
+        return self.broker.committed(group, TopicPartition(self.topic, p))
+
+    def close(self):
+        for c in self._clients:
+            c.close()
+        self.server.close()
+
+
+class _KafkaEnv(_Env):
+    supports_group_introspection = False  # needs an admin client; assert
+    # through a fresh consumer's committed() instead
+
+    def __init__(self, topic, partitions):
+        super().__init__(topic, partitions)
+        from kafka.admin import KafkaAdminClient, NewTopic
+
+        self._admin = KafkaAdminClient(bootstrap_servers=KAFKA_BOOTSTRAP)
+        self._admin.create_topics(
+            [NewTopic(topic, num_partitions=partitions, replication_factor=1)]
+        )
+        from kafka import KafkaProducer as _KP
+
+        self._producer = _KP(bootstrap_servers=KAFKA_BOOTSTRAP)
+
+    def produce(self, value, partition, key=None):
+        self._producer.send(
+            self.topic, value=value, key=key, partition=partition
+        )
+        self._producer.flush()
+
+    def consumer(self, group, **kw):
+        return tk.KafkaConsumer(
+            self.topic, group_id=group,
+            bootstrap_servers=KAFKA_BOOTSTRAP,
+            auto_offset_reset="earliest", **kw,
+        )
+
+    def committed_by_broker(self, group, p):
+        probe = self.consumer(group)
+        try:
+            return probe.committed(TopicPartition(self.topic, p))
+        finally:
+            probe.close()
+
+    def close(self):
+        self._producer.close()
+        self._admin.close()
+
+
+@pytest.fixture(params=TRANSPORTS)
+def env(request):
+    if request.param == "kafka" and not KAFKA_BOOTSTRAP:
+        pytest.skip("kafka-python importable but KAFKA_BOOTSTRAP not set")
+    topic = f"conf-{uuid.uuid4().hex[:12]}"
+    e = {
+        "memory": _MemoryEnv,
+        "netbroker": _NetbrokerEnv,
+        "kafka": _KafkaEnv,
+    }[request.param](topic, partitions=2)
+    e.name = request.param
+    yield e
+    e.close()
+
+
+def _fill(env, per_partition=4):
+    for p in range(env.partitions):
+        for i in range(per_partition):
+            env.produce(f"{p}:{i}".encode(), partition=p)
+
+
+def _drain(consumer, n, timeout_ms=3000):
+    out = []
+    import time
+
+    deadline = time.monotonic() + timeout_ms / 1e3
+    while len(out) < n and time.monotonic() < deadline:
+        out.extend(consumer.poll(max_records=64, timeout_ms=100))
+    return out
+
+
+class TestConformance:
+    def test_poll_preserves_partition_order(self, env):
+        _fill(env)
+        c = env.consumer("g-order")
+        records = _drain(c, 8)
+        assert len(records) == 8
+        per_part: dict[int, list[int]] = {}
+        for r in records:
+            assert r.topic == env.topic
+            per_part.setdefault(r.partition, []).append(r.offset)
+        assert set(per_part) == {0, 1}
+        for offs in per_part.values():
+            assert offs == sorted(offs)  # per-partition offset order
+
+    def test_commit_committed_resume(self, env):
+        """Explicit-offset commit is durable and is the resume point for
+        the next same-group consumer — the at-least-once anchor."""
+        _fill(env)
+        group = "g-commit"
+        c = env.consumer(group)
+        records = _drain(c, 8)
+        assert len(records) == 8
+        tp0 = TopicPartition(env.topic, 0)
+        c.commit({tp0: 2})
+        assert c.committed(tp0) == 2
+        c.close()
+        c2 = env.consumer(group)
+        redelivered = _drain(c2, 6)
+        offs0 = sorted(r.offset for r in redelivered if r.partition == 0)
+        offs1 = sorted(r.offset for r in redelivered if r.partition == 1)
+        assert offs0 == [2, 3]  # committed prefix never re-delivers
+        assert offs1 == [0, 1, 2, 3]  # uncommitted partition replays fully
+        c2.close()
+
+    def test_seek_rewinds(self, env):
+        _fill(env)
+        c = env.consumer("g-seek")
+        records = _drain(c, 8)
+        assert len(records) == 8
+        tp0 = TopicPartition(env.topic, 0)
+        c.seek(tp0, 1)
+        again = [r for r in _drain(c, 3) if r.partition == 0]
+        assert [r.offset for r in again] == [1, 2, 3]
+        c.close()
+
+    def test_pause_resume_surface(self, env):
+        """The exact surface the fleet's backpressure drives: pause stops
+        fetches without losing assignment or positions; resume restores
+        delivery in order; paused()/has_paused() report truthfully."""
+        _fill(env)
+        c = env.consumer("g-pause")
+        first = _drain(c, 8)
+        assert len(first) == 8
+        tp0 = TopicPartition(env.topic, 0)
+        tp1 = TopicPartition(env.topic, 1)
+        assert not c.has_paused() and list(c.paused()) == []
+        c.pause(tp0)
+        assert c.has_paused()
+        assert list(c.paused()) == [tp0]
+        for p in range(env.partitions):
+            env.produce(f"{p}:late".encode(), partition=p)
+        during = _drain(c, 1, timeout_ms=1000)
+        assert {r.partition for r in during} == {1}  # tp0 fetch is stopped
+        assert all(r.value == b"1:late" for r in during)
+        c.resume(tp0)
+        assert not c.has_paused()
+        after = _drain(c, 1)
+        assert [(r.partition, r.value) for r in after] == [(0, b"0:late")]
+        c.pause(tp0, tp1)
+        assert sorted(c.paused()) == [tp0, tp1]
+        c.resume(tp0, tp1)
+        c.close()
+
+    def test_pause_unassigned_raises(self, env):
+        _fill(env)
+        c = env.consumer("g-pause-bad")
+        _drain(c, 8)  # complete the group join
+        with pytest.raises(NotAssignedError):
+            c.pause(TopicPartition(env.topic, 99))
+        with pytest.raises(NotAssignedError):
+            c.resume(TopicPartition(env.topic, 99))
+        c.close()
+
+    def test_rebalance_generation_checked_commit(self, env):
+        """A second member joining the group invalidates the first's
+        generation: its stale commit raises CommitFailedError and commits
+        NOTHING — the re-delivery trigger the serving fleet's failover is
+        built on."""
+        if env.name == "kafka":
+            pytest.skip(
+                "deterministically racing a live broker's rebalance "
+                "against a commit needs coordinated timing; the memory-"
+                "semantics transports prove the protocol"
+            )
+        _fill(env)
+        group = "g-rebal"
+        c1 = env.consumer(group)
+        records = _drain(c1, 8)
+        assert len(records) == 8  # c1 owns both partitions
+        c2 = env.consumer(group)  # join bumps the generation
+        tp0 = TopicPartition(env.topic, 0)
+        with pytest.raises(CommitFailedError):
+            c1.commit({tp0: 4})
+        assert env.committed_by_broker(group, 0) is None  # nothing durable
+        # After syncing (any poll/assignment call), the split is disjoint
+        # and covers the topic.
+        a1 = set(c1.assignment())
+        a2 = set(c2.assignment())
+        assert a1 and a2
+        assert not (a1 & a2)
+        assert {tp.partition for tp in a1 | a2} == {0, 1}
+        c1.close()
+        c2.close()
+
+    def test_member_leave_redelivers_uncommitted(self, env):
+        """Leave → rebalance → the survivor redelivers exactly the
+        leaver's uncommitted records (the fleet kill path's transport
+        half)."""
+        if env.name == "kafka":
+            pytest.skip("needs coordinated live-broker timing; see above")
+        _fill(env)
+        group = "g-leave"
+        c1 = env.consumer(group)
+        records = _drain(c1, 8)
+        mine = {r.partition for r in records}
+        assert mine == {0, 1}
+        # Commit partition 0 fully, leave partition 1 uncommitted, leave.
+        c1.commit({TopicPartition(env.topic, 0): 4})
+        c1.close()
+        c2 = env.consumer(group)
+        redelivered = _drain(c2, 4)
+        assert sorted((r.partition, r.offset) for r in redelivered) == [
+            (1, 0), (1, 1), (1, 2), (1, 3)
+        ]
+        c2.close()
+
+    def test_close_contract(self, env):
+        """Closed consumers refuse the full surface; close never commits
+        (uncommitted work must re-deliver — the reference's teardown
+        contract)."""
+        _fill(env)
+        group = "g-close"
+        c = env.consumer(group)
+        got = _drain(c, 8)
+        assert len(got) == 8
+        c.close()
+        c.close()  # idempotent
+        with pytest.raises(ConsumerClosedError):
+            c.poll()
+        with pytest.raises(ConsumerClosedError):
+            c.commit({TopicPartition(env.topic, 0): 1})
+        if env.supports_group_introspection:
+            assert env.committed_by_broker(group, 0) is None
+            assert env.committed_by_broker(group, 1) is None
+
+    def test_lag_and_end_offsets(self, env):
+        _fill(env)
+        c = env.consumer("g-lag")
+        tps = [TopicPartition(env.topic, p) for p in range(2)]
+        got = _drain(c, 8)
+        assert len(got) == 8
+        assert c.end_offsets(tps) == {tp: 4 for tp in tps}
+        assert c.lag() == {tp: 0 for tp in tps}
+        env.produce(b"x", partition=0)
+        lag = c.lag()
+        assert lag[tps[0]] == 1 and lag[tps[1]] == 0
+        c.close()

@@ -193,3 +193,27 @@ class OffsetLedger:
     def pending_count(self) -> int:
         with self._lock:
             return sum(part.pending for part in self._parts.values())
+
+    def pending_by_partition(self) -> dict[TopicPartition, int]:
+        """Per-partition in-flight (fetched-but-unretired) record counts —
+        the fleet watermark view's 'how far behind is each replica'."""
+        with self._lock:
+            return {tp: part.pending for tp, part in self._parts.items()}
+
+
+def merged_watermarks(
+    snapshots: "list[dict[TopicPartition, int]]",
+) -> dict[TopicPartition, int]:
+    """Fleet-level committable view over several replicas' ledgers.
+
+    Under the consumer-group invariant each partition is owned by exactly
+    one member, so the merged view is normally a disjoint union. During a
+    handoff window (rebalance mid-redelivery) two ledgers can briefly know
+    the same partition; the merge takes the MINIMUM — a watermark that
+    never covers another replica's still-pending records, the same
+    fail-low rule the per-replica snapshot applies within a partition."""
+    out: dict[TopicPartition, int] = {}
+    for snap in snapshots:
+        for tp, off in snap.items():
+            out[tp] = min(out[tp], off) if tp in out else off
+    return out

@@ -1,0 +1,32 @@
+"""Serving fleet: partitioned multi-replica serving with QoS admission,
+replica failover, and graceful drain (see fleet/fleet.py for the design).
+"""
+
+from torchkafka_tpu.fleet.fleet import ReplicaChaos, ServingFleet
+from torchkafka_tpu.fleet.metrics import FleetMetrics
+from torchkafka_tpu.fleet.qos import (
+    BATCH,
+    INTERACTIVE,
+    AdmissionQueue,
+    QoSConfig,
+    TenantBuckets,
+    TokenBucket,
+    default_lane,
+    default_tenant,
+)
+from torchkafka_tpu.fleet.replica import Replica
+
+__all__ = [
+    "AdmissionQueue",
+    "BATCH",
+    "FleetMetrics",
+    "INTERACTIVE",
+    "QoSConfig",
+    "Replica",
+    "ReplicaChaos",
+    "ServingFleet",
+    "TenantBuckets",
+    "TokenBucket",
+    "default_lane",
+    "default_tenant",
+]

@@ -1,0 +1,331 @@
+"""Partitioned multi-replica serving: the fleet orchestrator.
+
+``ServingFleet`` runs N serving replicas as members of ONE consumer group
+over the prompt topic, so partitions range-assign across replicas and the
+at-least-once contract holds *per prompt across replica failure* — the
+consumer-group machinery that is battle-tested for training ingest
+(source/memory.py range assignment + generations; tests/test_pod.py
+elastic leave/join with exact re-delivery) generalized to the serving
+path, the way vLLM-class production stacks put a router with admission
+control in front of continuous-batching engines.
+
+The scheduler is COOPERATIVE: ``serve()`` round-robins one ``pump()``
+(poll → QoS admit → one tick block) across live replicas on the calling
+thread, which keeps every chaos/drain interleaving deterministic under a
+seeded schedule — the property the differential tests are built on. Each
+replica is still a real, independent group member with its own consumer,
+ledger, and commits; point the ``consumer_factory`` at a
+``BrokerClient`` (source/netbroker.py) and the same fleet spans OS
+processes, one replica each, exactly like the elastic pod tests.
+
+Failure model:
+
+- ``kill_replica`` / ``ReplicaChaos``: the victim leaves the group with
+  nothing committed past its last cadence commit. The rebalance hands its
+  partitions to survivors, whose polls resume from the committed offset —
+  its uncommitted prompts re-deliver and regenerate. Completions the
+  victim emitted but never committed are served AGAIN by a survivor
+  (duplicates, counted in ``FleetMetrics.duplicates``); completions it
+  committed never re-deliver. No prompt is lost, and no commit ever
+  covers unfinished work (each replica's interval ledger guarantees it
+  locally; the fleet's commit-follows-completion pump ordering makes it
+  observable globally).
+- ``ShutdownSignal`` / ``drain()``: stop admitting fleet-wide, finish
+  every in-flight generation, commit, leave the group — a restart resumes
+  with zero replayed completions (drain is the replay-free shutdown; kill
+  is the loss-free crash).
+
+Replay-free drain requires per-partition FIFO admission: the ledger
+watermark can only cover a completion once every EARLIER offset of its
+partition is retired, so a QoS policy that admits offset 10 ahead of a
+still-queued offset 3 of the SAME partition (cross-tenant throttling or
+cross-lane priority inside one partition) leaves 10 uncommittable at
+drain — it re-serves after restart (at-least-once still holds; the
+duplicate is the cost). Keep tenants/lanes partition-aligned (keyed
+production — Kafka's own multi-tenant idiom, harness scenario 10 shows
+the shape) and drain replay-freedom holds alongside QoS.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Iterator
+
+import numpy as np
+
+from torchkafka_tpu.commit.ledger import merged_watermarks
+from torchkafka_tpu.fleet.metrics import FleetMetrics
+from torchkafka_tpu.fleet.qos import AdmissionQueue, QoSConfig, TenantBuckets
+from torchkafka_tpu.fleet.replica import DEAD, DONE, Replica
+from torchkafka_tpu.serve import StreamingGenerator
+from torchkafka_tpu.source.records import Record
+
+
+class ReplicaChaos:
+    """Seeded replica-death schedule for chaos runs.
+
+    Picks (victim, kill point) from ``seed`` once the fleet is known;
+    fires when the fleet has served ``>= kill point`` completions AND the
+    victim is mid-generation with at least one completion already emitted
+    — the conditions under which a death provably exercises redelivery
+    (something uncommitted exists) rather than dying idle. Deterministic:
+    the same seed against the same fleet kills the same replica at the
+    same completion count."""
+
+    def __init__(
+        self, seed: int = 0, *, min_completions: int = 1,
+        max_completions: int = 8, kills: int = 1,
+    ) -> None:
+        if min_completions < 1 or max_completions < min_completions:
+            raise ValueError(
+                "need 1 <= min_completions <= max_completions for a kill "
+                "point that can exercise redelivery"
+            )
+        self._rng = np.random.default_rng(seed)
+        self._lo, self._hi = min_completions, max_completions
+        self._kills_left = kills
+        self._victim: int | None = None
+        self._at: int | None = None
+        self.killed: list[int] = []
+
+    def maybe_kill(self, fleet: "ServingFleet", served: int) -> None:
+        if self._kills_left <= 0:
+            return
+        if self._victim is None:
+            self._victim = int(self._rng.integers(len(fleet.replicas)))
+            self._at = int(self._rng.integers(self._lo, self._hi + 1))
+        if served < (self._at or 0):
+            return
+
+        def eligible(r) -> bool:
+            return (
+                r.runnable
+                and r.gen.has_active()
+                and fleet.metrics.replica_completions(r.id).count >= 1
+            )
+
+        victim = fleet.replicas[self._victim]
+        if not eligible(victim):
+            # The drawn victim cannot exercise redelivery — it drained,
+            # died on its own, or simply owns no active work (a keyed
+            # topic can concentrate every partition's traffic on one
+            # replica). Re-draw among replicas that CAN die
+            # mid-generation; if none can right now, wait (still
+            # deterministic: re-draws consume the seeded stream only when
+            # an eligible replica exists).
+            live = [r.id for r in fleet.replicas if eligible(r)]
+            if not live:
+                return
+            self._victim = int(live[self._rng.integers(len(live))])
+            victim = fleet.replicas[self._victim]
+        fleet.kill_replica(victim.id)
+        self.killed.append(victim.id)
+        self._kills_left -= 1
+        self._victim = None
+        self._at = None
+
+
+class ServingFleet:
+    """N replicas, one consumer group, QoS admission in front.
+
+    ``consumer_factory(rid) -> Consumer`` must return a GROUP-MANAGED
+    consumer over the prompt topic (same group_id for every replica —
+    that sharing is the whole mechanism). ``generator_cls`` defaults to
+    ``StreamingGenerator``; pass ``SpecStreamingGenerator`` for a
+    speculative fleet. ``gen_kwargs`` forward to the generator
+    constructor (kv_dtype, ticks_per_sync, output_producer, ...).
+
+    ``commit_every`` is the per-replica commit cadence in COMPLETIONS,
+    owned by the fleet loop (the generators' internal cadence is
+    disabled) so commits happen only at points where the fleet has
+    already registered every completion they cover.
+    """
+
+    def __init__(
+        self,
+        consumer_factory: Callable[[int], object],
+        params,
+        cfg,
+        *,
+        replicas: int = 2,
+        prompt_len: int,
+        max_new: int,
+        slots: int = 4,
+        eos_id: int | None = None,
+        qos: QoSConfig | None = None,
+        commit_every: int = 8,
+        generator_cls: type = StreamingGenerator,
+        max_poll_records: int = 256,
+        clock: Callable[[], float] = time.monotonic,
+        gen_kwargs: dict | None = None,
+    ) -> None:
+        if replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        self._qos = qos or QoSConfig()
+        self._clock = clock
+        self.metrics = FleetMetrics()
+        self._buckets = TenantBuckets(self._qos, clock)
+        self.replicas: list[Replica] = []
+        for rid in range(replicas):
+            consumer = consumer_factory(rid)
+            gen = generator_cls(
+                consumer, params, cfg,
+                slots=slots, prompt_len=prompt_len, max_new=max_new,
+                eos_id=eos_id,
+                # The fleet loop owns the cadence (commit-follows-
+                # completion ordering); the generator must never
+                # self-commit mid-step.
+                commit_every=2**31 - 1,
+                **(gen_kwargs or {}),
+            )
+            queue = AdmissionQueue(
+                self._qos, self._buckets, self.metrics, clock
+            )
+            self.replicas.append(Replica(
+                rid, gen, consumer, queue, self._qos, self.metrics,
+                commit_every=commit_every,
+                max_poll_records=max_poll_records, clock=clock,
+            ))
+        self._draining = False
+        # Every (topic, partition, offset) a completion has been emitted
+        # for, fleet-wide — updated BEFORE any commit that could cover it
+        # (the pump/maybe_flush ordering), so an external observer can
+        # assert "committed ⊆ completed" at every commit point.
+        self.completed: set[tuple[str, int, int]] = set()
+
+    # ------------------------------------------------------------- control
+
+    def warmup(self) -> None:
+        """Compile every replica's admit/tick programs (shared jit cache:
+        replica 0 pays, the rest hit)."""
+        for rep in self.replicas:
+            rep.gen.warmup()
+
+    def drain(self) -> None:
+        """Fleet-wide graceful drain: stop admitting everywhere; serve()
+        finishes in-flight generations, commits, and leaves the group."""
+        self._draining = True
+        for rep in self.replicas:
+            rep.start_drain()
+
+    def kill_replica(self, rid: int) -> None:
+        """Simulate a replica crash (see Replica.kill)."""
+        self.replicas[rid].kill()
+        self.metrics.replica_deaths.add(1)
+
+    def close(self) -> None:
+        """Graceful stop outside serve(): commit completed work, leave."""
+        for rep in self.replicas:
+            rep.close()
+
+    def __enter__(self) -> "ServingFleet":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------------- observability
+
+    def watermarks(self) -> dict:
+        """Fleet-level committable view: per-replica ledger snapshots
+        merged fail-low (commit.ledger.merged_watermarks)."""
+        return merged_watermarks([
+            rep.gen.committable_offsets()
+            for rep in self.replicas if rep.state != DEAD
+        ])
+
+    def pending_by_replica(self) -> dict[int, int]:
+        """In-flight (fetched-but-unretired) records per replica."""
+        return {
+            rep.id: sum(rep.gen._ledger.pending_by_partition().values())
+            for rep in self.replicas
+        }
+
+    # --------------------------------------------------------------- serve
+
+    def serve(
+        self,
+        max_records: int | None = None,
+        idle_timeout_ms: int = 2000,
+        shutdown=None,
+        chaos: ReplicaChaos | None = None,
+    ) -> Iterator[tuple[int, Record, np.ndarray]]:
+        """Yield ``(replica_id, record, tokens)`` in fleet completion
+        order until ``max_records`` completions, an idle timeout, or a
+        completed drain.
+
+        ``shutdown``: a ``ShutdownSignal`` (or anything with a
+        ``requested`` bool) — when it fires, the fleet drains gracefully
+        and serve() returns after the last in-flight generation commits.
+        ``chaos``: a ``ReplicaChaos`` schedule, evaluated once per
+        scheduling round."""
+        served = 0
+        exhausted_at: float | None = None
+        while True:
+            if (
+                shutdown is not None
+                and getattr(shutdown, "requested", False)
+                and not self._draining
+            ):
+                self.drain()
+            progressed = False
+            for rep in self.replicas:
+                if not rep.runnable:
+                    continue
+                completions = rep.pump()
+                # Register BEFORE the flush below: every commit must only
+                # ever cover completions already in self.completed.
+                for rec, _toks in completions:
+                    key = (rec.topic, rec.partition, rec.offset)
+                    if key in self.completed:
+                        self.metrics.duplicates.add(1)
+                    self.completed.add(key)
+                self.metrics.completions.add(len(completions))
+                rep.maybe_flush()
+                if rep.drain_idle:
+                    rep.finish_drain()
+                    self.metrics.drains.add(1)
+                if completions:
+                    progressed = True
+                for rec, toks in completions:
+                    served += 1
+                    yield rep.id, rec, toks
+            if chaos is not None:
+                chaos.maybe_kill(self, served)
+            live = [r for r in self.replicas if r.runnable]
+            if not live:
+                break  # drained (or every replica died)
+            if max_records is not None and served >= max_records and not any(
+                r.gen.has_active() for r in live
+            ):
+                break
+            idle = not progressed and not any(
+                r.gen.has_active() for r in live
+            )
+            if idle:
+                if not any(r.queue.depth() for r in live):
+                    # Truly exhausted (no work anywhere): start the idle
+                    # clock. A non-empty queue with nothing admissible is
+                    # the THROTTLED case — wait for token refill without
+                    # burning a core, but never time out on it.
+                    if exhausted_at is None:
+                        exhausted_at = time.monotonic()
+                    elif (
+                        time.monotonic() - exhausted_at
+                    ) * 1e3 >= idle_timeout_ms:
+                        break
+                time.sleep(0.001)
+            else:
+                exhausted_at = None
+        for rep in self.replicas:
+            if rep.runnable:
+                rep.maybe_flush(force=True)
+
+    # Convenience for scripts/tests that just want everything served.
+    def serve_all(
+        self, max_records: int | None = None, idle_timeout_ms: int = 2000,
+        shutdown=None, chaos: ReplicaChaos | None = None,
+    ) -> list[tuple[int, Record, np.ndarray]]:
+        return list(self.serve(
+            max_records, idle_timeout_ms, shutdown=shutdown, chaos=chaos
+        ))

@@ -1,0 +1,147 @@
+"""Fleet observability: per-replica occupancy, per-tenant QoS counters,
+pooled commit-latency percentiles — one summary dict and one Prometheus
+exposition for the whole fleet, labeled per replica/tenant/lane, built on
+the same primitives (and the shared renderer) as StreamMetrics and
+ServeMetrics so dashboards treat all three uniformly."""
+
+from __future__ import annotations
+
+from torchkafka_tpu.utils.metrics import (
+    Gauge,
+    LatencyHistogram,
+    RateMeter,
+    merge_latency_summaries,
+    render_exposition,
+)
+
+
+class FleetMetrics:
+    """The metric set one ServingFleet maintains.
+
+    Per-tenant and per-lane series are created lazily through the
+    accessors (``tenant_admitted`` etc.) so the tenant population never
+    needs declaring up front — exactly like Prometheus label children.
+    """
+
+    def __init__(self) -> None:
+        self.completions = RateMeter()
+        self.duplicates = RateMeter()  # completions for an already-served
+        # (topic, partition, offset): the fleet-level redelivery observable
+        # — nonzero after a replica death, exactly zero in a clean run
+        self.backpressure_pauses = RateMeter()
+        self.backpressure_resumes = RateMeter()
+        self.replica_deaths = RateMeter()
+        self.drains = RateMeter()  # replicas that completed a graceful drain
+        self._tenant_admitted: dict[str, RateMeter] = {}
+        self._tenant_throttled: dict[str, RateMeter] = {}
+        self._tenant_queue_depth: dict[str, Gauge] = {}
+        self._lane_wait: dict[str, LatencyHistogram] = {}
+        self._replica_occupancy: dict[int, Gauge] = {}
+        self._replica_completions: dict[int, RateMeter] = {}
+
+    # ------------------------------------------------------ lazy accessors
+
+    def tenant_admitted(self, tenant: str) -> RateMeter:
+        return self._tenant_admitted.setdefault(tenant, RateMeter())
+
+    def tenant_throttled(self, tenant: str) -> RateMeter:
+        return self._tenant_throttled.setdefault(tenant, RateMeter())
+
+    def tenant_queue_depth(self, tenant: str) -> Gauge:
+        return self._tenant_queue_depth.setdefault(tenant, Gauge())
+
+    def lane_wait(self, lane: str) -> LatencyHistogram:
+        return self._lane_wait.setdefault(lane, LatencyHistogram())
+
+    def replica_occupancy(self, rid: int) -> Gauge:
+        return self._replica_occupancy.setdefault(rid, Gauge())
+
+    def replica_completions(self, rid: int) -> RateMeter:
+        return self._replica_completions.setdefault(rid, RateMeter())
+
+    # ----------------------------------------------------------- reporting
+
+    def summary(self, replicas=None) -> dict:
+        """``replicas``: the fleet's replica list, for the pooled
+        commit-latency percentiles (each replica's generator keeps its own
+        histogram; the fleet view pools the sample windows)."""
+        commit = merge_latency_summaries(
+            [r.gen.metrics.commit_latency for r in replicas]
+            if replicas else []
+        )
+        return {
+            "completions": self.completions.count,
+            "completions_per_s": round(self.completions.rate(), 1),
+            "duplicates": self.duplicates.count,
+            "backpressure_pauses": self.backpressure_pauses.count,
+            "backpressure_resumes": self.backpressure_resumes.count,
+            "replica_deaths": self.replica_deaths.count,
+            "drains": self.drains.count,
+            "tenants": {
+                t: {
+                    "admitted": self.tenant_admitted(t).count,
+                    "admitted_per_s": round(self.tenant_admitted(t).rate(), 2),
+                    "throttled": self.tenant_throttled(t).count,
+                    "queue_depth": int(self.tenant_queue_depth(t).value),
+                }
+                for t in sorted(
+                    set(self._tenant_admitted) | set(self._tenant_throttled)
+                )
+            },
+            "lanes": {
+                lane: h.summary() for lane, h in sorted(self._lane_wait.items())
+            },
+            "replicas": {
+                rid: {
+                    "slot_occupancy": round(
+                        self.replica_occupancy(rid).value, 3
+                    ),
+                    "completions": self.replica_completions(rid).count,
+                }
+                for rid in sorted(self._replica_occupancy)
+            },
+            "commit": commit,
+        }
+
+    def render_prometheus(
+        self, prefix: str = "torchkafka_fleet", replicas=None,
+    ) -> str:
+        s = self.summary(replicas)
+        return render_exposition(prefix, [
+            ("completions_total", "counter", s["completions"]),
+            ("duplicate_completions_total", "counter", s["duplicates"]),
+            ("backpressure_pauses_total", "counter", s["backpressure_pauses"]),
+            ("backpressure_resumes_total", "counter", s["backpressure_resumes"]),
+            ("replica_deaths_total", "counter", s["replica_deaths"]),
+            ("replica_drains_total", "counter", s["drains"]),
+            ("completions_per_second", "gauge", s["completions_per_s"]),
+            ("tenant_admitted_total", "counter", [
+                (f'tenant="{t}"', v["admitted"]) for t, v in s["tenants"].items()
+            ] or 0),
+            ("tenant_throttled_total", "counter", [
+                (f'tenant="{t}"', v["throttled"]) for t, v in s["tenants"].items()
+            ] or 0),
+            ("tenant_queue_depth", "gauge", [
+                (f'tenant="{t}"', v["queue_depth"])
+                for t, v in s["tenants"].items()
+            ] or 0),
+            ("lane_queue_wait_ms", "gauge", [
+                (f'lane="{lane}",percentile="p50"', v["p50_ms"])
+                for lane, v in s["lanes"].items()
+            ] + [
+                (f'lane="{lane}",percentile="p99"', v["p99_ms"])
+                for lane, v in s["lanes"].items()
+            ] or 0),
+            ("replica_slot_occupancy", "gauge", [
+                (f'replica="{rid}"', v["slot_occupancy"])
+                for rid, v in s["replicas"].items()
+            ] or 0),
+            ("replica_completions_total", "counter", [
+                (f'replica="{rid}"', v["completions"])
+                for rid, v in s["replicas"].items()
+            ] or 0),
+            ("commit_latency_ms", "gauge", [
+                ('percentile="p50"', s["commit"]["p50_ms"]),
+                ('percentile="p99"', s["commit"]["p99_ms"]),
+            ]),
+        ])

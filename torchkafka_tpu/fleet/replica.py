@@ -1,0 +1,210 @@
+"""One serving replica: a generator + a group-managed consumer + QoS queue.
+
+A replica is the fleet's unit of failure and of scale. It owns:
+
+- a **group-managed consumer** over the prompt topic — membership is what
+  spreads partitions across the fleet and what makes replica death
+  recoverable (leave → rebalance → the committed-offset resume point, the
+  exact machinery tests/test_pod.py proves for training ingest);
+- a **generator** (``StreamingGenerator`` or ``SpecStreamingGenerator``)
+  driven through the external-admission surface
+  (note_fetched/admit_records/step/flush_commits), never its internal
+  poll loop;
+- an **admission queue** (fleet/qos.py) between the two.
+
+``pump()`` is one cooperative scheduling quantum: sync assignment, poll,
+enqueue, backpressure, bucket-gated admit, one device tick block. It
+returns the completions the tick retired and NEVER commits — the fleet
+calls ``maybe_flush()`` after it has registered those completions, so the
+commit-follows-completion ordering is externally observable (and
+assertable) at every commit point.
+
+Lifecycle: ``serving`` → (``start_drain()``) → ``draining`` →
+(``finish_drain()``) → ``done``; or ``kill()`` → ``dead`` at any point —
+the crash simulation: leave the group WITHOUT committing, abandoning
+in-flight slots and queue, exactly what a SIGKILL'd process looks like to
+the broker once its session lapses.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable
+
+import numpy as np
+
+from torchkafka_tpu.errors import NotAssignedError
+from torchkafka_tpu.fleet.qos import AdmissionQueue, QoSConfig
+from torchkafka_tpu.source.records import Record
+
+_logger = logging.getLogger(__name__)
+
+SERVING = "serving"
+DRAINING = "draining"
+DONE = "done"
+DEAD = "dead"
+
+
+class Replica:
+    def __init__(
+        self,
+        rid: int,
+        generator,
+        consumer,
+        queue: AdmissionQueue,
+        qos: QoSConfig,
+        metrics,
+        *,
+        commit_every: int = 8,
+        max_poll_records: int = 256,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.id = rid
+        self.gen = generator
+        self.consumer = consumer
+        self.queue = queue
+        self._qos = qos
+        self._metrics = metrics
+        self._commit_every = commit_every
+        self._max_poll = max_poll_records
+        self._clock = clock
+        self.state = SERVING
+        self._since_commit = 0
+        self._assigned: frozenset = frozenset()
+
+    # ----------------------------------------------------------- lifecycle
+
+    @property
+    def runnable(self) -> bool:
+        return self.state in (SERVING, DRAINING)
+
+    def start_drain(self) -> None:
+        """Stop admitting; in-flight slots finish through further pumps.
+        Queued-but-unadmitted records are abandoned UNCOMMITTED — they
+        re-deliver to the next incarnation, the loss-free half of the
+        drain contract (the replay-free half is finish_drain's commit)."""
+        if self.state == SERVING:
+            self.state = DRAINING
+
+    @property
+    def drain_idle(self) -> bool:
+        """Draining and every in-flight generation has retired."""
+        return self.state == DRAINING and not self.gen.has_active()
+
+    def finish_drain(self) -> None:
+        """Commit everything completed, then leave the group. After this,
+        a restarted fleet resumes at the committed watermark with ZERO
+        replayed completions (drain acceptance contract)."""
+        self.gen.flush_commits()
+        self.consumer.close()
+        self.state = DONE
+
+    def kill(self) -> None:
+        """Crash simulation: leave the group with NOTHING committed beyond
+        the last cadence commit. In-flight generations and queued records
+        vanish; the rebalance hands the partitions to survivors, whose
+        polls resume from this replica's last committed offset — its
+        uncommitted prompts re-deliver (at-least-once, per prompt, across
+        replica failure)."""
+        self.state = DEAD
+        try:
+            # Consumer.close never commits (the reference's close
+            # contract) — it only triggers leave/rebalance.
+            self.consumer.close()
+        except Exception:  # noqa: BLE001 - a dying replica stays dead
+            _logger.exception("replica %d consumer close failed", self.id)
+
+    def close(self) -> None:
+        """Voluntary shutdown outside a drain: commit completed work and
+        leave (mirrors StreamingGenerator.close)."""
+        if self.state in (SERVING, DRAINING):
+            self.finish_drain()
+
+    # ---------------------------------------------------------------- pump
+
+    def pump(self) -> list[tuple[Record, np.ndarray]]:
+        """One scheduling quantum; returns completions (never commits)."""
+        if not self.runnable:
+            return []
+        self._sync_assignment()
+        if self.state == SERVING:
+            self._poll_into_queue()
+            self._backpressure()
+            free = self.gen.free_slots()
+            if free:
+                picks = self.queue.select(free)
+                if picks:
+                    self.gen.admit_records(picks)
+        completions = self.gen.step()
+        if completions:
+            self._since_commit += len(completions)
+            self._metrics.replica_completions(self.id).add(len(completions))
+        self._metrics.replica_occupancy(self.id).set(
+            1.0 - self.gen.free_slots() / max(1, self.gen.slots)
+        )
+        return completions
+
+    def maybe_flush(self, force: bool = False) -> None:
+        """Cadence commit — called by the fleet AFTER it registered the
+        completions the last pump returned, so every commit provably
+        follows the completions it covers."""
+        if force or self._since_commit >= self._commit_every:
+            if self._since_commit:
+                self.gen.flush_commits()
+                self._since_commit = 0
+
+    # ------------------------------------------------------------ internal
+
+    def _sync_assignment(self) -> None:
+        assigned = frozenset(self.consumer.assignment())
+        if assigned != self._assigned:
+            dropped = self.queue.prune(set(assigned))
+            if dropped:
+                _logger.info(
+                    "replica %d rebalance: pruned %d queued records for "
+                    "departed partitions", self.id, dropped,
+                )
+            self._assigned = assigned
+
+    def _poll_into_queue(self) -> None:
+        if self.queue.depth() >= self._qos.max_queue_depth:
+            return
+        records = self.consumer.poll(
+            max_records=min(
+                self._max_poll, self._qos.max_queue_depth - self.queue.depth()
+            ),
+            timeout_ms=0,
+        )
+        if records:
+            # Ledger BEFORE queue: a queued record must already be pending
+            # so no later completion can commit past it (see
+            # StreamingGenerator.note_fetched).
+            self.gen.note_fetched(records)
+            for r in records:
+                self.queue.push(r)
+
+    def _backpressure(self) -> None:
+        """Pause fetches when saturated (slots full + queue at high water),
+        resume at low water. Flags live transport-side (consumer.paused),
+        so a rebalance — which clears them — self-heals."""
+        try:
+            if (
+                self.gen.free_slots() == 0
+                and self.queue.depth() >= self._qos.max_queue_depth
+                and not self.consumer.has_paused()
+                and self._assigned
+            ):
+                self.consumer.pause(*self._assigned)
+                self._metrics.backpressure_pauses.add(1)
+            elif (
+                self.consumer.has_paused()
+                and self.queue.depth() <= self._qos.resume_queue_depth
+            ):
+                self.consumer.resume(*self.consumer.paused())
+                self._metrics.backpressure_resumes.add(1)
+        except NotAssignedError:
+            # Raced a rebalance between assignment() and pause(): the new
+            # assignment arrives at the next sync; pause flags were
+            # cleared transport-side either way.
+            pass

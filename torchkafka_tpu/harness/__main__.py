@@ -49,6 +49,16 @@ def main() -> None:
     ap.add_argument("--spec-draft-layers", type=int, default=None,
                     help="--spec: layers in the truncated self-draft "
                     "(default: half the target's)")
+    ap.add_argument("--temperature", type=float, default=0.0,
+                    help="scenario 7: sampled serving (0 = greedy)")
+    ap.add_argument("--top-k", type=int, default=None,
+                    help="scenario 7 with --temperature: per-step top-k "
+                    "filter (static-shape; models.generate.sample_logits)")
+    ap.add_argument("--top-p", type=float, default=None,
+                    help="scenario 7 with --temperature: nucleus mass in "
+                    "(0, 1] — minimal prefix reaching p stays sampleable")
+    ap.add_argument("--replicas", type=int, default=2,
+                    help="scenario 10 (serving fleet): replica count")
     args = ap.parse_args()
     if args.scenario:
         nums = [args.scenario]
@@ -64,6 +74,8 @@ def main() -> None:
             kv_kernel={"auto": "auto", "on": True, "off": False}[args.kv_kernel],
             spec=args.spec, spec_k=args.spec_k,
             spec_draft_layers=args.spec_draft_layers,
+            temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
+            replicas=args.replicas,
         )))
 
 

@@ -716,6 +716,8 @@ def scenario_7(
     kv_int8: bool = False, kv_kernel: bool | str = "auto",
     spec: bool = False, spec_k: int = 4,
     spec_draft_layers: int | None = None,
+    temperature: float = 0.0, top_k: int | None = None,
+    top_p: float | None = None,
 ) -> dict:
     """Continuous-batching serving (serve.StreamingGenerator): same prompt
     topic shape as scenario 5, but slots recycle as generations hit EOS —
@@ -807,6 +809,9 @@ def scenario_7(
             max_new=max_new, eos_id=eos_id, commit_every=slots,
             kv_dtype="int8" if kv_int8 else None,
             kv_kernel=kv_kernel,
+            # --temperature/--top-k/--top-p: the sampled serving path
+            # (models.generate.sample_logits — static-shape top-k/nucleus).
+            temperature=temperature, top_k=top_k, top_p=top_p,
             # Dispatch + sync latency dominate per-token syncing on tunneled
             # transports. With EOS off at scale, ONE dispatch per generation
             # is strictly better (max_new - 1: prefill emits token 0, so a
@@ -866,6 +871,10 @@ def scenario_7(
         "truncated_by_eos": truncated,
         "readmissions": server.metrics.readmissions.count,
         "eos_mode": "on" if eos_id is not None else "off(one-dispatch)",
+        **({"sampling": {
+            "temperature": temperature, "top_k": top_k, "top_p": top_p,
+        }} if (temperature != 0.0 or top_k is not None or top_p is not None)
+            else {}),
         "ticks_per_sync": ticks_per_sync,
         "kv_dtype": "int8" if kv_int8 else "compute",
         "kv_kernel": server._kv_kernel,
@@ -875,6 +884,102 @@ def scenario_7(
         "dropped": server.metrics.dropped.count,
         "commit": server.metrics.commit_latency.summary(),
         **roofline,
+    }
+
+
+def scenario_10(size: str = "tiny", replicas: int = 2) -> dict:
+    """Serving fleet (torchkafka_tpu/fleet): N replicas as one consumer
+    group over the prompt topic, QoS admission in front (two tenants —
+    one token-bucket rate-limited — and both priority lanes), finished by
+    a mid-run graceful drain plus a restarted fleet serving the remainder
+    with zero replayed completions. The tier-1 smoke for the fleet's
+    admission + drain paths: tiny model, seconds on CPU; the throughput
+    story lives in benchmarks/bench_fleet.py."""
+    import time as _time
+
+    import torchkafka_tpu as tk
+    from torchkafka_tpu.fleet import QoSConfig, ServingFleet
+
+    prompt_len, max_new = (16, 8) if size == "tiny" else (64, 32)
+    n = 24 if size == "tiny" else 128
+    parts = 4
+    cfg, params, label = _serving_model(size, None, prompt_len, max_new)
+    broker = tk.InMemoryBroker()
+    broker.create_topic("t10", partitions=parts)
+    rng = np.random.default_rng(0)
+    # KEYED production (no explicit partition): tenants land on disjoint
+    # partitions via the key hash, and the lane rides the tenant. That
+    # per-partition homogeneity is what keeps admission FIFO per partition
+    # — the invariant the replay-free drain depends on (QoS reordering
+    # WITHIN a partition trades drain replay-freedom for priority; see the
+    # fleet README section). crc32: 'throttled'→p3, 'open'→p0 of 4.
+    produced: list[tuple[int, int]] = []
+    for i in range(n):
+        key = b"throttled" if i % 3 == 0 else b"open"
+        rec = broker.produce(
+            "t10",
+            rng.integers(0, cfg.vocab_size, prompt_len,
+                         dtype=np.int32).tobytes(),
+            key=key,
+            headers=(
+                ("lane", b"batch" if key == b"throttled" else b"interactive"),
+            ),
+        )
+        produced.append((rec.partition, rec.offset))
+    qos = QoSConfig(
+        # Low enough that the throttled tenant provably queues behind its
+        # bucket during the run, high enough that the smoke stays fast.
+        tenant_rates={"throttled": 4.0}, burst=1.0,
+        max_queue_depth=64, resume_queue_depth=16,
+    )
+
+    def build(group_stage_kw):
+        return ServingFleet(
+            lambda rid: tk.MemoryConsumer(broker, "t10", group_id="s10"),
+            params, cfg, replicas=replicas, prompt_len=prompt_len,
+            max_new=max_new, slots=4, qos=qos, **group_stage_kw,
+        )
+
+    fleet = build({"commit_every": 4})
+    fleet.warmup()
+    t0 = _time.perf_counter()
+    run1: list = []
+    for item in fleet.serve(idle_timeout_ms=2000):
+        run1.append(item)
+        if len(run1) == n // 2:
+            fleet.drain()  # graceful: finish in-flight, commit, leave
+    drained_states = [rep.state for rep in fleet.replicas]
+    fleet2 = build({"commit_every": 4})
+    run2 = fleet2.serve_all(idle_timeout_ms=2000)
+    fleet2.close()
+    elapsed = _time.perf_counter() - t0
+    keys1 = {(r.partition, r.offset) for _rid, r, _t in run1}
+    keys2 = {(r.partition, r.offset) for _rid, r, _t in run2}
+    s = fleet.metrics.summary(fleet.replicas)
+    done = len(run1) + len(run2)
+    gens = [rep.gen for rep in fleet.replicas + fleet2.replicas]
+    return {
+        "scenario": "10:serving-fleet",
+        "model_scale": label,
+        "replicas": replicas,
+        "records": done,
+        "elapsed_s": round(elapsed, 3),
+        "records_per_s": round(done / elapsed, 1) if elapsed else None,
+        "drained_states": drained_states,
+        "drains": s["drains"],
+        "coverage_complete": keys1 | keys2 == set(produced),
+        "zero_replayed_after_drain": not (keys1 & keys2),
+        "tenants": s["tenants"],
+        "lanes": {
+            lane: {"p50_ms": round(v["p50_ms"], 3), "count": v["count"]}
+            for lane, v in s["lanes"].items()
+        },
+        "backpressure_pauses": s["backpressure_pauses"],
+        "commit": s["commit"],
+        "commit_failures": sum(
+            g.metrics.commit_failures.count for g in gens
+        ),
+        "dropped": sum(g.metrics.dropped.count for g in gens),
     }
 
 
@@ -1242,6 +1347,7 @@ SCENARIOS = {
     7: scenario_7,
     8: scenario_8,
     9: scenario_9,
+    10: scenario_10,
 }
 
 
@@ -1251,6 +1357,8 @@ def run_scenario(
     kv_int8: bool = False, kv_kernel: bool | str = "auto",
     spec: bool = False, spec_k: int = 4,
     spec_draft_layers: int | None = None,
+    temperature: float = 0.0, top_k: int | None = None,
+    top_p: float | None = None, replicas: int = 2,
 ) -> dict:
     if size not in _SIZES:
         raise ValueError(f"size must be one of {_SIZES}")
@@ -1267,7 +1375,21 @@ def run_scenario(
             "--spec serves the compute-dtype pool (token-exactness is the "
             "contract); drop --kv-int8"
         )
+    sampling = temperature != 0.0 or top_k is not None or top_p is not None
+    if sampling and num != 7:
+        raise ValueError(
+            "--temperature/--top-k/--top-p apply to scenario 7 (the "
+            "sampled serving path)"
+        )
+    if spec and sampling:
+        raise ValueError(
+            "--spec is greedy-only (the accept rule is the target's "
+            "argmax); drop the sampling flags"
+        )
+    sample_kw = dict(temperature=temperature, top_k=top_k, top_p=top_p)
     spec_kw = dict(spec=spec, spec_k=spec_k, spec_draft_layers=spec_draft_layers)
+    if num == 10:
+        return SCENARIOS[10](size, replicas=replicas)
     if model_scale is not None:
         if num not in (5, 7):
             raise ValueError("model_scale applies to scenarios 5 and 7 only")
@@ -1275,11 +1397,13 @@ def run_scenario(
             return SCENARIOS[7](
                 size, model_scale=model_scale, serve_eos=serve_eos,
                 quantized=quantized, kv_int8=kv_int8, kv_kernel=kv_kernel,
-                **spec_kw,
+                **spec_kw, **sample_kw,
             )
         return SCENARIOS[5](size, model_scale=model_scale, quantized=quantized)
     if kv_int8:
-        return SCENARIOS[7](size, kv_int8=True, kv_kernel=kv_kernel)
+        return SCENARIOS[7](size, kv_int8=True, kv_kernel=kv_kernel, **sample_kw)
     if spec:
         return SCENARIOS[7](size, **spec_kw)
+    if sampling:
+        return SCENARIOS[7](size, **sample_kw)
     return SCENARIOS[num](size)

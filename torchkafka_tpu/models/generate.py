@@ -51,6 +51,72 @@ class KVCache(NamedTuple):
     v: jax.Array  # [L, B, max_len, K, Dh]
 
 
+# --------------------------------------------------------------- sampling
+
+
+def filter_logits(
+    logits: jax.Array,
+    *,
+    temperature: float = 1.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
+) -> jax.Array:
+    """Temperature → top-k → top-p filtering over [..., V] logits, the
+    standard composition order; masked-out entries go to -inf so a
+    categorical draw never selects them. STATIC shapes throughout — top-k
+    is a ``lax.top_k`` threshold compare, top-p a full sort + exclusive
+    cumulative-probability mask — so the serving tick stays one compiled
+    program for any (k, p). Ties at either threshold are kept (>= the
+    boundary value), the rule the NumPy reference in tests/test_sampling.py
+    mirrors bit-for-bit at f32."""
+    logits = logits.astype(jnp.float32) / jnp.float32(temperature)
+    neg = jnp.float32(-jnp.inf)
+    if top_k is not None and 0 < top_k < logits.shape[-1]:
+        kth = lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, neg, logits)
+    if top_p is not None and top_p < 1.0:
+        srt = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(srt, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # Keep a token while the cumulative probability BEFORE it is still
+        # < p: the minimal prefix whose mass reaches p, never empty.
+        keep = (cum - probs) < jnp.float32(top_p)
+        n_keep = jnp.sum(keep.astype(jnp.int32), axis=-1, keepdims=True)
+        kth = jnp.take_along_axis(srt, n_keep - 1, axis=-1)
+        logits = jnp.where(logits < kth, neg, logits)
+    return logits
+
+
+def sample_logits(
+    logits: jax.Array,
+    key: jax.Array,
+    *,
+    temperature: float = 0.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
+) -> jax.Array:
+    """[..., V] logits → [...] int32 token ids. ``temperature == 0`` is
+    greedy argmax (top_k/top_p ignored — the filter cannot change the
+    argmax); otherwise a categorical draw over ``filter_logits``. One
+    sampling definition serves the lockstep ``generate`` and the
+    continuous-batching server, so their sampled paths cannot drift."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    filtered = filter_logits(
+        logits, temperature=temperature, top_k=top_k, top_p=top_p
+    )
+    return jax.random.categorical(key, filtered, axis=-1).astype(jnp.int32)
+
+
+def check_sampling_params(top_k: int | None, top_p: float | None) -> None:
+    """Shared eager validation: a bad knob should fail at construction, not
+    as an XLA shape error three dispatches later."""
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+
+
 # ------------------------------------------------------------ mesh-sharded
 # Model-sharded decode (BASELINE config 5 names an 8-chip v5e slice): the
 # same tp/fsdp layouts training uses (param_specs) carry into inference,
@@ -340,15 +406,22 @@ def generate(
     max_new: int,
     *,
     temperature: float = 0.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
     rng: jax.Array | None = None,
     mesh: Mesh | None = None,
 ):
     """prompt: [B, S] int32 → generated [B, max_new] int32 (greedy when
     temperature == 0). Jit-friendly: static prompt length and max_new.
 
+    ``top_k``/``top_p``: nucleus/top-k filtering applied per step when
+    sampling (``sample_logits``) — static-shape, same definition the
+    serving path uses, differential-tested against a NumPy reference.
+
     ``mesh``: model-sharded decode — params must be committed to
     ``serving_shardings`` layouts (kv heads shard over tp, batch over
     data); token-exact vs the mesh-less path (differential-tested)."""
+    check_sampling_params(top_k, top_p)
     batch, seq = prompt.shape
     if mesh is not None:
         check_serving_mesh(cfg, mesh, batch=batch)
@@ -361,9 +434,9 @@ def generate(
         rng = jax.random.key(0)
 
     def pick(logits, key):
-        if temperature == 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(key, logits / temperature, axis=-1).astype(jnp.int32)
+        return sample_logits(
+            logits, key, temperature=temperature, top_k=top_k, top_p=top_p
+        )
 
     first = pick(logits, rng)
 

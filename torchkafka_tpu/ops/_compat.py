@@ -13,6 +13,20 @@ from __future__ import annotations
 
 import jax
 
+# jax 0.4.x defaults jax_threefry_partitionable=False, under which a
+# jit-compiled jax.random draw with a sharding constraint produces
+# DIFFERENT values depending on the layout (the tp-sharded DLRM tables
+# initialize to different numbers than the replicated ones — the
+# test_recsys tp-gather "mismatch" was never the gather). jax >= 0.5
+# defaults the flag True, where random bits are sharding-invariant by
+# construction. Align the 0.4.x line with the current default so the same
+# (key, shape) gives the same values on every mesh on both jax lines.
+if getattr(jax, "shard_map", None) is None:  # the 0.4.x probe used below
+    try:
+        jax.config.update("jax_threefry_partitionable", True)
+    except Exception:  # noqa: BLE001 - flag absent: nothing to align
+        pass
+
 
 def _shard_map_via_experimental(
     f, *, mesh, in_specs, out_specs, axis_names, check_vma=False,

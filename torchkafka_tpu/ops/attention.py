@@ -175,7 +175,12 @@ def _ring_flash_run(q, k, v, axis_name, axis_size, causal, block):
     from torchkafka_tpu.ops.flash import _default_interpret, _flash_fwd_bhsd, _to_bhsd
 
     b, sl, h, d = q.shape
-    my = lax.axis_index(axis_name)
+    # Non-causal steps ignore the shard offsets entirely (no position mask,
+    # no block-skip predicate), so the axis_index that feeds them would be a
+    # dead PartitionId op — which jax 0.4.x's SPMD partitioner rejects once
+    # DCE strands it outside the manual region. Skip it: offsets are only
+    # meaningful under the causal mask.
+    my = lax.axis_index(axis_name) if causal else 0
     interpret = _default_interpret()
     qb, kb, vb = _to_bhsd(q), _to_bhsd(k), _to_bhsd(v)
 
@@ -230,7 +235,9 @@ def _ring_flash_bwd(axis_name, axis_size, causal, block, res, g):
 
     q, k, v, o, lse = res
     b, sl, h, d = q.shape
-    my = lax.axis_index(axis_name)
+    # Same dead-PartitionId guard as _ring_flash_run: the dq/dkv kernels
+    # read the offsets only under the causal mask.
+    my = lax.axis_index(axis_name) if causal else 0
     interpret = _default_interpret()
     qb, kb, vb, gb = _to_bhsd(q), _to_bhsd(k), _to_bhsd(v), _to_bhsd(g)
 

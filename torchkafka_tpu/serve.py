@@ -41,10 +41,12 @@ from torchkafka_tpu.models.generate import (
     _attend_cached,
     _attn_tail,
     _project_qkv,
+    check_sampling_params,
     check_serving_mesh,
     kv_scale_sharding,
     kv_sharding,
     prefill,
+    sample_logits,
     serving_shardings,
     slot_sharding,
 )
@@ -295,6 +297,8 @@ class StreamingGenerator:
         max_poll_records: int = 512,
         ticks_per_sync: int = 4,
         temperature: float = 0.0,
+        top_k: int | None = None,
+        top_p: float | None = None,
         rng: jax.Array | None = None,
         output_producer=None,
         output_topic: str | None = None,
@@ -312,6 +316,11 @@ class StreamingGenerator:
         ``temperature``: 0 = greedy (matches ``generate``'s default);
         > 0 samples categorically per slot from logits/temperature, keyed
         by ``rng`` (per-tick fold-in, deterministic for a fixed key).
+        ``top_k``/``top_p`` restrict the sampled support (top-k threshold
+        then nucleus mass, ``models.generate.sample_logits`` — the SAME
+        definition the lockstep path uses, static-shape so the tick stays
+        one compiled program; ignored at temperature 0, where the filter
+        cannot change the argmax).
 
         ``output_producer``/``output_topic``: publish each completion to a
         topic (key = the prompt record's key; ``encode_output(record,
@@ -393,6 +402,9 @@ class StreamingGenerator:
         self._max_poll = max_poll_records
         self._ticks_per_sync = ticks_per_sync
         self._temperature = float(temperature)
+        check_sampling_params(top_k, top_p)
+        self._top_k = top_k
+        self._top_p = top_p
         self._rng = jax.random.key(0) if rng is None else rng
         if (output_producer is None) != (output_topic is None):
             raise ValueError(
@@ -424,6 +436,14 @@ class StreamingGenerator:
         self._ledger = OffsetLedger()
         self._max_len = prompt_len + max_new
         self.metrics = ServeMetrics()
+        # Slot bookkeeping lives on the instance (not run() locals) so an
+        # EXTERNAL admission loop — the serving fleet's QoS scheduler —
+        # can drive the server through note_fetched/admit_records/step
+        # without the internal poll loop; run() is built on the same
+        # surface.
+        self._slot_rec: list[Record | None] = [None] * slots
+        self._active = np.zeros((slots,), bool)
+        self._uncommitted = 0
         self._build()
 
     def _build(self) -> None:
@@ -510,11 +530,11 @@ class StreamingGenerator:
                 lax.with_sharding_constraint(gen, slot_sharding(mesh, 2)),
             )
 
+        top_k, top_p = self._top_k, self._top_p
+
         def pick(logits, key):
-            if temp == 0.0:
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            return jax.random.categorical(key, logits / temp, axis=-1).astype(
-                jnp.int32
+            return sample_logits(
+                logits, key, temperature=temp, top_k=top_k, top_p=top_p
             )
 
         def admit(params, caches, last_tok, pos, gen, prompts, admit_mask, key):
@@ -882,23 +902,210 @@ class StreamingGenerator:
         self._caches, self._last_tok, self._pos, self._gen = out[:4]
         jax.device_get(out[4])
 
+    # ------------------------------------------- external admission surface
+    #
+    # run() is a thin loop over four primitives, each usable on its own by
+    # an EXTERNAL scheduler (the serving fleet's QoS admission layer,
+    # torchkafka_tpu/fleet/): the caller polls its own consumer, decides
+    # which records deserve a slot, and drives the device loop tick by
+    # tick. The commit/ledger discipline is identical on both paths — the
+    # primitives are the same code run() executes.
+
+    @property
+    def slots(self) -> int:
+        """Size of the decode slot pool."""
+        return self._slots
+
+    def free_slots(self) -> int:
+        """Slots currently available for admission."""
+        return int((~self._active).sum())
+
+    def has_active(self) -> bool:
+        """True while any generation is in flight."""
+        return bool(self._active.any())
+
+    def note_fetched(self, records: list[Record]) -> None:
+        """Register polled records with the ledger BEFORE queueing them.
+
+        External admission must call this at poll time, not admit time: a
+        record sitting in an admission queue while a LATER record of the
+        same partition completes would otherwise be invisible to the
+        ledger, and the commit watermark could advance past it — losing it
+        on crash. (run() calls this on its own polls.)"""
+        self._ledger.fetched_many(records)
+
+    def admit_records(self, records: list[Record]) -> int:
+        """Prefill-admit ``records`` into free slots; returns the number
+        admitted. Undecodable records are retired as dropped (the
+        reference's None-filter analog) and do not consume a slot. Records
+        must already be ``note_fetched``; the caller must not offer more
+        records than ``free_slots()``."""
+        free = [i for i in range(self._slots) if not self._active[i]]
+        if len(records) > len(free):
+            raise ValueError(
+                f"offered {len(records)} records with {len(free)} free slots"
+            )
+        in_flight = self._slots - len(free)
+        prompts = np.zeros((self._slots, self._prompt_len), np.int32)
+        admit_mask = np.zeros((self._slots,), bool)
+        queue = list(records)
+        for i in free:
+            if not queue:
+                break
+            rec = queue.pop(0)
+            while True:
+                try:
+                    prompts[i] = self._decode_prompt(rec)
+                except Exception:
+                    # Poison record: retire it (dropped) or it would
+                    # re-deliver and crash the server forever on restart.
+                    _logger.exception(
+                        "dropping undecodable prompt %s@%s:%s",
+                        rec.topic, rec.partition, rec.offset,
+                    )
+                    self._ledger.dropped(rec)
+                    self.metrics.dropped.add(1)
+                    if not queue:
+                        rec = None
+                        break
+                    rec = queue.pop(0)
+                    continue
+                break
+            if rec is None:
+                break
+            self._slot_rec[i] = rec
+            admit_mask[i] = True
+            self._active[i] = True
+        admitted = int(admit_mask.sum())
+        if admitted:
+            if in_flight > 0:
+                # Slots refilled while other generations were mid-flight:
+                # the observable that distinguishes continuous batching
+                # from lockstep waves.
+                self.metrics.readmissions.add(admitted)
+            self._rng, sub = jax.random.split(self._rng)
+            out = self._admit_fn(
+                self._caches, self._last_tok, self._pos, self._gen,
+                jnp.asarray(prompts), jnp.asarray(admit_mask), sub,
+            )
+            # Rebind self state after every dispatch: admit/tick DONATE
+            # the pool, so the old self._caches handles are dead buffers —
+            # without this, anything reading server state afterwards (a
+            # second run, decode_roofline, spec_stats) holds deleted
+            # arrays.
+            self._caches, self._last_tok, self._pos, self._gen = out
+        return admitted
+
+    def step(self) -> list[tuple[Record, np.ndarray]]:
+        """One decode tick block over the active slots; returns the
+        completions it retired (ledger-emitted, output-published, commit
+        cadence applied) in completion order. No-op on an idle pool."""
+        if not self._active.any():
+            return []
+        self._rng, sub = jax.random.split(self._rng)
+        caches, last_tok, pos, gen, done, n_out = self._tick_fn(
+            self._caches, self._last_tok, self._pos, self._gen,
+            jnp.asarray(self._active), sub,
+        )
+        self._caches, self._last_tok, self._pos, self._gen = (
+            caches, last_tok, pos, gen
+        )
+        # ONE host sync per tick block: done/n_out/gen fetched together
+        # (separate np.asarray calls are separate round trips on
+        # high-latency transports).
+        done_h, n_out_h, gen_h = jax.device_get((done, n_out, gen))
+        self.metrics.slot_occupancy.set(float(self._active.mean()))
+        completions: list[tuple[Record, np.ndarray]] = []
+        if done_h.any():
+            for i in np.nonzero(done_h)[0]:
+                rec = self._slot_rec[i]
+                assert rec is not None
+                self._active[i] = False
+                self._slot_rec[i] = None
+                out = gen_h[i, : n_out_h[i]].copy()
+                self.metrics.completions.add(1)
+                self.metrics.tokens.add(len(out))
+                if len(out) < self._max_new:
+                    self.metrics.truncated.add(1)
+                sent_ok = True
+                if self._output_producer is not None:
+                    # Async send; durability is settled in _commit (flush
+                    # + per-handle get) BEFORE offsets commit. A
+                    # SYNCHRONOUS send failure (buffer full with the
+                    # output broker down, closed producer, missing topic)
+                    # must not kill serving OR let the record commit: skip
+                    # emitted() so the ledger watermark stalls at exactly
+                    # this record — it re-delivers and regenerates on
+                    # restart.
+                    try:
+                        self._pending_outputs.append(
+                            self._output_producer.send(
+                                self._output_topic,
+                                self._encode_output(rec, out),
+                                key=rec.key,
+                            )
+                        )
+                        self._send_failure_streak = 0
+                    except Exception:  # noqa: BLE001 - fail closed per record
+                        sent_ok = False
+                        self.metrics.output_send_failures.add(1)
+                        self._send_failure_streak += 1
+                        _logger.exception(
+                            "output send failed for %s@%d:%d; leaving "
+                            "it uncommitted to re-deliver",
+                            rec.topic, rec.partition, rec.offset,
+                        )
+                        if (
+                            self._send_failure_streak
+                            >= self._max_send_failure_streak
+                        ):
+                            # The output path is down, not blinking: every
+                            # further completion would be un-committable
+                            # replay work behind a permanently stalled
+                            # watermark. Fail-stop like the flush/get path
+                            # so the operator gets one signal for "output
+                            # lost".
+                            raise OutputDeliveryError(
+                                f"{self._send_failure_streak} "
+                                "consecutive output send failures; "
+                                "failing stop so uncommitted prompts "
+                                "re-deliver instead of serving into a "
+                                "stalled commit watermark"
+                            )
+                if sent_ok:
+                    self._ledger.emitted(rec)
+                    self._uncommitted += 1
+                completions.append((rec, out))
+            if self._uncommitted >= self._commit_every:
+                self._commit()
+                self._uncommitted = 0
+        return completions
+
+    def flush_commits(self) -> None:
+        """Commit anything emitted since the last commit (cadence-pending
+        completions). The external-admission caller's end-of-window flush;
+        run() calls it on exit."""
+        if self._uncommitted:
+            self._commit()
+            self._uncommitted = 0
+
+    def committable_offsets(self) -> dict:
+        """The ledger's committable next-read offsets right now — what the
+        next commit would durably record. Fleet observability merges these
+        per-replica views (commit.ledger.merged_watermarks)."""
+        return self._ledger.snapshot()
+
     def run(
         self, max_records: int | None = None, idle_timeout_ms: int = 2000
     ) -> Iterator[tuple[Record, np.ndarray]]:
         B = self._slots
-        slot_rec: list[Record | None] = [None] * B
         pending: list[Record] = []
-        active = np.zeros((B,), bool)
-        caches, last_tok, pos, gen = (
-            self._caches, self._last_tok, self._pos, self._gen
-        )
         served = 0
-        uncommitted = 0
         exhausted_at: float | None = None
         self.metrics.reset()
         while True:
-            free = [i for i in range(B) if not active[i]]
-            in_flight = B - len(free)
+            free = self.free_slots()
+            in_flight = B - free
             # Admission budget: never take more work than max_records allows
             # (completions already served + generations in flight).
             budget = (
@@ -906,62 +1113,22 @@ class StreamingGenerator:
                 if max_records is not None
                 else B
             )
-            if free and budget and len(pending) < min(len(free), budget):
+            if free and budget and len(pending) < min(free, budget):
                 # Never let an empty topic stall in-flight decode ticks:
                 # poll without blocking while anything is generating.
                 records = self._consumer.poll(
                     max_records=self._max_poll,
-                    timeout_ms=0 if active.any() else 50,
+                    timeout_ms=0 if in_flight else 50,
                 )
                 if records:
-                    self._ledger.fetched_many(records)
+                    self.note_fetched(records)
                     pending.extend(records)
                     exhausted_at = None
             if free and pending and budget:
-                prompts = np.zeros((B, self._prompt_len), np.int32)
-                admit_mask = np.zeros((B,), bool)
-                for i in free:
-                    if not pending or budget == 0:
-                        break
-                    rec = pending.pop(0)
-                    try:
-                        prompts[i] = self._decode_prompt(rec)
-                    except Exception:
-                        # Poison record: retire it (dropped, like the
-                        # reference's None-filter) or it would re-deliver
-                        # and crash the server forever on restart.
-                        _logger.exception(
-                            "dropping undecodable prompt %s@%s:%s",
-                            rec.topic, rec.partition, rec.offset,
-                        )
-                        self._ledger.dropped(rec)
-                        self.metrics.dropped.add(1)
-                        continue
-                    slot_rec[i] = rec
-                    admit_mask[i] = True
-                    active[i] = True
-                    budget -= 1
-                if admit_mask.any():
-                    if in_flight > 0:
-                        # Slots refilled while other generations were mid-
-                        # flight: the observable that distinguishes
-                        # continuous batching from lockstep waves.
-                        self.metrics.readmissions.add(int(admit_mask.sum()))
-                    self._rng, sub = jax.random.split(self._rng)
-                    caches, last_tok, pos, gen = self._admit_fn(
-                        caches, last_tok, pos, gen,
-                        jnp.asarray(prompts), jnp.asarray(admit_mask), sub,
-                    )
-                    # Rebind self state after every dispatch: admit/tick
-                    # DONATE the pool, so the old self._caches handles are
-                    # dead buffers — without this, anything reading server
-                    # state after run() (a second run, decode_roofline,
-                    # SpecStreamingGenerator.spec_stats) holds deleted
-                    # arrays.
-                    self._caches, self._last_tok, self._pos, self._gen = (
-                        caches, last_tok, pos, gen
-                    )
-            if not active.any():
+                take = pending[: min(free, budget)]
+                del pending[: len(take)]
+                self.admit_records(take)
+            if not self.has_active():
                 if max_records is not None and served >= max_records:
                     break
                 if not pending:
@@ -970,86 +1137,12 @@ class StreamingGenerator:
                     elif (time.monotonic() - exhausted_at) * 1000 >= idle_timeout_ms:
                         break
                 continue
-            self._rng, sub = jax.random.split(self._rng)
-            caches, last_tok, pos, gen, done, n_out = self._tick_fn(
-                caches, last_tok, pos, gen, jnp.asarray(active), sub
-            )
-            self._caches, self._last_tok, self._pos, self._gen = (
-                caches, last_tok, pos, gen
-            )
-            # ONE host sync per tick block: done/n_out/gen fetched together
-            # (separate np.asarray calls are separate round trips on
-            # high-latency transports).
-            done_h, n_out_h, gen_h = jax.device_get((done, n_out, gen))
-            self.metrics.slot_occupancy.set(float(active.mean()))
-            if done_h.any():
-                for i in np.nonzero(done_h)[0]:
-                    rec = slot_rec[i]
-                    assert rec is not None
-                    active[i] = False
-                    slot_rec[i] = None
-                    served += 1
-                    out = gen_h[i, : n_out_h[i]].copy()
-                    self.metrics.completions.add(1)
-                    self.metrics.tokens.add(len(out))
-                    if len(out) < self._max_new:
-                        self.metrics.truncated.add(1)
-                    sent_ok = True
-                    if self._output_producer is not None:
-                        # Async send; durability is settled in _commit
-                        # (flush + per-handle get) BEFORE offsets commit. A
-                        # SYNCHRONOUS send failure (buffer full with the
-                        # output broker down, closed producer, missing
-                        # topic) must not kill serving OR let the record
-                        # commit: skip emitted() so the ledger watermark
-                        # stalls at exactly this record — it re-delivers
-                        # and regenerates on restart.
-                        try:
-                            self._pending_outputs.append(
-                                self._output_producer.send(
-                                    self._output_topic,
-                                    self._encode_output(rec, out),
-                                    key=rec.key,
-                                )
-                            )
-                            self._send_failure_streak = 0
-                        except Exception:  # noqa: BLE001 - fail closed per record
-                            sent_ok = False
-                            self.metrics.output_send_failures.add(1)
-                            self._send_failure_streak += 1
-                            _logger.exception(
-                                "output send failed for %s@%d:%d; leaving "
-                                "it uncommitted to re-deliver",
-                                rec.topic, rec.partition, rec.offset,
-                            )
-                            if (
-                                self._send_failure_streak
-                                >= self._max_send_failure_streak
-                            ):
-                                # The output path is down, not blinking:
-                                # every further completion would be
-                                # un-committable replay work behind a
-                                # permanently stalled watermark. Fail-stop
-                                # like the flush/get path so the operator
-                                # gets one signal for "output lost".
-                                raise OutputDeliveryError(
-                                    f"{self._send_failure_streak} "
-                                    "consecutive output send failures; "
-                                    "failing stop so uncommitted prompts "
-                                    "re-deliver instead of serving into a "
-                                    "stalled commit watermark"
-                                )
-                    if sent_ok:
-                        self._ledger.emitted(rec)
-                        uncommitted += 1
-                    yield rec, out
-                if uncommitted >= self._commit_every:
-                    self._commit()
-                    uncommitted = 0
-                if max_records is not None and served >= max_records and not active.any():
-                    break
-        if uncommitted:
-            self._commit()
+            for rec, out in self.step():
+                served += 1
+                yield rec, out
+            if max_records is not None and served >= max_records and not self.has_active():
+                break
+        self.flush_commits()
 
     def _commit(self) -> None:
         """Commit the ledger watermark; commit failure is survivable (the

@@ -153,7 +153,16 @@ class KafkaConsumer(ConsumerIterMixin):
             headers=tuple(r.headers or ()),
         )
 
+    def _check_open(self) -> None:
+        """Same closed-consumer contract as the memory double (and the
+        transport-conformance suite): a closed consumer refuses the whole
+        surface with ConsumerClosedError instead of leaking kafka-python's
+        post-close behavior."""
+        if self._closed:
+            raise errors.ConsumerClosedError("consumer is closed")
+
     def poll(self, max_records: int = 500, timeout_ms: int = 0) -> list[Record]:
+        self._check_open()
         batches = self._consumer.poll(timeout_ms=timeout_ms, max_records=max_records)
         out: list[Record] = []
         for recs in batches.values():
@@ -161,6 +170,7 @@ class KafkaConsumer(ConsumerIterMixin):
         return out
 
     def commit(self, offsets: Mapping[TopicPartition, int] | None = None) -> None:
+        self._check_open()
         if offsets is None and self._last_yielded:
             # Iterator mode: commit the records handed to the user, NOT the
             # whole fetched buffer (poll() advanced kafka-python's position
@@ -184,15 +194,19 @@ class KafkaConsumer(ConsumerIterMixin):
             raise errors.CommitFailedError(str(e)) from e
 
     def committed(self, tp: TopicPartition) -> int | None:
+        self._check_open()
         return self._consumer.committed(_ktp(tp))
 
     def position(self, tp: TopicPartition) -> int:
+        self._check_open()
         return self._consumer.position(_ktp(tp))
 
     def seek(self, tp: TopicPartition, offset: int) -> None:
+        self._check_open()
         self._consumer.seek(_ktp(tp), offset)
 
     def assignment(self) -> list[TopicPartition]:
+        self._check_open()
         return [TopicPartition(tp.topic, tp.partition) for tp in self._consumer.assignment()]
 
     def offsets_for_times(
@@ -234,11 +248,13 @@ class KafkaConsumer(ConsumerIterMixin):
             raise errors.NotAssignedError(f"not assigned: {sorted(stray)}")
 
     def pause(self, *tps: TopicPartition) -> None:
+        self._check_open()
         self._check_assigned(tps)
         self._consumer.pause(*(_ktp(tp) for tp in tps))
         self._any_paused = True
 
     def resume(self, *tps: TopicPartition) -> None:
+        self._check_open()
         self._check_assigned(tps)
         self._consumer.resume(*(_ktp(tp) for tp in tps))
         # Recompute rather than clear: a partial resume may leave others

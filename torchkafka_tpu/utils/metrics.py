@@ -71,12 +71,39 @@ class LatencyHistogram:
         with self._lock:
             return self._total
 
+    def snapshot(self) -> list[float]:
+        """Copy of the retained sample window (seconds) — what fleet-level
+        aggregation pools across replicas before taking percentiles."""
+        with self._lock:
+            return list(self._samples)
+
     def summary(self) -> dict[str, float]:
         return {
             "count": self.count,
             "p50_ms": self.percentile(50) * 1e3,
             "p99_ms": self.percentile(99) * 1e3,
         }
+
+
+def merge_latency_summaries(histograms: "list[LatencyHistogram]") -> dict:
+    """Pool several histograms' retained samples into one percentile
+    summary (same shape as ``LatencyHistogram.summary``). Percentiles of
+    the pooled window, not averages of per-histogram percentiles — a
+    replica with 10× the commits weighs 10× the samples."""
+    samples: list[float] = []
+    total = 0
+    for h in histograms:
+        samples.extend(h.snapshot())
+        total += h.count
+    if not samples:
+        return {"count": total, "p50_ms": 0.0, "p99_ms": 0.0}
+    s = sorted(samples)
+
+    def pct(q: float) -> float:
+        idx = min(len(s) - 1, int(round(q / 100.0 * (len(s) - 1))))
+        return s[idx] * 1e3
+
+    return {"count": total, "p50_ms": pct(50), "p99_ms": pct(99)}
 
 
 class Gauge:
