@@ -13,6 +13,14 @@ commits every N batches, so per-commit cost amortises further).
 
 Usage: python benchmarks/bench_pod.py [--procs 1,2,4,8] [--batches 40]
 Prints one markdown table row per pod size, plus a JSON line per size.
+
+``--overhead`` instead runs the PAIRED resilience measurement: the same
+poll+commit drain loop over a raw MemoryConsumer vs the identical
+consumer wrapped in ``ResilientConsumer`` with no faults firing —
+interleaved repetitions, medians reported — so the wrapper's no-fault
+hot-path cost (one breaker ``allow()`` + one try/except + one
+``record_success()`` per op) is a measured number in PERF.md, not a
+claim.
 """
 
 from __future__ import annotations
@@ -195,6 +203,71 @@ def _validate(nproc: int, n_batches: int, commit_every: int) -> None:
         )
 
 
+def run_overhead(n_records: int = 200_000, reps: int = 5) -> dict:
+    """Paired resilience-on/off poll+commit drain over one broker.
+
+    Reps interleave (raw, wrapped, raw, wrapped, ...) so OS noise and
+    allocator state hit both arms equally; each rep drains the full topic
+    under a fresh consumer group (positions reset, the log does not).
+    Reports median rows/s per arm and the per-(poll+commit) overhead."""
+    import uuid
+
+    import numpy as np
+
+    import torchkafka_tpu as tk
+    from torchkafka_tpu.resilience import ResilientConsumer
+
+    broker = tk.InMemoryBroker()
+    broker.create_topic(TOPIC, partitions=N_PARTS)
+    payload = b"\x00" * 64
+    broker.produce_many(TOPIC, (payload for _ in range(n_records)))
+    tps = [tk.TopicPartition(TOPIC, p) for p in range(N_PARTS)]
+
+    def one_pass(wrap: bool) -> dict:
+        consumer = tk.MemoryConsumer(
+            broker, TOPIC, group_id=f"ovh-{uuid.uuid4().hex[:8]}",
+            assignment=tps,
+        )
+        if wrap:
+            consumer = ResilientConsumer(consumer)
+        rows = ops = 0
+        t0 = time.perf_counter()
+        while True:
+            recs = consumer.poll(max_records=512, timeout_ms=0)
+            ops += 1
+            if not recs:
+                break
+            rows += len(recs)
+            consumer.commit()
+            ops += 1
+        dt = time.perf_counter() - t0
+        consumer.close()
+        assert rows == n_records, f"drained {rows} != produced {n_records}"
+        return {"rows_per_s": rows / dt, "ops": ops, "dt": dt}
+
+    one_pass(False)  # warmup both code paths outside the timed reps
+    one_pass(True)
+    raw, wrapped = [], []
+    for _ in range(reps):
+        raw.append(one_pass(False))
+        wrapped.append(one_pass(True))
+    r = float(np.median([x["rows_per_s"] for x in raw]))
+    w = float(np.median([x["rows_per_s"] for x in wrapped]))
+    dt_r = float(np.median([x["dt"] for x in raw]))
+    dt_w = float(np.median([x["dt"] for x in wrapped]))
+    ops = raw[0]["ops"]
+    return {
+        "mode": "resilience-overhead",
+        "records": n_records,
+        "reps": reps,
+        "ops_per_rep": ops,
+        "raw_rows_per_s": r,
+        "resilient_rows_per_s": w,
+        "ratio": w / r,
+        "overhead_us_per_op": (dt_w - dt_r) / ops * 1e6,
+    }
+
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("localhost", 0))
@@ -258,7 +331,26 @@ def main() -> None:
     ap.add_argument("--batches", type=int, default=40)
     ap.add_argument("--commit-every", type=int, default=1)
     ap.add_argument("--cadences", default="1,16")
+    ap.add_argument("--overhead", action="store_true",
+                    help="paired resilience-on/off poll+commit overhead "
+                    "measurement (no faults firing) instead of the pod sweep")
+    ap.add_argument("--records", type=int, default=200_000,
+                    help="--overhead: records drained per repetition")
+    ap.add_argument("--reps", type=int, default=5,
+                    help="--overhead: interleaved repetitions per arm")
     args = ap.parse_args()
+    if args.overhead:
+        r = run_overhead(args.records, args.reps)
+        print("| records | raw rows/s | resilient rows/s | ratio | "
+              "overhead/op |")
+        print("|---|---|---|---|---|")
+        print(
+            f"| {r['records']:,} | {r['raw_rows_per_s']:,.0f} | "
+            f"{r['resilient_rows_per_s']:,.0f} | {r['ratio']:.3f} | "
+            f"{r['overhead_us_per_op']:.2f} us |"
+        )
+        print(json.dumps(r), file=sys.stderr)
+        return
     if args.worker:
         pid, nproc, port, outdir = args.worker
         worker(

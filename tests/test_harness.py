@@ -87,6 +87,27 @@ def test_scenario_10_fleet_smoke():
     assert set(out["lanes"]) == {"interactive", "batch"}
 
 
+def test_scenario_11_chaos_soak_smoke():
+    """The tier-1 resilience smoke: scenario 11 drives a broker-outage
+    window mid-serve plus one poisoned prompt through the 2-replica
+    fleet over ResilientConsumer(ChaosConsumer(...)). Recovery (circuit
+    open THEN closed, all non-poisoned prompts exactly once, commits at
+    every log end) and the DLQ routing are asserted — commit_failures
+    here are the outage's survivable commits, not a defect."""
+    out = run_scenario(11, "tiny")
+    assert out["scenario"] == "11:chaos-soak"
+    assert out["records"] == 15  # 16 produced, 1 poisoned
+    assert out["exactly_once"] is True
+    assert out["duplicates"] == 0
+    assert out["committed_complete"] is True
+    assert out["dlq_records"] == 1
+    assert out["quarantined"] == 1
+    assert out["dropped"] == 1  # the quarantined prompt, retired
+    assert out["outage_faults"] > 0  # the outage actually fired
+    assert out["circuit_opens"] >= 1
+    assert out["circuit_closes"] >= 1  # ...and recovery was observed
+
+
 def test_scenario_7_sampled_serving():
     """--temperature/--top-k through the harness: the sampled serving row
     completes with exact commits and reports its sampling knobs."""

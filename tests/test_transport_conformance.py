@@ -9,6 +9,12 @@ Transports:
 - ``memory``: MemoryConsumer over an in-process InMemoryBroker.
 - ``netbroker``: the SAME MemoryConsumer over a BrokerClient socket proxy
   (the cross-process fleet/pod transport) — group state lives server-side.
+- ``chaos``: ``ChaosConsumer(MemoryConsumer, ...)`` with every fault rate
+  at zero — the injector must be contract-TRANSPARENT when idle, or every
+  chaos test conflates wrapper bugs with injected faults.
+- ``resilient``: ``ResilientConsumer(MemoryConsumer)`` with no faults
+  firing — same transparency requirement for the resilience layer's
+  no-fault hot path (retry loops, breaker bookkeeping, forwarding).
 - ``kafka``: the kafka-python adapter, auto-included when the library is
   importable; the broker-dependent cases additionally need
   ``KAFKA_BOOTSTRAP`` (a live broker) and skip cleanly without it.
@@ -40,7 +46,9 @@ except ImportError:
     HAVE_KAFKA = False
 KAFKA_BOOTSTRAP = os.environ.get("KAFKA_BOOTSTRAP")
 
-TRANSPORTS = ["memory", "netbroker"] + (["kafka"] if HAVE_KAFKA else [])
+TRANSPORTS = ["memory", "netbroker", "chaos", "resilient"] + (
+    ["kafka"] if HAVE_KAFKA else []
+)
 
 
 class _Env:
@@ -106,6 +114,22 @@ class _NetbrokerEnv(_Env):
         self.server.close()
 
 
+class _ChaosEnv(_MemoryEnv):
+    """ChaosConsumer at zero fault rates: pure pass-through, provably."""
+
+    def consumer(self, group, **kw):
+        return tk.ChaosConsumer(super().consumer(group, **kw), seed=0)
+
+
+class _ResilientEnv(_MemoryEnv):
+    """ResilientConsumer over a healthy transport: the wrapper must be
+    invisible — retries never fire, the breaker stays closed, terminal
+    errors (closed consumer, rebalance commits) pass through."""
+
+    def consumer(self, group, **kw):
+        return tk.ResilientConsumer(super().consumer(group, **kw))
+
+
 class _KafkaEnv(_Env):
     supports_group_introspection = False  # needs an admin client; assert
     # through a fresh consumer's committed() instead
@@ -155,6 +179,8 @@ def env(request):
     e = {
         "memory": _MemoryEnv,
         "netbroker": _NetbrokerEnv,
+        "chaos": _ChaosEnv,
+        "resilient": _ResilientEnv,
         "kafka": _KafkaEnv,
     }[request.param](topic, partitions=2)
     e.name = request.param

@@ -21,16 +21,26 @@ from torchkafka_tpu.commit import (
 )
 from torchkafka_tpu.errors import (
     BarrierError,
+    BrokerUnavailableError,
     CommitFailedError,
     ConsumerClosedError,
     OutputDeliveryError,
+    PoisonRecordError,
     ProducerClosedError,
     TpuKafkaError,
 )
 from torchkafka_tpu.parallel import batch_sharding, global_batch, make_mesh
 from torchkafka_tpu.pipeline import KafkaStream, stream
+from torchkafka_tpu.resilience import (
+    CircuitBreaker,
+    ManualClock,
+    PoisonQuarantine,
+    ResilientConsumer,
+    RetryPolicy,
+)
 from torchkafka_tpu.source import (
     ChaosConsumer,
+    ChaosProducer,
     Consumer,
     BrokerClient,
     BrokerServer,
@@ -61,16 +71,19 @@ from torchkafka_tpu.transform import (
     raw_bytes,
 )
 
-__version__ = "0.1.0"
+__version__ = "0.7.0"
 
 __all__ = [
     "BarrierError",
     "Batch",
     "Batcher",
+    "BrokerUnavailableError",
+    "CircuitBreaker",
     "CommitBarrier",
     "CommitFailedError",
     "CommitToken",
     "ChaosConsumer",
+    "ChaosProducer",
     "Consumer",
     "ConsumerClosedError",
     "BrokerClient",
@@ -80,12 +93,17 @@ __all__ = [
     "KafkaProducer",
     "KafkaStream",
     "LocalBarrier",
+    "ManualClock",
     "MemoryConsumer",
     "MemoryProducer",
     "OutputDeliveryError",
+    "PoisonQuarantine",
+    "PoisonRecordError",
     "Producer",
     "ProducerClosedError",
     "RecordMetadata",
+    "ResilientConsumer",
+    "RetryPolicy",
     "dead_letter_to_topic",
     "seek_to_timestamp",
     "OffsetLedger",

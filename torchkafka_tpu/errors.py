@@ -13,7 +13,33 @@ from __future__ import annotations
 
 
 class TpuKafkaError(Exception):
-    """Base class for all torchkafka_tpu errors."""
+    """Base class for all torchkafka_tpu errors.
+
+    Every error carries a **retryable / terminal** classification via the
+    ``retryable`` class attribute — the contract the resilience layer
+    (``torchkafka_tpu/resilience``) keys its retry decisions on:
+
+    - ``retryable = True``: a *transient transport fault* — the operation
+      itself was sound and repeating it verbatim can succeed once the
+      broker recovers (``BrokerUnavailableError``). Safe to retry because
+      the affected operations are idempotent: polls re-fetch from the
+      consumer position, commits carry absolute next-read offsets.
+    - ``retryable = False`` (default): *terminal for that operation* —
+      repeating the identical call cannot help. Either the protocol moved
+      on (``CommitFailedError`` after a rebalance: the fix is
+      re-delivery, not a retry of the stale-generation commit), the
+      caller holds a bug (``NotAssignedError``, ``ConsumerClosedError``),
+      or the failure is per-payload (``PoisonRecordError``: the record
+      itself is bad and will fail identically forever — the escape hatch
+      is the dead-letter quarantine, never a retry loop).
+
+    Terminal is not the same as fatal: ``CommitFailedError`` is terminal
+    *and survivable* (the watermark stays put and records re-deliver),
+    while ``OutputDeliveryError`` is terminal and fail-stop (crash before
+    commit).
+    """
+
+    retryable: bool = False
 
 
 class CommitFailedError(TpuKafkaError):
@@ -43,6 +69,28 @@ class OutputDeliveryError(TpuKafkaError):
     exhausted, too large, authorization). Raised instead of committing
     source offsets past the lost output: fail-stop = crash-before-commit,
     so the affected inputs re-deliver and the output regenerates."""
+
+
+class BrokerUnavailableError(TpuKafkaError):
+    """The broker could not be reached (connection refused/reset, request
+    timeout, leadership election in progress). RETRYABLE: polls and
+    commits are idempotent, so repeating the operation after a backoff is
+    always safe — ``ResilientConsumer`` does exactly that, behind a
+    circuit breaker so a long outage degrades (empty polls, fast-failed
+    commits) instead of hot-looping. ``ChaosConsumer`` raises this during
+    injected outage windows."""
+
+    retryable = True
+
+
+class PoisonRecordError(TpuKafkaError):
+    """A record's *payload* cannot be processed (undecodable bytes,
+    schema violation, a processor crash specific to this record).
+    TERMINAL PER RECORD: under at-least-once delivery the identical bytes
+    re-deliver forever, so retrying is an infinite crash loop — the only
+    exits are dropping the record or routing it to a dead-letter topic
+    (``resilience.PoisonQuarantine``), after which its offset may retire.
+    Transport and broker state are healthy; only this record is not."""
 
 
 class UnknownTopicError(TpuKafkaError):

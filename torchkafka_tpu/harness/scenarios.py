@@ -12,6 +12,8 @@ each returning a metrics dict.
 | 7 | continuous-batching serving (slot recycling, EOS) | none |
 | 8 | streaming CTR: DLRM train, tp-sharded embedding tables | none |
 | 9 | ragged text → length-bucketed batches → per-width train steps | none |
+| 10 | serving fleet: QoS admission + graceful drain | none |
+| 11 | chaos soak: broker outage + poison prompt → recovery + DLQ | none |
 
 Every scenario runs the full transactional loop (poll → transform → batch →
 device → step → barrier → commit) and reports ``records_per_s`` plus commit
@@ -983,6 +985,125 @@ def scenario_10(size: str = "tiny", replicas: int = 2) -> dict:
     }
 
 
+def scenario_11(size: str = "tiny", replicas: int = 2) -> dict:
+    """Chaos-soak smoke (torchkafka_tpu/resilience): a 2-replica serving
+    fleet over ``ResilientConsumer(ChaosConsumer(MemoryConsumer))`` hits
+    a broker-outage window mid-serve plus one poisoned (corrupted)
+    prompt. The circuit must open then close (metrics-observable), every
+    non-poisoned prompt must complete exactly once with the committed
+    watermark at every log end, and the poisoned prompt must land in the
+    DLQ topic with an acknowledged produce — the resilience layer's
+    tier-1 guard, seconds on CPU; the full differential lives in
+    tests/test_resilience.py."""
+    import time as _time
+
+    import torchkafka_tpu as tk
+    from torchkafka_tpu.fleet import ServingFleet
+    from torchkafka_tpu.resilience import (
+        CLOSED, CircuitBreaker, PoisonQuarantine, ResilientConsumer,
+        RetryPolicy,
+    )
+    from torchkafka_tpu.source.records import TopicPartition
+
+    prompt_len, max_new = (16, 8) if size == "tiny" else (64, 32)
+    n, parts = (16, 4) if size == "tiny" else (96, 4)
+    poison = ("t11", 2, 1)  # (topic, partition, offset) of the bad prompt
+    cfg, params, label = _serving_model(size, None, prompt_len, max_new)
+    broker = tk.InMemoryBroker()
+    broker.create_topic("t11", partitions=parts)
+    broker.create_topic("t11-dlq", partitions=1)
+    rng = np.random.default_rng(0)
+    produced = []
+    for i in range(n):
+        rec = broker.produce(
+            "t11",
+            rng.integers(0, cfg.vocab_size, prompt_len,
+                         dtype=np.int32).tobytes(),
+            partition=i % parts,
+        )
+        produced.append((rec.partition, rec.offset))
+    quarantine = PoisonQuarantine(
+        tk.MemoryProducer(broker), "t11-dlq", budget=2
+    )
+    chaos_list, rc_list = [], []
+
+    def factory(rid):
+        chaos = tk.ChaosConsumer(
+            tk.MemoryConsumer(broker, "t11", group_id="s11"),
+            seed=rid,
+            outages=[(6, 6)],  # ops 6-11: poll AND commit raise
+            corrupt_offsets={poison},
+        )
+        rc = ResilientConsumer(
+            chaos,
+            policy=RetryPolicy(
+                max_attempts=2, base_delay_s=0.001, max_delay_s=0.002,
+                deadline_s=5.0, seed=rid,
+            ),
+            breaker=CircuitBreaker(failure_threshold=2, reset_timeout_s=0.02),
+        )
+        chaos_list.append(chaos)
+        rc_list.append(rc)
+        return rc
+
+    fleet = ServingFleet(
+        factory, params, cfg, replicas=replicas, prompt_len=prompt_len,
+        max_new=max_new, slots=2, commit_every=4,
+        gen_kwargs={"quarantine": quarantine},
+    )
+    fleet.warmup()
+    t0 = _time.perf_counter()
+    served: list = []
+    served_during_open = 0
+    for _rid, rec, _toks in fleet.serve(idle_timeout_ms=2000):
+        if any(rc.breaker.state != CLOSED for rc in rc_list):
+            served_during_open += 1
+        served.append((rec.partition, rec.offset))
+    # Settle: cadence commits that failed survivably during the outage
+    # stay pending (pending_commit > 0); retry against the healed broker.
+    deadline = _time.monotonic() + 10.0
+    while any(rep.gen.pending_commit for rep in fleet.replicas):
+        for rep in fleet.replicas:
+            if rep.gen.pending_commit:
+                rep.gen.flush_commits()
+        if _time.monotonic() > deadline:
+            break
+        _time.sleep(0.005)
+    fleet.close()
+    elapsed = _time.perf_counter() - t0
+    expect = {(p, o) for p, o in produced if ("t11", p, o) != poison}
+    committed_complete = all(
+        broker.committed("s11", TopicPartition("t11", p))
+        == broker.end_offset(TopicPartition("t11", p))
+        for p in range(parts)
+    )
+    gens = [rep.gen for rep in fleet.replicas]
+    return {
+        "scenario": "11:chaos-soak",
+        "model_scale": label,
+        "replicas": replicas,
+        "records": len(served),
+        "elapsed_s": round(elapsed, 3),
+        "records_per_s": round(len(served) / elapsed, 1) if elapsed else None,
+        "exactly_once": set(served) == expect and len(served) == len(expect),
+        "duplicates": fleet.metrics.duplicates.count,
+        "committed_complete": committed_complete,
+        "dlq_records": broker.end_offset(TopicPartition("t11-dlq", 0)),
+        "quarantined": sum(g.metrics.quarantined.count for g in gens),
+        "served_during_open": served_during_open,
+        "outage_faults": sum(c.injected_outage_faults for c in chaos_list),
+        "retries": sum(rc.metrics.retries.count for rc in rc_list),
+        "circuit_opens": sum(rc.metrics.circuit_opens.count for rc in rc_list),
+        "circuit_closes": sum(
+            rc.metrics.circuit_closes.count for rc in rc_list
+        ),
+        "commit_failures": sum(
+            g.metrics.commit_failures.count for g in gens
+        ),
+        "dropped": sum(g.metrics.dropped.count for g in gens),
+    }
+
+
 def scenario_8(size: str = "tiny") -> dict:
     """Streaming CTR: DLRM-style recommender trained from a Kafka event
     stream — label + dense features + hashed categorical ids per record,
@@ -1348,6 +1469,7 @@ SCENARIOS = {
     8: scenario_8,
     9: scenario_9,
     10: scenario_10,
+    11: scenario_11,
 }
 
 
@@ -1388,8 +1510,8 @@ def run_scenario(
         )
     sample_kw = dict(temperature=temperature, top_k=top_k, top_p=top_p)
     spec_kw = dict(spec=spec, spec_k=spec_k, spec_draft_layers=spec_draft_layers)
-    if num == 10:
-        return SCENARIOS[10](size, replicas=replicas)
+    if num in (10, 11):
+        return SCENARIOS[num](size, replicas=replicas)
     if model_scale is not None:
         if num not in (5, 7):
             raise ValueError("model_scale applies to scenarios 5 and 7 only")

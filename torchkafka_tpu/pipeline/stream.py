@@ -84,10 +84,24 @@ class KafkaStream:
         is counted in ``metrics.processor_errors`` and logged, and the
         stream continues — the poison-pill policy. For a CHUNKED processor
         the whole failing chunk drops (the chunk call is all-or-nothing).
+        'quarantine': requires ``quarantine=``; each failure spends the
+        record's retry budget (in-place re-attempts for transient
+        processing faults), and once the budget is gone the record is
+        dead-lettered with an ACKNOWLEDGED produce before its offset
+        retires (counted in ``metrics.quarantined``) — so the committed
+        watermark never covers a record that is neither processed nor
+        durably quarantined. A failed DLQ produce fail-stops the stream
+        (``OutputDeliveryError``, crash-before-commit) — the discipline
+        'drop'+``dead_letter`` deliberately does NOT give you (there, a
+        broken DLQ loses the copy but keeps ingest alive). Per-record
+        processors only: a chunked processor's all-or-nothing call has no
+        per-record failure to budget.
     dead_letter: optional ``(record, exception) -> None`` callback invoked
         for each record dropped by the 'drop' policy — wire it to a DLQ
         producer, a file, or a metrics sink. Exceptions it raises are
         logged and swallowed (a broken DLQ must not take down ingest).
+    quarantine: a ``resilience.PoisonQuarantine`` (producer + DLQ topic +
+        retry budget), required by ``on_processor_error='quarantine'``.
     buckets: length-bucket widths (e.g. ``(64, 128, 512)``) for RAGGED
         record streams: the (per-record) processor returns variable-length
         1-D rows; each lands in the smallest bucket that fits (longer than
@@ -127,16 +141,30 @@ class KafkaStream:
         owns_consumer: bool = False,
         on_processor_error: str = "raise",
         dead_letter: Any | None = None,
+        quarantine: Any | None = None,
         buckets: Any | None = None,
         bucket_pad_value: int = 0,
     ) -> None:
-        if on_processor_error not in ("raise", "drop"):
+        if on_processor_error not in ("raise", "drop", "quarantine"):
             raise ValueError(
-                f"on_processor_error must be 'raise'|'drop', got {on_processor_error!r}"
+                "on_processor_error must be 'raise'|'drop'|'quarantine', "
+                f"got {on_processor_error!r}"
+            )
+        if (on_processor_error == "quarantine") != (quarantine is not None):
+            raise ValueError(
+                "quarantine= and on_processor_error='quarantine' go "
+                "together (the policy needs a DLQ route; a route needs "
+                "the policy)"
             )
         self._consumer = consumer
         self._processor = processor
         self._chunked = bool(getattr(processor, "chunked", False))
+        if quarantine is not None and self._chunked:
+            raise ValueError(
+                "on_processor_error='quarantine' needs a per-record "
+                "processor: a chunked processor's all-or-nothing call has "
+                "no per-record failure to budget (use 'drop' or 'raise')"
+            )
         self._mesh = mesh
         self._data_axis = data_axis
         self._to_device = to_device
@@ -146,6 +174,7 @@ class KafkaStream:
         self._owns_consumer = owns_consumer
         self._on_processor_error = on_processor_error
         self._dead_letter = dead_letter
+        self._quarantine = quarantine
         if barrier is not None:
             self._barrier = barrier
         elif jax.process_count() > 1:
@@ -254,14 +283,31 @@ class KafkaStream:
 
     def _apply(self, record):
         """Processor with the error policy applied; an error under 'drop'
-        becomes the None-drop contract (offset retires, stream continues)."""
-        try:
-            return self._processor(record)
-        except Exception as e:  # noqa: BLE001 - policy decides
-            if self._on_processor_error == "raise":
-                raise
-            self._drop_errored(record, e)
-            return None
+        becomes the None-drop contract (offset retires, stream continues).
+        Under 'quarantine' the record is re-attempted in place while its
+        budget lasts, then dead-lettered (acknowledged) and retired; a
+        failed DLQ produce raises OutputDeliveryError through the normal
+        sticky-death path — fail-stop, crash-before-commit."""
+        while True:
+            try:
+                return self._processor(record)
+            except Exception as e:  # noqa: BLE001 - policy decides
+                if self._on_processor_error == "raise":
+                    raise
+                if self._on_processor_error == "quarantine":
+                    self.metrics.processor_errors.add(1)
+                    if not self._quarantine.note_failure(record, e):
+                        continue  # budget left: transient until proven poison
+                    self.metrics.quarantined.add(1)
+                    _logger.warning(
+                        "poison record %s offset %d dead-lettered to %r; "
+                        "offset retires (%s)",
+                        record.tp, record.offset,
+                        self._quarantine.topic, e,
+                    )
+                    return None  # resolved: retires like a drop
+                self._drop_errored(record, e)
+                return None
 
     def _process_chunk(self, records) -> list[Batch]:
         """One poll chunk through ledger + transform + batcher. Shared by the

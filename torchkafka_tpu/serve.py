@@ -182,6 +182,7 @@ class ServeMetrics:
         # other generations were in flight) — continuous batching's defining
         # behavior; 0 in lockstep-equivalent runs
         self.dropped = RateMeter()  # undecodable prompts retired
+        self.quarantined = RateMeter()  # poison prompts dead-lettered
         self.commit_failures = RateMeter()
         self.output_flush_failures = RateMeter()  # output topic not durable
         self.output_send_failures = RateMeter()  # sync send refusals (stall)
@@ -207,6 +208,7 @@ class ServeMetrics:
             "truncated_by_eos": self.truncated.count,
             "readmissions": self.readmissions.count,
             "dropped": self.dropped.count,
+            "quarantined": self.quarantined.count,
             "commit_failures": self.commit_failures.count,
             "output_flush_failures": self.output_flush_failures.count,
             "output_send_failures": self.output_send_failures.count,
@@ -226,6 +228,7 @@ class ServeMetrics:
             ("truncated_by_eos_total", "counter", s["truncated_by_eos"]),
             ("slot_readmissions_total", "counter", s["readmissions"]),
             ("dropped_prompts_total", "counter", s["dropped"]),
+            ("quarantined_prompts_total", "counter", s["quarantined"]),
             ("commit_failures_total", "counter", s["commit_failures"]),
             ("output_flush_failures_total", "counter", s["output_flush_failures"]),
             ("output_send_failures_total", "counter", s["output_send_failures"]),
@@ -304,6 +307,7 @@ class StreamingGenerator:
         output_topic: str | None = None,
         encode_output: Callable[[Record, np.ndarray], bytes] | None = None,
         max_send_failure_streak: int = 64,
+        quarantine=None,
         mesh=None,
         kv_dtype: str | None = None,
         kv_kernel: bool | str = "auto",
@@ -379,7 +383,18 @@ class StreamingGenerator:
         failures the output path is evidently down and every further
         completion is un-committable replay work, so the server fail-stops
         with ``OutputDeliveryError`` — the same signal the flush/get path
-        gives for terminal delivery failures (ADVICE r3)."""
+        gives for terminal delivery failures (ADVICE r3).
+
+        ``quarantine``: a ``resilience.PoisonQuarantine``. Without it, an
+        undecodable prompt is retired immediately as dropped (the
+        original policy — no durable copy). With it, each decode failure
+        spends the record's retry budget (re-attempted in place — a
+        transient external-tokenizer fault heals here), and once the
+        budget is gone the prompt is dead-lettered with an ACKNOWLEDGED
+        produce before its offset retires (``metrics.quarantined``); a
+        failed DLQ produce raises ``OutputDeliveryError`` — fail-stop,
+        crash-before-commit, so the committed watermark never covers a
+        record that is neither served nor durably quarantined."""
         if prompt_len + max_new > cfg.max_seq_len:
             raise ValueError("prompt_len + max_new exceeds cfg.max_seq_len")
         if max_new < 2:
@@ -432,6 +447,7 @@ class StreamingGenerator:
         self._kv_kernel_opt = kv_kernel
         self._max_send_failure_streak = max_send_failure_streak
         self._send_failure_streak = 0
+        self._quarantine = quarantine
         self._pending_outputs: list = []  # send handles since last commit
         self._ledger = OffsetLedger()
         self._max_len = prompt_len + max_new
@@ -956,13 +972,26 @@ class StreamingGenerator:
             while True:
                 try:
                     prompts[i] = self._decode_prompt(rec)
-                except Exception:
-                    # Poison record: retire it (dropped) or it would
-                    # re-deliver and crash the server forever on restart.
-                    _logger.exception(
-                        "dropping undecodable prompt %s@%s:%s",
-                        rec.topic, rec.partition, rec.offset,
-                    )
+                except Exception as exc:
+                    if self._quarantine is not None:
+                        if not self._quarantine.note_failure(rec, exc):
+                            # Budget left: transient until proven poison —
+                            # re-attempt the SAME record in place.
+                            continue
+                        # Dead-lettered, DLQ produce acknowledged: the
+                        # record is RESOLVED, its offset may retire. (A
+                        # failed DLQ produce raised OutputDeliveryError
+                        # out of note_failure — fail-stop before any
+                        # commit could cover the record.)
+                        self.metrics.quarantined.add(1)
+                    else:
+                        # No quarantine route: retire it (dropped) or it
+                        # would re-deliver and crash the server forever
+                        # on restart.
+                        _logger.exception(
+                            "dropping undecodable prompt %s@%s:%s",
+                            rec.topic, rec.partition, rec.offset,
+                        )
                     self._ledger.dropped(rec)
                     self.metrics.dropped.add(1)
                     if not queue:
@@ -1076,18 +1105,28 @@ class StreamingGenerator:
                     self._ledger.emitted(rec)
                     self._uncommitted += 1
                 completions.append((rec, out))
-            if self._uncommitted >= self._commit_every:
-                self._commit()
+            if self._uncommitted >= self._commit_every and self._commit():
                 self._uncommitted = 0
         return completions
 
     def flush_commits(self) -> None:
         """Commit anything emitted since the last commit (cadence-pending
         completions). The external-admission caller's end-of-window flush;
-        run() calls it on exit."""
-        if self._uncommitted:
-            self._commit()
+        run() calls it on exit. A SURVIVABLE commit failure (rebalance,
+        open circuit, broker fault) leaves the cadence counter intact, so
+        the completions stay commit-pending and the next cadence point or
+        flush retries them — a transient failure at the final flush no
+        longer silently strands the tail uncommitted until restart."""
+        if self._uncommitted and self._commit():
             self._uncommitted = 0
+
+    @property
+    def pending_commit(self) -> int:
+        """Completions emitted since the last SUCCESSFUL commit — what a
+        flush would cover. Nonzero after serving means a survivable
+        commit failure is still unhealed (retry flush_commits once the
+        broker recovers, or accept the re-delivery on restart)."""
+        return self._uncommitted
 
     def committable_offsets(self) -> dict:
         """The ledger's committable next-read offsets right now — what the
@@ -1144,11 +1183,14 @@ class StreamingGenerator:
                 break
         self.flush_commits()
 
-    def _commit(self) -> None:
-        """Commit the ledger watermark; commit failure is survivable (the
-        reference's contract, /root/reference/src/kafka_dataset.py:131-135):
-        a rebalance raises CommitFailedError and the moved partitions'
-        uncommitted prompts simply re-deliver to their new owner.
+    def _commit(self) -> bool:
+        """Commit the ledger watermark; returns True iff offsets were
+        durably committed (callers reset the commit cadence only then, so
+        failed cadence commits retry instead of silently skipping).
+        Commit failure is survivable (the reference's contract,
+        /root/reference/src/kafka_dataset.py:131-135): a rebalance raises
+        CommitFailedError and the moved partitions' uncommitted prompts
+        simply re-deliver to their new owner.
 
         With an output topic configured, output durability is settled
         FIRST: flush, then ``get()`` every send handle since the last
@@ -1174,7 +1216,7 @@ class StreamingGenerator:
                     "output flush failed; SKIPPING offset commit so the "
                     "affected prompts re-deliver and regenerate"
                 )
-                return
+                return False
             pending, self._pending_outputs = self._pending_outputs, []
             for handle in pending:
                 try:
@@ -1189,9 +1231,11 @@ class StreamingGenerator:
         try:
             self._consumer.commit(self._ledger.snapshot())
             self.metrics.commit_latency.observe(time.perf_counter() - t0)
+            return True
         except CommitFailedError:
             self.metrics.commit_failures.add(1)
             _logger.exception("offset commit failed; prompts will re-deliver")
+            return False
 
     def close(self) -> None:
         """Voluntary shutdown: commit the watermark for everything already

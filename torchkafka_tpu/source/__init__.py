@@ -1,7 +1,7 @@
 """Source layer: transports implementing the Consumer/Producer protocols."""
 
 from torchkafka_tpu.source.assignment import local_batch_size, partitions_for_process
-from torchkafka_tpu.source.chaos import ChaosConsumer
+from torchkafka_tpu.source.chaos import ChaosConsumer, ChaosProducer
 from torchkafka_tpu.source.consumer import Consumer, seek_to_timestamp
 from torchkafka_tpu.source.kafka import (
     HAVE_KAFKA_PYTHON,
@@ -22,6 +22,7 @@ __all__ = [
     "BrokerClient",
     "BrokerServer",
     "ChaosConsumer",
+    "ChaosProducer",
     "Consumer",
     "HAVE_KAFKA_PYTHON",
     "InMemoryBroker",

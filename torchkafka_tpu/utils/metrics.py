@@ -139,6 +139,57 @@ def render_exposition(prefix: str, series: list[tuple]) -> str:
     return "\n".join(lines) + "\n"
 
 
+class ResilienceMetrics:
+    """The metric set one ResilientConsumer maintains (resilience/).
+
+    Counters follow the layer's three escalation stages: a *retry* is a
+    fault absorbed inside one operation; a *degraded poll* is an
+    operation that gave up for this round (empty result, watermark
+    intact); a *suppressed* operation never reached the transport at all
+    because the circuit was open. ``circuit_state`` is the breaker gauge
+    (0 closed / 0.5 half-open / 1 open); ``circuit_opens``/``closes``
+    mirror the breaker's transition counters so "opened then closed" is
+    assertable from a metrics snapshot alone."""
+
+    def __init__(self) -> None:
+        self.retries = RateMeter()  # backoff-scheduled retry attempts
+        self.poll_faults = RateMeter()  # retryable poll failures observed
+        self.commit_faults = RateMeter()  # retryable commit failures observed
+        self.degraded_polls = RateMeter()  # polls that gave up -> []
+        self.suppressed_polls = RateMeter()  # fast-failed: circuit open
+        self.suppressed_commits = RateMeter()  # fast-failed: circuit open
+        self.circuit_opens = RateMeter()
+        self.circuit_closes = RateMeter()
+        self.circuit_state = Gauge()
+
+    def summary(self) -> dict:
+        return {
+            "retries": self.retries.count,
+            "poll_faults": self.poll_faults.count,
+            "commit_faults": self.commit_faults.count,
+            "degraded_polls": self.degraded_polls.count,
+            "suppressed_polls": self.suppressed_polls.count,
+            "suppressed_commits": self.suppressed_commits.count,
+            "circuit_opens": self.circuit_opens.count,
+            "circuit_closes": self.circuit_closes.count,
+            "circuit_state": self.circuit_state.value,
+        }
+
+    def render_prometheus(self, prefix: str = "torchkafka_resilience") -> str:
+        s = self.summary()
+        return render_exposition(prefix, [
+            ("retries_total", "counter", s["retries"]),
+            ("poll_faults_total", "counter", s["poll_faults"]),
+            ("commit_faults_total", "counter", s["commit_faults"]),
+            ("degraded_polls_total", "counter", s["degraded_polls"]),
+            ("suppressed_polls_total", "counter", s["suppressed_polls"]),
+            ("suppressed_commits_total", "counter", s["suppressed_commits"]),
+            ("circuit_opens_total", "counter", s["circuit_opens"]),
+            ("circuit_closes_total", "counter", s["circuit_closes"]),
+            ("circuit_state", "gauge", s["circuit_state"]),
+        ])
+
+
 class StreamMetrics:
     """The metric set one KafkaStream maintains."""
 
@@ -147,6 +198,7 @@ class StreamMetrics:
         self.batches = RateMeter()  # batches emitted to the consumer
         self.dropped = RateMeter()  # records dropped by the processor
         self.processor_errors = RateMeter()  # drops caused by a RAISING processor
+        self.quarantined = RateMeter()  # poison records dead-lettered (resolved)
         self.commit_latency = LatencyHistogram()
         self.commit_failures = RateMeter()
         self.ingest_lag_ms = Gauge()  # append-time -> poll-time of newest record
@@ -158,6 +210,7 @@ class StreamMetrics:
             "batches": self.batches.count,
             "dropped": self.dropped.count,
             "processor_errors": self.processor_errors.count,
+            "quarantined": self.quarantined.count,
             "commit": self.commit_latency.summary(),
             "commit_failures": self.commit_failures.count,
             "ingest_lag_ms": round(self.ingest_lag_ms.value, 3),
@@ -175,6 +228,7 @@ class StreamMetrics:
             ("batches_total", "counter", s["batches"]),
             ("dropped_records_total", "counter", s["dropped"]),
             ("processor_errors_total", "counter", s["processor_errors"]),
+            ("quarantined_records_total", "counter", s["quarantined"]),
             ("commit_failures_total", "counter", s["commit_failures"]),
             ("commits_total", "counter", s["commit"]["count"]),
             ("records_per_second", "gauge", s["records_per_s"]),
