@@ -1,0 +1,231 @@
+"""Paged KV-cache paired bench: prefix-overlap sweep + memory table.
+
+Two questions, each answered with paired runs over IDENTICAL broker
+content (the repo's pairing discipline — absolute numbers on a
+contended CPU box drift; paired counts and ratios are the signal):
+
+1. PREFILL SAVINGS — sweep the prompt stream's prefix-overlap rate
+   (0 / 50 / 90% of prompt tokens shared via a common system prefix)
+   and report, per rate: radix hit rate, prefill tokens actually
+   computed vs the dense server's (= n x prompt_len, it re-prefills
+   every prompt in full), and the saved fraction. The differential is
+   also re-asserted inline: the paged server's tokens and commit ledger
+   must be byte-identical to the dense server's in every slice.
+
+2. MEMORY — the dense pool permanently holds slots x max_len tokens of
+   KV; the paged pool's PEAK live blocks are measured per overlap rate.
+   At the dense pool's byte budget, the headroom factor (dense-equivalent
+   blocks / peak used) is how much LONGER an effective context the same
+   HBM could serve paged — the 8B long-context OOM lever (VERDICT.md).
+
+The model is deliberately tiny on CPU: prefill-token counts and block
+occupancy are exact regardless of scale, and wall-clock here is
+host-dispatch-bound (per-record suffix prefills), not a device claim —
+tok/s is reported for completeness, ratios only.
+
+Usage: python benchmarks/bench_kvcache.py [--prompts 48] [--slots 4]
+       [--overlaps 0,0.5,0.9] [--slices 2] [--json PATH]
+Prints one markdown row per overlap rate plus a JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+PROMPT_LEN, MAX_NEW, BLOCK, VOCAB = 32, 16, 8, 512
+
+
+def build_broker(tk, np, n: int, overlap: float, seed: int):
+    broker = tk.InMemoryBroker()
+    broker.create_topic("bench", partitions=4)
+    rng = np.random.default_rng(seed)
+    shared_len = int(round(overlap * PROMPT_LEN))
+    shared = rng.integers(0, VOCAB, shared_len, dtype=np.int32)
+    for i in range(n):
+        tail = rng.integers(0, VOCAB, PROMPT_LEN - shared_len, dtype=np.int32)
+        broker.produce(
+            "bench", np.concatenate([shared, tail]).tobytes(),
+            partition=i % 4,
+        )
+    return broker
+
+
+def run_once(tk, np, jax, cfg, params, broker, slots: int, n: int,
+             pages: dict | None):
+    from torchkafka_tpu.serve import StreamingGenerator
+
+    class PeakTracking(StreamingGenerator):
+        """Sample the live footprint at step ENTRY (post-admission,
+        pre-release): DISTINCT blocks mapped by slot tables — the
+        must-keep bytes. Tree-only cached blocks are excluded because
+        eviction is advisory (they free on demand); sampling after
+        completions would miss the in-flight peak."""
+
+        peak_blocks = 0
+
+        def step(self):
+            if self._kv_pages is not None:
+                live = {
+                    int(b) for row in self._table_np for b in row if b != 0
+                }
+                self.peak_blocks = max(self.peak_blocks, len(live))
+            return super().step()
+
+    consumer = tk.MemoryConsumer(broker, "bench", group_id="b")
+    server = PeakTracking(
+        consumer, params, cfg, slots=slots, prompt_len=PROMPT_LEN,
+        max_new=MAX_NEW, commit_every=8, kv_pages=pages,
+    )
+    server.warmup()
+    out = {}
+    toks = 0
+    t0 = time.perf_counter()
+    for rec, gen in server.run(max_records=n):
+        out[(rec.partition, rec.offset)] = np.asarray(gen)
+        toks += int(gen.shape[0])
+    elapsed = time.perf_counter() - t0
+    committed = {
+        p: broker.committed("b", tk.TopicPartition("bench", p))
+        for p in range(4)
+    }
+    consumer.close()
+    return {
+        "out": out,
+        "committed": committed,
+        "elapsed_s": elapsed,
+        "tok_s": toks / elapsed if elapsed else None,
+        "cache": server.metrics.cache_summary(),
+        "peak_blocks": server.peak_blocks,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--prompts", type=int, default=48)
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--overlaps", default="0,0.5,0.9")
+    ap.add_argument("--slices", type=int, default=2)
+    ap.add_argument("--json", default=None, help="also write the JSON here")
+    args = ap.parse_args()
+    overlaps = [float(x) for x in args.overlaps.split(",")]
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from torchkafka_tpu.utils.devices import force_cpu_devices
+
+    force_cpu_devices(8)
+    import torchkafka_tpu as tk
+    from torchkafka_tpu.models.transformer import (
+        TransformerConfig, init_params,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=VOCAB, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=PROMPT_LEN + MAX_NEW, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.key(0), cfg)
+    n, slots = args.prompts, args.slots
+    max_len = PROMPT_LEN + MAX_NEW
+    nblk_slot = -(-max_len // BLOCK)
+    # The paged pool gets the DENSE pool's block-equivalent budget plus
+    # the sink: same bytes, so the memory rows compare at fixed budget.
+    dense_blocks = slots * nblk_slot
+    pages = {"block_size": BLOCK, "num_blocks": dense_blocks + 1}
+    kv_elem_bytes = jnp.dtype(cfg.dtype).itemsize
+    block_bytes = (
+        2 * cfg.n_layers * BLOCK * cfg.n_kv_heads * cfg.head_dim
+        * kv_elem_bytes
+    )
+
+    print(
+        f"# bench_kvcache — {n} prompts, {slots} slots, prompt {PROMPT_LEN} "
+        f"+ new {MAX_NEW}, block {BLOCK}, {args.slices} paired slices",
+    )
+    header = (
+        "| overlap | hit rate | prefill tok (paged/dense) | saved | "
+        "peak blocks (vs dense) | context headroom | paged/dense wall |"
+    )
+    print(header)
+    print("|---|---|---|---|---|---|---|")
+    results = []
+    for overlap in overlaps:
+        ratios, row = [], None
+        for s in range(args.slices):
+            # Fresh identical content per side, dense/paged back to back
+            # inside the slice (same box conditions).
+            dense = run_once(
+                tk, np, jax, cfg, params,
+                build_broker(tk, np, n, overlap, seed=s), slots, n, None,
+            )
+            paged = run_once(
+                tk, np, jax, cfg, params,
+                build_broker(tk, np, n, overlap, seed=s), slots, n, pages,
+            )
+            assert set(dense["out"]) == set(paged["out"])
+            for k in dense["out"]:
+                np.testing.assert_array_equal(
+                    dense["out"][k], paged["out"][k],
+                    err_msg=f"overlap {overlap} slice {s} prompt {k}",
+                )
+            assert dense["committed"] == paged["committed"], (
+                "commit ledgers diverged"
+            )
+            ratios.append(paged["elapsed_s"] / dense["elapsed_s"])
+            row = (dense, paged)  # counts identical across slices
+        dense, paged = row
+        cache = paged["cache"]
+        prefill_dense = n * PROMPT_LEN
+        saved = 1 - cache["prefill_tokens"] / prefill_dense
+        headroom = dense_blocks / max(1, paged["peak_blocks"])
+        rec = {
+            "overlap": overlap,
+            "hit_rate": cache["hit_rate"],
+            "prefill_tokens_paged": cache["prefill_tokens"],
+            "prefill_tokens_dense": prefill_dense,
+            "prefix_tokens_saved": cache["prefix_tokens_saved"],
+            "saved_frac": round(saved, 4),
+            "evictions": cache["evictions"],
+            "deferrals": cache["deferrals"],
+            "peak_blocks": paged["peak_blocks"],
+            "dense_blocks": dense_blocks,
+            "pool_bytes": dense_blocks * block_bytes,
+            "context_headroom_x": round(headroom, 2),
+            "effective_max_len_at_dense_bytes": int(max_len * headroom),
+            "paged_over_dense_wall": round(
+                float(np.median(ratios)), 2
+            ),
+            "dense_tok_s": round(dense["tok_s"], 1),
+            "paged_tok_s": round(paged["tok_s"], 1),
+        }
+        results.append(rec)
+        print(
+            f"| {overlap:.0%} | "
+            f"{(cache['hit_rate'] or 0):.2f} | "
+            f"{cache['prefill_tokens']} / {prefill_dense} | "
+            f"{saved:.0%} | "
+            f"{paged['peak_blocks']} / {dense_blocks} | "
+            f"{headroom:.2f}x (max_len {rec['effective_max_len_at_dense_bytes']}) | "
+            f"{rec['paged_over_dense_wall']:.2f}x |"
+        )
+    payload = {
+        "bench": "kvcache",
+        "prompts": n, "slots": slots, "prompt_len": PROMPT_LEN,
+        "max_new": MAX_NEW, "block_size": BLOCK,
+        "slices": args.slices,
+        "token_exact_and_ledger_identical": True,  # asserted per slice
+        "results": results,
+    }
+    print(json.dumps(payload))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(payload, f, indent=1)
+        print(f"wrote {args.json}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -108,6 +108,34 @@ def test_scenario_11_chaos_soak_smoke():
     assert out["circuit_closes"] >= 1  # ...and recovery was observed
 
 
+def test_scenario_12_prefix_cache_smoke():
+    """The tier-1 prefix-cache smoke: a duplicate-heavy keyed prompt
+    topic (three tenants, fixed per-tenant system prompts) through a
+    2-replica fleet with the paged radix cache on. Coverage and commits
+    stay exact, and the cache measurably works: only each tenant's first
+    prompt per owning replica misses, so the hit rate is high and real
+    prefill tokens were saved (the exactness differential lives in
+    tests/test_kvcache.py)."""
+    out = run_scenario(12, "tiny")
+    assert out["scenario"] == "12:prefix-cache-fleet"
+    assert out["replicas"] == 2
+    assert out["records"] == 24
+    assert out["coverage_complete"] is True
+    assert out["committed_complete"] is True
+    assert out["commit_failures"] == 0
+    assert out["dropped"] == 0
+    cache = out["cache"]
+    # Keyed tenants pin each tenant's partition to one replica, so at
+    # most one miss per tenant (3 tenants) — every other admission links
+    # the cached system-prompt blocks.
+    assert cache["misses"] <= 3
+    assert cache["hits"] >= out["records"] - 3
+    assert cache["hit_rate"] >= 0.8
+    assert cache["prefix_tokens_saved"] > 0
+    assert out["prefill_tokens"] < out["prefill_tokens_dense"]
+    assert out["prefill_savings_pct"] > 0
+
+
 def test_scenario_7_sampled_serving():
     """--temperature/--top-k through the harness: the sampled serving row
     completes with exact commits and reports its sampling knobs."""

@@ -1,0 +1,544 @@
+"""Paged KV-cache pool with radix-tree prefix reuse (torchkafka_tpu/kvcache,
+serve.py kv_pages=, ops/kvattn block-table attention).
+
+Pins the subsystem's three contracts:
+
+1. HOST INVARIANTS — allocator refcounts never go negative, blocks are
+   conserved (free + live == usable) through random admit/release/evict
+   schedules, evicted blocks return to the free list, and the radix match
+   equals a brute-force longest-prefix reference (property tests).
+2. TOKEN EXACTNESS — cache-on serving (plain and speculative) emits
+   byte-identical tokens and a byte-identical commit ledger vs the
+   cache-off server, for greedy and seeded sampling, under allocator
+   pressure (deferred admissions), and under seeded replica-kill chaos
+   through a 2-replica fleet. Eviction is advisory: exactness never
+   depends on what the cache holds.
+3. STALE-TAIL SAFETY — the serve.py docstring's recycling hazard as an
+   asserted invariant: after a slot/block is recycled, every cache
+   position that is not yet readable is POISONED with garbage and the
+   outputs must not change, on both the dense pool and the paged one.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import torchkafka_tpu as tk
+from torchkafka_tpu.kvcache import SINK_BLOCK, BlockAllocator, PagedKVConfig, RadixCache
+from torchkafka_tpu.models.generate import generate
+from torchkafka_tpu.models.transformer import TransformerConfig, init_params
+from torchkafka_tpu.serve import StreamingGenerator
+from torchkafka_tpu.serve_spec import SpecStreamingGenerator
+
+P, MAX_NEW, VOCAB, BS = 8, 8, 64, 4
+PAGES = {"block_size": BS, "num_blocks": 40}
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = TransformerConfig(
+        vocab_size=VOCAB, d_model=32, n_layers=2, n_heads=2, n_kv_heads=1,
+        d_ff=64, max_seq_len=P + MAX_NEW, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+def _prompts(n, shared_prefix_len=5, seed=7):
+    """n prompts sharing their first ``shared_prefix_len`` tokens — the
+    multi-tenant system-prompt shape the radix tree exists for."""
+    rng = np.random.default_rng(seed)
+    prompts = rng.integers(0, VOCAB, (n, P), dtype=np.int32)
+    if shared_prefix_len:
+        prompts[:, :shared_prefix_len] = np.arange(
+            shared_prefix_len, dtype=np.int32
+        )
+    return prompts
+
+
+def _topic(broker, prompts):
+    broker.create_topic("p", partitions=2)
+    for i in range(prompts.shape[0]):
+        broker.produce("p", prompts[i].tobytes(), partition=i % 2)
+
+
+def _serve(cfg, params, prompts, cls=StreamingGenerator, **kw):
+    broker = tk.InMemoryBroker()
+    _topic(broker, prompts)
+    consumer = tk.MemoryConsumer(broker, "p", group_id="g")
+    server = cls(
+        consumer, params, cfg, slots=4, prompt_len=P, max_new=MAX_NEW,
+        commit_every=4, **kw,
+    )
+    out = {}
+    for rec, toks in server.run(max_records=prompts.shape[0]):
+        out[(rec.partition, rec.offset)] = np.asarray(toks)
+    committed = {
+        pt: broker.committed("g", tk.TopicPartition("p", pt)) for pt in (0, 1)
+    }
+    consumer.close()
+    return out, committed, server
+
+
+class TestBlockAllocator:
+    def test_alloc_free_conservation(self):
+        a = BlockAllocator(9)
+        assert a.usable == 8 and a.available() == 8
+        got = a.alloc(3)
+        assert sorted(got) == [1, 2, 3] and SINK_BLOCK not in got
+        assert a.available() == 5 and a.allocated() == 3
+        assert a.alloc(6) is None and a.available() == 5  # all-or-nothing
+        a.incref(got)
+        assert a.decref(got) == []  # still referenced
+        assert a.decref(got) == got  # now free
+        assert a.available() == 8
+
+    def test_refcount_underflow_raises(self):
+        a = BlockAllocator(4)
+        (b,) = a.alloc(1)
+        a.decref([b])
+        with pytest.raises(ValueError, match="decref on free block"):
+            a.decref([b])
+        with pytest.raises(ValueError, match="sink"):
+            a.incref([SINK_BLOCK])
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="block_size"):
+            PagedKVConfig(block_size=0, num_blocks=8)
+        with pytest.raises(ValueError, match="num_blocks"):
+            PagedKVConfig(block_size=4, num_blocks=1)
+        assert PagedKVConfig(4, 8).blocks_per_slot(10) == 3
+
+
+class TestRadixCache:
+    """Property tests over random admit/release schedules against a
+    brute-force reference trie."""
+
+    def _reference_match(self, ref, toks, bs):
+        out = []
+        cap = RadixCache.matchable_blocks(len(toks), bs)
+        for j in range(cap):
+            path = tuple(int(t) for t in toks[: (j + 1) * bs])
+            if path not in ref:
+                break
+            out.append(ref[path])
+        return out
+
+    def test_match_insert_property(self):
+        bs, nblk = 4, P // 4
+        alloc = BlockAllocator(256)
+        radix = RadixCache(alloc, bs)
+        ref: dict[tuple, int] = {}
+        rng = np.random.default_rng(1)
+        families = _prompts(6, shared_prefix_len=4, seed=3)
+        live: list[list[int]] = []
+        for _ in range(200):
+            if live and rng.random() < 0.4:
+                alloc.decref(live.pop(rng.integers(len(live))))
+                continue
+            toks = families[rng.integers(len(families))].copy()
+            if rng.random() < 0.5:  # mutate the tail: partial-prefix hits
+                toks[rng.integers(4, P):] = rng.integers(0, VOCAB)
+            matched = radix.match(toks)
+            assert matched == self._reference_match(ref, toks, bs)
+            priv = alloc.alloc(nblk - len(matched))
+            assert priv is not None
+            row = matched + priv
+            cap = RadixCache.matchable_blocks(len(toks), bs)
+            radix.insert(toks, row[:cap])
+            for j in range(cap):
+                ref[tuple(int(t) for t in toks[: (j + 1) * bs])] = row[j]
+            live.append(row)
+            # Conservation: every usable block is either free or carries
+            # at least one reference.
+            held = sum(
+                1 for b in range(1, alloc.num_blocks) if alloc.refcount(b) > 0
+            )
+            assert alloc.available() + held == alloc.usable
+            # Refcounts equal tree-holds + slot-holds exactly.
+            for b in range(1, alloc.num_blocks):
+                expect = (b in ref.values()) + sum(r.count(b) for r in live)
+                assert alloc.refcount(b) == expect, b
+
+    def test_evict_returns_blocks_and_is_advisory(self):
+        bs = 4
+        alloc = BlockAllocator(32)
+        radix = RadixCache(alloc, bs)
+        # Distinct families: each prompt caches its own first block.
+        prompts = _prompts(5, shared_prefix_len=0, seed=9)
+        for toks in prompts:
+            matched = radix.match(toks)
+            priv = alloc.alloc(P // bs - len(matched))
+            row = matched + priv
+            cap = RadixCache.matchable_blocks(len(toks), bs)
+            radix.insert(toks, row[:cap])
+            alloc.decref(row)  # slot retires immediately
+        cached = radix.cached_blocks
+        assert cached > 0 and alloc.allocated() == cached
+        before = alloc.available()
+        freed = radix.evict(2)
+        assert freed == 2 and alloc.available() == before + 2
+        # Full eviction empties the tree and the pool is whole again.
+        radix.evict(alloc.usable)
+        assert radix.cached_blocks == 0
+        assert alloc.available() == alloc.usable
+        # Advisory: a miss after eviction just means no shared blocks.
+        assert radix.match(prompts[0]) == []
+        assert alloc.alloc(alloc.usable) is not None  # all blocks reusable
+
+    def test_lru_eviction_order(self):
+        bs = 4
+        alloc = BlockAllocator(32)
+        radix = RadixCache(alloc, bs)
+        a = np.arange(P, dtype=np.int32)
+        b = np.arange(P, dtype=np.int32) + 8
+        for toks in (a, b):
+            priv = alloc.alloc(1)
+            radix.insert(toks, priv)
+            alloc.decref(priv)
+        blk_a = radix.match(a)
+        alloc.decref(blk_a)  # touch a: now b is LRU
+        assert radix.evict(1) == 1
+        assert radix.match(a) == [blk_a[0]] and radix.match(b) == []
+        alloc.decref(blk_a)
+
+    def test_pinned_leaves_never_evict(self):
+        bs = 4
+        alloc = BlockAllocator(32)
+        radix = RadixCache(alloc, bs)
+        toks = np.arange(P, dtype=np.int32)
+        priv = alloc.alloc(1)
+        radix.insert(toks, priv)  # slot ref still held (priv not decref'd)
+        assert radix.evict(8) == 0  # pinned by the live slot
+        alloc.decref(priv)
+        assert radix.evict(8) == 1
+
+
+class TestPagedServer:
+    def test_token_exact_greedy_and_ledger(self, model):
+        cfg, params = model
+        prompts = _prompts(10)
+        base, cb, _ = _serve(cfg, params, prompts)
+        paged, cp, sp = _serve(cfg, params, prompts, kv_pages=PAGES)
+        assert set(base) == set(paged)
+        for k in base:
+            np.testing.assert_array_equal(paged[k], base[k], err_msg=str(k))
+        assert cp == cb  # commit ledger byte-identical
+        pc = sp.metrics.cache_summary()
+        assert pc["hits"] > 0 and pc["prefix_tokens_saved"] > 0
+        assert pc["prefill_tokens"] < prompts.size  # measured savings
+
+    def test_token_exact_seeded_sampling(self, model):
+        cfg, params = model
+        prompts = _prompts(8)
+        kw = dict(temperature=0.9, top_k=16, rng=jax.random.key(11))
+        base, cb, _ = _serve(cfg, params, prompts, **kw)
+        paged, cp, _ = _serve(
+            cfg, params, prompts, kv_pages=PAGES,
+            temperature=0.9, top_k=16, rng=jax.random.key(11),
+        )
+        assert set(base) == set(paged)
+        for k in base:
+            np.testing.assert_array_equal(paged[k], base[k], err_msg=str(k))
+        assert cp == cb
+
+    def test_identical_prompts_cap_leaves_suffix(self, model):
+        """A full-duplicate prompt matches at most prompt_len - 1 tokens
+        (the last position must prefill to sample token 0) and still
+        serves token-exact."""
+        cfg, params = model
+        prompts = np.tile(_prompts(1, shared_prefix_len=0), (6, 1))
+        base, cb, _ = _serve(cfg, params, prompts)
+        paged, cp, sp = _serve(cfg, params, prompts, kv_pages=PAGES)
+        for k in base:
+            np.testing.assert_array_equal(paged[k], base[k])
+        assert cp == cb
+        pc = sp.metrics.cache_summary()
+        assert pc["hits"] == 5 and pc["misses"] == 1
+        # 8-token prompts share (P-1)//BS = 1 whole block; every hit still
+        # prefills the remaining P - BS tokens.
+        assert pc["prefix_tokens_saved"] == 5 * BS
+        assert pc["prefill_tokens"] == P + 5 * (P - BS)
+
+    def test_allocator_exhaustion_defers_then_serves_exactly(self, model):
+        """A pool holding ~1.5 slots' worth of blocks: admissions DEFER
+        under pressure (never drop, never deadlock) and the output stays
+        token-exact with the full commit ledger."""
+        cfg, params = model
+        prompts = _prompts(8)
+        base, cb, _ = _serve(cfg, params, prompts)
+        paged, cp, sp = _serve(
+            cfg, params, prompts,
+            kv_pages={"block_size": BS, "num_blocks": 7},
+        )
+        assert set(base) == set(paged)
+        for k in base:
+            np.testing.assert_array_equal(paged[k], base[k], err_msg=str(k))
+        assert cp == cb
+        assert sp.metrics.admission_deferrals.count > 0
+        assert sp.pending_admissions == 0  # backlog fully drained
+
+    def test_pool_too_small_falls_back_cache_off(self, model, caplog):
+        """Graceful cache-off fallback: a pool that cannot hold even one
+        slot serves DENSE (token-exact, full commits) instead of
+        deadlocking, with the fallback counted and logged."""
+        import logging
+
+        caplog.set_level(logging.WARNING, logger="torchkafka_tpu.serve")
+        cfg, params = model
+        prompts = _prompts(6)
+        base, cb, _ = _serve(cfg, params, prompts)
+        paged, cp, sp = _serve(
+            cfg, params, prompts,
+            kv_pages={"block_size": BS, "num_blocks": 3},
+        )
+        for k in base:
+            np.testing.assert_array_equal(paged[k], base[k])
+        assert cp == cb
+        assert sp.metrics.cache_fallbacks.count == 1
+        assert sp._kv_pages is None  # dense build took over
+        assert any("falling back" in r.message for r in caplog.records)
+
+    def test_eviction_under_pressure_stays_exact(self, model):
+        """Distinct prompt families through a pool with little cache
+        headroom: cached prefixes get LRU-evicted to make room and the
+        outputs stay exact — eviction is advisory."""
+        cfg, params = model
+        rng = np.random.default_rng(5)
+        prompts = rng.integers(0, VOCAB, (10, P), dtype=np.int32)  # no overlap
+        base, cb, _ = _serve(cfg, params, prompts)
+        paged, cp, sp = _serve(
+            cfg, params, prompts,
+            # 4 slots x 4 blocks = 16 live worst case; 18 usable blocks
+            # leaves 2 blocks of cache headroom -> eviction pressure.
+            kv_pages={"block_size": BS, "num_blocks": 19},
+        )
+        for k in base:
+            np.testing.assert_array_equal(paged[k], base[k], err_msg=str(k))
+        assert cp == cb
+        assert sp.metrics.cache_evictions.count > 0
+
+    def test_spec_paged_token_exact(self, model):
+        """Speculative serving over the paged pool: same tokens and
+        ledger as the PLAIN dense server (the spec contract composed
+        with the paging contract), acceptance counters live, prefix
+        hits counted."""
+        cfg, params = model
+        prompts = _prompts(8)
+        base, cb, _ = _serve(cfg, params, prompts)
+        spec, cs, ss = _serve(
+            cfg, params, prompts, cls=SpecStreamingGenerator, k=2,
+            kv_pages={"block_size": BS, "num_blocks": 48},
+        )
+        assert set(base) == set(spec)
+        for k in base:
+            np.testing.assert_array_equal(spec[k], base[k], err_msg=str(k))
+        assert cs == cb
+        st = ss.spec_stats()
+        assert st["proposed"] > 0 and st["acceptance"] is not None
+        assert ss.metrics.cache_summary()["hits"] > 0
+
+    def test_metrics_exposition_format(self, model):
+        cfg, params = model
+        prompts = _prompts(6)
+        _, _, sp = _serve(cfg, params, prompts, kv_pages=PAGES)
+        text = sp.metrics.render_prometheus()
+        for name in (
+            "prefix_cache_hits_total", "prefix_cache_misses_total",
+            "prefix_tokens_saved_total", "prefill_tokens_total",
+            "kvcache_evictions_total", "admission_deferrals_total",
+            "kvcache_fallbacks_total", "prefix_cache_hit_rate",
+            "kvcache_pool_occupancy",
+        ):
+            assert f"torchkafka_serve_{name}" in text, name
+        for line in text.strip().split("\n"):
+            if not line.startswith("#"):
+                float(line.rsplit(" ", 1)[1])  # every sample parses
+        s = sp.metrics.summary()["prefix_cache"]
+        assert s["hits"] + s["misses"] == 6
+
+
+class TestStaleTailInvariant:
+    """The serve.py docstring hazard as an asserted invariant: a recycled
+    slot/block never attends over stale positions. Every cache position
+    that is not yet readable (logical position >= the slot's watermark;
+    in paged mode also every block the slot does not own) is overwritten
+    with garbage mid-serve — outputs must be byte-identical to a fresh
+    server's, because each position is written before it first becomes
+    attendable."""
+
+    def _drive(self, cfg, params, server, broker, n, poison):
+        out = {}
+        consumer = server._consumer
+        while len(out) < n:
+            recs = consumer.poll(max_records=server.free_slots(), timeout_ms=0)
+            if recs:
+                server.note_fetched(recs)
+                server.admit_records(recs)
+                poison(server)  # corrupt every not-yet-readable position
+            for rec, toks in server.step():
+                out[(rec.partition, rec.offset)] = np.asarray(toks)
+        server.flush_commits()
+        return out
+
+    def _expected(self, cfg, params, prompts):
+        return np.asarray(
+            generate(params, cfg, jnp.asarray(prompts), MAX_NEW)
+        )
+
+    def test_dense_recycled_slot_ignores_stale_tail(self, model):
+        cfg, params = model
+        prompts = _prompts(6, shared_prefix_len=0)
+        broker = tk.InMemoryBroker()
+        _topic(broker, prompts)
+        consumer = tk.MemoryConsumer(broker, "p", group_id="gs")
+        server = StreamingGenerator(
+            consumer, params, cfg, slots=2, prompt_len=P, max_new=MAX_NEW,
+        )
+
+        def poison(s):
+            pos = jnp.asarray(np.asarray(s._pos))
+            stale = (
+                jnp.arange(s._max_len)[None, :] >= pos[:, None]
+            )[None, :, :, None, None]
+            s._caches = tuple(
+                jnp.where(stale, jnp.float32(1e9), c) for c in s._caches
+            )
+
+        got = self._drive(cfg, params, server, broker, 6, poison)
+        expected = self._expected(cfg, params, prompts)
+        for (part, off), toks in got.items():
+            np.testing.assert_array_equal(
+                toks, expected[2 * off + part], err_msg=f"{part}:{off}"
+            )
+        consumer.close()
+
+    def test_paged_recycled_blocks_ignore_stale_tail(self, model):
+        """Paged: poison EVERY pool position except the live slots' own
+        readable prefix — covering freed blocks re-allocated later, the
+        sink block, and each slot's not-yet-written tail."""
+        cfg, params = model
+        prompts = _prompts(6, shared_prefix_len=0)
+        broker = tk.InMemoryBroker()
+        _topic(broker, prompts)
+        consumer = tk.MemoryConsumer(broker, "p", group_id="gsp")
+        server = StreamingGenerator(
+            consumer, params, cfg, slots=2, prompt_len=P, max_new=MAX_NEW,
+            # No prefix overlap in these prompts: a poisoned CACHED block
+            # would break exactness, so keep sharing out of this test
+            # (the differential suite covers shared prefixes).
+            kv_pages={"block_size": BS, "num_blocks": 12},
+        )
+        assert server._kv_pages is not None
+
+        def poison(s):
+            keep = np.zeros(
+                (s._kv_pages.num_blocks, s._kv_pages.block_size), bool
+            )
+            pos = np.asarray(s._pos)
+            for i in range(s._slots):
+                if not s._active[i]:
+                    continue
+                row = s._table_np[i]
+                for p in range(int(pos[i])):  # readable: [0, pos)
+                    keep[row[p // BS], p % BS] = True
+            stale = jnp.asarray(~keep)[None, :, :, None, None]
+            pk, pv, table = s._caches
+            s._caches = (
+                jnp.where(stale, jnp.float32(1e9), pk),
+                jnp.where(stale, jnp.float32(1e9), pv),
+                table,
+            )
+
+        got = self._drive(cfg, params, server, broker, 6, poison)
+        expected = self._expected(cfg, params, prompts)
+        for (part, off), toks in got.items():
+            np.testing.assert_array_equal(
+                toks, expected[2 * off + part], err_msg=f"{part}:{off}"
+            )
+        consumer.close()
+
+
+class TestFleetChaosDifferential:
+    """Cache-on vs cache-off through a 2-replica fleet with a seeded
+    mid-generation replica kill: the redelivery/replay path must be
+    byte-identical — same completions (duplicates included), same tokens
+    per prompt, same committed offsets at every log end."""
+
+    def _run(self, cfg, params, kv_pages):
+        from torchkafka_tpu.fleet import ReplicaChaos, ServingFleet
+
+        broker = tk.InMemoryBroker()
+        broker.create_topic("t", partitions=4)
+        prompts = _prompts(16, shared_prefix_len=5, seed=21)
+        for i in range(16):
+            broker.produce(
+                "t", prompts[i].tobytes(),
+                key=b"tenant-%d" % (i % 2), partition=i % 4,
+            )
+        fleet = ServingFleet(
+            lambda rid: tk.MemoryConsumer(broker, "t", group_id="gc"),
+            params, cfg, replicas=2, prompt_len=P, max_new=MAX_NEW,
+            slots=2, commit_every=2,
+            gen_kwargs={"kv_pages": kv_pages} if kv_pages else None,
+        )
+        chaos = ReplicaChaos(seed=5, min_completions=2, max_completions=6)
+        outputs: dict = {}
+        order = []
+        for _rid, rec, toks in fleet.serve(idle_timeout_ms=2000, chaos=chaos):
+            key = (rec.partition, rec.offset)
+            order.append(key)
+            outputs.setdefault(key, []).append(np.asarray(toks))
+        committed = {
+            pt: broker.committed("gc", tk.TopicPartition("t", pt))
+            for pt in range(4)
+        }
+        summary = fleet.metrics.summary(fleet.replicas)
+        fleet.close()
+        return outputs, order, committed, chaos.killed, summary
+
+    def test_chaos_replay_token_and_ledger_identical(self, model):
+        cfg, params = model
+        off = self._run(cfg, params, None)
+        on = self._run(cfg, params, PAGES)
+        assert on[3] == off[3] and len(on[3]) == 1  # same seeded kill
+        assert on[1] == off[1]  # same completion order, duplicates included
+        assert set(on[0]) == set(off[0]) and len(on[0]) == 16
+        for key in off[0]:
+            for a, b in zip(on[0][key], off[0][key]):
+                np.testing.assert_array_equal(a, b, err_msg=str(key))
+        assert on[2] == off[2]  # committed watermarks byte-identical
+        # The cache did real work during the chaos run...
+        cache = on[4]["prefix_cache"]
+        assert cache["hits"] > 0 and cache["hit_rate"] > 0
+        # ...and redelivery actually happened (the kill exercised replay).
+        assert any(len(v) > 1 for v in on[0].values()) or (
+            on[4]["duplicates"] == off[4]["duplicates"]
+        )
+
+    def test_fleet_exposition_includes_cache(self, model):
+        from torchkafka_tpu.fleet import ServingFleet
+
+        cfg, params = model
+        broker = tk.InMemoryBroker()
+        broker.create_topic("t", partitions=2)
+        prompts = _prompts(6)
+        for i in range(6):
+            broker.produce("t", prompts[i].tobytes(), partition=i % 2)
+        fleet = ServingFleet(
+            lambda rid: tk.MemoryConsumer(broker, "t", group_id="gf"),
+            params, cfg, replicas=2, prompt_len=P, max_new=MAX_NEW,
+            slots=2, commit_every=2, gen_kwargs={"kv_pages": PAGES},
+        )
+        served = fleet.serve_all(idle_timeout_ms=1500)
+        assert len(served) == 6
+        text = fleet.metrics.render_prometheus(replicas=fleet.replicas)
+        assert "torchkafka_fleet_prefix_cache_hits_total" in text
+        assert "torchkafka_fleet_prefix_cache_hit_rate" in text
+        for line in text.strip().split("\n"):
+            if not line.startswith("#"):
+                float(line.rsplit(" ", 1)[1])
+        s = fleet.metrics.summary(fleet.replicas)
+        assert s["prefix_cache"]["hits"] + s["prefix_cache"]["misses"] == 6
+        fleet.close()

@@ -64,12 +64,35 @@ class FleetMetrics:
     def summary(self, replicas=None) -> dict:
         """``replicas``: the fleet's replica list, for the pooled
         commit-latency percentiles (each replica's generator keeps its own
-        histogram; the fleet view pools the sample windows)."""
+        histogram; the fleet view pools the sample windows) and the
+        aggregated prefix-cache view (each replica owns a PER-REPLICA
+        paged pool + radix tree — kvcache/ — so the fleet hit rate is the
+        count-weighted merge of the per-replica counters)."""
         commit = merge_latency_summaries(
             [r.gen.metrics.commit_latency for r in replicas]
             if replicas else []
         )
+        gens = [r.gen.metrics for r in replicas] if replicas else []
+        hits = sum(m.prefix_hits.count for m in gens)
+        misses = sum(m.prefix_misses.count for m in gens)
+        occ = [m.cache_pool_occupancy.value for m in gens]
+        cache = {
+            "hits": hits,
+            "misses": misses,
+            "hit_rate": (
+                round(hits / (hits + misses), 4) if hits + misses else None
+            ),
+            "prefix_tokens_saved": sum(
+                m.prefix_tokens_saved.count for m in gens
+            ),
+            "prefill_tokens": sum(m.prefill_tokens.count for m in gens),
+            "evictions": sum(m.cache_evictions.count for m in gens),
+            "deferrals": sum(m.admission_deferrals.count for m in gens),
+            "fallbacks": sum(m.cache_fallbacks.count for m in gens),
+            "pool_occupancy": round(sum(occ) / len(occ), 3) if occ else 0.0,
+        }
         return {
+            "prefix_cache": cache,
             "completions": self.completions.count,
             "completions_per_s": round(self.completions.rate(), 1),
             "duplicates": self.duplicates.count,
@@ -107,6 +130,7 @@ class FleetMetrics:
         self, prefix: str = "torchkafka_fleet", replicas=None,
     ) -> str:
         s = self.summary(replicas)
+        pc = s["prefix_cache"]
         return render_exposition(prefix, [
             ("completions_total", "counter", s["completions"]),
             ("duplicate_completions_total", "counter", s["duplicates"]),
@@ -144,4 +168,12 @@ class FleetMetrics:
                 ('percentile="p50"', s["commit"]["p50_ms"]),
                 ('percentile="p99"', s["commit"]["p99_ms"]),
             ]),
+            ("prefix_cache_hits_total", "counter", pc["hits"]),
+            ("prefix_cache_misses_total", "counter", pc["misses"]),
+            ("prefix_tokens_saved_total", "counter", pc["prefix_tokens_saved"]),
+            ("prefill_tokens_total", "counter", pc["prefill_tokens"]),
+            ("kvcache_evictions_total", "counter", pc["evictions"]),
+            ("admission_deferrals_total", "counter", pc["deferrals"]),
+            ("prefix_cache_hit_rate", "gauge", pc["hit_rate"] or 0.0),
+            ("kvcache_pool_occupancy", "gauge", pc["pool_occupancy"]),
         ])

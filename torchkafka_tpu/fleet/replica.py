@@ -132,10 +132,17 @@ class Replica:
             self._poll_into_queue()
             self._backpressure()
             free = self.gen.free_slots()
-            if free:
-                picks = self.queue.select(free)
-                if picks:
-                    self.gen.admit_records(picks)
+            # Paged-pool pressure defers admissions inside the generator
+            # (StreamingGenerator.pending_admissions); deferred records
+            # hold their future slots and re-offer FIRST (per-partition
+            # FIFO), so size new QoS picks by the remainder and keep
+            # offering while a backlog exists — an empty offer just
+            # drains it as blocks free. Always 0 on dense generators.
+            deferred = self.gen.pending_admissions
+            room = free - deferred
+            picks = self.queue.select(room) if room > 0 else []
+            if picks or (deferred and free):
+                self.gen.admit_records(picks)
         completions = self.gen.step()
         if completions:
             self._since_commit += len(completions)

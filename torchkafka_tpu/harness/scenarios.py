@@ -14,6 +14,7 @@ each returning a metrics dict.
 | 9 | ragged text → length-bucketed batches → per-width train steps | none |
 | 10 | serving fleet: QoS admission + graceful drain | none |
 | 11 | chaos soak: broker outage + poison prompt → recovery + DLQ | none |
+| 12 | prefix-cache fleet: per-tenant system prompts, paged KV reuse | none |
 
 Every scenario runs the full transactional loop (poll → transform → batch →
 device → step → barrier → commit) and reports ``records_per_s`` plus commit
@@ -1104,6 +1105,100 @@ def scenario_11(size: str = "tiny", replicas: int = 2) -> dict:
     }
 
 
+def scenario_12(size: str = "tiny", replicas: int = 2) -> dict:
+    """Prefix-cache serving smoke (torchkafka_tpu/kvcache): a
+    DUPLICATE-HEAVY prompt topic — three tenants, each with a fixed
+    system prompt prefix, keyed production routing every tenant to one
+    partition ('alpha'→p2, 'beta'→p3, 'gamma'→p1 of 4 via crc32, the
+    scenario-10 keying idiom) — through a 2-replica fleet whose
+    generators run the PAGED pool with radix prefix reuse
+    (``kv_pages=``). Per replica, only each tenant's FIRST prompt pays a
+    full prefill; every later one links the cached system-prompt blocks
+    and prefills the suffix. The tier-1 guard for the cache-on fleet
+    path: coverage + commit exactness (token-exactness vs cache-off is
+    tests/test_kvcache.py's differential; the throughput/memory story is
+    benchmarks/bench_kvcache.py)."""
+    import time as _time
+
+    import torchkafka_tpu as tk
+    from torchkafka_tpu.fleet import ServingFleet
+    from torchkafka_tpu.source.records import TopicPartition
+
+    prompt_len, max_new = (16, 8) if size == "tiny" else (64, 32)
+    n = 24 if size == "tiny" else 128
+    block = 4 if size == "tiny" else 16
+    sys_len = 3 * block  # tenant system prompt: 3 whole shareable blocks
+    parts = 4
+    cfg, params, label = _serving_model(size, None, prompt_len, max_new)
+    broker = tk.InMemoryBroker()
+    broker.create_topic("t12", partitions=parts)
+    rng = np.random.default_rng(0)
+    tenants = ("alpha", "beta", "gamma")
+    system = {
+        t: rng.integers(0, cfg.vocab_size, sys_len, dtype=np.int32)
+        for t in tenants
+    }
+    produced = []
+    for i in range(n):
+        t = tenants[i % len(tenants)]
+        prompt = np.concatenate([
+            system[t],
+            rng.integers(0, cfg.vocab_size, prompt_len - sys_len,
+                         dtype=np.int32),
+        ])
+        rec = broker.produce("t12", prompt.tobytes(), key=t.encode())
+        produced.append((rec.partition, rec.offset))
+    slots = 4
+    pages = {
+        "block_size": block,
+        # Per-replica pool: all slots' worst case + sink + cache headroom
+        # for the three tenants' system prompts.
+        "num_blocks": slots * -(-(prompt_len + max_new) // block) + 16,
+    }
+    fleet = ServingFleet(
+        lambda rid: tk.MemoryConsumer(broker, "t12", group_id="s12"),
+        params, cfg, replicas=replicas, prompt_len=prompt_len,
+        max_new=max_new, slots=slots, commit_every=4,
+        gen_kwargs={"kv_pages": pages},
+    )
+    fleet.warmup()
+    t0 = _time.perf_counter()
+    served = fleet.serve_all(idle_timeout_ms=2000)
+    elapsed = _time.perf_counter() - t0
+    keys = {(r.partition, r.offset) for _rid, r, _t in served}
+    committed_complete = all(
+        broker.committed("s12", TopicPartition("t12", rec_p))
+        == broker.end_offset(TopicPartition("t12", rec_p))
+        for rec_p in {p for p, _ in produced}
+    )
+    s = fleet.metrics.summary(fleet.replicas)
+    cache = s["prefix_cache"]
+    gens = [rep.gen for rep in fleet.replicas]
+    fleet.close()
+    return {
+        "scenario": "12:prefix-cache-fleet",
+        "model_scale": label,
+        "replicas": replicas,
+        "records": len(served),
+        "elapsed_s": round(elapsed, 3),
+        "records_per_s": round(len(served) / elapsed, 1) if elapsed else None,
+        "coverage_complete": keys == set(produced),
+        "committed_complete": committed_complete,
+        "tenants": len(tenants),
+        "system_prompt_tokens": sys_len,
+        "cache": cache,
+        "prefill_tokens": cache["prefill_tokens"],
+        "prefill_tokens_dense": n * prompt_len,
+        "prefill_savings_pct": round(
+            100 * (1 - cache["prefill_tokens"] / (n * prompt_len)), 1
+        ),
+        "commit_failures": sum(
+            g.metrics.commit_failures.count for g in gens
+        ),
+        "dropped": sum(g.metrics.dropped.count for g in gens),
+    }
+
+
 def scenario_8(size: str = "tiny") -> dict:
     """Streaming CTR: DLRM-style recommender trained from a Kafka event
     stream — label + dense features + hashed categorical ids per record,
@@ -1470,6 +1565,7 @@ SCENARIOS = {
     9: scenario_9,
     10: scenario_10,
     11: scenario_11,
+    12: scenario_12,
 }
 
 
@@ -1510,7 +1606,7 @@ def run_scenario(
         )
     sample_kw = dict(temperature=temperature, top_k=top_k, top_p=top_p)
     spec_kw = dict(spec=spec, spec_k=spec_k, spec_draft_layers=spec_draft_layers)
-    if num in (10, 11):
+    if num in (10, 11, 12):
         return SCENARIOS[num](size, replicas=replicas)
     if model_scale is not None:
         if num not in (5, 7):

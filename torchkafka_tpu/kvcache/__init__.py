@@ -1,0 +1,24 @@
+"""Paged KV-cache pool with radix-tree prefix reuse for the serving path.
+
+Host-side machinery (static device shapes live in ops/kvattn.py and the
+servers): ``BlockAllocator`` — refcounted free-list blocks over a
+``[num_blocks, block_size, K, Dh]`` pool, block 0 reserved as the sink
+for idle-slot writes; ``RadixCache`` — prompt-prefix tree mapping whole
+block runs, LRU-evicting unreferenced leaves (eviction is advisory: a
+miss just re-prefills, token-exactness never depends on the cache);
+``PagedKVConfig`` — the ``StreamingGenerator(kv_pages=...)`` knob.
+"""
+
+from torchkafka_tpu.kvcache.blocks import (
+    SINK_BLOCK,
+    BlockAllocator,
+    PagedKVConfig,
+)
+from torchkafka_tpu.kvcache.radix import RadixCache
+
+__all__ = [
+    "BlockAllocator",
+    "PagedKVConfig",
+    "RadixCache",
+    "SINK_BLOCK",
+]

@@ -1,0 +1,177 @@
+"""Host-side radix tree over prompt token prefixes → physical block runs.
+
+Cross-request prefix reuse for the paged serving pool (SGLang's
+RadixAttention shape, block-granular): prompts streamed from a Kafka
+topic that share a tenant/system-prompt prefix map the SAME physical
+blocks for the shared part and prefill only the uncached suffix.
+
+Granularity is one BLOCK of ``block_size`` tokens per tree edge — only
+whole blocks are shared, so a shared block is always entirely inside
+the matched prefix and is never written again after it is cached
+(decode writes land strictly beyond the prompt; the straddling partial
+block stays private). That is what makes copy-on-write unnecessary.
+
+The match is capped at ``prompt_len - 1`` tokens: admission always
+prefills at least the prompt's final token, because sampling token 0
+needs the last position's logits (the standard full-hit rule).
+
+EVICTION IS ADVISORY: the tree only ever holds blocks alive (one cache
+reference each); evicting an unreferenced leaf frees its block, and the
+only consequence is that a future prompt re-prefills — token-exactness
+NEVER depends on what the cache holds. LRU over leaves, cascading
+upward while parents become unreferenced leaves themselves.
+
+Determinism: no wall clock — the LRU ticks on a monotone counter
+advanced per operation, so the same admission sequence evicts the same
+blocks (the property the chaos-replay differential rests on).
+"""
+
+from __future__ import annotations
+
+from torchkafka_tpu.kvcache.blocks import BlockAllocator
+
+
+class _Node:
+    __slots__ = ("chunk", "block", "children", "parent", "stamp")
+
+    def __init__(self, chunk: tuple, block: int, parent: "_Node | None"):
+        self.chunk = chunk          # the block_size tokens this edge spells
+        self.block = block          # physical block holding their k/v
+        self.children: dict[tuple, _Node] = {}
+        self.parent = parent
+        self.stamp = 0              # LRU tick of the last match/insert touch
+
+
+class RadixCache:
+    """Prefix cache over an allocator's blocks.
+
+    The tree owns ONE reference on every block it maps (taken at
+    ``insert``, dropped at eviction); ``match`` adds a slot reference
+    per returned block, which the server drops via
+    ``allocator.decref`` when the slot retires. ``evict`` frees LRU
+    leaves whose blocks carry no reference beyond the tree's own.
+    """
+
+    def __init__(self, allocator: BlockAllocator, block_size: int) -> None:
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self._alloc = allocator
+        self._bs = block_size
+        self._root = _Node((), -1, None)
+        self._clock = 0
+        self.cached_blocks = 0
+
+    # ------------------------------------------------------------- helpers
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def _chunks(self, tokens, limit_blocks: int):
+        bs = self._bs
+        n = min(limit_blocks, len(tokens) // bs)
+        for j in range(n):
+            yield tuple(int(t) for t in tokens[j * bs:(j + 1) * bs])
+
+    @staticmethod
+    def matchable_blocks(prompt_len: int, block_size: int) -> int:
+        """Whole blocks of a prompt that can ever be shared: the final
+        token is always prefilled (its logits sample token 0), so the
+        shareable prefix is at most ``prompt_len - 1`` tokens."""
+        return max(0, (prompt_len - 1) // block_size)
+
+    # ----------------------------------------------------------------- api
+
+    def match(self, tokens) -> list[int]:
+        """Longest cached whole-block prefix of ``tokens`` (capped at
+        ``matchable_blocks``) → physical block ids in logical order.
+        Takes one SLOT reference per returned block (caller decrefs when
+        the slot retires) and refreshes the path's LRU stamps."""
+        stamp = self._tick()
+        cap = self.matchable_blocks(len(tokens), self._bs)
+        node = self._root
+        out: list[int] = []
+        for chunk in self._chunks(tokens, cap):
+            child = node.children.get(chunk)
+            if child is None:
+                break
+            child.stamp = stamp
+            out.append(child.block)
+            node = child
+        if out:
+            self._alloc.incref(out)
+        return out
+
+    def insert(self, tokens, blocks: list[int]) -> int:
+        """Register ``blocks`` (the slot's table entries for the first
+        ``len(blocks)`` whole blocks of ``tokens``) as cached prefix
+        blocks. Existing nodes are left in place (the slot got those
+        blocks FROM the tree, so the ids must agree); new nodes adopt
+        the slot's private blocks with one cache reference each.
+        Returns the number of blocks newly cached."""
+        stamp = self._tick()
+        node = self._root
+        added = 0
+        for j, chunk in enumerate(self._chunks(tokens, len(blocks))):
+            child = node.children.get(chunk)
+            if child is None:
+                child = _Node(chunk, blocks[j], node)
+                node.children[chunk] = child
+                self._alloc.incref([blocks[j]])
+                self.cached_blocks += 1
+                added += 1
+            elif child.block != blocks[j]:
+                raise AssertionError(
+                    f"radix divergence at depth {j}: cached block "
+                    f"{child.block} vs slot block {blocks[j]} — a slot's "
+                    "table must reuse the tree's block wherever a node "
+                    "exists (match-before-insert contract)"
+                )
+            child.stamp = stamp
+            node = child
+        return added
+
+    # ------------------------------------------------------------ eviction
+
+    def _evictable_leaves(self) -> list[_Node]:
+        out = []
+        stack = [self._root]
+        while stack:
+            node = stack.pop()
+            for child in node.children.values():
+                if child.children:
+                    stack.append(child)
+                elif self._alloc.refcount(child.block) == 1:
+                    out.append(child)  # only the tree's own reference
+        return out
+
+    def evict(self, n_blocks: int) -> int:
+        """Free up to ``n_blocks`` via LRU leaf eviction (cascading: a
+        parent that becomes an unreferenced leaf is immediately
+        eligible). Returns blocks actually freed — fewer than asked is
+        normal when the rest of the tree is pinned by live slots."""
+        freed = 0
+        while freed < n_blocks:
+            leaves = self._evictable_leaves()
+            if not leaves:
+                break
+            victim = min(leaves, key=lambda nd: nd.stamp)
+            while victim is not None and freed < n_blocks:
+                parent = victim.parent
+                assert parent is not None
+                del parent.children[victim.chunk]
+                self._alloc.decref([victim.block])
+                self.cached_blocks -= 1
+                freed += 1
+                # Cascade upward while the parent is itself an
+                # unreferenced leaf (saves a full re-scan per block).
+                victim = (
+                    parent
+                    if (
+                        parent is not self._root
+                        and not parent.children
+                        and self._alloc.refcount(parent.block) == 1
+                    )
+                    else None
+                )
+        return freed

@@ -303,6 +303,77 @@ def int8_decode_attention(
     return out.reshape(b, 1, h, dh)
 
 
+# ------------------------------------------------------- paged (block-table)
+# Block-table attention for the paged slot pool (torchkafka_tpu/kvcache):
+# the cache is a SHARED pool of fixed-size blocks [NB, bs, K, Dh] and each
+# slot maps logical positions to physical blocks through a per-slot block
+# table [B, nblk] — multiple slots may map the same physical prefix blocks
+# (radix-tree sharing), which is what decouples pool bytes from
+# slots × max_context. Static shapes throughout, the XLA discipline: the
+# write is a scatter at (table[pos // bs], pos % bs), the read a gather of
+# each slot's nblk blocks into a contiguous [B, nblk·bs, K, Dh] logical
+# view, masked to the live length. The gather materialises the per-slot
+# view each call (read bytes match the dense pool read); the wins are
+# STORAGE (shared prefixes held once; pool sized to live tokens, not
+# slots × max_len) and PREFILL compute (cached prefixes skip re-prefill).
+
+
+def paged_gather(pool: jax.Array, table: jax.Array) -> jax.Array:
+    """Gather a per-slot logical cache view from a block pool.
+
+    pool: [NB, bs, ...rest]; table: [B, nblk] int32 physical block ids →
+    [B, nblk * bs, ...rest] — logical position p of slot b lands at
+    index p (block table order), so position masks apply unchanged."""
+    b, nblk = table.shape
+    return pool[table].reshape(b, nblk * pool.shape[1], *pool.shape[2:])
+
+
+def paged_scatter(
+    pool: jax.Array, table: jax.Array, positions: jax.Array,
+    values: jax.Array,
+) -> jax.Array:
+    """Write ``values`` [B, S, ...rest] at logical ``positions`` [B, S]
+    through ``table`` [B, nblk] into ``pool`` [NB, bs, ...rest].
+
+    Live slots write only blocks they own privately (sharing is limited
+    to whole blocks strictly below any written position — the radix
+    contract), so no two live slots ever collide. Idle slots' table rows
+    point every entry at the sink block (kvcache.SINK_BLOCK), which no
+    live table references — their unconditional frozen-position writes
+    land there harmlessly (masking the write would cost a pool-sized
+    select per layer; serve._slot_layer_step's lesson)."""
+    bs = pool.shape[1]
+    blk = jnp.take_along_axis(table, positions // bs, axis=1)  # [B, S]
+    off = positions % bs
+    return pool.at[blk, off].set(values.astype(pool.dtype))
+
+
+def block_table_attention(
+    x, q, k_new, v_new, pool_k, pool_v, table, positions, layer, cfg,
+):
+    """One layer of write-then-attend over a paged pool.
+
+    x: [B, S, D]; q/k_new/v_new: [B, S, ·, Dh] (already rope'd);
+    pools: [NB, bs, K, Dh]; table: [B, nblk]; positions: [B, S] the
+    logical positions of the S queries. Writes k/v at ``positions``
+    (write-before-attend, the serving discipline), gathers each slot's
+    logical view, masks per query to [0, positions[b, s]] and runs the
+    shared ``_attend_cached`` tail — the SAME math as the dense slot
+    pool on a gathered operand, so paged serving stays token-comparable
+    with the dense path. Returns (x, pool_k, pool_v)."""
+    from torchkafka_tpu.models.generate import _attend_cached
+
+    pool_k = paged_scatter(pool_k, table, positions, k_new)
+    pool_v = paged_scatter(pool_v, table, positions, v_new)
+    ck = paged_gather(pool_k, table)  # [B, M', K, Dh]
+    cv = paged_gather(pool_v, table)
+    valid = (
+        jnp.arange(ck.shape[1])[None, None, :] <= positions[:, :, None]
+    )  # [B, S, M'] per-query masks, live-length bounded
+    x = _attend_cached(x, q, ck, cv, valid, layer, cfg)
+    return x, pool_k, pool_v
+
+
 # ------------------------------------------------------------------ v3
 # Dynamic-length read: the capability XLA's static shapes cannot express.
 # Every XLA spelling of decode attention (and kernels v1/v2) reads the

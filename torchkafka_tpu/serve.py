@@ -17,6 +17,13 @@ and the decode tick advances all B slots with inactive slots masked out.
 Slot kv-cache rows are recycled without clearing: a freed slot's stale tail
 is overwritten position-by-position before each position becomes readable
 (the decode step writes kv at ``pos`` before attending over ``[0, pos]``).
+That write-before-attend recycling is an ASSERTED invariant, not a hope:
+tests/test_kvcache.py poisons every not-yet-readable cache position after
+a recycled admission and requires byte-identical outputs, on BOTH the
+dense pool and the paged one (``kv_pages=`` — the block-pool +
+radix-prefix-reuse mode, torchkafka_tpu/kvcache, where "stale tail" also
+covers freed blocks re-allocated to other slots and idle slots' writes
+routed to the sink block).
 
 Citations: commit-exactly-what-completed mirrors the reference's
 commit-after-batch contract (/root/reference/src/auto_commit.py:55-58)
@@ -37,6 +44,12 @@ from jax import lax
 
 from torchkafka_tpu.commit.ledger import OffsetLedger
 from torchkafka_tpu.errors import CommitFailedError, OutputDeliveryError
+from torchkafka_tpu.kvcache import (
+    SINK_BLOCK,
+    BlockAllocator,
+    PagedKVConfig,
+    RadixCache,
+)
 from torchkafka_tpu.models.generate import (
     _attend_cached,
     _attn_tail,
@@ -189,6 +202,17 @@ class ServeMetrics:
         self.commit_latency = LatencyHistogram()  # full commit path: output
         # flush + durability waits + offset commit (see _commit docstring)
         self.slot_occupancy = Gauge()  # active slots / pool size, last tick
+        # Paged prefix cache (kv_pages=, torchkafka_tpu/kvcache): all zero
+        # on the dense path.
+        self.prefix_hits = RateMeter()  # admissions that reused cached blocks
+        self.prefix_misses = RateMeter()  # admissions that prefilled in full
+        self.prefix_tokens_saved = RateMeter()  # prompt tokens NOT re-prefilled
+        self.prefill_tokens = RateMeter()  # prompt tokens actually prefilled
+        self.cache_evictions = RateMeter()  # cached blocks LRU-evicted
+        self.admission_deferrals = RateMeter()  # admissions deferred on pool
+        # pressure (records re-offered FIFO once blocks free)
+        self.cache_fallbacks = RateMeter()  # paged → dense cache-off fallbacks
+        self.cache_pool_occupancy = Gauge()  # allocated / usable blocks
 
     def reset(self) -> None:
         """Zero the rate clocks — called at run() start so compile/warmup
@@ -214,6 +238,23 @@ class ServeMetrics:
             "output_send_failures": self.output_send_failures.count,
             "commit": self.commit_latency.summary(),
             "slot_occupancy": round(self.slot_occupancy.value, 3),
+            "prefix_cache": self.cache_summary(),
+        }
+
+    def cache_summary(self) -> dict:
+        hits, misses = self.prefix_hits.count, self.prefix_misses.count
+        return {
+            "hits": hits,
+            "misses": misses,
+            "hit_rate": (
+                round(hits / (hits + misses), 4) if hits + misses else None
+            ),
+            "prefix_tokens_saved": self.prefix_tokens_saved.count,
+            "prefill_tokens": self.prefill_tokens.count,
+            "evictions": self.cache_evictions.count,
+            "deferrals": self.admission_deferrals.count,
+            "fallbacks": self.cache_fallbacks.count,
+            "pool_occupancy": round(self.cache_pool_occupancy.value, 3),
         }
 
     def render_prometheus(self, prefix: str = "torchkafka_serve") -> str:
@@ -222,6 +263,7 @@ class ServeMetrics:
         from torchkafka_tpu.utils.metrics import render_exposition
 
         s = self.summary()
+        pc = s["prefix_cache"]
         return render_exposition(prefix, [
             ("completions_total", "counter", s["completions"]),
             ("tokens_total", "counter", s["tokens"]),
@@ -237,6 +279,15 @@ class ServeMetrics:
             ("completions_per_second", "gauge", s["completions_per_s"]),
             ("tokens_per_second", "gauge", s["tokens_per_s"]),
             ("slot_occupancy", "gauge", s["slot_occupancy"]),
+            ("prefix_cache_hits_total", "counter", pc["hits"]),
+            ("prefix_cache_misses_total", "counter", pc["misses"]),
+            ("prefix_tokens_saved_total", "counter", pc["prefix_tokens_saved"]),
+            ("prefill_tokens_total", "counter", pc["prefill_tokens"]),
+            ("kvcache_evictions_total", "counter", pc["evictions"]),
+            ("admission_deferrals_total", "counter", pc["deferrals"]),
+            ("kvcache_fallbacks_total", "counter", pc["fallbacks"]),
+            ("prefix_cache_hit_rate", "gauge", pc["hit_rate"] or 0.0),
+            ("kvcache_pool_occupancy", "gauge", pc["pool_occupancy"]),
         ])
 
 
@@ -311,6 +362,7 @@ class StreamingGenerator:
         mesh=None,
         kv_dtype: str | None = None,
         kv_kernel: bool | str = "auto",
+        kv_pages: PagedKVConfig | dict | None = None,
     ) -> None:
         """``ticks_per_sync``: decode ticks chained per device dispatch
         (and per host sync of the done mask). Higher amortises dispatch
@@ -385,6 +437,28 @@ class StreamingGenerator:
         with ``OutputDeliveryError`` — the same signal the flush/get path
         gives for terminal delivery failures (ADVICE r3).
 
+        ``kv_pages``: a ``kvcache.PagedKVConfig`` (or its dict) — the
+        PAGED slot pool with radix-tree prefix reuse. The per-slot dense
+        cache is replaced by a shared pool of ``num_blocks`` blocks of
+        ``block_size`` tokens plus per-slot block tables; admission
+        matches each prompt's longest cached whole-block prefix in a
+        host-side radix tree (``kvcache.RadixCache``), links the shared
+        physical blocks into the slot's table, and prefills ONLY the
+        uncached suffix — prompts sharing a tenant/system prefix stop
+        re-prefilling it, and pool bytes follow live tokens instead of
+        slots × max_context. Token-comparable with the dense path (same
+        ``_attend_cached`` math over a gathered view — the cache-on/off
+        differential in tests/test_kvcache.py pins greedy + seeded
+        sampling + chaos-replay exactness); eviction is ADVISORY (a miss
+        just re-prefills). Pool pressure defers admissions (FIFO
+        re-offer once blocks free); a pool too small for even one slot
+        falls back to dense cache-off serving with a warning
+        (``metrics.cache_fallbacks``). Single-device, compute-dtype
+        only (mesh / int8-KV / Pallas-kernel composition validated out),
+        and not MoE (the paged prefill routes experts densely — decode's
+        rule — which would break exactness vs the training-dispatch
+        dense prefill).
+
         ``quarantine``: a ``resilience.PoisonQuarantine``. Without it, an
         undecodable prompt is retired immediately as dropped (the
         original policy — no durable copy). With it, each decode failure
@@ -443,6 +517,35 @@ class StreamingGenerator:
             )
         if kv_kernel is True and kv_dtype != "int8":
             raise ValueError("kv_kernel requires kv_dtype='int8'")
+        if kv_pages is not None:
+            if isinstance(kv_pages, dict):
+                kv_pages = PagedKVConfig(**kv_pages)
+            if kv_dtype is not None:
+                raise ValueError(
+                    "kv_pages serves the compute-dtype pool: int8 paging "
+                    "is not implemented (pick one capacity lever)"
+                )
+            if kv_kernel is True:
+                raise ValueError(
+                    "kv_kernel=True cannot be honored with kv_pages: the "
+                    "paged read is the XLA block-table gather, not the "
+                    "Pallas contiguous-pool kernel"
+                )
+            if mesh is not None:
+                raise ValueError(
+                    "kv_pages is single-device for now: the block-table "
+                    "gather/scatter has no sharded spelling here yet — "
+                    "serve with mesh=None"
+                )
+            if cfg.is_moe:
+                raise ValueError(
+                    "kv_pages does not serve MoE configs: the paged suffix "
+                    "prefill routes experts densely (decode's rule) while "
+                    "the dense prefill uses the training dispatch, which "
+                    "would break the cache-on/off exactness contract"
+                )
+        self._kv_pages = kv_pages
+        self._paged_deferred: list[Record] = []
         self._kv_int8 = kv_dtype == "int8"
         self._kv_kernel_opt = kv_kernel
         self._max_send_failure_streak = max_send_failure_streak
@@ -463,6 +566,9 @@ class StreamingGenerator:
         self._build()
 
     def _build(self) -> None:
+        if self._kv_pages is not None and self._paged_setup():
+            self._build_paged()
+            return
         cfg = self._cfg
         B, P, M = self._slots, self._prompt_len, self._max_len
         nl, kh, dh = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
@@ -719,6 +825,334 @@ class StreamingGenerator:
             self._pos = jax.device_put(self._pos, row)
             self._gen = jax.device_put(self._gen, slot_sharding(mesh, 2))
 
+    # ------------------------------------------------------ paged slot pool
+    #
+    # kv_pages mode (torchkafka_tpu/kvcache): the dense per-slot cache
+    # [L, B, M, K, Dh] becomes a SHARED block pool [L, NB, bs, K, Dh] plus
+    # per-slot block tables [B, nblk]. Device shapes stay fully static (the
+    # XLA discipline); the dynamic part — which physical block backs which
+    # logical position — lives host-side in the allocator/radix pair. The
+    # table rides INSIDE the donated state tuple (returned unchanged by the
+    # tick) so every dispatch signature matches the dense path and
+    # decode_roofline/warmup/step need no special plumbing.
+
+    def _paged_setup(self) -> bool:
+        """Host-side paging state; False = pool too small for even ONE
+        slot's worst case → graceful cache-off fallback (dense build)."""
+        pages = self._kv_pages
+        nblk = pages.blocks_per_slot(self._max_len)
+        if pages.num_blocks - 1 < nblk:
+            _logger.warning(
+                "kv_pages pool (%d usable blocks of %d tokens) cannot hold "
+                "one slot's %d-token worst case (%d blocks); falling back "
+                "to dense cache-off serving",
+                pages.num_blocks - 1, pages.block_size, self._max_len, nblk,
+            )
+            self.metrics.cache_fallbacks.add(1)
+            self._kv_pages = None
+            return False
+        self._blocks_per_slot = nblk
+        self._kv_alloc = BlockAllocator(pages.num_blocks)
+        self._kv_radix = RadixCache(self._kv_alloc, pages.block_size)
+        self._table_np = np.zeros((self._slots, nblk), np.int32)  # all sink
+        self._paged_prefill_jits: dict[int, Callable] = {}
+        return True
+
+    def _build_paged(self) -> None:
+        from torchkafka_tpu.ops.kvattn import block_table_attention
+
+        cfg = self._cfg
+        B, P = self._slots, self._prompt_len
+        bs = self._kv_pages.block_size
+        NB = self._kv_pages.num_blocks
+        nl, kh, dh = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+        temp = self._temperature
+        top_k, top_p = self._top_k, self._top_p
+        self._kv_kernel = False  # the base flag; never engaged here
+
+        def pick(logits, key):
+            return sample_logits(
+                logits, key, temperature=temp, top_k=top_k, top_p=top_p
+            )
+
+        def suffix_prefill(params, pool_k, pool_v, table_row, toks, *, start):
+            """Chunked prefill of ONE slot's uncached prompt suffix.
+
+            toks: [1, S] (S = prompt_len - matched tokens); queries sit at
+            positions [start, start + S) and attend over the cached
+            prefix (gathered from the shared blocks the radix match
+            linked) plus themselves, causally — a miss (start=0) is a
+            plain full prefill. Per-S jit specialisations are cached
+            (at most prompt_len // block_size + 1 of them). Returns the
+            last position's logits (token 0 sampling) + updated pools."""
+            s = toks.shape[1]
+            x = embed_rows(params["embed"], toks, cfg.dtype)  # [1, S, D]
+            positions = (start + jnp.arange(s))[None, :]  # [1, S]
+
+            def body(x, inputs):
+                layer, pk, pv = inputs
+                q, k, v = _project_qkv(x, layer, cfg)
+                q = _rope(q, positions, cfg.rope_theta)
+                k = _rope(k, positions, cfg.rope_theta)
+                x, pk, pv = block_table_attention(
+                    x, q, k, v, pk, pv, table_row, positions, layer, cfg
+                )
+                return x, (pk, pv)
+
+            x, (pool_k, pool_v) = lax.scan(
+                body, x, (params["layers"], pool_k, pool_v)
+            )
+            x = _rms_norm(x, params["ln_f"])
+            logits = jnp.einsum(
+                "bd,dv->bv", x[:, -1],
+                load_weight(params["lm_head"], cfg.dtype),
+                preferred_element_type=jnp.float32,
+            )
+            return logits, pool_k, pool_v
+
+        self._paged_suffix_fn = suffix_prefill
+
+        def admit_merge(last_tok, pos, gen, logits, admit_mask, key):
+            """The dense admit's sampling/bookkeeping tail over host-
+            assembled per-slot logits rows: same [B, V] pick, same key
+            discipline, so cache-on token 0 matches the dense server's."""
+            tok0 = pick(logits, key)
+            last_tok = jnp.where(admit_mask, tok0, last_tok)
+            pos = jnp.where(admit_mask, P, pos)
+            gen = jnp.where(admit_mask[:, None], 0, gen)
+            gen = gen.at[:, 0].set(jnp.where(admit_mask, tok0, gen[:, 0]))
+            return last_tok, pos, gen
+
+        self._paged_merge = jax.jit(admit_merge)
+
+        K = self._ticks_per_sync
+
+        def tick_block(params, caches, last_tok, pos, gen, active_in, key):
+            """The dense tick_block over the paged pool: same K-chained
+            latched-done structure and bookkeeping (see the dense body
+            for the measured rationale); only the cache write/read is the
+            block-table scatter/gather. The table passes through the
+            donated state unchanged. Inactive slots still write their
+            frozen position — their table rows point at the sink block,
+            so the write can never corrupt a block re-allocated to a
+            live slot (kvcache.blocks docstring; pinned by the stale-
+            tail regression in tests/test_kvcache.py)."""
+            pool_k, pool_v, table = caches
+
+            def one(carry, _):
+                pool_k, pool_v, last_tok, pos, gen, done_latch, n_out, key = carry
+                key, sub = jax.random.split(key)
+                act = active_in & ~done_latch
+                x = embed_rows(params["embed"], last_tok, cfg.dtype)[:, None, :]
+
+                def body(x, inputs):
+                    layer, pk, pv = inputs
+                    q, k, v = _project_qkv(x, layer, cfg)
+                    q = _rope(q, pos[:, None], cfg.rope_theta)
+                    k = _rope(k, pos[:, None], cfg.rope_theta)
+                    x, pk, pv = block_table_attention(
+                        x, q, k, v, pk, pv, table, pos[:, None], layer, cfg
+                    )
+                    return x, (pk, pv)
+
+                x, (pool_k, pool_v) = lax.scan(
+                    body, x, (params["layers"], pool_k, pool_v)
+                )
+                x = _rms_norm(x, params["ln_f"])
+                logits = jnp.einsum(
+                    "bd,dv->bv", x[:, 0],
+                    load_weight(params["lm_head"], cfg.dtype),
+                    preferred_element_type=jnp.float32,
+                )
+                tok = pick(logits, sub)
+                t = pos - P  # decode ticks completed before this one
+                idx = jnp.minimum(t + 1, self._max_new - 1)
+                onehot = jnp.arange(self._max_new)[None, :] == idx[:, None]
+                gen = jnp.where(onehot & act[:, None], tok[:, None], gen)
+                hit_eos = (
+                    (tok == self._eos_id) if self._eos_id is not None
+                    else jnp.zeros_like(act)
+                )
+                done_now = act & (hit_eos | (t + 2 >= self._max_new))
+                pos = jnp.where(act & ~done_now, pos + 1, pos)
+                last_tok = jnp.where(act, tok, last_tok)
+                n_out = jnp.where(
+                    done_now, jnp.minimum(t + 2, self._max_new), n_out
+                )
+                done_latch = done_latch | done_now
+                return (
+                    pool_k, pool_v, last_tok, pos, gen, done_latch, n_out,
+                    key,
+                ), None
+
+            done0 = jnp.zeros((B,), bool)
+            n0 = jnp.zeros((B,), jnp.int32)
+            (pool_k, pool_v, last_tok, pos, gen, done, n_out, _), _ = lax.scan(
+                one,
+                (pool_k, pool_v, last_tok, pos, gen, done0, n0, key),
+                None, length=K,
+            )
+            return (pool_k, pool_v, table), last_tok, pos, gen, done, n_out
+
+        _tick = jax.jit(tick_block, donate_argnums=(1,))
+        self._tick_block_raw = tick_block
+        self._tick_fn = lambda *a: _tick(self._params, *a)
+        self._admit_fn = None  # paged admission is host-orchestrated
+        self._caches = (
+            jnp.zeros((nl, NB, bs, kh, dh), cfg.dtype),
+            jnp.zeros((nl, NB, bs, kh, dh), cfg.dtype),
+            jnp.asarray(self._table_np),
+        )
+        self._last_tok = jnp.zeros((B,), jnp.int32)
+        self._pos = jnp.zeros((B,), jnp.int32)
+        self._gen = jnp.zeros((B, self._max_new), jnp.int32)
+
+    def _paged_prefill_call(self, caches, table_row, toks):
+        """Dispatch the per-S-jitted suffix prefill; returns (logits [1, V],
+        caches with the pools rebound). Overridden by the spec server to
+        prefill both model pools."""
+        s = int(toks.shape[1])
+        fn = self._paged_prefill_jits.get(s)
+        if fn is None:
+            fn = jax.jit(
+                functools.partial(
+                    self._paged_suffix_fn, start=self._prompt_len - s
+                ),
+                donate_argnums=(1, 2),
+            )
+            self._paged_prefill_jits[s] = fn
+        logits, pool_k, pool_v = fn(
+            self._params, caches[0], caches[1], table_row, toks
+        )
+        return logits, (pool_k, pool_v) + caches[2:]
+
+    def _paged_set_table(self, caches, table_dev):
+        """Rebind the device block table inside the state tuple (the
+        table's slot in the tuple differs for the spec server)."""
+        return caches[:2] + (table_dev,) + caches[3:]
+
+    def _release_slot_blocks(self, i: int) -> None:
+        """Drop a retired slot's references; its table row falls back to
+        the sink so the tick's frozen-position write lands harmlessly."""
+        row = [int(b) for b in self._table_np[i] if b != SINK_BLOCK]
+        if row:
+            self._kv_alloc.decref(row)
+        self._table_np[i, :] = SINK_BLOCK
+
+    @property
+    def pending_admissions(self) -> int:
+        """Records accepted by ``admit_records`` but deferred on block-pool
+        pressure — they re-offer FIRST (per-partition FIFO) as blocks
+        free. Callers subtract this from ``free_slots()`` when sizing new
+        offers, and keep calling ``admit_records([])`` while it is
+        nonzero so the backlog drains."""
+        return len(self._paged_deferred)
+
+    def _admit_records_paged(self, records: list[Record]) -> int:
+        """Paged admission: per record — radix longest-prefix match, link
+        the shared blocks, allocate private blocks (LRU-evicting
+        unreferenced cached prefixes under pressure), prefill ONLY the
+        uncached suffix, then register the prompt's whole blocks for
+        future reuse. Sequential per record so a duplicate prompt inside
+        one batch hits its predecessor's freshly inserted prefix. Ends
+        with the same [B, V] sampling merge (one RNG split per admitting
+        call) as the dense admit."""
+        phys_free = [i for i in range(self._slots) if not self._active[i]]
+        if len(records) + len(self._paged_deferred) > len(phys_free):
+            raise ValueError(
+                f"offered {len(records)} records with "
+                f"{len(phys_free) - len(self._paged_deferred)} admission "
+                "slots (free slots minus deferred admissions)"
+            )
+        in_flight = self._slots - len(phys_free)
+        was_deferred = len(self._paged_deferred)
+        queue = self._paged_deferred + list(records)
+        self._paged_deferred = []
+        bs = self._kv_pages.block_size
+        nblk = self._blocks_per_slot
+        admit_mask = np.zeros((self._slots,), bool)
+        slot_ids: list[int] = []
+        logits_rows: list = []
+        caches = self._caches
+        for i in phys_free:
+            nxt = self._next_decodable(queue)
+            if nxt is None:
+                break
+            rec, toks = nxt
+            toks = np.asarray(toks, np.int32)
+            matched = self._kv_radix.match(toks)
+            needed = nblk - len(matched)
+            short = needed - self._kv_alloc.available()
+            if short > 0:
+                evicted = self._kv_radix.evict(short)
+                if evicted:
+                    self.metrics.cache_evictions.add(evicted)
+            priv = self._kv_alloc.alloc(needed)
+            if priv is None:
+                # Every free block is pinned by in-flight slots: DEFER.
+                # Blocks free as generations retire; deferred records
+                # re-offer first, keeping per-partition FIFO (the
+                # replay-free-drain invariant). The one-slot worst case
+                # always fits (constructor fallback guards it), so this
+                # is pressure, never deadlock.
+                if matched:
+                    self._kv_alloc.decref(matched)
+                self._paged_deferred.append(rec)
+                self._paged_deferred.extend(queue)
+                queue = []
+                break
+            row = matched + priv
+            self._table_np[i, :] = row
+            start = len(matched) * bs
+            table_row = jnp.asarray(self._table_np[i][None, :])
+            logits, caches = self._paged_prefill_call(
+                caches, table_row, jnp.asarray(toks[None, start:])
+            )
+            # Register the prompt's matchable whole blocks for reuse
+            # (existing nodes are the ones we just matched; new nodes
+            # adopt this slot's freshly prefilled private blocks).
+            cacheable = RadixCache.matchable_blocks(len(toks), bs)
+            self._kv_radix.insert(toks, row[:cacheable])
+            if matched:
+                self.metrics.prefix_hits.add(1)
+                self.metrics.prefix_tokens_saved.add(start)
+            else:
+                self.metrics.prefix_misses.add(1)
+            self.metrics.prefill_tokens.add(len(toks) - start)
+            self._slot_rec[i] = rec
+            admit_mask[i] = True
+            self._active[i] = True
+            slot_ids.append(i)
+            logits_rows.append(logits)
+        if queue:  # defensive: slots exhausted with records left
+            self._paged_deferred.extend(queue)
+        # Count records ENTERING the deferred state, not retry spins: the
+        # run/pump loops re-offer the backlog every quantum under
+        # pressure, which must not inflate the counter.
+        newly_deferred = len(self._paged_deferred) - was_deferred
+        if newly_deferred > 0:
+            self.metrics.admission_deferrals.add(newly_deferred)
+        self.metrics.cache_pool_occupancy.set(self._kv_alloc.occupancy())
+        admitted = int(admit_mask.sum())
+        if admitted:
+            if in_flight > 0:
+                self.metrics.readmissions.add(admitted)
+            caches = self._paged_set_table(
+                caches, jnp.asarray(self._table_np)
+            )
+            logits_b = jnp.zeros(
+                (self._slots, self._cfg.vocab_size), jnp.float32
+            ).at[jnp.asarray(slot_ids)].set(
+                jnp.concatenate(logits_rows, axis=0)
+            )
+            self._rng, sub = jax.random.split(self._rng)
+            self._last_tok, self._pos, self._gen = self._paged_merge(
+                self._last_tok, self._pos, self._gen, logits_b,
+                jnp.asarray(admit_mask), sub,
+            )
+        self._caches = caches
+        return admitted
+
     def decode_roofline(
         self, *, iters: int = 8, windows: int = 3,
         peak_hbm_gbs: float = V5E_PEAK_HBM_GBS, fill: str = "mid",
@@ -908,6 +1342,26 @@ class StreamingGenerator:
         B = self._slots
         none = jnp.zeros((B,), bool)
         key = jax.random.key(0)
+        if self._kv_pages is not None:
+            # Compile the miss-path suffix prefill (S = prompt_len — the
+            # most common specialisation), the sampling merge, and the
+            # tick. All writes land in the sink block (the warmup table
+            # row is all-sink) and the all-False merge admits nothing.
+            table_row = jnp.zeros((1, self._blocks_per_slot), jnp.int32)
+            toks = jnp.zeros((1, self._prompt_len), jnp.int32)
+            _logits, self._caches = self._paged_prefill_call(
+                self._caches, table_row, toks
+            )
+            logits_b = jnp.zeros((B, self._cfg.vocab_size), jnp.float32)
+            self._last_tok, self._pos, self._gen = self._paged_merge(
+                self._last_tok, self._pos, self._gen, logits_b, none, key
+            )
+            out = self._tick_fn(
+                self._caches, self._last_tok, self._pos, self._gen, none, key
+            )
+            self._caches, self._last_tok, self._pos, self._gen = out[:4]
+            jax.device_get(out[4])
+            return
         self._caches, self._last_tok, self._pos, self._gen = self._admit_fn(
             self._caches, self._last_tok, self._pos, self._gen,
             jnp.zeros((B, self._prompt_len), jnp.int32), none, key,
@@ -950,12 +1404,47 @@ class StreamingGenerator:
         on crash. (run() calls this on its own polls.)"""
         self._ledger.fetched_many(records)
 
+    def _next_decodable(self, queue: list[Record]):
+        """Pop ``queue`` until a record decodes; returns (record, tokens)
+        or None when exhausted. Failures follow the poison policy: with a
+        quarantine, each failure spends the record's retry budget (the
+        SAME record re-attempts in place — a transient tokenizer fault
+        heals here) and an exhausted budget dead-letters it (the record
+        is RESOLVED, its offset may retire; a failed DLQ produce raised
+        OutputDeliveryError out of note_failure — fail-stop before any
+        commit could cover the record). Without one, the record retires
+        as dropped (the reference's None-filter analog) — or it would
+        re-deliver and crash the server forever on restart."""
+        while queue:
+            rec = queue.pop(0)
+            while True:
+                try:
+                    return rec, self._decode_prompt(rec)
+                except Exception as exc:
+                    if self._quarantine is not None:
+                        if not self._quarantine.note_failure(rec, exc):
+                            continue  # budget left: re-attempt in place
+                        self.metrics.quarantined.add(1)
+                    else:
+                        _logger.exception(
+                            "dropping undecodable prompt %s@%s:%s",
+                            rec.topic, rec.partition, rec.offset,
+                        )
+                    self._ledger.dropped(rec)
+                    self.metrics.dropped.add(1)
+                    break  # next record
+        return None
+
     def admit_records(self, records: list[Record]) -> int:
         """Prefill-admit ``records`` into free slots; returns the number
-        admitted. Undecodable records are retired as dropped (the
-        reference's None-filter analog) and do not consume a slot. Records
-        must already be ``note_fetched``; the caller must not offer more
-        records than ``free_slots()``."""
+        admitted. Undecodable records are retired as dropped/quarantined
+        (``_next_decodable``) and do not consume a slot. Records must
+        already be ``note_fetched``; the caller must not offer more
+        records than ``free_slots()`` (minus ``pending_admissions`` in
+        paged mode, where pool pressure can also DEFER records — call
+        with an empty list to re-offer the deferred backlog)."""
+        if self._kv_pages is not None:
+            return self._admit_records_paged(records)
         free = [i for i in range(self._slots) if not self._active[i]]
         if len(records) > len(free):
             raise ValueError(
@@ -966,42 +1455,11 @@ class StreamingGenerator:
         admit_mask = np.zeros((self._slots,), bool)
         queue = list(records)
         for i in free:
-            if not queue:
+            nxt = self._next_decodable(queue)
+            if nxt is None:
                 break
-            rec = queue.pop(0)
-            while True:
-                try:
-                    prompts[i] = self._decode_prompt(rec)
-                except Exception as exc:
-                    if self._quarantine is not None:
-                        if not self._quarantine.note_failure(rec, exc):
-                            # Budget left: transient until proven poison —
-                            # re-attempt the SAME record in place.
-                            continue
-                        # Dead-lettered, DLQ produce acknowledged: the
-                        # record is RESOLVED, its offset may retire. (A
-                        # failed DLQ produce raised OutputDeliveryError
-                        # out of note_failure — fail-stop before any
-                        # commit could cover the record.)
-                        self.metrics.quarantined.add(1)
-                    else:
-                        # No quarantine route: retire it (dropped) or it
-                        # would re-deliver and crash the server forever
-                        # on restart.
-                        _logger.exception(
-                            "dropping undecodable prompt %s@%s:%s",
-                            rec.topic, rec.partition, rec.offset,
-                        )
-                    self._ledger.dropped(rec)
-                    self.metrics.dropped.add(1)
-                    if not queue:
-                        rec = None
-                        break
-                    rec = queue.pop(0)
-                    continue
-                break
-            if rec is None:
-                break
+            rec, toks = nxt
+            prompts[i] = toks
             self._slot_rec[i] = rec
             admit_mask[i] = True
             self._active[i] = True
@@ -1051,6 +1509,13 @@ class StreamingGenerator:
                 assert rec is not None
                 self._active[i] = False
                 self._slot_rec[i] = None
+                if self._kv_pages is not None:
+                    # Unpin the slot's blocks: uncached ones return to
+                    # the free list; cached prefix blocks stay alive on
+                    # the radix tree's own reference. The row falls back
+                    # to the sink so this slot's frozen-position tick
+                    # writes can never touch a re-allocated block.
+                    self._release_slot_blocks(i)
                 out = gen_h[i, : n_out_h[i]].copy()
                 self.metrics.completions.add(1)
                 self.metrics.tokens.add(len(out))
@@ -1105,6 +1570,13 @@ class StreamingGenerator:
                     self._ledger.emitted(rec)
                     self._uncommitted += 1
                 completions.append((rec, out))
+            if self._kv_pages is not None:
+                self._caches = self._paged_set_table(
+                    self._caches, jnp.asarray(self._table_np)
+                )
+                self.metrics.cache_pool_occupancy.set(
+                    self._kv_alloc.occupancy()
+                )
             if self._uncommitted >= self._commit_every and self._commit():
                 self._uncommitted = 0
         return completions
@@ -1152,7 +1624,11 @@ class StreamingGenerator:
                 if max_records is not None
                 else B
             )
-            if free and budget and len(pending) < min(free, budget):
+            # Paged-mode deferred admissions hold their future slots (and
+            # re-offer first, FIFO); always 0 on the dense path.
+            deferred = self.pending_admissions
+            take_cap = max(0, min(free - deferred, budget))
+            if take_cap and len(pending) < take_cap:
                 # Never let an empty topic stall in-flight decode ticks:
                 # poll without blocking while anything is generating.
                 records = self._consumer.poll(
@@ -1163,8 +1639,8 @@ class StreamingGenerator:
                     self.note_fetched(records)
                     pending.extend(records)
                     exhausted_at = None
-            if free and pending and budget:
-                take = pending[: min(free, budget)]
+            if (take_cap and pending) or (free and deferred and budget):
+                take = pending[:take_cap]
                 del pending[: len(take)]
                 self.admit_records(take)
             if not self.has_active():

@@ -58,12 +58,16 @@ checkpoint, not a hypothetical point on the i.i.d. curve.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-from torchkafka_tpu.models.generate import KVCache, prefill
+from torchkafka_tpu.models.generate import KVCache, _project_qkv, prefill
+from torchkafka_tpu.models.quant import embed_rows, load_weight
 from torchkafka_tpu.models.spec_decode import _multi_step, truncated_draft
+from torchkafka_tpu.models.transformer import _rms_norm, _rope
 from torchkafka_tpu.serve import StreamingGenerator
 
 
@@ -157,6 +161,12 @@ class SpecStreamingGenerator(StreamingGenerator):
         # for those never-attended stale tails.)
         self._max_len = M = P + max_new + k
         self._kv_kernel = False  # the base flag; never engaged here
+        if self._kv_pages is not None and self._paged_setup():
+            # Paged pools for BOTH models under ONE block table (same
+            # block ids address target and draft tensors), so a radix
+            # prefix hit reuses both models' cached prompt k/v.
+            self._build_paged()
+            return
 
         def admit(params_pair, state, last_tok, pos, gen, prompts,
                   admit_mask, key):
@@ -323,6 +333,232 @@ class SpecStreamingGenerator(StreamingGenerator):
         self._pos = jnp.zeros((B,), jnp.int32)
         self._gen = jnp.zeros((B, max_new), jnp.int32)
 
+    def _build_paged(self) -> None:
+        """Speculative serving over the paged pool (``kv_pages=``).
+
+        Same speculative round as the dense build — k+1 draft steps, one
+        multi-query verify, target-argmax accept — but both models' slot
+        caches are block pools ``[L, NB, bs, K, Dh]`` addressed through
+        ONE per-slot block table, and admission goes through the base
+        class's radix match → link → suffix-prefill path (both pools
+        prefilled per record; a prefix hit skips BOTH models' prompt
+        re-prefill). Verify/rollback respect block boundaries by
+        construction: the verify's [pos, pos + k] writes scatter through
+        the table (a span may straddle blocks — each position resolves
+        its own (block, offset)), the slot's table covers the full
+        P + max_new + k overshoot from admission, and rollback stays pure
+        position bookkeeping — rejected positions become stale entries in
+        blocks the slot still owns, overwritten write-before-attend next
+        round, never blocks another slot could hold. Token-exact vs the
+        dense spec server AND the plain servers (greedy), differential-
+        tested in tests/test_kvcache.py."""
+        from torchkafka_tpu.ops.kvattn import block_table_attention
+
+        cfg, dcfg, k = self._cfg, self._draft_cfg, self._k
+        B, P = self._slots, self._prompt_len
+        max_new = self._max_new
+        eos_id = self._eos_id
+        bs = self._kv_pages.block_size
+        NB = self._kv_pages.num_blocks
+
+        def multi_step_paged(params, mcfg, pool_k, pool_v, table, tokens,
+                             pos_b):
+            """``spec_decode._multi_step`` over a paged pool: S queries at
+            per-row start positions, write-before-attend through the
+            block table, per-query causal masks to the live length."""
+            b, s = tokens.shape
+            x = embed_rows(params["embed"], tokens, mcfg.dtype)
+            positions = pos_b[:, None] + jnp.arange(s)[None, :]  # [B, S]
+
+            def body(x, inputs):
+                layer, pk, pv = inputs
+                q, kk, vv = _project_qkv(x, layer, mcfg)
+                q = _rope(q, positions, mcfg.rope_theta)
+                kk = _rope(kk, positions, mcfg.rope_theta)
+                x, pk, pv = block_table_attention(
+                    x, q, kk, vv, pk, pv, table, positions, layer, mcfg
+                )
+                return x, (pk, pv)
+
+            x, (pool_k, pool_v) = lax.scan(
+                body, x, (params["layers"], pool_k, pool_v)
+            )
+            x = _rms_norm(x, params["ln_f"])
+            logits = jnp.einsum(
+                "bsd,dv->bsv", x, load_weight(params["lm_head"], mcfg.dtype),
+                preferred_element_type=jnp.float32,
+            )
+            return logits, pool_k, pool_v
+
+        def suffix_prefill(params_pair, t_k, t_v, d_k, d_v, table_row, toks,
+                           *, start):
+            """Chunked prompt-suffix prefill of BOTH pools for one slot
+            (the multi-query step at a fixed start IS a suffix prefill);
+            returns the target's last-position logits for token 0."""
+            tparams, dparams = params_pair
+            pos0 = jnp.full((1,), start, jnp.int32)
+            t_logits, t_k, t_v = multi_step_paged(
+                tparams, cfg, t_k, t_v, table_row, toks, pos0
+            )
+            _d, d_k, d_v = multi_step_paged(
+                dparams, dcfg, d_k, d_v, table_row, toks, pos0
+            )
+            return t_logits[:, -1], t_k, t_v, d_k, d_v
+
+        self._paged_suffix_fn = suffix_prefill
+
+        def admit_merge(last_tok, pos, gen, logits, admit_mask, key):
+            """Greedy token 0 from the target's logits — identical to the
+            dense spec admit's tail (speculative serving is greedy-only,
+            so the key goes unused past the shared signature)."""
+            tok0 = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            last_tok = jnp.where(admit_mask, tok0, last_tok)
+            pos = jnp.where(admit_mask, P, pos)
+            gen = jnp.where(admit_mask[:, None], 0, gen)
+            gen = gen.at[:, 0].set(jnp.where(admit_mask, tok0, gen[:, 0]))
+            return last_tok, pos, gen
+
+        self._paged_merge = jax.jit(admit_merge)
+
+        K = self._ticks_per_sync
+
+        def tick_block(params_pair, caches, last_tok, pos, gen, active_in,
+                       key):
+            """The dense spec tick over paged pools (same round structure
+            and accept/emit bookkeeping — see the dense body's comments);
+            the table rides through the donated state unchanged."""
+            tparams, dparams = params_pair
+            t_k, t_v, d_k, d_v, table, acc, prop, rounds = caches
+
+            def one(carry, _):
+                (t_k, t_v, d_k, d_v, acc, prop, rounds, last_tok, pos, gen,
+                 done_latch, n_out) = carry
+                act = active_in & ~done_latch
+
+                def dbody(c, j):
+                    (dk, dv), tok = c
+                    logits, dk, dv = multi_step_paged(
+                        dparams, dcfg, dk, dv, table, tok[:, None], pos + j
+                    )
+                    nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+                    return ((dk, dv), nxt), nxt
+
+                ((d_k, d_v), _), d_toks = lax.scan(
+                    dbody, ((d_k, d_v), last_tok), jnp.arange(k + 1)
+                )
+                d = jnp.transpose(d_toks[:k])  # [B, k]
+
+                v_in = jnp.concatenate([last_tok[:, None], d], axis=1)
+                t_logits, t_k, t_v = multi_step_paged(
+                    tparams, cfg, t_k, t_v, table, v_in, pos
+                )
+                tga = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+
+                match = tga[:, :k] == d
+                n_acc = jnp.sum(
+                    jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1
+                )
+                corr = jnp.take_along_axis(tga, n_acc[:, None], axis=1)[:, 0]
+
+                emitted_before = pos - P + 1
+                rem = max_new - emitted_before
+                idxbuf = jnp.arange(max_new)[None, :]
+                alive = act
+                n_emit = jnp.zeros_like(pos)
+                new_last = last_tok
+                eos_hit = jnp.zeros_like(act)
+                for j in range(k + 1):
+                    tok_j = d[:, j] if j < k else corr
+                    tok_j = jnp.where(j < n_acc, tok_j, corr)
+                    emit = alive & (j <= n_acc) & (j < rem)
+                    sel = (
+                        idxbuf == (emitted_before + j)[:, None]
+                    ) & emit[:, None]
+                    gen = jnp.where(sel, tok_j[:, None], gen)
+                    n_emit = n_emit + emit.astype(jnp.int32)
+                    new_last = jnp.where(emit, tok_j, new_last)
+                    if eos_id is not None:
+                        hit = emit & (tok_j == eos_id)
+                        eos_hit = eos_hit | hit
+                        alive = alive & ~hit
+                emitted_after = emitted_before + n_emit
+                done_now = act & (eos_hit | (emitted_after >= max_new))
+                n_out = jnp.where(done_now, emitted_after, n_out)
+                pos = jnp.where(act & ~done_now, pos + n_emit, pos)
+                last_tok = jnp.where(act, new_last, last_tok)
+
+                n_act = jnp.sum(act.astype(jnp.int32))
+                acc = acc + jnp.sum(jnp.where(act, n_acc, 0))
+                prop = prop + k * n_act
+                rounds = rounds + (n_act > 0).astype(jnp.int32)
+                done_latch = done_latch | done_now
+                return (
+                    t_k, t_v, d_k, d_v, acc, prop, rounds, last_tok, pos,
+                    gen, done_latch, n_out,
+                ), None
+
+            done0 = jnp.zeros((B,), bool)
+            n0 = jnp.zeros((B,), jnp.int32)
+            (t_k, t_v, d_k, d_v, acc, prop, rounds, last_tok, pos, gen,
+             done, n_out), _ = lax.scan(
+                one,
+                (t_k, t_v, d_k, d_v, acc, prop, rounds, last_tok, pos, gen,
+                 done0, n0),
+                None, length=K,
+            )
+            return (
+                (t_k, t_v, d_k, d_v, table, acc, prop, rounds),
+                last_tok, pos, gen, done, n_out,
+            )
+
+        _tick = jax.jit(tick_block, donate_argnums=(1,))
+        self._tick_fn = lambda *a: _tick(
+            (self._params, self._draft_params), *a
+        )
+        self._tick_block_raw = (
+            lambda params, *a: tick_block((params, self._draft_params), *a)
+        )
+        self._admit_fn = None  # paged admission is host-orchestrated
+
+        nl, kh, dh = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+        dl, dkh, ddh = dcfg.n_layers, dcfg.n_kv_heads, dcfg.head_dim
+        self._caches = (
+            jnp.zeros((nl, NB, bs, kh, dh), cfg.dtype),
+            jnp.zeros((nl, NB, bs, kh, dh), cfg.dtype),
+            jnp.zeros((dl, NB, bs, dkh, ddh), dcfg.dtype),
+            jnp.zeros((dl, NB, bs, dkh, ddh), dcfg.dtype),
+            jnp.asarray(self._table_np),
+            # accepted / proposed / rounds — distinct buffers (donated
+            # tuple; one buffer donated thrice is an XLA error).
+            jnp.zeros((), jnp.int32).copy(),
+            jnp.zeros((), jnp.int32).copy(),
+            jnp.zeros((), jnp.int32).copy(),
+        )
+        self._last_tok = jnp.zeros((B,), jnp.int32)
+        self._pos = jnp.zeros((B,), jnp.int32)
+        self._gen = jnp.zeros((B, max_new), jnp.int32)
+
+    def _paged_prefill_call(self, caches, table_row, toks):
+        """Both models' pools prefilled per record; counters/table pass
+        through untouched."""
+        s = int(toks.shape[1])
+        fn = self._paged_prefill_jits.get(s)
+        if fn is None:
+            fn = jax.jit(
+                functools.partial(
+                    self._paged_suffix_fn, start=self._prompt_len - s
+                ),
+                donate_argnums=(1, 2, 3, 4),
+            )
+            self._paged_prefill_jits[s] = fn
+        logits, t_k, t_v, d_k, d_v = fn(
+            (self._params, self._draft_params), *caches[:4], table_row, toks
+        )
+        return logits, (t_k, t_v, d_k, d_v) + caches[4:]
+
+    def _paged_set_table(self, caches, table_dev):
+        return caches[:4] + (table_dev,) + caches[5:]
+
     def spec_stats(self) -> dict:
         """Measured speculation counters since construction (one device
         fetch). ``acceptance`` is the realized α — the workload-dependent
@@ -331,8 +567,10 @@ class SpecStreamingGenerator(StreamingGenerator):
         a ``decode_roofline`` probe DOES run live rounds, so measure α
         from a server that hasn't probed (the harness probes a separate
         instance)."""
+        # Counters are the state tuple's TAIL in both layouts (dense:
+        # pools + 3 counters; paged: pools + table + 3 counters).
         acc, prop, rounds = (
-            int(jax.device_get(x)) for x in self._caches[4:7]
+            int(jax.device_get(x)) for x in self._caches[-3:]
         )
         return {
             "rounds": rounds,
