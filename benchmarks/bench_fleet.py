@@ -16,6 +16,14 @@ cost is device-bound and replicas scale across chips).
 Usage: python benchmarks/bench_fleet.py [--replicas 1,2,4] [--prompts 96]
        [--slices 3]
 Prints one markdown row per replica count plus a JSON line.
+
+``--failover``: the warm-failover differential instead — the SAME seeded
+mid-generation replica kill with the decode journal off (cold replay)
+vs on (warm resume), paired over ``--slices`` rounds. Reported signal:
+tokens re-decoded after the death, cold vs warm — the journal's whole
+value proposition — with byte-exactness vs a no-kill reference ASSERTED
+for every run before its numbers count (a fast failover that changed an
+output would be a bug, not a result).
 """
 
 from __future__ import annotations
@@ -49,11 +57,99 @@ def build(tk, cfg, params, n_prompts: int, replicas: int, vocab: int,
     )
 
 
+def run_failover(tk, cfg, params, args, vocab: int, prompt_len: int,
+                 max_new: int) -> None:
+    import tempfile
+
+    import numpy as np
+
+    from torchkafka_tpu.fleet import ReplicaChaos, ServingFleet
+
+    n, parts = args.prompts, 4
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(0, vocab, (n, prompt_len), dtype=np.int32)
+
+    def fresh_broker():
+        broker = tk.InMemoryBroker()
+        broker.create_topic("bench", partitions=parts)
+        for i in range(n):
+            broker.produce("bench", prompts[i].tobytes(), partition=i % parts)
+        return broker
+
+    def serve(broker, journal_dir, chaos):
+        fleet = ServingFleet(
+            lambda rid: tk.MemoryConsumer(broker, "bench", group_id="b"),
+            params, cfg, replicas=2, prompt_len=prompt_len, max_new=max_new,
+            slots=4, commit_every=10**6,  # kill provably redelivers
+            journal_dir=journal_dir, journal_cadence=args.cadence,
+        )
+        fleet.warmup()
+        got = {}
+        for _rid, rec, toks in fleet.serve(idle_timeout_ms=2000, chaos=chaos):
+            got[(rec.partition, rec.offset)] = toks
+        redecoded = sum(
+            rep.gen.metrics.decoded_tokens.count for rep in fleet.replicas
+        )
+        summary = fleet.metrics.summary(fleet.replicas)["journal"]
+        fleet.close()
+        return got, redecoded, summary
+
+    ref, _, _ = serve(fresh_broker(), None, None)
+
+    def killed_run(warm: bool, seed: int):
+        chaos = ReplicaChaos(seed=seed, min_completions=2, max_completions=5)
+        with tempfile.TemporaryDirectory() as td:
+            got, redecoded, jn = serve(
+                fresh_broker(), td if warm else None, chaos
+            )
+        assert len(chaos.killed) == 1, "the seeded kill never fired"
+        # Exactness gate: a differential between two runs that disagree
+        # on even one token is meaningless — assert before measuring.
+        assert set(got) == set(ref), "coverage broken after kill"
+        for k in ref:
+            np.testing.assert_array_equal(got[k], ref[k], err_msg=str(k))
+        return redecoded, jn
+
+    cold, warm = [], []
+    for s in range(args.slices):
+        seed = 5 + s  # same seed within a pair → identical kill schedule
+        c, _ = killed_run(warm=False, seed=seed)
+        w, jn = killed_run(warm=True, seed=seed)
+        cold.append(c)
+        warm.append(w)
+        assert w < c, (
+            f"slice {s}: warm resume re-decoded {w} tokens vs cold {c} — "
+            "the journal saved nothing"
+        )
+        print(f"slice {s}: re-decoded cold {c} warm {w} "
+              f"(saved {c - w}, restored {jn['tokens_restored']}, "
+              f"journal-served {jn['served_from_journal']})",
+              file=sys.stderr)
+    med_c = float(np.median(cold))
+    med_w = float(np.median(warm))
+    print("| failover | re-decoded tokens (median) | vs cold |")
+    print("|---|---|---|")
+    print(f"| cold replay (journal off) | {med_c:,.0f} | 1.00× |")
+    print(f"| warm resume (cadence {args.cadence}) | {med_w:,.0f} | "
+          f"{med_w / med_c:.2f}× |")
+    print(json.dumps({
+        "prompts": n, "max_new": max_new, "cadence": args.cadence,
+        "slices": args.slices, "cold_redecoded": cold,
+        "warm_redecoded": warm,
+        "median_saved_tokens": med_c - med_w,
+        "exactness": "asserted vs no-kill reference, every run",
+    }), file=sys.stderr)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--replicas", default="1,2,4")
     ap.add_argument("--prompts", type=int, default=96)
     ap.add_argument("--slices", type=int, default=3)
+    ap.add_argument("--failover", action="store_true",
+                    help="paired cold-vs-warm failover differential")
+    ap.add_argument("--cadence", type=int, default=4,
+                    help="--failover: journal token cadence")
     args = ap.parse_args()
     counts = [int(x) for x in args.replicas.split(",")]
 
@@ -75,6 +171,10 @@ def main() -> None:
         d_ff=128, max_seq_len=prompt_len + 16, dtype=jnp.float32,
     )
     params = init_params(jax.random.key(0), cfg)
+
+    if args.failover:
+        run_failover(tk, cfg, params, args, vocab, prompt_len, max_new=16)
+        return
 
     # Warm the jit cache once so slice 0 of the first config doesn't pay
     # compile while the others hit the cache (pairing would be broken).

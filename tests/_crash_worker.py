@@ -1,0 +1,187 @@
+"""Worker process for the crash matrix (test_crash_matrix.py).
+
+Runs as ``python _crash_worker.py <mode> <host> <port> <workdir>``: one
+real process over the parent's ``BrokerServer`` socket, armed from the
+``TORCHKAFKA_CRASHPOINT`` environment variable (``mode="kill"`` →
+SIGKILL, the honest unclean death — no atexit, no flushes). The parent
+asserts the at-least-once invariants against the broker/journal/
+checkpoint state the corpse leaves behind, then runs the SAME mode
+function in-process as the recovery incarnation.
+
+Modes:
+  serve — the full serving loop: group-managed consumer over the prompt
+          topic, decode journal (warm-resumed from any previous
+          incarnation's file), output producer, poison quarantine → DLQ.
+          Covers post_poll, pre_commit, mid_tick, post_dlq_pre_retire
+          and journal_mid_write.
+  ckpt  — the training-shaped commit→checkpoint pairing: poll a chunk,
+          commit its offsets, then StreamCheckpointer.save — resuming
+          from the newest complete checkpoint at startup. Covers
+          post_commit_pre_checkpoint and checkpoint_mid_write.
+
+Importable from test_crash_matrix.py: the mode functions double as the
+parent's no-kill reference and recovery runners (identical logic, same
+model seed), so "recovery serves what the victim abandoned" is the same
+code path, not a test-only reimplementation. All argv parsing and jax
+config mutation happen under the __main__ guard.
+"""
+
+import os
+import sys
+
+P, MAX_NEW, VOCAB, SLOTS = 8, 8, 64, 2
+PROMPT_TOPIC, OUT_TOPIC, DLQ_TOPIC = "t", "out", "dlq"
+GROUP = "crash"
+POISON = b"POISON"
+N_PROMPTS = 8  # healthy prompts; the poison record rides in addition
+PARTS = 2
+JOURNAL_CADENCE = 2
+COMMIT_EVERY = 2
+CKPT_CHUNK = 3
+
+
+def build_model():
+    import jax
+    import jax.numpy as jnp
+
+    from torchkafka_tpu.models.transformer import (
+        TransformerConfig,
+        init_params,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=VOCAB, d_model=32, n_layers=2, n_heads=2, n_kv_heads=1,
+        d_ff=64, max_seq_len=P + MAX_NEW, dtype=jnp.float32,
+    )
+    return cfg, init_params(jax.random.key(0), cfg)
+
+
+def make_decode_prompt():
+    import numpy as np
+
+    def decode(record):
+        if record.value == POISON:
+            raise ValueError("poison prompt")
+        toks = np.frombuffer(record.value, dtype=np.int32)[:P]
+        if toks.shape[0] < P:
+            toks = np.pad(toks, (0, P - toks.shape[0]))
+        return toks
+
+    return decode
+
+
+def prime_topics(broker):
+    """Create and fill the prompt topic (idempotent layout; the parent
+    calls this once). Prompt i → partition i % PARTS, key = i as ascii;
+    the poison record lands after the healthy ones on partition 0."""
+    import numpy as np
+
+    broker.create_topic(PROMPT_TOPIC, partitions=PARTS)
+    broker.create_topic(OUT_TOPIC, partitions=1)
+    broker.create_topic(DLQ_TOPIC, partitions=1)
+    rng = np.random.default_rng(7)
+    prompts = rng.integers(0, VOCAB, (N_PROMPTS, P), dtype=np.int32)
+    for i in range(N_PROMPTS):
+        broker.produce(
+            PROMPT_TOPIC, prompts[i].tobytes(), partition=i % PARTS,
+            key=str(i).encode(),
+        )
+    broker.produce(PROMPT_TOPIC, POISON, partition=0, key=b"poison")
+    return prompts
+
+
+def run_serve(broker, workdir: str) -> None:
+    """One serving incarnation over ``broker`` (InMemoryBroker or
+    BrokerClient — duck-typed alike). Warm-resumes from the journal file
+    a previous incarnation left in ``workdir``."""
+    import torchkafka_tpu as tk
+    from torchkafka_tpu.journal import DecodeJournal
+    from torchkafka_tpu.resilience import PoisonQuarantine
+    from torchkafka_tpu.serve import StreamingGenerator
+
+    cfg, params = build_model()
+    jpath = os.path.join(workdir, "journal.json")
+    hints = DecodeJournal.load(jpath)  # before the new journal's 1st flush
+    consumer = tk.MemoryConsumer(broker, PROMPT_TOPIC, group_id=GROUP)
+    producer = tk.MemoryProducer(broker)
+    server = StreamingGenerator(
+        consumer, params, cfg, slots=SLOTS, prompt_len=P, max_new=MAX_NEW,
+        commit_every=COMMIT_EVERY, ticks_per_sync=1,
+        # Small polls: post_poll must ARRIVE repeatedly (one non-empty
+        # poll would leave its 2nd-arrival arming unreachable).
+        max_poll_records=SLOTS,
+        decode_prompt=make_decode_prompt(),
+        output_producer=producer, output_topic=OUT_TOPIC,
+        quarantine=PoisonQuarantine(
+            producer, DLQ_TOPIC, budget=1, timeout_s=5.0
+        ),
+        journal=DecodeJournal(jpath, cadence=JOURNAL_CADENCE),
+    )
+    if hints:
+        server.add_resume_hints(hints)
+    for _rec, _toks in server.run(idle_timeout_ms=400):
+        pass
+    server.close()
+    consumer.close()
+
+
+def run_ckpt(broker, workdir: str) -> None:
+    """One training-shaped incarnation: resume from the newest complete
+    checkpoint, then chunks of poll → commit → save. The commit-then-
+    save ordering is the window post_commit_pre_checkpoint pins."""
+    import numpy as np
+
+    import torchkafka_tpu as tk
+    from torchkafka_tpu.checkpoint.manager import StreamCheckpointer
+    from torchkafka_tpu.source.records import TopicPartition
+
+    ckptr = StreamCheckpointer(os.path.join(workdir, "ckpts"), keep=16)
+    consumer = tk.MemoryConsumer(broker, PROMPT_TOPIC, group_id="ckpt")
+    consumer.assignment()  # join + sync before the resume-seek
+    state = {"folded": np.zeros((), np.int64)}
+    step = 0
+    if ckptr.latest_step() is not None:
+        state, step = ckptr.resume(consumer, template=state)
+        step += 1
+    offsets: dict = {}
+    while True:
+        records = consumer.poll(max_records=CKPT_CHUNK, timeout_ms=300)
+        if not records:
+            break
+        state = {"folded": state["folded"] + len(records)}
+        for r in records:
+            tp = TopicPartition(r.topic, r.partition)
+            offsets[tp] = max(offsets.get(tp, 0), r.offset + 1)
+        consumer.commit(offsets)
+        ckptr.save(step, state, offsets)
+        step += 1
+    consumer.close()
+
+
+def main() -> int:
+    mode, host, port, workdir = (
+        sys.argv[1], sys.argv[2], int(sys.argv[3]), sys.argv[4]
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from torchkafka_tpu.resilience.crashpoint import arm_from_env
+
+    arm_from_env()
+    import torchkafka_tpu as tk
+
+    client = tk.BrokerClient(host, port)
+    try:
+        if mode == "serve":
+            run_serve(client, workdir)
+        elif mode == "ckpt":
+            run_ckpt(client, workdir)
+        else:
+            raise ValueError(f"unknown mode {mode!r}")
+    finally:
+        client.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

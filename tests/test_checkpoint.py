@@ -300,3 +300,80 @@ class TestKillAndResume:
         )
         ck.resume(consumer)  # must not raise
         assert consumer.position(TopicPartition("t", 0)) == 1
+
+
+class TestTornWriteHardening:
+    """Satellite of the crash matrix (ISSUE 5): in-process torn-save
+    injection — the stack-intact twin of the subprocess
+    ``checkpoint_mid_write`` kill — plus disk-full during the offsets
+    write. Both must degrade to "newest complete step wins", with
+    ``resume`` still seeking correctly."""
+
+    def test_crashpoint_mid_write_falls_back_and_heals(self, tmp_path):
+        """A death between the payload write and the atomic rename leaves
+        a .tmp step that restore(step=None) must skip; the next save of
+        the SAME step heals (clears the torn tmp and commits)."""
+        from torchkafka_tpu.resilience import crashpoint
+        from torchkafka_tpu.resilience.crashpoint import CrashPointInjected
+
+        ck = StreamCheckpointer(tmp_path / "ck")
+        tp = TopicPartition("t", 0)
+        ck.save(1, _state(1), {tp: 10})
+        crashpoint.arm("checkpoint_mid_write", mode="raise")
+        try:
+            with pytest.raises(CrashPointInjected):
+                ck.save(2, _state(2), {tp: 20})
+        finally:
+            crashpoint.disarm()
+        assert os.path.isdir(tmp_path / "ck" / "2.tmp")  # the torn step
+        assert ck.steps() == [1]
+        _, offsets, step = ck.restore(step=None)
+        assert step == 1 and offsets == {tp: 10}
+        ck.save(2, _state(2), {tp: 20})  # heals: tmp cleared, commit lands
+        assert ck.steps() == [1, 2]
+        _, offsets, step = ck.restore(step=None)
+        assert step == 2 and offsets == {tp: 20}
+
+    def test_enospc_during_offsets_write_falls_back(
+        self, tmp_path, broker, monkeypatch
+    ):
+        """Disk-full mid offsets write: a PARTIAL offsets file inside the
+        tmp dir, no rename. restore(step=None) falls back to the newest
+        complete step and resume seeks the consumer to ITS watermark."""
+        import errno
+
+        ck = StreamCheckpointer(tmp_path / "ck")
+        tp = TopicPartition("t", 0)
+        ck.save(1, _state(1), {tp: 4})
+
+        real_write = StreamCheckpointer._write_offsets
+
+        def torn_write(self, tmp, pid, multi, step, offsets):
+            # Half the bytes land, then the device is full.
+            real_write(self, tmp, pid, multi, step, offsets)
+            f = os.path.join(tmp, "stream_offsets.json")
+            data = open(f, "rb").read()
+            with open(f, "wb") as fh:
+                fh.write(data[: len(data) // 2])
+            raise OSError(errno.ENOSPC, "No space left on device")
+
+        monkeypatch.setattr(StreamCheckpointer, "_write_offsets", torn_write)
+        with pytest.raises(OSError, match="No space left"):
+            ck.save(2, _state(2), {tp: 12})
+        monkeypatch.undo()
+
+        assert ck.steps() == [1]
+        _, offsets, step = ck.restore(step=None)
+        assert step == 1 and offsets == {tp: 4}
+
+        # resume still seeks correctly: the consumer lands on the COMPLETE
+        # checkpoint's watermark, not the lost one, replaying the gap.
+        broker.create_topic("t", partitions=1)
+        for i in range(16):
+            broker.produce("t", np.full(1, i, np.int32).tobytes())
+        consumer = tk.MemoryConsumer(broker, "t", group_id="g", assignment=[tp])
+        consumer.commit({tp: 12})  # the group ran ahead of the checkpoint
+        _, step = ck.resume(consumer)
+        assert step == 1
+        first = consumer.poll(max_records=1, timeout_ms=100)[0]
+        assert first.offset == 4

@@ -1,0 +1,287 @@
+"""The crash matrix: a REAL subprocess SIGKILLed at every registered
+crash point, with the at-least-once invariants asserted at each one.
+
+Each case: the parent hosts the broker (``BrokerServer`` over an
+``InMemoryBroker``), spawns ``_crash_worker.py`` with
+``TORCHKAFKA_CRASHPOINT=<point>:<at>:kill:<marker>``, and waits for the
+corpse. The child writes the marker file atomically just before
+``os.kill(SIGKILL)``, so the parent can prove the death happened AT the
+armed point (a child that exited for any other reason fails the test).
+Then the parent audits the state the death left behind, runs the SAME
+worker logic in-process as the recovery incarnation, and audits again:
+
+- commit ledger: the committed watermark NEVER covers a prompt without a
+  durable completion (or DLQ copy) — loss is impossible, duplicates are
+  bounded and byte-identical;
+- DLQ/watermark discipline: a poison record's offset retires only after
+  its DLQ copy is durable; redelivery re-quarantines idempotently;
+- journal: a torn journal write is invisible (recovery parses the
+  previous complete file) and partial generations warm-resume to
+  byte-identical completions;
+- checkpoint: a torn checkpoint step is invisible (restore falls back to
+  the newest complete step) and commit-then-crash-before-save resumes by
+  seeking BACK to the checkpoint watermark.
+
+Completeness is enforced: a crash point present in
+``REGISTERED_CRASH_POINTS`` but absent from the matrix fails the suite
+(``test_matrix_covers_every_registered_point``). The full matrix is
+``chaos`` + ``slow`` (run it with ``-m chaos``); one representative
+serve-mode and ckpt-mode death stay in tier-1.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import torchkafka_tpu as tk
+from torchkafka_tpu.checkpoint.manager import StreamCheckpointer
+from torchkafka_tpu.journal import DecodeJournal
+from torchkafka_tpu.resilience.crashpoint import REGISTERED_CRASH_POINTS
+from torchkafka_tpu.source.records import TopicPartition
+
+from tests import _crash_worker as W
+
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "_crash_worker.py")
+
+# point -> (worker mode, Nth arrival to kill at). The arrival counts are
+# chosen so the death lands mid-stream: some work committed, some in
+# flight, some not yet fetched.
+MATRIX: dict[str, tuple[str, int]] = {
+    "post_poll": ("serve", 2),
+    "pre_commit": ("serve", 2),
+    "mid_tick": ("serve", 6),
+    "post_dlq_pre_retire": ("serve", 1),
+    "journal_mid_write": ("serve", 3),
+    "post_commit_pre_checkpoint": ("ckpt", 2),
+    "checkpoint_mid_write": ("ckpt", 2),
+}
+
+# The tier-1 representative subset: one mid-serve death (commit path) and
+# one mid-checkpoint death (torn save). Everything else is chaos+slow.
+TIER1 = ("pre_commit", "checkpoint_mid_write")
+
+
+def _spawn(mode: str, port: int, workdir: str, point: str, at: int):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # the child configures CPU itself
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    marker = os.path.join(workdir, "marker")
+    env["TORCHKAFKA_CRASHPOINT"] = f"{point}:{at}:kill:{marker}"
+    log = open(os.path.join(workdir, "child.log"), "wb")
+    proc = subprocess.Popen(
+        [sys.executable, WORKER, mode, "localhost", str(port), workdir],
+        env=env, stdout=log, stderr=subprocess.STDOUT,
+    )
+    log.close()
+    return proc, marker
+
+
+def _reap_group(broker, group_id: str) -> None:
+    # The in-memory broker has no session timeout; evicting the corpse's
+    # membership here is exactly what Kafka's session.timeout.ms reaper
+    # does to a SIGKILLed client — without it the dead member would own
+    # its partitions forever and recovery could never be assigned them.
+    grp = broker._groups.get(group_id)
+    for member in list(grp.members) if grp else ():
+        broker.leave(group_id, member)
+
+
+def _outputs_by_key(broker):
+    """Output-topic records grouped by prompt key → list of token arrays."""
+    tp = TopicPartition(W.OUT_TOPIC, 0)
+    out: dict[bytes, list] = {}
+    for rec in broker.fetch(tp, 0, 100000):
+        out.setdefault(rec.key, []).append(
+            np.frombuffer(rec.value, dtype=np.int32)
+        )
+    return out
+
+
+def _committed(broker, group=W.GROUP):
+    return {
+        p: broker.committed(group, TopicPartition(W.PROMPT_TOPIC, p)) or 0
+        for p in range(W.PARTS)
+    }
+
+
+@pytest.fixture(scope="module")
+def reference(tmp_path_factory):
+    """The no-kill run: key → completion tokens (the poison key gets
+    dead-lettered, so it has no entry)."""
+    broker = tk.InMemoryBroker()
+    W.prime_topics(broker)
+    W.run_serve(broker, str(tmp_path_factory.mktemp("crash-ref")))
+    outs = _outputs_by_key(broker)
+    assert set(outs) == {str(i).encode() for i in range(W.N_PROMPTS)}
+    return {k: v[0] for k, v in outs.items()}
+
+
+def _run_serve_case(tmp_path, reference, point: str, at: int):
+    broker = tk.InMemoryBroker()
+    W.prime_topics(broker)
+    workdir = str(tmp_path / point)
+    os.makedirs(workdir, exist_ok=True)
+    with tk.BrokerServer(broker) as server:
+        proc, marker = _spawn("serve", server.port, workdir, point, at)
+        proc.wait(timeout=180)
+    with open(os.path.join(workdir, "child.log"), "rb") as f:
+        log = f.read().decode(errors="replace")
+    assert proc.returncode == -signal.SIGKILL, (
+        f"worker exited {proc.returncode}, not SIGKILL — the armed point "
+        f"{point!r} was never reached?\n{log}"
+    )
+    with open(marker) as f:
+        assert f.read().strip() == f"{point}:{at}"
+    _reap_group(broker, W.GROUP)
+
+    # ---- invariants at the moment of death --------------------------------
+    committed = _committed(broker)
+    outs = _outputs_by_key(broker)
+    dlq = broker.fetch(TopicPartition(W.DLQ_TOPIC, 0), 0, 1000)
+    poison_tp, poison_off = 0, W.N_PROMPTS // W.PARTS
+    for p, wm in committed.items():
+        end = broker.end_offset(TopicPartition(W.PROMPT_TOPIC, p))
+        assert wm <= end
+        for off in range(wm):
+            # Every committed offset is covered by durable output (or, for
+            # the poison record, a durable DLQ copy): commit-past-loss is
+            # the invariant every crash point must preserve.
+            if (p, off) == (poison_tp, poison_off):
+                assert dlq, "poison offset committed with no DLQ copy"
+                continue
+            key = str(off * W.PARTS + p).encode()
+            assert key in outs, (
+                f"committed {p}:{off} (prompt {key}) has no durable output"
+            )
+    # The journal the corpse left is parseable — a torn tmp write is
+    # invisible (journal_mid_write kills INSIDE the tmp write to pin it).
+    jpath = os.path.join(workdir, "journal.json")
+    journal_entries = DecodeJournal.load(jpath)
+    if point == "journal_mid_write":
+        assert os.path.exists(jpath + ".tmp"), "expected the torn tmp"
+
+    # ---- recovery: same worker logic, in-process --------------------------
+    W.run_serve(broker, workdir)
+
+    outs = _outputs_by_key(broker)
+    assert set(outs) == set(reference), (
+        "lost completions after recovery: "
+        f"{set(reference) ^ set(outs)}"
+    )
+    for key, copies in outs.items():
+        for c in copies:  # duplicates allowed, divergence not
+            np.testing.assert_array_equal(c, reference[key], err_msg=str(key))
+    dlq = broker.fetch(TopicPartition(W.DLQ_TOPIC, 0), 0, 1000)
+    assert len(dlq) >= 1  # quarantined at least once (maybe re-quarantined)
+    assert all(r.value == W.POISON for r in dlq)
+    assert b"poison" not in outs  # never served as a completion
+    final = _committed(broker)
+    for p in range(W.PARTS):
+        assert final[p] == broker.end_offset(
+            TopicPartition(W.PROMPT_TOPIC, p)
+        ), f"partition {p} not fully committed after recovery"
+    return journal_entries
+
+
+def _run_ckpt_case(tmp_path, point: str, at: int):
+    broker = tk.InMemoryBroker()
+    W.prime_topics(broker)
+    workdir = str(tmp_path / point)
+    os.makedirs(workdir, exist_ok=True)
+    with tk.BrokerServer(broker) as server:
+        proc, marker = _spawn("ckpt", server.port, workdir, point, at)
+        proc.wait(timeout=180)
+    with open(os.path.join(workdir, "child.log"), "rb") as f:
+        log = f.read().decode(errors="replace")
+    assert proc.returncode == -signal.SIGKILL, (
+        f"worker exited {proc.returncode}; point {point!r} never reached?"
+        f"\n{log}"
+    )
+    with open(marker) as f:
+        assert f.read().strip() == f"{point}:{at}"
+    _reap_group(broker, "ckpt")
+
+    root = os.path.join(workdir, "ckpts")
+    ckptr = StreamCheckpointer(root, keep=16)
+    committed = _committed(broker, group="ckpt")
+
+    # ---- invariants at the moment of death --------------------------------
+    # The first chunk's save (step 0) completed before the armed second
+    # arrival killed the child, so restore MUST fall back to it — the torn
+    # or missing step is invisible.
+    steps = ckptr.steps()
+    assert steps, "no complete checkpoint survived the death"
+    state, offsets, step = ckptr.restore(step=None)
+    assert step == steps[-1]
+    if point == "checkpoint_mid_write":
+        # Payload + offsets written, rename pending: the torn step must be
+        # on disk as .tmp and excluded from steps().
+        torn = [d for d in os.listdir(root) if d.endswith(".tmp")]
+        assert torn, "expected a torn .tmp step dir"
+        assert int(torn[0].split(".")[0]) not in steps
+    for tp, off in offsets.items():
+        # The checkpoint is never AHEAD of the commit log (commit happens
+        # first); resume seeks BACK to the checkpoint — re-consume, never
+        # lose.
+        assert off <= committed[tp.partition], (tp, off, committed)
+    if point == "post_commit_pre_checkpoint":
+        # The defining window: the second commit landed, its save did not.
+        assert sum(committed.values()) > sum(offsets.values())
+
+    # ---- recovery: same worker logic, in-process --------------------------
+    W.run_ckpt(broker, workdir)
+    final_state, final_offsets, final_step = ckptr.restore(step=None)
+    assert final_step > step
+    for tp, off in final_offsets.items():
+        assert off == broker.end_offset(tp), (tp, off)
+    # Folded counts are at-least-once: every record folded >= 1 time
+    # across incarnations; the recovery's resume-seek re-consumed the
+    # commit/checkpoint gap rather than skipping it.
+    assert int(final_state["folded"]) >= (
+        sum(final_offsets.values()) - sum(offsets.values())
+    )
+
+
+FULL_POINTS = [p for p in MATRIX if p not in TIER1]
+
+
+class TestCrashMatrix:
+    def test_matrix_covers_every_registered_point(self):
+        """Registry-vs-matrix completeness: registering a crash point
+        without adding a subprocess kill for it fails the suite."""
+        assert set(MATRIX) == set(REGISTERED_CRASH_POINTS), (
+            "crash points registered but not matrix-covered: "
+            f"{set(REGISTERED_CRASH_POINTS) - set(MATRIX)}; "
+            "matrix entries no longer registered: "
+            f"{set(MATRIX) - set(REGISTERED_CRASH_POINTS)}"
+        )
+        assert all(p in MATRIX for p in TIER1)
+
+    @pytest.mark.chaos
+    @pytest.mark.parametrize("point", TIER1)
+    def test_crash_point_tier1(self, tmp_path, reference, point):
+        """The tier-1 representative deaths: one mid-serve (outputs
+        durable, offsets not yet committed), one mid-checkpoint (torn
+        step dir)."""
+        mode, at = MATRIX[point]
+        if mode == "serve":
+            _run_serve_case(tmp_path, reference, point, at)
+        else:
+            _run_ckpt_case(tmp_path, point, at)
+
+    @pytest.mark.chaos
+    @pytest.mark.slow
+    @pytest.mark.parametrize("point", FULL_POINTS)
+    def test_crash_point_full(self, tmp_path, reference, point):
+        """The rest of the matrix (run with ``-m chaos``)."""
+        mode, at = MATRIX[point]
+        if mode == "serve":
+            _run_serve_case(tmp_path, reference, point, at)
+        else:
+            _run_ckpt_case(tmp_path, point, at)

@@ -152,3 +152,29 @@ def test_sampling_flag_scoping():
         run_scenario(5, "tiny", temperature=0.5)
     with pytest.raises(ValueError, match="greedy-only"):
         run_scenario(7, "tiny", spec=True, top_k=4)
+
+
+def test_scenario_13_warm_failover_smoke():
+    """The tier-1 warm-failover smoke: a seeded mid-generation replica
+    kill through a journaled 2-replica fleet. The survivor consults the
+    victim's on-disk journal — warm resumes and journal-served
+    completions both nonzero — and the fleet's output is byte-identical
+    to the no-kill reference with full coverage and complete commits
+    (the cadence/mode matrix lives in tests/test_journal.py, the
+    subprocess deaths in tests/test_crash_matrix.py)."""
+    out = run_scenario(13, "tiny")
+    assert out["scenario"] == "13:warm-failover"
+    assert out["replicas"] == 2
+    assert len(out["killed"]) == 1 and out["replica_deaths"] == 1
+    assert out["coverage_complete"] is True
+    assert out["committed_complete"] is True
+    assert out["identical_to_no_kill"] is True
+    assert out["duplicates_identical"] is True
+    assert out["journal_handoffs"] > 0
+    # The journal provably drove the recovery: partial generations warm-
+    # resumed (restoring real tokens) and finished-uncommitted ones
+    # re-served with zero re-decode.
+    assert out["warm_resumes"] > 0
+    assert out["tokens_restored"] > 0
+    assert out["served_from_journal"] > 0
+    assert out["resume_rejected"] == 0

@@ -1,0 +1,637 @@
+"""Decode journal + warm failover (torchkafka_tpu/journal) and the
+crash-point registry (torchkafka_tpu/resilience/crashpoint).
+
+Pins the four properties the warm-failover story stands on:
+
+1. **Durability discipline**: the journal's tmp-fsync-rename write makes a
+   torn write invisible (the previous complete journal survives a death
+   inside the tmp write), a corrupt file degrades to cold replay, and
+   ``close()`` is idempotent under a second shutdown signal.
+2. **Token-exactness** (the headline differential): a seeded mid-generation
+   kill with the journal on — at cadence 1, 4, and 16 — produces final
+   completions and commit ledgers byte-identical to the no-kill run, for
+   greedy, seeded sampling, speculative serving, and ``kv_pages`` on/off.
+3. **Warm beats cold, measurably**: the resuming server re-decodes fewer
+   tokens than a cold replay of the same death (metrics-asserted, both
+   dense and paged).
+4. **Journal GC bound**: after any commit flush, the journal never holds
+   entries below the committed watermark — its size is bounded by in-flight
+   work, property-tested against a brute-force reference.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import torchkafka_tpu as tk
+from torchkafka_tpu.journal import DecodeJournal, JournalEntry, value_crc
+from torchkafka_tpu.models.transformer import TransformerConfig, init_params
+from torchkafka_tpu.resilience import crashpoint
+from torchkafka_tpu.resilience.crashpoint import (
+    REGISTERED_CRASH_POINTS,
+    CrashPointInjected,
+)
+from torchkafka_tpu.serve import StreamingGenerator
+from torchkafka_tpu.serve_spec import SpecStreamingGenerator
+from torchkafka_tpu.source.records import Record, TopicPartition
+
+P, MAX_NEW, VOCAB = 8, 16, 64
+SLOTS = 2
+PARTS = 2
+PAGES = {
+    "block_size": 4,
+    "num_blocks": SLOTS * -(-(P + MAX_NEW) // 4) + 9,  # + sink + headroom
+}
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = TransformerConfig(
+        vocab_size=VOCAB, d_model=32, n_layers=2, n_heads=2, n_kv_heads=1,
+        d_ff=64, max_seq_len=P + MAX_NEW, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+def _rec(off: int, part: int = 0, value: bytes = b"v") -> Record:
+    return Record(topic="t", partition=part, offset=off, value=value)
+
+
+def _produce(broker, n, topic="p", seed=7):
+    broker.create_topic(topic, partitions=PARTS)
+    rng = np.random.default_rng(seed)
+    prompts = rng.integers(0, VOCAB, (n, P), dtype=np.int32)
+    for i in range(n):
+        broker.produce(topic, prompts[i].tobytes(), partition=i % PARTS)
+    return prompts
+
+
+def _watermarks(broker, group):
+    return {
+        p: broker.committed(group, TopicPartition("p", p)) or 0
+        for p in range(PARTS)
+    }
+
+
+def _reference(model, n, cls=StreamingGenerator, **kw):
+    """The no-kill run: completions by (partition, offset) + final
+    committed watermark."""
+    cfg, params = model
+    broker = tk.InMemoryBroker()
+    _produce(broker, n)
+    consumer = tk.MemoryConsumer(broker, "p", group_id="ref")
+    server = cls(
+        consumer, params, cfg, slots=SLOTS, prompt_len=P, max_new=MAX_NEW,
+        commit_every=4, **kw,
+    )
+    got = {
+        (r.partition, r.offset): t for r, t in server.run(max_records=n)
+    }
+    server.close()
+    return got, _watermarks(broker, "ref")
+
+
+def _kill_run(
+    model, n, jpath, cadence, kill_steps, cls=StreamingGenerator,
+    warm=True, **kw,
+):
+    """Seeded mid-generation death: a first server decodes ``kill_steps``
+    ticks with a journal at ``cadence``, then dies WITHOUT committing or
+    flushing (disk truth = last cadence write). A second server —
+    hinted from the on-disk journal when ``warm`` — serves everything.
+    Returns (completions, watermark, re-decoded tokens, metrics)."""
+    cfg, params = model
+    broker = tk.InMemoryBroker()
+    _produce(broker, n)
+    skw = dict(
+        slots=SLOTS, prompt_len=P, max_new=MAX_NEW, ticks_per_sync=1, **kw
+    )
+    c1 = tk.MemoryConsumer(broker, "p", group_id="g")
+    gen1 = cls(
+        c1, params, cfg, commit_every=2**31 - 1,
+        journal=DecodeJournal(jpath, cadence=cadence), **skw,
+    )
+    got: dict = {}
+
+    def _absorb(completions):
+        for rec, toks in completions:
+            key = (rec.partition, rec.offset)
+            if key in got:  # a duplicate must be byte-identical
+                np.testing.assert_array_equal(got[key], toks, err_msg=str(key))
+            got[key] = toks
+
+    records = c1.poll(max_records=SLOTS, timeout_ms=100)
+    gen1.note_fetched(records)
+    gen1.admit_records(records[: gen1.free_slots()])
+    assert gen1.has_active()
+    for _ in range(kill_steps):
+        _absorb(gen1.step())
+    # The death: no close(), no commit, no final journal flush — the
+    # journal file holds whatever the cadence writes left behind.
+    c1.close()
+
+    c2 = tk.MemoryConsumer(broker, "p", group_id="g")
+    gen2 = cls(c2, params, cfg, commit_every=4, **skw)
+    if warm:
+        gen2.add_resume_hints(DecodeJournal.load(jpath))
+    _absorb(gen2.run(max_records=n))
+    redecoded = gen2.metrics.decoded_tokens.count
+    metrics = gen2.metrics
+    gen2.close()
+    return got, _watermarks(broker, "g"), redecoded, metrics
+
+
+# --------------------------------------------------------------------------
+# 1. Journal durability / persistence unit tier
+# --------------------------------------------------------------------------
+
+
+class TestDecodeJournal:
+    def test_roundtrip_record_progress_finish(self, tmp_path):
+        path = str(tmp_path / "j.json")
+        j = DecodeJournal(path, cadence=4)
+        rec = _rec(3, part=1, value=b"prompt")
+        j.record(rec, np.array([1, 2], np.uint32), temperature=0.9,
+                 top_k=8, top_p=0.95)
+        j.progress(rec, [5, 6, 7])
+        j.flush()
+        loaded = DecodeJournal.load(path)
+        e = loaded[("t", 1, 3)]
+        assert e.tokens == (5, 6, 7) and not e.finished
+        assert e.key_data == (1, 2)
+        assert (e.temperature, e.top_k, e.top_p) == (0.9, 8, 0.95)
+        assert e.crc == value_crc(b"prompt")
+        j.finish(rec, [5, 6, 7, 9])
+        j.flush()
+        e = DecodeJournal.load(path)[("t", 1, 3)]
+        assert e.finished and e.tokens == (5, 6, 7, 9)
+
+    def test_progress_without_record_is_noop(self, tmp_path):
+        j = DecodeJournal(str(tmp_path / "j.json"))
+        j.progress(_rec(0), [1])
+        j.finish(_rec(0), [1])
+        j.flush()
+        assert DecodeJournal.load(j.path) == {}
+
+    def test_torn_write_leaves_previous_journal_visible(self, tmp_path):
+        """A death inside the tmp write (journal_mid_write) must leave the
+        PREVIOUS complete journal as the disk truth — the torn tmp is
+        invisible to load()."""
+        path = str(tmp_path / "j.json")
+        j = DecodeJournal(path, cadence=1)
+        j.record(_rec(0), None, tokens=(1, 2))
+        j.flush()
+        before = DecodeJournal.load(path)
+        j.record(_rec(1), None, tokens=(3,))
+        crashpoint.arm("journal_mid_write", mode="raise")
+        try:
+            with pytest.raises(CrashPointInjected):
+                j.flush()
+        finally:
+            crashpoint.disarm()
+        assert os.path.exists(path + ".tmp")  # the torn artifact
+        assert DecodeJournal.load(path) == before
+        # Recovery-side write heals: the next flush completes normally.
+        j.flush()
+        assert set(DecodeJournal.load(path)) == {("t", 0, 0), ("t", 0, 1)}
+
+    def test_corrupt_file_degrades_to_cold_replay(self, tmp_path, caplog):
+        path = str(tmp_path / "j.json")
+        with open(path, "w") as f:
+            f.write('{"version": 1, "entr')
+        with caplog.at_level("WARNING"):
+            assert DecodeJournal.load(path) == {}
+        assert "cold-replay" in caplog.text
+        assert DecodeJournal.load(str(tmp_path / "missing.json")) == {}
+
+    def test_close_is_idempotent_and_syncs(self, tmp_path):
+        """The SIGTERM drain contract: close() flushes; a second signal
+        hitting close()/sync() again is a no-op, not a crash."""
+        path = str(tmp_path / "j.json")
+        j = DecodeJournal(path, cadence=8)
+        j.record(_rec(0), None, tokens=(1,))
+        j.close()
+        assert ("t", 0, 0) in DecodeJournal.load(path)
+        j.close()  # second signal
+        j.sync()  # sync after close: tolerated no-op
+        assert ("t", 0, 0) in DecodeJournal.load(path)
+
+    def test_cadence_validation(self, tmp_path):
+        with pytest.raises(ValueError, match="cadence"):
+            DecodeJournal(str(tmp_path / "j.json"), cadence=0)
+
+    def test_prune_gc_property_vs_bruteforce(self, tmp_path):
+        """Journal GC bound, property-tested: drive a random schedule of
+        admits / progress / finishes / commit-prunes over a virtual slot
+        pool. After EVERY prune+flush, the on-disk journal holds exactly
+        the not-yet-committed records (brute-force reference) — never
+        history — so its size is bounded by in-flight work."""
+        rng = np.random.default_rng(0)
+        path = str(tmp_path / "j.json")
+        j = DecodeJournal(path, cadence=2)
+        slots = 4
+        live: dict[int, Record] = {}  # slot -> record (the virtual pool)
+        reference: dict[tuple, bool] = {}  # key -> finished
+        committed = {TopicPartition("t", 0): 0}
+        next_off = 0
+        finished_uncommitted: list[Record] = []
+        for _ in range(300):
+            op = rng.integers(4)
+            if op == 0 and len(live) < slots:  # admit
+                rec = _rec(next_off, value=bytes([next_off % 256]))
+                next_off += 1
+                live[min(set(range(slots)) - set(live))] = rec
+                j.record(rec, (1,), tokens=(0,))
+                reference[(rec.topic, rec.partition, rec.offset)] = False
+            elif op == 1 and live:  # progress
+                slot = list(live)[rng.integers(len(live))]
+                j.progress(live[slot], list(range(int(rng.integers(1, 9)))))
+            elif op == 2 and live:  # finish (stays until committed)
+                slot = list(live)[rng.integers(len(live))]
+                rec = live.pop(slot)
+                j.finish(rec, [1, 2, 3])
+                reference[(rec.topic, rec.partition, rec.offset)] = True
+                finished_uncommitted.append(rec)
+            else:  # commit flush: watermark = contiguous finished prefix
+                wm = committed[TopicPartition("t", 0)]
+                done = {r.offset for r in finished_uncommitted}
+                while wm in done:
+                    wm += 1
+                committed[TopicPartition("t", 0)] = wm
+                j.prune(committed)
+                j.flush()
+                on_disk = DecodeJournal.load(path)
+                expect = {
+                    k for k in reference if k[2] >= wm
+                }
+                assert set(on_disk) == expect
+                # The bound: nothing but in-flight + finished-uncommitted.
+                assert len(on_disk) <= slots + len(
+                    [r for r in finished_uncommitted if r.offset >= wm]
+                )
+        assert j.stats.pruned > 0  # the schedule actually exercised GC
+
+
+# --------------------------------------------------------------------------
+# 2. Crash-point registry unit tier
+# --------------------------------------------------------------------------
+
+
+class TestCrashPoints:
+    def teardown_method(self):
+        crashpoint.disarm()
+
+    def test_fires_at_nth_arrival_only(self):
+        crashpoint.arm("pre_commit", at=3, mode="raise")
+        crashpoint.crash_hook("pre_commit")
+        crashpoint.crash_hook("post_poll")  # other points are free
+        crashpoint.crash_hook("pre_commit")
+        with pytest.raises(CrashPointInjected, match="pre_commit"):
+            crashpoint.crash_hook("pre_commit")
+        # Deterministic single shot: arrival N+1 does not re-fire.
+        crashpoint.crash_hook("pre_commit")
+
+    def test_registry_is_closed(self):
+        with pytest.raises(ValueError, match="unknown crash point"):
+            crashpoint.arm("not_a_point")
+        with pytest.raises(ValueError, match="unregistered"):
+            crashpoint.crash_hook("not_a_point")
+        crashpoint.arm("mid_tick")
+        with pytest.raises(ValueError, match="unregistered"):
+            crashpoint.crash_hook("not_a_point")
+
+    def test_arm_validation(self):
+        with pytest.raises(ValueError, match="at must be"):
+            crashpoint.arm("mid_tick", at=0)
+        with pytest.raises(ValueError, match="mode"):
+            crashpoint.arm("mid_tick", mode="explode")
+
+    def test_arm_from_env_and_marker(self, tmp_path):
+        marker = str(tmp_path / "marker")
+        assert not crashpoint.arm_from_env({})
+        assert crashpoint.arm_from_env({
+            crashpoint.ENV_VAR: f"post_poll:2:raise:{marker}"
+        })
+        assert crashpoint.armed_point() == "post_poll"
+        crashpoint.crash_hook("post_poll")
+        with pytest.raises(CrashPointInjected):
+            crashpoint.crash_hook("post_poll")
+        with open(marker) as f:
+            assert f.read().strip() == "post_poll:2"
+        with pytest.raises(ValueError, match="point:at:mode"):
+            crashpoint.arm_from_env({crashpoint.ENV_VAR: "pre_commit"})
+
+    def test_registry_contents_are_stable(self):
+        """The registry the crash matrix must cover — renaming/removing a
+        point is an API change that must show up here too."""
+        assert set(REGISTERED_CRASH_POINTS) == {
+            "post_poll", "pre_commit", "post_commit_pre_checkpoint",
+            "mid_tick", "post_dlq_pre_retire", "journal_mid_write",
+            "checkpoint_mid_write",
+        }
+
+
+# --------------------------------------------------------------------------
+# 3. Warm-failover differentials (the headline)
+# --------------------------------------------------------------------------
+
+
+class TestWarmFailoverDifferential:
+    N = 6
+    KILL_STEPS = 5  # mid-generation: < MAX_NEW ticks at ticks_per_sync=1
+
+    def _differential(self, model, jpath, cadence, cls=StreamingGenerator,
+                      **kw):
+        ref, ref_wm = _reference(model, self.N, cls=cls, **kw)
+        got, wm, redecoded, metrics = _kill_run(
+            model, self.N, jpath, cadence, self.KILL_STEPS, cls=cls, **kw,
+        )
+        assert set(got) == set(ref)
+        for key in ref:
+            np.testing.assert_array_equal(got[key], ref[key], err_msg=str(key))
+        assert wm == ref_wm
+        return redecoded, metrics
+
+    @pytest.mark.parametrize("cadence", [1, 4, 16])
+    def test_greedy_dense_token_exact_at_cadence(self, model, tmp_path, cadence):
+        """Kill at cadence boundaries 1/4/16: byte-identical completions
+        and commit ledger vs the no-kill run. Cadence 16 > MAX_NEW leaves
+        only admit-time entries — partials cold-replay, still exact."""
+        redecoded, metrics = self._differential(
+            model, str(tmp_path / "j.json"), cadence,
+        )
+        if cadence < MAX_NEW:
+            assert metrics.warm_resumes.count > 0
+
+    def test_seeded_sampling_token_exact(self, model, tmp_path):
+        """Per-(record, token) keys make sampled warm resume replay the
+        identical draw sequence on the resuming server."""
+        _, metrics = self._differential(
+            model, str(tmp_path / "j.json"), 4,
+            temperature=0.9, top_k=16, rng=jax.random.key(11),
+        )
+        assert metrics.warm_resumes.count > 0
+
+    def test_greedy_paged_token_exact(self, model, tmp_path):
+        """kv_pages on: the resume prefill rides the radix/suffix path."""
+        _, metrics = self._differential(
+            model, str(tmp_path / "j.json"), 4, kv_pages=PAGES,
+        )
+        assert metrics.warm_resumes.count > 0
+
+    def test_sampled_paged_token_exact(self, model, tmp_path):
+        self._differential(
+            model, str(tmp_path / "j.json"), 4, kv_pages=PAGES,
+            temperature=0.7, top_p=0.9, rng=jax.random.key(5),
+        )
+
+    def test_spec_serving_token_exact(self, model, tmp_path):
+        """Speculative serving (greedy-only): resume restores both models'
+        cache rows; accept/rollback continues token-exact."""
+        _, metrics = self._differential(
+            model, str(tmp_path / "j.json"), 4,
+            cls=SpecStreamingGenerator, k=2,
+        )
+        assert metrics.warm_resumes.count > 0
+
+    def test_spec_paged_token_exact(self, model, tmp_path):
+        self._differential(
+            model, str(tmp_path / "j.json"), 4,
+            cls=SpecStreamingGenerator, k=2, kv_pages=PAGES,
+        )
+
+    @pytest.mark.parametrize("pages", [None, PAGES],
+                             ids=["dense", "kv_pages"])
+    def test_warm_redecodes_fewer_tokens_than_cold(
+        self, model, tmp_path, pages
+    ):
+        """The acceptance differential: same seeded death, journal hints
+        on vs off — both runs byte-identical to the no-kill reference,
+        and the warm survivor measurably re-decodes fewer tokens."""
+        kw = {"kv_pages": pages} if pages else {}
+        ref, ref_wm = _reference(model, self.N, **kw)
+
+        def run(warm):
+            got, wm, redecoded, metrics = _kill_run(
+                model, self.N, str(tmp_path / f"j-{warm}.json"), 2,
+                self.KILL_STEPS, warm=warm, **kw,
+            )
+            assert set(got) == set(ref) and wm == ref_wm
+            for key in ref:
+                np.testing.assert_array_equal(
+                    got[key], ref[key], err_msg=str(key)
+                )
+            return redecoded, metrics
+
+        cold_redecoded, cold_m = run(warm=False)
+        warm_redecoded, warm_m = run(warm=True)
+        assert warm_m.journal_tokens_restored.count > 0
+        assert cold_m.journal_tokens_restored.count == 0
+        assert warm_redecoded < cold_redecoded, (
+            f"warm resume re-decoded {warm_redecoded} tokens, cold replay "
+            f"{cold_redecoded} — the journal saved nothing"
+        )
+
+    def test_finished_uncommitted_serves_from_journal(self, model, tmp_path):
+        """A generation that FINISHED on the victim but never committed
+        re-serves from the journal with zero re-decode on the survivor."""
+        got, wm, _, metrics = _kill_run(
+            model, self.N, str(tmp_path / "j.json"), 1, MAX_NEW + 2,
+        )
+        ref, ref_wm = _reference(model, self.N)
+        assert metrics.journal_served.count > 0
+        assert set(got) == set(ref) and wm == ref_wm
+        for key in ref:
+            np.testing.assert_array_equal(got[key], ref[key], err_msg=str(key))
+
+    def test_crc_mismatch_rejects_hint(self, model, tmp_path):
+        """A hint whose payload CRC does not match the redelivered record
+        is discarded (cold replay), never applied — topic recreation with
+        colliding offsets cannot corrupt a resume."""
+        cfg, params = model
+        broker = tk.InMemoryBroker()
+        _produce(broker, 2)
+        consumer = tk.MemoryConsumer(broker, "p", group_id="crc")
+        server = StreamingGenerator(
+            consumer, params, cfg, slots=SLOTS, prompt_len=P,
+            max_new=MAX_NEW, commit_every=4,
+        )
+        bogus = JournalEntry(
+            topic="p", partition=0, offset=0, crc=0xDEADBEEF,
+            key_data=None, temperature=0.0, top_k=None, top_p=None,
+            tokens=(1, 2, 3), finished=False,
+        )
+        server.add_resume_hints({bogus.key: bogus})
+        ref, _ = _reference(model, 2)
+        got = {
+            (r.partition, r.offset): t for r, t in server.run(max_records=2)
+        }
+        assert server.metrics.resume_rejected.count == 1
+        assert server.metrics.warm_resumes.count == 0
+        for key in ref:
+            np.testing.assert_array_equal(got[key], ref[key], err_msg=str(key))
+        server.close()
+
+
+# --------------------------------------------------------------------------
+# 4. Fleet drain: journal sync + drain-timeout escalation
+# --------------------------------------------------------------------------
+
+
+class TestFleetDrainJournal:
+    def _fleet(self, broker, model, jdir, **kw):
+        from torchkafka_tpu.fleet import ServingFleet
+        cfg, params = model
+        kw.setdefault("replicas", 2)
+        kw.setdefault("slots", SLOTS)
+        group = kw.pop("group_id", "fj")
+        return ServingFleet(
+            lambda rid: tk.MemoryConsumer(broker, "p", group_id=group),
+            params, cfg, prompt_len=P, max_new=MAX_NEW,
+            journal_dir=jdir, **kw,
+        )
+
+    def test_clean_drain_prunes_and_syncs_journals(self, model, tmp_path):
+        """A graceful drain commits everything it finished, so the synced
+        journals end EMPTY (GC pruned) — and the files are valid JSON on
+        disk, not torn tmps."""
+        broker = tk.InMemoryBroker()
+        _produce(broker, 8)
+        jdir = str(tmp_path / "journals")
+        fleet = self._fleet(broker, model, jdir, commit_every=4)
+        served = 0
+        for _rid, _rec, _t in fleet.serve(idle_timeout_ms=1500):
+            served += 1
+            if served == 3:
+                fleet.drain()
+        assert all(rep.state == "done" for rep in fleet.replicas)
+        for rid in range(2):
+            path = os.path.join(jdir, f"replica_{rid}.json")
+            assert os.path.exists(path)
+            assert not os.path.exists(path + ".tmp")
+            with open(path) as f:
+                doc = json.load(f)
+            assert doc["entries"] == []
+
+    def test_sigterm_drain_syncs_journal_and_second_close_is_noop(
+        self, model, tmp_path
+    ):
+        """The SIGTERM drain path (the existing ShutdownSignal machinery):
+        the journal is flushed+fsynced before the replicas leave, and the
+        close() a SECOND signal races in during teardown is an idempotent
+        no-op — no double commit, no exception, journal still valid."""
+        import signal as _sig
+
+        broker = tk.InMemoryBroker()
+        _produce(broker, 8)
+        jdir = str(tmp_path / "journals")
+        fleet = self._fleet(broker, model, jdir, commit_every=4,
+                            group_id="sig")
+        served = 0
+        with tk.ShutdownSignal() as stop:
+            for _rid, _rec, _t in fleet.serve(
+                idle_timeout_ms=1500, shutdown=stop,
+            ):
+                served += 1
+                if served == 3:
+                    _sig.raise_signal(_sig.SIGTERM)
+        assert all(rep.state == "done" for rep in fleet.replicas)
+        committed = {
+            p: broker.committed("sig", TopicPartition("p", p)) or 0
+            for p in range(PARTS)
+        }
+        for rid in range(2):
+            assert DecodeJournal.load(
+                os.path.join(jdir, f"replica_{rid}.json")
+            ) == {}  # synced and fully pruned by the drain commit
+        # The second-signal race: close() lands again on every layer.
+        for rep in fleet.replicas:
+            rep.close()
+            rep.gen.close()
+            rep.gen.close()
+            rep.gen.sync_journal()
+        assert {
+            p: broker.committed("sig", TopicPartition("p", p)) or 0
+            for p in range(PARTS)
+        } == committed  # nothing re-committed through a closed consumer
+
+    def test_drain_timeout_kills_then_next_fleet_resumes_warm(
+        self, model, tmp_path
+    ):
+        """drain_timeout_s overrun: the overrunning replicas' journals are
+        synced, the replicas killed, and a NEXT fleet over the same
+        journal_dir warm-resumes the abandoned in-flight work — coverage
+        complete, completions byte-identical to a no-kill run."""
+        ref, _ = _reference(model, 8)
+        broker = tk.InMemoryBroker()
+        _produce(broker, 8)
+        jdir = str(tmp_path / "journals")
+        fleet1 = self._fleet(
+            broker, model, jdir, commit_every=100, group_id="dt",
+            drain_timeout_s=0.0, journal_cadence=1,
+        )
+        got: dict = {}
+        for _rid, rec, toks in fleet1.serve(idle_timeout_ms=1500):
+            got[(rec.partition, rec.offset)] = toks
+            if len(got) == 2:
+                fleet1.drain()  # timeout 0: next loop iteration escalates
+        assert fleet1.metrics.drain_timeout_kills.count >= 1
+        assert any(rep.state == "dead" for rep in fleet1.replicas)
+
+        fleet2 = self._fleet(
+            broker, model, jdir, commit_every=4, group_id="dt",
+            journal_cadence=1,
+        )
+        for _rid, rec, toks in fleet2.serve(idle_timeout_ms=1500):
+            key = (rec.partition, rec.offset)
+            if key in got:
+                np.testing.assert_array_equal(got[key], toks, err_msg=str(key))
+            got[key] = toks
+        fleet2.close()
+        s = fleet2.metrics.summary(fleet2.replicas)
+        assert (
+            s["journal"]["warm_resumes"] + s["journal"]["served_from_journal"]
+        ) > 0, "the carried-over journals never produced a warm resume"
+        assert set(got) == set(ref)
+        for key in ref:
+            np.testing.assert_array_equal(got[key], ref[key], err_msg=str(key))
+
+    def test_killed_replica_hands_hints_to_survivor(self, model, tmp_path):
+        """kill_replica consults the victim's on-disk journal: the
+        survivor warm-resumes the redelivered prompts (journal metrics),
+        and the fleet's output stays byte-identical to the no-kill run."""
+        ref, _ = _reference(model, 8)
+        broker = tk.InMemoryBroker()
+        _produce(broker, 8)
+        fleet = self._fleet(
+            broker, model, str(tmp_path / "j"), commit_every=100,
+            group_id="kh", journal_cadence=1,
+        )
+        got: dict = {}
+        killed = False
+        for _rid, rec, toks in fleet.serve(idle_timeout_ms=1500):
+            key = (rec.partition, rec.offset)
+            if key in got:
+                np.testing.assert_array_equal(got[key], toks, err_msg=str(key))
+            got[key] = toks
+            if not killed and len(got) == 2:
+                victim = next(
+                    rep.id for rep in fleet.replicas if rep.gen.has_active()
+                )
+                fleet.kill_replica(victim)
+                killed = True
+        assert killed
+        assert fleet.metrics.journal_handoffs.count > 0
+        s = fleet.metrics.summary(fleet.replicas)
+        assert (
+            s["journal"]["warm_resumes"] + s["journal"]["served_from_journal"]
+        ) > 0
+        fleet.close()
+        assert set(got) == set(ref)
+        for key in ref:
+            np.testing.assert_array_equal(got[key], ref[key], err_msg=str(key))

@@ -29,6 +29,7 @@ from torchkafka_tpu.errors import (
     ProducerClosedError,
     TpuKafkaError,
 )
+from torchkafka_tpu.journal import DecodeJournal, JournalEntry
 from torchkafka_tpu.parallel import batch_sharding, global_batch, make_mesh
 from torchkafka_tpu.pipeline import KafkaStream, stream
 from torchkafka_tpu.resilience import (
@@ -71,7 +72,7 @@ from torchkafka_tpu.transform import (
     raw_bytes,
 )
 
-__version__ = "0.8.0"
+__version__ = "0.9.0"
 
 __all__ = [
     "BarrierError",
@@ -86,6 +87,8 @@ __all__ = [
     "ChaosProducer",
     "Consumer",
     "ConsumerClosedError",
+    "DecodeJournal",
+    "JournalEntry",
     "BrokerClient",
     "BrokerServer",
     "InMemoryBroker",

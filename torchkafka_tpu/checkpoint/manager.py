@@ -30,6 +30,7 @@ from typing import Any, Mapping
 import jax
 import numpy as np
 
+from torchkafka_tpu.resilience.crashpoint import crash_hook
 from torchkafka_tpu.source.consumer import Consumer
 from torchkafka_tpu.source.records import TopicPartition
 
@@ -151,6 +152,12 @@ class StreamCheckpointer:
         into ``state`` — i.e. commit watermark and weights describe the same
         records.
         """
+        # The caller has typically just committed the offsets this save
+        # pairs with: death between that commit and this save means the
+        # checkpoint on disk is OLDER than the commit log — resume must
+        # seek back to the checkpoint's watermark (re-consuming, never
+        # losing). The crash matrix kills here to pin that.
+        crash_hook("post_commit_pre_checkpoint")
         self.wait_until_finished()  # serialize after any async save
         final = os.path.join(self._root, str(step))
         tmp = final + ".tmp"
@@ -194,6 +201,11 @@ class StreamCheckpointer:
             self._ckptr.save(os.path.join(tmp, "state"), state)
         self._ckptr.wait_until_finished()
         self._write_offsets(tmp, pid, multi, step, offsets)
+        # Payload and offsets written, the atomic rename NOT yet done:
+        # death here leaves a ``.tmp`` step that steps()/restore must
+        # never see (restore(step=None) falls back to the newest
+        # COMPLETE step).
+        crash_hook("checkpoint_mid_write")
         if multi:
             from jax.experimental import multihost_utils as _mh
 
@@ -246,6 +258,9 @@ class StreamCheckpointer:
         )
         self._ckptr.save(os.path.join(tmp, "state"), state)
         self._write_offsets(tmp, 0, False, step, offsets)
+        # Same torn window as the sync path: everything written, rename
+        # pending (here on the finalizer thread).
+        crash_hook("checkpoint_mid_write")
 
         def _finalize() -> None:
             try:

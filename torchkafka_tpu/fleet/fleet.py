@@ -48,6 +48,8 @@ the shape) and drain replay-freedom holds alongside QoS.
 
 from __future__ import annotations
 
+import logging
+import os
 import time
 from typing import Callable, Iterator
 
@@ -56,9 +58,12 @@ import numpy as np
 from torchkafka_tpu.commit.ledger import merged_watermarks
 from torchkafka_tpu.fleet.metrics import FleetMetrics
 from torchkafka_tpu.fleet.qos import AdmissionQueue, QoSConfig, TenantBuckets
-from torchkafka_tpu.fleet.replica import DEAD, DONE, Replica
+from torchkafka_tpu.fleet.replica import DEAD, DONE, DRAINING, Replica
+from torchkafka_tpu.journal import DecodeJournal
 from torchkafka_tpu.serve import StreamingGenerator
 from torchkafka_tpu.source.records import Record
+
+_logger = logging.getLogger(__name__)
 
 
 class ReplicaChaos:
@@ -139,6 +144,18 @@ class ServingFleet:
     owned by the fleet loop (the generators' internal cadence is
     disabled) so commits happen only at points where the fleet has
     already registered every completion they cover.
+
+    ``journal_dir``/``journal_cadence``: WARM failover
+    (torchkafka_tpu/journal). Each replica writes a decode journal
+    (``<journal_dir>/replica_<rid>.json``) of its in-flight generations.
+    When a replica dies — ``kill_replica``, ``ReplicaChaos``, or a
+    SIGTERM drain that overruns ``drain_timeout_s`` — the fleet loads
+    the victim's journal FROM DISK (exactly what a survivor of a real
+    process death would see) and installs its entries as resume hints on
+    every surviving replica, so the rebalance-redelivered prompts
+    warm-resume instead of re-decoding from token 0. On construction,
+    journals left by a PREVIOUS incarnation are consulted the same way —
+    a whole-fleet crash restarts warm too.
     """
 
     def __init__(
@@ -158,6 +175,9 @@ class ServingFleet:
         max_poll_records: int = 256,
         clock: Callable[[], float] = time.monotonic,
         gen_kwargs: dict | None = None,
+        journal_dir: str | os.PathLike | None = None,
+        journal_cadence: int = 8,
+        drain_timeout_s: float | None = None,
     ) -> None:
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
@@ -165,9 +185,31 @@ class ServingFleet:
         self._clock = clock
         self.metrics = FleetMetrics()
         self._buckets = TenantBuckets(self._qos, clock)
+        self._journal_paths: dict[int, str] = {}
+        carried_hints: dict = {}
+        if journal_dir is not None:
+            journal_dir = os.fspath(journal_dir)
+            os.makedirs(journal_dir, exist_ok=True)
+            for rid in range(replicas):
+                path = os.path.join(journal_dir, f"replica_{rid}.json")
+                self._journal_paths[rid] = path
+                # A journal left by a previous incarnation = that
+                # replica's in-flight state at the whole-fleet crash;
+                # its prompts redeliver to THIS incarnation's members.
+                carried_hints.update(DecodeJournal.load(path))
+            if carried_hints:
+                _logger.info(
+                    "fleet restart: %d journal entries carried over for "
+                    "warm resume", len(carried_hints),
+                )
         self.replicas: list[Replica] = []
         for rid in range(replicas):
             consumer = consumer_factory(rid)
+            kw = dict(gen_kwargs or {})
+            if journal_dir is not None:
+                kw["journal"] = DecodeJournal(
+                    self._journal_paths[rid], cadence=journal_cadence
+                )
             gen = generator_cls(
                 consumer, params, cfg,
                 slots=slots, prompt_len=prompt_len, max_new=max_new,
@@ -176,8 +218,10 @@ class ServingFleet:
                 # completion ordering); the generator must never
                 # self-commit mid-step.
                 commit_every=2**31 - 1,
-                **(gen_kwargs or {}),
+                **kw,
             )
+            if carried_hints:
+                gen.add_resume_hints(carried_hints)
             queue = AdmissionQueue(
                 self._qos, self._buckets, self.metrics, clock
             )
@@ -187,6 +231,8 @@ class ServingFleet:
                 max_poll_records=max_poll_records, clock=clock,
             ))
         self._draining = False
+        self._drain_timeout_s = drain_timeout_s
+        self._drain_started: float | None = None
         # Every (topic, partition, offset) a completion has been emitted
         # for, fleet-wide — updated BEFORE any commit that could cover it
         # (the pump/maybe_flush ordering), so an external observer can
@@ -203,15 +249,67 @@ class ServingFleet:
 
     def drain(self) -> None:
         """Fleet-wide graceful drain: stop admitting everywhere; serve()
-        finishes in-flight generations, commits, and leaves the group."""
+        finishes in-flight generations, commits, and leaves the group.
+        With ``drain_timeout_s`` set, a replica whose in-flight work
+        outlives the timeout is escalated: its journal is synced (the
+        one cooperative act a SIGTERM grace window still allows) and the
+        replica is killed — its uncommitted prompts re-deliver to the
+        NEXT incarnation, which warm-resumes them from the synced
+        journal instead of re-decoding from token 0."""
         self._draining = True
+        self._drain_started = self._clock()
         for rep in self.replicas:
             rep.start_drain()
 
+    def _enforce_drain_timeout(self) -> None:
+        if (
+            self._drain_timeout_s is None
+            or self._drain_started is None
+            or self._clock() - self._drain_started < self._drain_timeout_s
+        ):
+            return
+        for rep in self.replicas:
+            if rep.state == DRAINING:
+                # Last cooperative act before the axe: the journal's
+                # disk state becomes exactly current, so the overrun
+                # in-flight work resumes warm (and token-exact) later.
+                rep.gen.sync_journal()
+                _logger.warning(
+                    "replica %d overran drain timeout (%.1fs); killing "
+                    "with journal synced for warm resume", rep.id,
+                    self._drain_timeout_s,
+                )
+                self.kill_replica(rep.id)
+                self.metrics.drain_timeout_kills.add(1)
+
     def kill_replica(self, rid: int) -> None:
-        """Simulate a replica crash (see Replica.kill)."""
+        """Simulate a replica crash (see Replica.kill), then consult the
+        victim's decode journal for warm failover: its entries — read
+        FROM DISK, exactly the state a real process death leaves behind,
+        never the dead generator's fresher in-memory view — become resume
+        hints on every survivor. The rebalance re-delivers the victim's
+        uncommitted prompts to whichever survivor inherits the
+        partitions; the hint is consumed there (CRC-checked), and stale
+        copies on the other survivors sit harmlessly."""
         self.replicas[rid].kill()
         self.metrics.replica_deaths.add(1)
+        self._install_journal_hints(rid)
+
+    def _install_journal_hints(self, rid: int) -> None:
+        path = self._journal_paths.get(rid)
+        if path is None:
+            return
+        hints = DecodeJournal.load(path)
+        if not hints:
+            return
+        survivors = [r for r in self.replicas if r.runnable]
+        for rep in survivors:
+            rep.gen.add_resume_hints(hints)
+        self.metrics.journal_handoffs.add(len(hints))
+        _logger.info(
+            "replica %d death: %d journal entries handed to %d "
+            "survivor(s) for warm resume", rid, len(hints), len(survivors),
+        )
 
     def close(self) -> None:
         """Graceful stop outside serve(): commit completed work, leave."""
@@ -268,6 +366,8 @@ class ServingFleet:
                 and not self._draining
             ):
                 self.drain()
+            if self._draining:
+                self._enforce_drain_timeout()
             progressed = False
             for rep in self.replicas:
                 if not rep.runnable:

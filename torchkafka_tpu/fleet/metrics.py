@@ -32,6 +32,11 @@ class FleetMetrics:
         self.backpressure_resumes = RateMeter()
         self.replica_deaths = RateMeter()
         self.drains = RateMeter()  # replicas that completed a graceful drain
+        self.journal_handoffs = RateMeter()  # journal entries handed from a
+        # dead replica to survivors as warm-resume hints
+        self.drain_timeout_kills = RateMeter()  # replicas killed for
+        # overrunning the drain timeout (journal synced first, so the next
+        # incarnation resumes warm)
         self._tenant_admitted: dict[str, RateMeter] = {}
         self._tenant_throttled: dict[str, RateMeter] = {}
         self._tenant_queue_depth: dict[str, Gauge] = {}
@@ -91,8 +96,19 @@ class FleetMetrics:
             "fallbacks": sum(m.cache_fallbacks.count for m in gens),
             "pool_occupancy": round(sum(occ) / len(occ), 3) if occ else 0.0,
         }
+        journal = {
+            "handoffs": self.journal_handoffs.count,
+            "drain_timeout_kills": self.drain_timeout_kills.count,
+            "warm_resumes": sum(m.warm_resumes.count for m in gens),
+            "tokens_restored": sum(
+                m.journal_tokens_restored.count for m in gens
+            ),
+            "served_from_journal": sum(m.journal_served.count for m in gens),
+            "resume_rejected": sum(m.resume_rejected.count for m in gens),
+        }
         return {
             "prefix_cache": cache,
+            "journal": journal,
             "completions": self.completions.count,
             "completions_per_s": round(self.completions.rate(), 1),
             "duplicates": self.duplicates.count,
@@ -138,6 +154,16 @@ class FleetMetrics:
             ("backpressure_resumes_total", "counter", s["backpressure_resumes"]),
             ("replica_deaths_total", "counter", s["replica_deaths"]),
             ("replica_drains_total", "counter", s["drains"]),
+            ("journal_handoffs_total", "counter", s["journal"]["handoffs"]),
+            ("drain_timeout_kills_total", "counter",
+             s["journal"]["drain_timeout_kills"]),
+            ("warm_resumes_total", "counter", s["journal"]["warm_resumes"]),
+            ("journal_tokens_restored_total", "counter",
+             s["journal"]["tokens_restored"]),
+            ("journal_served_total", "counter",
+             s["journal"]["served_from_journal"]),
+            ("resume_rejected_total", "counter",
+             s["journal"]["resume_rejected"]),
             ("completions_per_second", "gauge", s["completions_per_s"]),
             ("tenant_admitted_total", "counter", [
                 (f'tenant="{t}"', v["admitted"]) for t, v in s["tenants"].items()

@@ -95,8 +95,13 @@ class Replica:
     def finish_drain(self) -> None:
         """Commit everything completed, then leave the group. After this,
         a restarted fleet resumes at the committed watermark with ZERO
-        replayed completions (drain acceptance contract)."""
+        replayed completions (drain acceptance contract). The decode
+        journal is synced (flush + fsync) before the consumer leaves:
+        a clean drain retires everything so the journal is empty-pruned,
+        but a SECOND signal racing this path must still find the disk
+        state current."""
         self.gen.flush_commits()
+        self.gen.sync_journal()
         self.consumer.close()
         self.state = DONE
 

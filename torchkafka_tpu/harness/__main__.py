@@ -58,8 +58,8 @@ def main() -> None:
                     help="scenario 7 with --temperature: nucleus mass in "
                     "(0, 1] — minimal prefix reaching p stays sampleable")
     ap.add_argument("--replicas", type=int, default=2,
-                    help="scenarios 10/11/12 (serving fleet / chaos soak / "
-                    "prefix-cache fleet): replica count")
+                    help="scenarios 10/11/12/13 (serving fleet / chaos soak / "
+                    "prefix-cache fleet / warm failover): replica count")
     args = ap.parse_args()
     if args.scenario:
         nums = [args.scenario]

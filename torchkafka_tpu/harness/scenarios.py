@@ -24,6 +24,7 @@ latency percentiles from the stream's own metrics.
 from __future__ import annotations
 
 import json
+import os
 import time
 from typing import Any, Callable
 
@@ -1199,6 +1200,103 @@ def scenario_12(size: str = "tiny", replicas: int = 2) -> dict:
     }
 
 
+def scenario_13(size: str = "tiny", replicas: int = 2) -> dict:
+    """Warm-failover smoke (torchkafka_tpu/journal): a 2-replica fleet
+    with per-replica decode journals, a SEEDED mid-generation replica
+    kill (ReplicaChaos), and the survivor warm-resuming the victim's
+    in-flight prompts from its on-disk journal. Audited against a
+    no-kill reference fleet over the same prompts: coverage total,
+    commits complete, completions BYTE-IDENTICAL record-for-record
+    (duplicates allowed, divergence not), and the journal provably used
+    (warm resumes + journal-served > 0). The full cadence/mode
+    differential is tests/test_journal.py; the re-decoded-token savings
+    story is benchmarks/bench_fleet.py --failover."""
+    import tempfile
+    import time as _time
+
+    import torchkafka_tpu as tk
+    from torchkafka_tpu.fleet import ReplicaChaos, ServingFleet
+    from torchkafka_tpu.source.records import TopicPartition
+
+    prompt_len, max_new = (8, 16) if size == "tiny" else (32, 32)
+    n = 16 if size == "tiny" else 64
+    parts = 4
+    cfg, params, label = _serving_model(size, None, prompt_len, max_new)
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(0, cfg.vocab_size, (n, prompt_len),
+                           dtype=np.int32)
+
+    def build(group: str):
+        broker = tk.InMemoryBroker()
+        broker.create_topic("t13", partitions=parts)
+        for i in range(n):
+            broker.produce("t13", prompts[i].tobytes(), partition=i % parts)
+        return broker
+
+    def serve(broker, group, journal_dir, chaos):
+        fleet = ServingFleet(
+            lambda rid: tk.MemoryConsumer(broker, "t13", group_id=group),
+            params, cfg, replicas=replicas, prompt_len=prompt_len,
+            max_new=max_new, slots=2,
+            # A large cadence keeps the victim's completions uncommitted,
+            # so the kill provably exercises redelivery + warm resume.
+            commit_every=100,
+            journal_dir=journal_dir, journal_cadence=1,
+        )
+        fleet.warmup()
+        got: dict = {}
+        duplicates_identical = True
+        for _rid, rec, toks in fleet.serve(idle_timeout_ms=2000,
+                                           chaos=chaos):
+            key = (rec.partition, rec.offset)
+            if key in got and not np.array_equal(got[key], toks):
+                duplicates_identical = False
+            got[key] = toks
+        for rep in fleet.replicas:
+            if rep.runnable:
+                rep.gen.flush_commits()
+        summary = fleet.metrics.summary(fleet.replicas)
+        fleet.close()
+        return got, summary, duplicates_identical
+
+    with tempfile.TemporaryDirectory() as td:
+        ref, _, _ = serve(build("ref13"), "ref13", None, None)
+        t0 = _time.perf_counter()
+        chaos = ReplicaChaos(seed=5, min_completions=2, max_completions=5)
+        broker = build("s13")
+        got, s, dup_ok = serve(
+            broker, "s13", os.path.join(td, "journals"), chaos
+        )
+        elapsed = _time.perf_counter() - t0
+        committed_complete = all(
+            broker.committed("s13", TopicPartition("t13", p))
+            == broker.end_offset(TopicPartition("t13", p))
+            for p in range(parts)
+        )
+    identical = set(got) == set(ref) and all(
+        np.array_equal(got[k], ref[k]) for k in ref
+    )
+    jn = s["journal"]
+    return {
+        "scenario": "13:warm-failover",
+        "model_scale": label,
+        "replicas": replicas,
+        "records": len(got),
+        "elapsed_s": round(elapsed, 3),
+        "killed": chaos.killed,
+        "replica_deaths": s["replica_deaths"],
+        "coverage_complete": set(got) == set(ref),
+        "committed_complete": committed_complete,
+        "identical_to_no_kill": identical,
+        "duplicates_identical": dup_ok,
+        "journal_handoffs": jn["handoffs"],
+        "warm_resumes": jn["warm_resumes"],
+        "tokens_restored": jn["tokens_restored"],
+        "served_from_journal": jn["served_from_journal"],
+        "resume_rejected": jn["resume_rejected"],
+    }
+
+
 def scenario_8(size: str = "tiny") -> dict:
     """Streaming CTR: DLRM-style recommender trained from a Kafka event
     stream — label + dense features + hashed categorical ids per record,
@@ -1566,6 +1664,7 @@ SCENARIOS = {
     10: scenario_10,
     11: scenario_11,
     12: scenario_12,
+    13: scenario_13,
 }
 
 
@@ -1606,7 +1705,7 @@ def run_scenario(
         )
     sample_kw = dict(temperature=temperature, top_k=top_k, top_p=top_p)
     spec_kw = dict(spec=spec, spec_k=spec_k, spec_draft_layers=spec_draft_layers)
-    if num in (10, 11, 12):
+    if num in (10, 11, 12, 13):
         return SCENARIOS[num](size, replicas=replicas)
     if model_scale is not None:
         if num not in (5, 7):

@@ -1,0 +1,10 @@
+"""Decode journal: per-replica resumable generation state for warm
+failover (see journal.py's module docstring for the full design)."""
+
+from torchkafka_tpu.journal.journal import (
+    DecodeJournal,
+    JournalEntry,
+    value_crc,
+)
+
+__all__ = ["DecodeJournal", "JournalEntry", "value_crc"]

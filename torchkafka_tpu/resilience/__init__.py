@@ -26,6 +26,14 @@ from torchkafka_tpu.resilience.breaker import (
     CircuitBreaker,
 )
 from torchkafka_tpu.resilience.consumer import ResilientConsumer
+from torchkafka_tpu.resilience.crashpoint import (
+    REGISTERED_CRASH_POINTS,
+    CrashPointInjected,
+    arm,
+    arm_from_env,
+    crash_hook,
+    disarm,
+)
 from torchkafka_tpu.resilience.policy import ManualClock, RetryPolicy
 from torchkafka_tpu.resilience.quarantine import PoisonQuarantine
 from torchkafka_tpu.utils.metrics import ResilienceMetrics
@@ -34,10 +42,16 @@ __all__ = [
     "CLOSED",
     "HALF_OPEN",
     "OPEN",
+    "REGISTERED_CRASH_POINTS",
     "CircuitBreaker",
+    "CrashPointInjected",
     "ManualClock",
     "PoisonQuarantine",
     "ResilienceMetrics",
     "ResilientConsumer",
     "RetryPolicy",
+    "arm",
+    "arm_from_env",
+    "crash_hook",
+    "disarm",
 ]

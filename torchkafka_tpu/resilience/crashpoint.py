@@ -1,0 +1,181 @@
+"""Named crash-point injection: deterministic process death at chosen
+fault windows.
+
+The resilience layer's chaos tools so far (source/chaos.py's seeded
+consumer/producer faults, fleet.ReplicaChaos's cooperative "kill") inject
+faults at the TRANSPORT and SCHEDULER level. What they cannot express is
+arbitrary *process death at a specific instruction boundary* — the window
+between a poll and its ledger registration, between an output flush and
+the offset commit it gates, mid-way through a journal or checkpoint
+write. Those windows are exactly where at-least-once arguments live or
+die, so each one is a NAMED crash point:
+
+========================== =================================================
+point                      window it pins
+========================== =================================================
+post_poll                  records fetched, nothing registered/committed —
+                           death here must redeliver them verbatim
+pre_commit                 outputs durable, offsets NOT yet committed —
+                           death here replays (duplicates), never loses
+post_commit_pre_checkpoint offsets committed, the paired checkpoint not yet
+                           saved — resume must seek BACK to the checkpoint
+mid_tick                   a decode tick block landed, completions not yet
+                           retired — in-flight state dies with the process
+post_dlq_pre_retire        a poison record's DLQ copy is durable but its
+                           offset not yet retired — redelivery must
+                           re-quarantine idempotently, never double-count
+journal_mid_write          death inside the decode journal's tmp write —
+                           the torn tmp must be invisible to recovery
+checkpoint_mid_write       death after the checkpoint payload, before the
+                           atomic rename — the torn step must be invisible
+========================== =================================================
+
+Sites call ``crash_hook("<name>")``; production cost is one global ``is
+None`` check. Tests arm a point with ``arm()`` (in-process, ``mode=
+"raise"``) or via the ``TORCHKAFKA_CRASHPOINT`` environment variable in a
+subprocess (``mode="kill"`` → SIGKILL, a real unclean death). Injection
+is DETERMINISTIC: the Nth arrival at the armed point fires, every other
+arrival is free — so a crash matrix can replay the same death precisely.
+
+The registry is closed: ``crash_hook`` rejects unregistered names, so a
+typo'd site cannot silently never fire, and the crash-matrix test can
+assert REGISTERED_CRASH_POINTS ⊆ points-actually-killed-at (a registered
+point the matrix does not cover fails the suite).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+
+from torchkafka_tpu.errors import TpuKafkaError
+
+# The closed set of instrumented crash windows. Adding a site means adding
+# its name HERE first — and the crash matrix (tests/test_crash_matrix.py)
+# fails until the new point is exercised by a real subprocess kill.
+REGISTERED_CRASH_POINTS: tuple[str, ...] = (
+    "post_poll",
+    "pre_commit",
+    "post_commit_pre_checkpoint",
+    "mid_tick",
+    "post_dlq_pre_retire",
+    "journal_mid_write",
+    "checkpoint_mid_write",
+)
+
+ENV_VAR = "TORCHKAFKA_CRASHPOINT"
+
+
+class CrashPointInjected(TpuKafkaError):
+    """Raised by an armed crash point in ``mode="raise"`` — the in-process
+    stand-in for death, used where a test wants the stack intact (torn
+    checkpoint writes) rather than a subprocess. Terminal by definition:
+    retrying the crashed operation is the recovery path's job."""
+
+
+class _Armed:
+    __slots__ = ("point", "at", "mode", "marker", "count", "lock")
+
+    def __init__(self, point: str, at: int, mode: str, marker: str | None):
+        self.point = point
+        self.at = at
+        self.mode = mode
+        self.marker = marker
+        self.count = 0
+        self.lock = threading.Lock()
+
+
+_armed: _Armed | None = None
+
+
+def arm(
+    point: str, *, at: int = 1, mode: str = "raise",
+    marker: str | None = None,
+) -> None:
+    """Arm ``point`` to fire at its ``at``-th arrival.
+
+    ``mode="raise"`` raises ``CrashPointInjected`` (in-process tests);
+    ``mode="kill"`` SIGKILLs the process — no handlers, no atexit, no
+    flushes, the honest crash. ``marker``: a file path written atomically
+    just before firing, so a parent process can prove the point was
+    actually reached (a SIGKILL'd child cannot report anything after)."""
+    global _armed
+    if point not in REGISTERED_CRASH_POINTS:
+        raise ValueError(
+            f"unknown crash point {point!r}; registered: "
+            f"{REGISTERED_CRASH_POINTS}"
+        )
+    if at < 1:
+        raise ValueError(f"at must be >= 1, got {at}")
+    if mode not in ("raise", "kill"):
+        raise ValueError(f"mode must be 'raise' or 'kill', got {mode!r}")
+    _armed = _Armed(point, at, mode, marker)
+
+
+def disarm() -> None:
+    global _armed
+    _armed = None
+
+
+def armed_point() -> str | None:
+    return _armed.point if _armed is not None else None
+
+
+def arm_from_env(environ=os.environ) -> bool:
+    """Arm from ``TORCHKAFKA_CRASHPOINT=point:at:mode[:marker_path]`` —
+    the subprocess side of the crash matrix. Returns True if armed."""
+    spec = environ.get(ENV_VAR)
+    if not spec:
+        return False
+    parts = spec.split(":", 3)
+    if len(parts) < 3:
+        raise ValueError(
+            f"{ENV_VAR} must be 'point:at:mode[:marker]', got {spec!r}"
+        )
+    point, at, mode = parts[0], int(parts[1]), parts[2]
+    marker = parts[3] if len(parts) > 3 else None
+    arm(point, at=at, mode=mode, marker=marker)
+    return True
+
+
+def _write_marker(path: str, point: str, count: int) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(f"{point}:{count}\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def crash_hook(point: str) -> None:
+    """The site-side call. Free when nothing is armed (one global load);
+    rejects unregistered names so sites cannot drift out of the registry."""
+    armed = _armed
+    if armed is None:
+        if point not in REGISTERED_CRASH_POINTS:
+            raise ValueError(
+                f"crash_hook called with unregistered point {point!r}"
+            )
+        return
+    if point not in REGISTERED_CRASH_POINTS:
+        raise ValueError(
+            f"crash_hook called with unregistered point {point!r}"
+        )
+    if point != armed.point:
+        return
+    with armed.lock:
+        armed.count += 1
+        fire = armed.count == armed.at
+    if not fire:
+        return
+    if armed.marker:
+        _write_marker(armed.marker, point, armed.at)
+    if armed.mode == "kill":
+        # SIGKILL over os._exit: nothing in this process may run another
+        # instruction — no finally blocks, no daemon-thread flushes. This
+        # is the crash the at-least-once contract is sworn against.
+        os.kill(os.getpid(), signal.SIGKILL)
+    raise CrashPointInjected(
+        f"crash point {point!r} fired at arrival {armed.at}"
+    )

@@ -35,6 +35,7 @@ from __future__ import annotations
 import functools
 import logging
 import time
+import zlib
 from typing import Callable, Iterator
 
 import jax
@@ -43,13 +44,19 @@ import numpy as np
 from jax import lax
 
 from torchkafka_tpu.commit.ledger import OffsetLedger
-from torchkafka_tpu.errors import CommitFailedError, OutputDeliveryError
+from torchkafka_tpu.errors import (
+    CommitFailedError,
+    ConsumerClosedError,
+    OutputDeliveryError,
+)
+from torchkafka_tpu.journal import DecodeJournal, JournalEntry, value_crc
 from torchkafka_tpu.kvcache import (
     SINK_BLOCK,
     BlockAllocator,
     PagedKVConfig,
     RadixCache,
 )
+from torchkafka_tpu.resilience.crashpoint import crash_hook
 from torchkafka_tpu.models.generate import (
     _attend_cached,
     _attn_tail,
@@ -104,6 +111,30 @@ def decode_tick_bytes(params, cfg: TransformerConfig, batch: int,
     else:
         kv = groups * cfg.head_dim * jnp.dtype(cfg.dtype).itemsize
     return total - embed + embed_rows_read, kv
+
+
+def _pick_slots(logits, key_data, idx, *, temperature, top_k, top_p):
+    """Per-slot sampling with per-(record, token-index) keys.
+
+    ``logits``: [B, V]; ``key_data``: [B, W] uint32 — each row the raw
+    key data of that slot's RECORD key (derived once at admit from the
+    record's identity, ``StreamingGenerator._record_key_data``); ``idx``:
+    [B] int32 — the gen-buffer index of the token being sampled. Row b
+    draws with ``fold_in(record_key_b, idx_b)``, so a record's token i is
+    the same draw no matter which slot, tick, replica, or process decodes
+    it — the property warm failover's token-exactness stands on (a
+    journal-resumed continuation replays the identical key sequence).
+    Greedy (temperature 0) ignores the keys, as everywhere else."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    keys = jax.vmap(jax.random.fold_in)(
+        jax.random.wrap_key_data(key_data), idx
+    )
+    return jax.vmap(
+        lambda row, k: sample_logits(
+            row, k, temperature=temperature, top_k=top_k, top_p=top_p
+        )
+    )(logits, keys)
 
 
 def _quant_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
@@ -213,6 +244,19 @@ class ServeMetrics:
         # pressure (records re-offered FIFO once blocks free)
         self.cache_fallbacks = RateMeter()  # paged → dense cache-off fallbacks
         self.cache_pool_occupancy = Gauge()  # allocated / usable blocks
+        # Decode journal / warm failover (torchkafka_tpu/journal): all zero
+        # without a journal or resume hints.
+        self.decoded_tokens = RateMeter()  # tokens produced by decode ticks
+        # (prefilled/journal-restored tokens excluded — the cold-vs-warm
+        # replay differential reads exactly this)
+        self.warm_resumes = RateMeter()  # redelivered prompts resumed from
+        # a journal hint (prompt + emitted tokens prefilled in one dispatch)
+        self.journal_tokens_restored = RateMeter()  # emitted tokens NOT
+        # re-decoded thanks to warm resume
+        self.journal_served = RateMeter()  # finished-but-uncommitted
+        # completions re-served straight from the journal (zero re-decode)
+        self.resume_rejected = RateMeter()  # hints discarded (payload CRC /
+        # sampling-contract mismatch, or an unsupported pool mode)
 
     def reset(self) -> None:
         """Zero the rate clocks — called at run() start so compile/warmup
@@ -239,6 +283,16 @@ class ServeMetrics:
             "commit": self.commit_latency.summary(),
             "slot_occupancy": round(self.slot_occupancy.value, 3),
             "prefix_cache": self.cache_summary(),
+            "journal": self.journal_summary(),
+        }
+
+    def journal_summary(self) -> dict:
+        return {
+            "decoded_tokens": self.decoded_tokens.count,
+            "warm_resumes": self.warm_resumes.count,
+            "tokens_restored": self.journal_tokens_restored.count,
+            "served_from_journal": self.journal_served.count,
+            "resume_rejected": self.resume_rejected.count,
         }
 
     def cache_summary(self) -> dict:
@@ -264,7 +318,13 @@ class ServeMetrics:
 
         s = self.summary()
         pc = s["prefix_cache"]
+        jn = s["journal"]
         return render_exposition(prefix, [
+            ("decoded_tokens_total", "counter", jn["decoded_tokens"]),
+            ("warm_resumes_total", "counter", jn["warm_resumes"]),
+            ("journal_tokens_restored_total", "counter", jn["tokens_restored"]),
+            ("journal_served_total", "counter", jn["served_from_journal"]),
+            ("resume_rejected_total", "counter", jn["resume_rejected"]),
             ("completions_total", "counter", s["completions"]),
             ("tokens_total", "counter", s["tokens"]),
             ("truncated_by_eos_total", "counter", s["truncated_by_eos"]),
@@ -363,6 +423,7 @@ class StreamingGenerator:
         kv_dtype: str | None = None,
         kv_kernel: bool | str = "auto",
         kv_pages: PagedKVConfig | dict | None = None,
+        journal: DecodeJournal | None = None,
     ) -> None:
         """``ticks_per_sync``: decode ticks chained per device dispatch
         (and per host sync of the done mask). Higher amortises dispatch
@@ -370,13 +431,21 @@ class StreamingGenerator:
         re-admission. 1 = immediate recycling (lowest latency hardware).
 
         ``temperature``: 0 = greedy (matches ``generate``'s default);
-        > 0 samples categorically per slot from logits/temperature, keyed
-        by ``rng`` (per-tick fold-in, deterministic for a fixed key).
-        ``top_k``/``top_p`` restrict the sampled support (top-k threshold
-        then nucleus mass, ``models.generate.sample_logits`` — the SAME
-        definition the lockstep path uses, static-shape so the tick stays
-        one compiled program; ignored at temperature 0, where the filter
-        cannot change the argmax).
+        > 0 samples categorically per slot from logits/temperature.
+        ``rng`` is the BASE of a per-record key schedule: each admitted
+        record derives ``fold_in(rng, topic/partition/offset)`` once, and
+        token i of that record draws with ``fold_in(record_key, i)`` —
+        so a record's sampled continuation is a pure function of (base
+        key, record identity), independent of slot placement, tick
+        interleaving, admission order, or WHICH replica decodes it. That
+        independence is what makes journal-based warm failover
+        token-exact (torchkafka_tpu/journal) and same-seed fleet runs
+        replayable under chaos. ``top_k``/``top_p`` restrict the sampled
+        support (top-k threshold then nucleus mass,
+        ``models.generate.sample_logits`` — the SAME definition the
+        lockstep path uses, static-shape so the tick stays one compiled
+        program; ignored at temperature 0, where the filter cannot
+        change the argmax).
 
         ``output_producer``/``output_topic``: publish each completion to a
         topic (key = the prompt record's key; ``encode_output(record,
@@ -459,6 +528,23 @@ class StreamingGenerator:
         rule — which would break exactness vs the training-dispatch
         dense prefill).
 
+        ``journal``: a ``journal.DecodeJournal`` — record, per in-flight
+        slot, the minimal resumable state (record identity + payload CRC,
+        sampling params, the per-record RNG key, tokens emitted so far),
+        refreshed every ``journal.cadence`` tokens and always at admit
+        and finish, written tmp-fsync-rename so a torn write is
+        invisible. Paired with ``add_resume_hints`` (the fleet feeds a
+        dead replica's journal to survivors): a redelivered prompt with a
+        hint is WARM-RESUMED — ``prompt + emitted_tokens`` prefilled in
+        one dispatch (a radix hit under ``kv_pages``, a plain longer
+        prefill when dense), RNG key and position restored — so the
+        continuation is token-exact vs the never-killed run and the
+        re-decoded tokens are bounded by the journal cadence; a
+        journaled FINISHED completion re-serves with zero re-decode.
+        Warm resume of partial generations needs the compute-dtype pool
+        on one device (``kv_dtype=None``, ``mesh=None``); hints are
+        ignored (cold replay, still correct) otherwise.
+
         ``quarantine``: a ``resilience.PoisonQuarantine``. Without it, an
         undecodable prompt is retired immediately as dropped (the
         original policy — no durable copy). With it, each decode failure
@@ -494,7 +580,13 @@ class StreamingGenerator:
         check_sampling_params(top_k, top_p)
         self._top_k = top_k
         self._top_p = top_p
-        self._rng = jax.random.key(0) if rng is None else rng
+        rng = jax.random.key(0) if rng is None else rng
+        if not jax.dtypes.issubdtype(rng.dtype, jax.dtypes.prng_key):
+            # Old-style raw uint32 keys: normalize to a typed key so the
+            # per-record fold_in/key_data derivation has one spelling.
+            rng = jax.random.wrap_key_data(rng)
+        self._rng = rng  # per-record key BASE (never split/mutated)
+        self._key_width = int(jax.random.key_data(rng).shape[-1])
         if (output_producer is None) != (output_topic is None):
             raise ValueError(
                 "output_producer and output_topic must be given together"
@@ -563,6 +655,21 @@ class StreamingGenerator:
         self._slot_rec: list[Record | None] = [None] * slots
         self._active = np.zeros((slots,), bool)
         self._uncommitted = 0
+        self._closed = False
+        # Warm failover (torchkafka_tpu/journal): the journal this server
+        # WRITES, the hints it may RESUME from, completions re-servable
+        # straight from a journal (finished-but-uncommitted), and the
+        # host-side per-slot emitted-token mirrors that drive journal
+        # cadence and the decoded-token accounting.
+        self._journal = journal
+        self._resume_hints: dict[tuple[str, int, int], JournalEntry] = {}
+        self._journal_ready: list[tuple[Record, np.ndarray]] = []
+        self._slot_emitted = np.zeros((slots,), np.int64)
+        self._slot_journaled = np.zeros((slots,), np.int64)
+        # Per-slot RECORD keys (raw key data), merged at admit and read by
+        # every tick's sampling — deliberately outside the donated state
+        # tuple so state-poking tests/tools see the same tuple shapes.
+        self._slot_keys = jnp.zeros((slots, self._key_width), jnp.uint32)
         self._build()
 
     def _build(self) -> None:
@@ -652,16 +759,16 @@ class StreamingGenerator:
                 lax.with_sharding_constraint(gen, slot_sharding(mesh, 2)),
             )
 
-        top_k, top_p = self._top_k, self._top_p
+        pick_rows = functools.partial(
+            _pick_slots, temperature=temp, top_k=self._top_k,
+            top_p=self._top_p,
+        )
 
-        def pick(logits, key):
-            return sample_logits(
-                logits, key, temperature=temp, top_k=top_k, top_p=top_p
-            )
-
-        def admit(params, caches, last_tok, pos, gen, prompts, admit_mask, key):
+        def admit(params, caches, last_tok, pos, gen, prompts, admit_mask,
+                  keys):
             """Prefill the full [B, P] prompt batch; merge admitted rows in.
-            prompts: [B, P] int32; admit_mask: [B] bool."""
+            prompts: [B, P] int32; admit_mask: [B] bool; keys: [B, W]
+            uint32 per-record key data (token 0 draws at index 0)."""
             caches, last_tok, pos, gen = pin_state(caches, last_tok, pos, gen)
             logits, fresh = prefill(params, cfg, prompts, M, mesh)
             sel = admit_mask[None, :, None, None, None]  # over [L, B, M, K, Dh]
@@ -690,7 +797,7 @@ class StreamingGenerator:
                     jnp.where(sel, fresh.k, caches[0]),
                     jnp.where(sel, fresh.v, caches[1]),
                 )
-            tok0 = pick(logits, key)  # [B]
+            tok0 = pick_rows(logits, keys, jnp.zeros((B,), jnp.int32))  # [B]
             last_tok = jnp.where(admit_mask, tok0, last_tok)
             pos = jnp.where(admit_mask, P, pos)
             gen = jnp.where(admit_mask[:, None], 0, gen)
@@ -699,18 +806,21 @@ class StreamingGenerator:
 
         K = self._ticks_per_sync
 
-        def tick_block(params, caches, last_tok, pos, gen, active_in, key):
+        def tick_block(params, caches, last_tok, pos, gen, active_in, skey):
             """K chained decode ticks in ONE dispatch (static K), with a
             LATCHED done mask: a slot that completes at inner tick j is
             masked out of ticks j+1..K, so its output cannot be clobbered.
             One host sync per K tokens — per-token syncing costs a full
             host↔device round trip per generated token, which is the whole
-            serving budget on high-latency transports."""
+            serving budget on high-latency transports. ``skey``: [B, W]
+            uint32 per-slot RECORD keys; tick t of slot b draws at fold
+            index ``pos_b - P + 1`` (token 0 was the admit draw), so the
+            sampled stream is a pure function of (record, index) — the
+            warm-failover exactness contract."""
             caches, last_tok, pos, gen = pin_state(caches, last_tok, pos, gen)
 
             def one(carry, _):
-                caches, last_tok, pos, gen, done_latch, n_out, key = carry
-                key, sub = jax.random.split(key)
+                caches, last_tok, pos, gen, done_latch, n_out = carry
                 act = active_in & ~done_latch
                 x = embed_rows(params["embed"], last_tok, cfg.dtype)[:, None, :]
 
@@ -737,7 +847,7 @@ class StreamingGenerator:
                     "bd,dv->bv", x[:, 0], load_weight(params["lm_head"], cfg.dtype),
                     preferred_element_type=jnp.float32,
                 )
-                tok = pick(logits, sub)
+                tok = pick_rows(logits, skey, pos - P + 1)
                 # Inactive slots write stale kv at their frozen position —
                 # safe: re-admission overwrites [0, P) via prefill and every
                 # later position is rewritten by the tick that reaches it
@@ -766,14 +876,41 @@ class StreamingGenerator:
                     done_now, jnp.minimum(t + 2, self._max_new), n_out
                 )
                 done_latch = done_latch | done_now
-                return (caches, last_tok, pos, gen, done_latch, n_out, key), None
+                return (caches, last_tok, pos, gen, done_latch, n_out), None
 
             done0 = jnp.zeros((B,), bool)
             n0 = jnp.zeros((B,), jnp.int32)
-            (caches, last_tok, pos, gen, done, n_out, _), _ = lax.scan(
-                one, (caches, last_tok, pos, gen, done0, n0, key), None, length=K
+            (caches, last_tok, pos, gen, done, n_out), _ = lax.scan(
+                one, (caches, last_tok, pos, gen, done0, n0), None, length=K
             )
             return caches, last_tok, pos, gen, done, n_out
+
+        def resume_admit(params, caches, last_tok, pos, gen, seq, slot,
+                         emitted_row, g):
+            """Warm-resume ONE slot from a journal hint: prefill ``seq``
+            (= prompt + the g journaled tokens minus the last — position
+            P+g-1 is rewritten by the next tick's own write-before-attend
+            anyway) into the slot's cache row in one dispatch, and restore
+            the position/last-token/gen-buffer state the no-kill run would
+            hold. seq: [1, S] with S = P + g - 1; slot/g: scalars;
+            emitted_row: [max_new] (journaled tokens, zero-padded — zeros
+            beyond g match a fresh admit's cleared buffer)."""
+            caches, last_tok, pos, gen = pin_state(caches, last_tok, pos, gen)
+            _logits, fresh = prefill(params, cfg, seq, M, mesh)
+            caches = (
+                lax.dynamic_update_slice(
+                    caches[0], fresh.k.astype(caches[0].dtype),
+                    (0, slot, 0, 0, 0),
+                ),
+                lax.dynamic_update_slice(
+                    caches[1], fresh.v.astype(caches[1].dtype),
+                    (0, slot, 0, 0, 0),
+                ),
+            )
+            last_tok = last_tok.at[slot].set(emitted_row[g - 1])
+            pos = pos.at[slot].set(P + g - 1)
+            gen = lax.dynamic_update_slice(gen, emitted_row[None, :], (slot, 0))
+            return caches, last_tok, pos, gen
 
         # Donate the cache pool: admit/tick rebuild it every call, and
         # without donation each dispatch copies the full [L, B, M, K, Dh]
@@ -788,6 +925,14 @@ class StreamingGenerator:
         self._tick_block_raw = tick_block
         self._admit_fn = lambda *a: _admit(self._params, *a)
         self._tick_fn = lambda *a: _tick(self._params, *a)
+        if kv_int8:
+            # int8 pools deliberately give up token-exactness, the one
+            # contract warm resume exists to keep; hints are filtered out
+            # in _take_hint, so no resume program is built.
+            self._resume_exec = None
+        else:
+            _resume = jax.jit(resume_admit, donate_argnums=(1,))
+            self._resume_exec = lambda *a: _resume(self._params, *a)
         if kv_int8 and kv_kernel:
             # K-major pool for the Pallas read (see _slot_layer_step_q).
             self._caches = (
@@ -855,7 +1000,7 @@ class StreamingGenerator:
         self._kv_alloc = BlockAllocator(pages.num_blocks)
         self._kv_radix = RadixCache(self._kv_alloc, pages.block_size)
         self._table_np = np.zeros((self._slots, nblk), np.int32)  # all sink
-        self._paged_prefill_jits: dict[int, Callable] = {}
+        self._paged_prefill_jits: dict[tuple[int, int], Callable] = {}
         return True
 
     def _build_paged(self) -> None:
@@ -867,13 +1012,12 @@ class StreamingGenerator:
         NB = self._kv_pages.num_blocks
         nl, kh, dh = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
         temp = self._temperature
-        top_k, top_p = self._top_k, self._top_p
         self._kv_kernel = False  # the base flag; never engaged here
 
-        def pick(logits, key):
-            return sample_logits(
-                logits, key, temperature=temp, top_k=top_k, top_p=top_p
-            )
+        pick_rows = functools.partial(
+            _pick_slots, temperature=temp, top_k=self._top_k,
+            top_p=self._top_p,
+        )
 
         def suffix_prefill(params, pool_k, pool_v, table_row, toks, *, start):
             """Chunked prefill of ONE slot's uncached prompt suffix.
@@ -912,11 +1056,14 @@ class StreamingGenerator:
 
         self._paged_suffix_fn = suffix_prefill
 
-        def admit_merge(last_tok, pos, gen, logits, admit_mask, key):
+        def admit_merge(last_tok, pos, gen, logits, admit_mask, keys):
             """The dense admit's sampling/bookkeeping tail over host-
-            assembled per-slot logits rows: same [B, V] pick, same key
-            discipline, so cache-on token 0 matches the dense server's."""
-            tok0 = pick(logits, key)
+            assembled per-slot logits rows: same [B, V] pick, same
+            per-record key discipline (index 0), so cache-on token 0
+            matches the dense server's bitwise."""
+            tok0 = pick_rows(
+                logits, keys, jnp.zeros((logits.shape[0],), jnp.int32)
+            )
             last_tok = jnp.where(admit_mask, tok0, last_tok)
             pos = jnp.where(admit_mask, P, pos)
             gen = jnp.where(admit_mask[:, None], 0, gen)
@@ -927,7 +1074,7 @@ class StreamingGenerator:
 
         K = self._ticks_per_sync
 
-        def tick_block(params, caches, last_tok, pos, gen, active_in, key):
+        def tick_block(params, caches, last_tok, pos, gen, active_in, skey):
             """The dense tick_block over the paged pool: same K-chained
             latched-done structure and bookkeeping (see the dense body
             for the measured rationale); only the cache write/read is the
@@ -940,8 +1087,7 @@ class StreamingGenerator:
             pool_k, pool_v, table = caches
 
             def one(carry, _):
-                pool_k, pool_v, last_tok, pos, gen, done_latch, n_out, key = carry
-                key, sub = jax.random.split(key)
+                pool_k, pool_v, last_tok, pos, gen, done_latch, n_out = carry
                 act = active_in & ~done_latch
                 x = embed_rows(params["embed"], last_tok, cfg.dtype)[:, None, :]
 
@@ -964,7 +1110,7 @@ class StreamingGenerator:
                     load_weight(params["lm_head"], cfg.dtype),
                     preferred_element_type=jnp.float32,
                 )
-                tok = pick(logits, sub)
+                tok = pick_rows(logits, skey, pos - P + 1)
                 t = pos - P  # decode ticks completed before this one
                 idx = jnp.minimum(t + 1, self._max_new - 1)
                 onehot = jnp.arange(self._max_new)[None, :] == idx[:, None]
@@ -982,14 +1128,13 @@ class StreamingGenerator:
                 done_latch = done_latch | done_now
                 return (
                     pool_k, pool_v, last_tok, pos, gen, done_latch, n_out,
-                    key,
                 ), None
 
             done0 = jnp.zeros((B,), bool)
             n0 = jnp.zeros((B,), jnp.int32)
-            (pool_k, pool_v, last_tok, pos, gen, done, n_out, _), _ = lax.scan(
+            (pool_k, pool_v, last_tok, pos, gen, done, n_out), _ = lax.scan(
                 one,
-                (pool_k, pool_v, last_tok, pos, gen, done0, n0, key),
+                (pool_k, pool_v, last_tok, pos, gen, done0, n0),
                 None, length=K,
             )
             return (pool_k, pool_v, table), last_tok, pos, gen, done, n_out
@@ -998,6 +1143,7 @@ class StreamingGenerator:
         self._tick_block_raw = tick_block
         self._tick_fn = lambda *a: _tick(self._params, *a)
         self._admit_fn = None  # paged admission is host-orchestrated
+        self._resume_exec = None  # paged resume rides the suffix prefill
         self._caches = (
             jnp.zeros((nl, NB, bs, kh, dh), cfg.dtype),
             jnp.zeros((nl, NB, bs, kh, dh), cfg.dtype),
@@ -1007,20 +1153,23 @@ class StreamingGenerator:
         self._pos = jnp.zeros((B,), jnp.int32)
         self._gen = jnp.zeros((B, self._max_new), jnp.int32)
 
-    def _paged_prefill_call(self, caches, table_row, toks):
-        """Dispatch the per-S-jitted suffix prefill; returns (logits [1, V],
-        caches with the pools rebound). Overridden by the spec server to
-        prefill both model pools."""
+    def _paged_prefill_call(self, caches, table_row, toks, *,
+                            total_len: int | None = None):
+        """Dispatch the per-(suffix, start)-jitted suffix prefill; returns
+        (logits [1, V], caches with the pools rebound). ``total_len``: the
+        full sequence being prefilled (default prompt_len; a journal warm
+        resume prefills prompt + emitted tokens, so its queries start at
+        ``total_len - S``). Overridden by the spec server to prefill both
+        model pools."""
         s = int(toks.shape[1])
-        fn = self._paged_prefill_jits.get(s)
+        start = (total_len or self._prompt_len) - s
+        fn = self._paged_prefill_jits.get((s, start))
         if fn is None:
             fn = jax.jit(
-                functools.partial(
-                    self._paged_suffix_fn, start=self._prompt_len - s
-                ),
+                functools.partial(self._paged_suffix_fn, start=start),
                 donate_argnums=(1, 2),
             )
-            self._paged_prefill_jits[s] = fn
+            self._paged_prefill_jits[(s, start)] = fn
         logits, pool_k, pool_v = fn(
             self._params, caches[0], caches[1], table_row, toks
         )
@@ -1055,8 +1204,12 @@ class StreamingGenerator:
         uncached suffix, then register the prompt's whole blocks for
         future reuse. Sequential per record so a duplicate prompt inside
         one batch hits its predecessor's freshly inserted prefix. Ends
-        with the same [B, V] sampling merge (one RNG split per admitting
-        call) as the dense admit."""
+        with the same [B, V] per-record-key sampling merge as the dense
+        admit. A record carrying a journal resume hint prefills
+        ``prompt + emitted_tokens`` instead (the cached prompt prefix
+        still radix-hits) and restores position/RNG state host-side — no
+        token 0 to sample; a FINISHED hint consumes no slot at all (the
+        completion re-serves from the journal at the next step)."""
         phys_free = [i for i in range(self._slots) if not self._active[i]]
         if len(records) + len(self._paged_deferred) > len(phys_free):
             raise ValueError(
@@ -1070,17 +1223,47 @@ class StreamingGenerator:
         self._paged_deferred = []
         bs = self._kv_pages.block_size
         nblk = self._blocks_per_slot
-        admit_mask = np.zeros((self._slots,), bool)
+        B, W = self._slots, self._key_width
+        admit_mask = np.zeros((B,), bool)
+        keys_np = np.zeros((B, W), np.uint32)
+        key_mask = np.zeros((B,), bool)
         slot_ids: list[int] = []
         logits_rows: list = []
+        resumed: list[tuple[int, np.ndarray]] = []
+        journal_dirty = False
         caches = self._caches
-        for i in phys_free:
+        slot_iter = iter(phys_free)
+        while True:
             nxt = self._next_decodable(queue)
             if nxt is None:
                 break
             rec, toks = nxt
             toks = np.asarray(toks, np.int32)
-            matched = self._kv_radix.match(toks)
+            kd = self._record_key_data(rec)
+            hint = self._take_hint(rec)
+            if hint is not None and hint.finished:
+                out = np.asarray(hint.tokens, np.int32)
+                self._journal_ready.append((rec, out))
+                self.metrics.journal_served.add(1)
+                if self._journal is not None:
+                    self._journal_record(rec, hint.key_data or kd, out, True)
+                    journal_dirty = True
+                continue
+            i = next(slot_iter, None)
+            if i is None:
+                # Unreachable under the caller contract (records <= free
+                # slots, finished hints consume none) — fail loudly
+                # rather than silently dropping a record.
+                raise RuntimeError("paged admission ran out of free slots")
+            emitted = (
+                np.asarray(hint.tokens, np.int32) if hint is not None
+                else None
+            )
+            seq = (
+                toks if emitted is None
+                else np.concatenate([toks, emitted[:-1]])
+            )
+            matched = self._kv_radix.match(seq)
             needed = nblk - len(matched)
             short = needed - self._kv_alloc.available()
             if short > 0:
@@ -1094,9 +1277,14 @@ class StreamingGenerator:
                 # re-offer first, keeping per-partition FIFO (the
                 # replay-free-drain invariant). The one-slot worst case
                 # always fits (constructor fallback guards it), so this
-                # is pressure, never deadlock.
+                # is pressure, never deadlock. A resume hint goes back on
+                # the shelf with its record.
                 if matched:
                     self._kv_alloc.decref(matched)
+                if hint is not None:
+                    self._resume_hints[
+                        (rec.topic, rec.partition, rec.offset)
+                    ] = hint
                 self._paged_deferred.append(rec)
                 self._paged_deferred.extend(queue)
                 queue = []
@@ -1106,11 +1294,14 @@ class StreamingGenerator:
             start = len(matched) * bs
             table_row = jnp.asarray(self._table_np[i][None, :])
             logits, caches = self._paged_prefill_call(
-                caches, table_row, jnp.asarray(toks[None, start:])
+                caches, table_row, jnp.asarray(seq[None, start:]),
+                total_len=len(seq),
             )
-            # Register the prompt's matchable whole blocks for reuse
+            # Register the PROMPT's matchable whole blocks for reuse
             # (existing nodes are the ones we just matched; new nodes
             # adopt this slot's freshly prefilled private blocks).
+            # Emitted-token blocks are never cached: offsets are unique,
+            # so they could only ever match their own redelivery.
             cacheable = RadixCache.matchable_blocks(len(toks), bs)
             self._kv_radix.insert(toks, row[:cacheable])
             if matched:
@@ -1118,12 +1309,33 @@ class StreamingGenerator:
                 self.metrics.prefix_tokens_saved.add(start)
             else:
                 self.metrics.prefix_misses.add(1)
-            self.metrics.prefill_tokens.add(len(toks) - start)
+            self.metrics.prefill_tokens.add(len(seq) - start)
             self._slot_rec[i] = rec
-            admit_mask[i] = True
             self._active[i] = True
-            slot_ids.append(i)
-            logits_rows.append(logits)
+            key_np = (
+                np.asarray(hint.key_data, np.uint32)
+                if hint is not None and hint.key_data is not None else kd
+            )
+            keys_np[i] = key_np
+            key_mask[i] = True
+            if hint is None:
+                admit_mask[i] = True
+                slot_ids.append(i)
+                logits_rows.append(logits)
+                self._slot_emitted[i] = 0
+                self._slot_journaled[i] = 0
+                if self._journal is not None:
+                    self._journal_record(rec, kd, (), False)
+                    journal_dirty = True
+            else:
+                resumed.append((i, emitted))
+                self._slot_emitted[i] = len(emitted)
+                self._slot_journaled[i] = len(emitted)
+                self.metrics.warm_resumes.add(1)
+                self.metrics.journal_tokens_restored.add(len(emitted))
+                if self._journal is not None:
+                    self._journal_record(rec, key_np, emitted, False)
+                    journal_dirty = True
         if queue:  # defensive: slots exhausted with records left
             self._paged_deferred.extend(queue)
         # Count records ENTERING the deferred state, not retry spins: the
@@ -1134,24 +1346,49 @@ class StreamingGenerator:
             self.metrics.admission_deferrals.add(newly_deferred)
         self.metrics.cache_pool_occupancy.set(self._kv_alloc.occupancy())
         admitted = int(admit_mask.sum())
-        if admitted:
+        filled = admitted + len(resumed)
+        if filled:
             if in_flight > 0:
-                self.metrics.readmissions.add(admitted)
+                self.metrics.readmissions.add(filled)
             caches = self._paged_set_table(
                 caches, jnp.asarray(self._table_np)
             )
+            self._slot_keys = jnp.where(
+                jnp.asarray(key_mask)[:, None], jnp.asarray(keys_np),
+                self._slot_keys,
+            )
+        if admitted:
             logits_b = jnp.zeros(
                 (self._slots, self._cfg.vocab_size), jnp.float32
             ).at[jnp.asarray(slot_ids)].set(
                 jnp.concatenate(logits_rows, axis=0)
             )
-            self._rng, sub = jax.random.split(self._rng)
             self._last_tok, self._pos, self._gen = self._paged_merge(
                 self._last_tok, self._pos, self._gen, logits_b,
-                jnp.asarray(admit_mask), sub,
+                jnp.asarray(admit_mask), jnp.asarray(keys_np),
+            )
+        if resumed:
+            res_mask = np.zeros((B,), bool)
+            res_last = np.zeros((B,), np.int32)
+            res_pos = np.zeros((B,), np.int32)
+            res_gen = np.zeros((B, self._max_new), np.int32)
+            for i, emitted in resumed:
+                res_mask[i] = True
+                res_last[i] = emitted[-1]
+                res_pos[i] = self._prompt_len + len(emitted) - 1
+                res_gen[i, : len(emitted)] = emitted
+            m = jnp.asarray(res_mask)
+            self._last_tok = jnp.where(
+                m, jnp.asarray(res_last), self._last_tok
+            )
+            self._pos = jnp.where(m, jnp.asarray(res_pos), self._pos)
+            self._gen = jnp.where(
+                m[:, None], jnp.asarray(res_gen), self._gen
             )
         self._caches = caches
-        return admitted
+        if journal_dirty:
+            self._journal.flush()
+        return filled
 
     def decode_roofline(
         self, *, iters: int = 8, windows: int = 3,
@@ -1187,7 +1424,7 @@ class StreamingGenerator:
         cfg = self._cfg
         B, K = self._slots, self._ticks_per_sync
         active = jnp.ones((B,), bool)
-        key = jax.random.key(1)
+        key = self._slot_keys  # per-slot record-key data, [B, W] uint32
         tick_block = self._tick_block_raw
         # ``fill``: the slot positions the measurement starts from. With
         # the dynamic-length kernel the tick reads only [0, pos] per
@@ -1341,7 +1578,10 @@ class StreamingGenerator:
         (all-False mask) leaves the slot state semantically unchanged."""
         B = self._slots
         none = jnp.zeros((B,), bool)
-        key = jax.random.key(0)
+        # The tick/admit "key" operand is per-slot record-key data
+        # ([B, W] uint32); the zero-initialized slot keys are exactly the
+        # no-op shape.
+        key = self._slot_keys
         if self._kv_pages is not None:
             # Compile the miss-path suffix prefill (S = prompt_len — the
             # most common specialisation), the sampling merge, and the
@@ -1402,6 +1642,10 @@ class StreamingGenerator:
         same partition completes would otherwise be invisible to the
         ledger, and the commit watermark could advance past it — losing it
         on crash. (run() calls this on its own polls.)"""
+        # Fetched, not yet registered anywhere durable: death in this
+        # window must re-deliver the records verbatim (nothing references
+        # them but the broker's uncommitted offsets).
+        crash_hook("post_poll")
         self._ledger.fetched_many(records)
 
     def _next_decodable(self, queue: list[Record]):
@@ -1425,6 +1669,10 @@ class StreamingGenerator:
                         if not self._quarantine.note_failure(rec, exc):
                             continue  # budget left: re-attempt in place
                         self.metrics.quarantined.add(1)
+                        # DLQ copy acknowledged durable; the offset has
+                        # NOT retired yet — the crash window where
+                        # redelivery must re-quarantine idempotently.
+                        crash_hook("post_dlq_pre_retire")
                     else:
                         _logger.exception(
                             "dropping undecodable prompt %s@%s:%s",
@@ -1435,9 +1683,92 @@ class StreamingGenerator:
                     break  # next record
         return None
 
+    def _record_key_data(self, rec: Record) -> np.ndarray:
+        """The record's sampling key: ``rng`` folded with the record's
+        identity — a pure function of (base key, topic, partition,
+        offset), so every replica/process derives the SAME key for the
+        same record (the fleet shares gen_kwargs). Raw key data, journal-
+        and device-friendly."""
+        k = jax.random.fold_in(
+            self._rng, zlib.crc32(rec.topic.encode()) & 0x7FFFFFFF
+        )
+        k = jax.random.fold_in(k, rec.partition & 0x7FFFFFFF)
+        k = jax.random.fold_in(k, rec.offset & 0x7FFFFFFF)
+        return np.asarray(jax.random.key_data(k), np.uint32)
+
+    def add_resume_hints(self, entries: dict) -> None:
+        """Install journal entries (``journal.DecodeJournal.load`` of a
+        dead replica's file, or a previous incarnation's) keyed by
+        (topic, partition, offset). A hint is consumed when its record is
+        next offered for admission; unmatched hints sit harmlessly (the
+        payload CRC check means a hint can never resume a different
+        record)."""
+        self._resume_hints.update(entries)
+
+    def _take_hint(self, rec: Record) -> JournalEntry | None:
+        """Pop and validate ``rec``'s resume hint. None = admit cold."""
+        hint = self._resume_hints.pop(
+            (rec.topic, rec.partition, rec.offset), None
+        )
+        if hint is None:
+            return None
+        g = len(hint.tokens)
+        ok = (
+            hint.crc == value_crc(rec.value)
+            and hint.temperature == self._temperature
+            and hint.top_k == self._top_k
+            and hint.top_p == self._top_p
+            and 1 <= g <= self._max_new
+            and (hint.finished or g < self._max_new)
+            # Partial-generation resume prefills through this server's
+            # cache; int8 pools (exactness already traded away) and mesh
+            # serving (a [1, S] prefill can't shard over data) fall back
+            # to cold replay. Finished hints need no prefill at all.
+            and (hint.finished or (not self._kv_int8 and self._mesh is None))
+        )
+        if not ok:
+            if g >= 1:  # a bare admit-time entry is not a rejection
+                self.metrics.resume_rejected.add(1)
+            return None
+        return hint
+
+    def _journal_record(self, rec, key_data, tokens, finished) -> None:
+        self._journal.record(
+            rec, key_data, tokens=tokens, finished=finished,
+            temperature=self._temperature, top_k=self._top_k,
+            top_p=self._top_p,
+        )
+
+    def _resume_into_slot(self, i: int, rec: Record, prompt_toks,
+                          hint: JournalEntry, key_np: np.ndarray) -> None:
+        """Dense warm resume: one prefill dispatch of prompt + journaled
+        tokens into slot ``i`` (see the in-jit ``resume_admit``)."""
+        emitted = np.asarray(hint.tokens, np.int32)
+        g = len(emitted)
+        seq = np.concatenate(
+            [np.asarray(prompt_toks, np.int32), emitted[:-1]]
+        )[None, :]
+        row = np.zeros((self._max_new,), np.int32)
+        row[:g] = emitted
+        out = self._resume_exec(
+            self._caches, self._last_tok, self._pos, self._gen,
+            jnp.asarray(seq), jnp.int32(i), jnp.asarray(row), jnp.int32(g),
+        )
+        self._caches, self._last_tok, self._pos, self._gen = out
+        self._slot_rec[i] = rec
+        self._active[i] = True
+        self._slot_emitted[i] = g
+        self._slot_journaled[i] = g
+        self.metrics.warm_resumes.add(1)
+        self.metrics.journal_tokens_restored.add(g)
+        if self._journal is not None:
+            self._journal_record(rec, key_np, emitted, False)
+
     def admit_records(self, records: list[Record]) -> int:
         """Prefill-admit ``records`` into free slots; returns the number
-        admitted. Undecodable records are retired as dropped/quarantined
+        of slots filled (cold admissions + journal warm resumes; a
+        FINISHED journal hint re-serves from the journal without a slot).
+        Undecodable records are retired as dropped/quarantined
         (``_next_decodable``) and do not consume a slot. Records must
         already be ``note_fetched``; the caller must not offer more
         records than ``free_slots()`` (minus ``pending_admissions`` in
@@ -1451,29 +1782,75 @@ class StreamingGenerator:
                 f"offered {len(records)} records with {len(free)} free slots"
             )
         in_flight = self._slots - len(free)
-        prompts = np.zeros((self._slots, self._prompt_len), np.int32)
-        admit_mask = np.zeros((self._slots,), bool)
+        B, W = self._slots, self._key_width
+        prompts = np.zeros((B, self._prompt_len), np.int32)
+        admit_mask = np.zeros((B,), bool)
+        keys_np = np.zeros((B, W), np.uint32)
+        key_mask = np.zeros((B,), bool)
         queue = list(records)
-        for i in free:
+        slot_iter = iter(free)
+        resumed = 0
+        journal_dirty = False
+        while True:
             nxt = self._next_decodable(queue)
             if nxt is None:
                 break
             rec, toks = nxt
+            kd = self._record_key_data(rec)
+            hint = self._take_hint(rec)
+            if hint is not None and hint.finished:
+                # The dead replica finished this completion but never
+                # committed it: re-serve the journaled tokens verbatim at
+                # the next step — zero re-decode, byte-identical output.
+                out = np.asarray(hint.tokens, np.int32)
+                self._journal_ready.append((rec, out))
+                self.metrics.journal_served.add(1)
+                if self._journal is not None:
+                    self._journal_record(rec, hint.key_data or kd, out, True)
+                    journal_dirty = True
+                continue
+            i = next(slot_iter, None)
+            if i is None:
+                # Unreachable under the caller contract (records <= free
+                # slots; finished hints consume none).
+                raise RuntimeError("admission ran out of free slots")
+            key_np = (
+                np.asarray(hint.key_data, np.uint32)
+                if hint is not None and hint.key_data is not None else kd
+            )
+            keys_np[i] = key_np
+            key_mask[i] = True
+            if hint is not None:
+                self._resume_into_slot(i, rec, toks, hint, key_np)
+                resumed += 1
+                journal_dirty = journal_dirty or self._journal is not None
+                continue
             prompts[i] = toks
             self._slot_rec[i] = rec
             admit_mask[i] = True
             self._active[i] = True
+            self._slot_emitted[i] = 0
+            self._slot_journaled[i] = 0
+            if self._journal is not None:
+                self._journal_record(rec, kd, (), False)
+                journal_dirty = True
         admitted = int(admit_mask.sum())
-        if admitted:
+        filled = admitted + resumed
+        if filled:
             if in_flight > 0:
                 # Slots refilled while other generations were mid-flight:
                 # the observable that distinguishes continuous batching
                 # from lockstep waves.
-                self.metrics.readmissions.add(admitted)
-            self._rng, sub = jax.random.split(self._rng)
+                self.metrics.readmissions.add(filled)
+            self._slot_keys = jnp.where(
+                jnp.asarray(key_mask)[:, None], jnp.asarray(keys_np),
+                self._slot_keys,
+            )
+        if admitted:
             out = self._admit_fn(
                 self._caches, self._last_tok, self._pos, self._gen,
-                jnp.asarray(prompts), jnp.asarray(admit_mask), sub,
+                jnp.asarray(prompts), jnp.asarray(admit_mask),
+                jnp.asarray(keys_np),
             )
             # Rebind self state after every dispatch: admit/tick DONATE
             # the pool, so the old self._caches handles are dead buffers —
@@ -1481,104 +1858,163 @@ class StreamingGenerator:
             # second run, decode_roofline, spec_stats) holds deleted
             # arrays.
             self._caches, self._last_tok, self._pos, self._gen = out
-        return admitted
+        if journal_dirty:
+            self._journal.flush()
+        return filled
+
+    def _retire_completion(
+        self, rec: Record, out: np.ndarray,
+        completions: list[tuple[Record, np.ndarray]],
+    ) -> None:
+        """The single completion exit: metrics, output publish (fail
+        closed per record), ledger retirement. Shared by tick-produced
+        completions and journal-served ones, so both follow the exact
+        same durability discipline."""
+        self.metrics.completions.add(1)
+        self.metrics.tokens.add(len(out))
+        if len(out) < self._max_new:
+            self.metrics.truncated.add(1)
+        sent_ok = True
+        if self._output_producer is not None:
+            # Async send; durability is settled in _commit (flush
+            # + per-handle get) BEFORE offsets commit. A
+            # SYNCHRONOUS send failure (buffer full with the
+            # output broker down, closed producer, missing topic)
+            # must not kill serving OR let the record commit: skip
+            # emitted() so the ledger watermark stalls at exactly
+            # this record — it re-delivers and regenerates on
+            # restart.
+            try:
+                self._pending_outputs.append(
+                    self._output_producer.send(
+                        self._output_topic,
+                        self._encode_output(rec, out),
+                        key=rec.key,
+                    )
+                )
+                self._send_failure_streak = 0
+            except Exception:  # noqa: BLE001 - fail closed per record
+                sent_ok = False
+                self.metrics.output_send_failures.add(1)
+                self._send_failure_streak += 1
+                _logger.exception(
+                    "output send failed for %s@%d:%d; leaving "
+                    "it uncommitted to re-deliver",
+                    rec.topic, rec.partition, rec.offset,
+                )
+                if (
+                    self._send_failure_streak
+                    >= self._max_send_failure_streak
+                ):
+                    # The output path is down, not blinking: every
+                    # further completion would be un-committable
+                    # replay work behind a permanently stalled
+                    # watermark. Fail-stop like the flush/get path
+                    # so the operator gets one signal for "output
+                    # lost".
+                    raise OutputDeliveryError(
+                        f"{self._send_failure_streak} "
+                        "consecutive output send failures; "
+                        "failing stop so uncommitted prompts "
+                        "re-deliver instead of serving into a "
+                        "stalled commit watermark"
+                    )
+        if sent_ok:
+            self._ledger.emitted(rec)
+            self._uncommitted += 1
+        completions.append((rec, out))
 
     def step(self) -> list[tuple[Record, np.ndarray]]:
         """One decode tick block over the active slots; returns the
         completions it retired (ledger-emitted, output-published, commit
-        cadence applied) in completion order. No-op on an idle pool."""
-        if not self._active.any():
-            return []
-        self._rng, sub = jax.random.split(self._rng)
-        caches, last_tok, pos, gen, done, n_out = self._tick_fn(
-            self._caches, self._last_tok, self._pos, self._gen,
-            jnp.asarray(self._active), sub,
-        )
-        self._caches, self._last_tok, self._pos, self._gen = (
-            caches, last_tok, pos, gen
-        )
-        # ONE host sync per tick block: done/n_out/gen fetched together
-        # (separate np.asarray calls are separate round trips on
-        # high-latency transports).
-        done_h, n_out_h, gen_h = jax.device_get((done, n_out, gen))
-        self.metrics.slot_occupancy.set(float(self._active.mean()))
+        cadence applied) in completion order — journal-served
+        completions (finished entries from a dead replica's journal,
+        zero re-decode) first, then the tick's. No-op on an idle pool
+        with no journal backlog."""
         completions: list[tuple[Record, np.ndarray]] = []
-        if done_h.any():
-            for i in np.nonzero(done_h)[0]:
-                rec = self._slot_rec[i]
-                assert rec is not None
-                self._active[i] = False
-                self._slot_rec[i] = None
+        if self._journal_ready:
+            ready, self._journal_ready = self._journal_ready, []
+            for rec, out in ready:
+                self._retire_completion(rec, out, completions)
+        if self._active.any():
+            caches, last_tok, pos, gen, done, n_out = self._tick_fn(
+                self._caches, self._last_tok, self._pos, self._gen,
+                jnp.asarray(self._active), self._slot_keys,
+            )
+            self._caches, self._last_tok, self._pos, self._gen = (
+                caches, last_tok, pos, gen
+            )
+            # ONE host sync per tick block: done/n_out/gen/pos fetched
+            # together (separate np.asarray calls are separate round trips
+            # on high-latency transports).
+            done_h, n_out_h, gen_h, pos_h = jax.device_get(
+                (done, n_out, gen, pos)
+            )
+            crash_hook("mid_tick")
+            self.metrics.slot_occupancy.set(float(self._active.mean()))
+            # Per-slot emitted-token mirrors: decoded-token accounting
+            # (the cold-vs-warm replay differential) and the journal's
+            # token cadence both read them. Counted BEFORE retirement so
+            # a completing slot's final tokens are journaled while its
+            # record is still attached.
+            journal_dirty = False
+            decoded = 0
+            for i in np.nonzero(self._active)[0]:
+                cnt = int(
+                    n_out_h[i] if done_h[i]
+                    else pos_h[i] - self._prompt_len + 1
+                )
+                decoded += cnt - int(self._slot_emitted[i])
+                self._slot_emitted[i] = cnt
+                if self._journal is not None:
+                    rec = self._slot_rec[i]
+                    if done_h[i]:
+                        self._journal.finish(rec, gen_h[i, :cnt])
+                        journal_dirty = True
+                    elif (
+                        cnt - int(self._slot_journaled[i])
+                        >= self._journal.cadence
+                    ):
+                        self._journal.progress(rec, gen_h[i, :cnt])
+                        self._slot_journaled[i] = cnt
+                        journal_dirty = True
+            if decoded > 0:
+                self.metrics.decoded_tokens.add(decoded)
+            if journal_dirty:
+                # Synchronous at the cadence point: the whole point is
+                # that a SIGKILL one instruction later finds these tokens
+                # on disk.
+                self._journal.flush()
+            if done_h.any():
+                for i in np.nonzero(done_h)[0]:
+                    rec = self._slot_rec[i]
+                    assert rec is not None
+                    self._active[i] = False
+                    self._slot_rec[i] = None
+                    self._slot_emitted[i] = 0
+                    self._slot_journaled[i] = 0
+                    if self._kv_pages is not None:
+                        # Unpin the slot's blocks: uncached ones return to
+                        # the free list; cached prefix blocks stay alive on
+                        # the radix tree's own reference. The row falls back
+                        # to the sink so this slot's frozen-position tick
+                        # writes can never touch a re-allocated block.
+                        self._release_slot_blocks(i)
+                    out = gen_h[i, : n_out_h[i]].copy()
+                    self._retire_completion(rec, out, completions)
                 if self._kv_pages is not None:
-                    # Unpin the slot's blocks: uncached ones return to
-                    # the free list; cached prefix blocks stay alive on
-                    # the radix tree's own reference. The row falls back
-                    # to the sink so this slot's frozen-position tick
-                    # writes can never touch a re-allocated block.
-                    self._release_slot_blocks(i)
-                out = gen_h[i, : n_out_h[i]].copy()
-                self.metrics.completions.add(1)
-                self.metrics.tokens.add(len(out))
-                if len(out) < self._max_new:
-                    self.metrics.truncated.add(1)
-                sent_ok = True
-                if self._output_producer is not None:
-                    # Async send; durability is settled in _commit (flush
-                    # + per-handle get) BEFORE offsets commit. A
-                    # SYNCHRONOUS send failure (buffer full with the
-                    # output broker down, closed producer, missing topic)
-                    # must not kill serving OR let the record commit: skip
-                    # emitted() so the ledger watermark stalls at exactly
-                    # this record — it re-delivers and regenerates on
-                    # restart.
-                    try:
-                        self._pending_outputs.append(
-                            self._output_producer.send(
-                                self._output_topic,
-                                self._encode_output(rec, out),
-                                key=rec.key,
-                            )
-                        )
-                        self._send_failure_streak = 0
-                    except Exception:  # noqa: BLE001 - fail closed per record
-                        sent_ok = False
-                        self.metrics.output_send_failures.add(1)
-                        self._send_failure_streak += 1
-                        _logger.exception(
-                            "output send failed for %s@%d:%d; leaving "
-                            "it uncommitted to re-deliver",
-                            rec.topic, rec.partition, rec.offset,
-                        )
-                        if (
-                            self._send_failure_streak
-                            >= self._max_send_failure_streak
-                        ):
-                            # The output path is down, not blinking: every
-                            # further completion would be un-committable
-                            # replay work behind a permanently stalled
-                            # watermark. Fail-stop like the flush/get path
-                            # so the operator gets one signal for "output
-                            # lost".
-                            raise OutputDeliveryError(
-                                f"{self._send_failure_streak} "
-                                "consecutive output send failures; "
-                                "failing stop so uncommitted prompts "
-                                "re-deliver instead of serving into a "
-                                "stalled commit watermark"
-                            )
-                if sent_ok:
-                    self._ledger.emitted(rec)
-                    self._uncommitted += 1
-                completions.append((rec, out))
-            if self._kv_pages is not None:
-                self._caches = self._paged_set_table(
-                    self._caches, jnp.asarray(self._table_np)
-                )
-                self.metrics.cache_pool_occupancy.set(
-                    self._kv_alloc.occupancy()
-                )
-            if self._uncommitted >= self._commit_every and self._commit():
-                self._uncommitted = 0
+                    self._caches = self._paged_set_table(
+                        self._caches, jnp.asarray(self._table_np)
+                    )
+                    self.metrics.cache_pool_occupancy.set(
+                        self._kv_alloc.occupancy()
+                    )
+        if (
+            completions
+            and self._uncommitted >= self._commit_every
+            and self._commit()
+        ):
+            self._uncommitted = 0
         return completions
 
     def flush_commits(self) -> None:
@@ -1643,7 +2079,7 @@ class StreamingGenerator:
                 take = pending[:take_cap]
                 del pending[: len(take)]
                 self.admit_records(take)
-            if not self.has_active():
+            if not self.has_active() and not self._journal_ready:
                 if max_records is not None and served >= max_records:
                     break
                 if not pending:
@@ -1704,14 +2140,31 @@ class StreamingGenerator:
                         "refusing to commit source offsets past lost "
                         "output (restart re-delivers and regenerates)"
                     ) from exc
+        snapshot = self._ledger.snapshot()
+        # Outputs durable, offsets not yet committed: death here must
+        # replay (duplicates on the output topic), never lose.
+        crash_hook("pre_commit")
         try:
-            self._consumer.commit(self._ledger.snapshot())
+            self._consumer.commit(snapshot)
             self.metrics.commit_latency.observe(time.perf_counter() - t0)
-            return True
         except CommitFailedError:
             self.metrics.commit_failures.add(1)
             _logger.exception("offset commit failed; prompts will re-deliver")
             return False
+        if self._journal is not None:
+            # Journal GC at commit flush: entries below the committed
+            # watermark are durable history — pruning here is what bounds
+            # the journal file by in-flight work.
+            self._journal.prune(snapshot)
+            self._journal.flush()
+        return True
+
+    def sync_journal(self) -> None:
+        """Flush + fsync the decode journal (no-op without one) — the
+        SIGTERM drain path's durability point: whatever is in flight when
+        the process exits must be warm-resumable by the next owner."""
+        if self._journal is not None:
+            self._journal.sync()
 
     def close(self) -> None:
         """Voluntary shutdown: commit the watermark for everything already
@@ -1719,8 +2172,24 @@ class StreamingGenerator:
         this — a crash must re-deliver). In-flight generations stay
         uncommitted and re-deliver on restart, like the stream's close
         contract (/root/reference/src/kafka_dataset.py:89 keeps unfinished
-        work uncommitted; finished-and-yielded work is the user's)."""
-        self._commit()
+        work uncommitted; finished-and-yielded work is the user's).
+        IDEMPOTENT: the drain path can hit this twice (a second SIGTERM
+        lands mid-drain) — the second call must not re-commit through a
+        consumer the first call's caller already closed."""
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._commit()
+        except ConsumerClosedError:
+            # A completed drain (Replica.finish_drain) already committed
+            # the final watermark and closed the consumer; the close()
+            # that a shutdown teardown (or second signal) lands here
+            # afterwards must not die re-committing an unchanged
+            # watermark through it.
+            pass
+        finally:
+            self.sync_journal()
 
     def __enter__(self) -> "StreamingGenerator":
         return self

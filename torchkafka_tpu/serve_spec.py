@@ -295,15 +295,52 @@ class SpecStreamingGenerator(StreamingGenerator):
             )
             return state, last_tok, pos, gen, done, n_out
 
+        def resume_admit(params_pair, state, last_tok, pos, gen, seq, slot,
+                         emitted_row, g):
+            """Journal warm resume, spec flavor: BOTH models' cache rows
+            prefilled with prompt + journaled tokens in one dispatch (the
+            base class's resume_admit over the two-pool state). The
+            restored position invariant is the spec one unchanged: pos is
+            last_tok's sequence position, whose k/v the NEXT verify
+            writes."""
+            tparams, dparams = params_pair
+            t_k, t_v, d_k, d_v, acc, prop, rounds = state
+            _tl, t_fresh = prefill(tparams, cfg, seq, M)
+            _dl, d_fresh = prefill(dparams, dcfg, seq, M)
+            t_k = lax.dynamic_update_slice(
+                t_k, t_fresh.k.astype(t_k.dtype), (0, slot, 0, 0, 0)
+            )
+            t_v = lax.dynamic_update_slice(
+                t_v, t_fresh.v.astype(t_v.dtype), (0, slot, 0, 0, 0)
+            )
+            d_k = lax.dynamic_update_slice(
+                d_k, d_fresh.k.astype(d_k.dtype), (0, slot, 0, 0, 0)
+            )
+            d_v = lax.dynamic_update_slice(
+                d_v, d_fresh.v.astype(d_v.dtype), (0, slot, 0, 0, 0)
+            )
+            last_tok = last_tok.at[slot].set(emitted_row[g - 1])
+            pos = pos.at[slot].set(P + g - 1)
+            gen = lax.dynamic_update_slice(
+                gen, emitted_row[None, :], (slot, 0)
+            )
+            return (
+                (t_k, t_v, d_k, d_v, acc, prop, rounds), last_tok, pos, gen
+            )
+
         # Same dispatch shape as the base: donate the state tuple, pass
         # BOTH param trees as arguments (a closed-over tree lowers as
         # jaxpr constants — the base _build's note).
         _admit = jax.jit(admit, donate_argnums=(1,))
         _tick = jax.jit(tick_block, donate_argnums=(1,))
+        _resume = jax.jit(resume_admit, donate_argnums=(1,))
         self._admit_fn = lambda *a: _admit(
             (self._params, self._draft_params), *a
         )
         self._tick_fn = lambda *a: _tick(
+            (self._params, self._draft_params), *a
+        )
+        self._resume_exec = lambda *a: _resume(
             (self._params, self._draft_params), *a
         )
         # decode_roofline's raw hook passes only the target tree; close
@@ -519,6 +556,7 @@ class SpecStreamingGenerator(StreamingGenerator):
             lambda params, *a: tick_block((params, self._draft_params), *a)
         )
         self._admit_fn = None  # paged admission is host-orchestrated
+        self._resume_exec = None  # paged resume rides the suffix prefill
 
         nl, kh, dh = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
         dl, dkh, ddh = dcfg.n_layers, dcfg.n_kv_heads, dcfg.head_dim
@@ -538,19 +576,21 @@ class SpecStreamingGenerator(StreamingGenerator):
         self._pos = jnp.zeros((B,), jnp.int32)
         self._gen = jnp.zeros((B, max_new), jnp.int32)
 
-    def _paged_prefill_call(self, caches, table_row, toks):
+    def _paged_prefill_call(self, caches, table_row, toks, *,
+                            total_len: int | None = None):
         """Both models' pools prefilled per record; counters/table pass
-        through untouched."""
+        through untouched. ``total_len``: full sequence length — a
+        journal warm resume prefills prompt + emitted tokens (base-class
+        semantics)."""
         s = int(toks.shape[1])
-        fn = self._paged_prefill_jits.get(s)
+        start = (total_len or self._prompt_len) - s
+        fn = self._paged_prefill_jits.get((s, start))
         if fn is None:
             fn = jax.jit(
-                functools.partial(
-                    self._paged_suffix_fn, start=self._prompt_len - s
-                ),
+                functools.partial(self._paged_suffix_fn, start=start),
                 donate_argnums=(1, 2, 3, 4),
             )
-            self._paged_prefill_jits[s] = fn
+            self._paged_prefill_jits[(s, start)] = fn
         logits, t_k, t_v, d_k, d_v = fn(
             (self._params, self._draft_params), *caches[:4], table_row, toks
         )
