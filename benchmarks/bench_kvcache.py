@@ -1,6 +1,6 @@
-"""Paged KV-cache paired bench: prefix-overlap sweep + memory table.
+"""Paged KV-cache paired bench: prefix-overlap x chunk sweep + memory.
 
-Two questions, each answered with paired runs over IDENTICAL broker
+Three questions, each answered with paired runs over IDENTICAL broker
 content (the repo's pairing discipline — absolute numbers on a
 contended CPU box drift; paired counts and ratios are the signal):
 
@@ -12,20 +12,30 @@ contended CPU box drift; paired counts and ratios are the signal):
    also re-asserted inline: the paged server's tokens and commit ledger
    must be byte-identical to the dense server's in every slice.
 
-2. MEMORY — the dense pool permanently holds slots x max_len tokens of
+2. WALL-CLOCK (the PR-6 headline) — ``--chunk`` sweeps the CHUNKED
+   admission width (suffix tokens the fused tick carries alongside
+   decode; "auto" = slots x prompt_len, 0 = the legacy PR-4 per-record
+   dispatch) and reports the paged/dense wall ratio per (overlap,
+   chunk) cell. The PR-4 CPU result was an honest 2-9x LOSS
+   (per-record prefill dispatch + per-tick gather, host-bound); the
+   chunked tick's job is to flip the prefill-heavy slice positive —
+   ``--prefill-heavy`` adds that slice (short decodes, high overlap:
+   the admission-dominated regime a prompt storm produces).
+
+3. MEMORY — the dense pool permanently holds slots x max_len tokens of
    KV; the paged pool's PEAK live blocks are measured per overlap rate.
    At the dense pool's byte budget, the headroom factor (dense-equivalent
    blocks / peak used) is how much LONGER an effective context the same
    HBM could serve paged — the 8B long-context OOM lever (VERDICT.md).
 
 The model is deliberately tiny on CPU: prefill-token counts and block
-occupancy are exact regardless of scale, and wall-clock here is
-host-dispatch-bound (per-record suffix prefills), not a device claim —
-tok/s is reported for completeness, ratios only.
+occupancy are exact regardless of scale; CPU wall ratios are a
+dispatch-structure signal (the thing PR-6 changed), not a device claim.
 
 Usage: python benchmarks/bench_kvcache.py [--prompts 48] [--slots 4]
-       [--overlaps 0,0.5,0.9] [--slices 2] [--json PATH]
-Prints one markdown row per overlap rate plus a JSON line.
+       [--overlaps 0,0.5,0.9] [--chunk auto,0] [--max-new 16]
+       [--prefill-heavy] [--slices 2] [--json PATH]
+Prints one markdown row per (overlap, chunk) cell plus a JSON line.
 """
 
 from __future__ import annotations
@@ -35,17 +45,17 @@ import json
 import sys
 import time
 
-PROMPT_LEN, MAX_NEW, BLOCK, VOCAB = 32, 16, 8, 512
+BLOCK, VOCAB = 8, 512
 
 
-def build_broker(tk, np, n: int, overlap: float, seed: int):
+def build_broker(tk, np, n: int, prompt_len: int, overlap: float, seed: int):
     broker = tk.InMemoryBroker()
     broker.create_topic("bench", partitions=4)
     rng = np.random.default_rng(seed)
-    shared_len = int(round(overlap * PROMPT_LEN))
+    shared_len = int(round(overlap * prompt_len))
     shared = rng.integers(0, VOCAB, shared_len, dtype=np.int32)
     for i in range(n):
-        tail = rng.integers(0, VOCAB, PROMPT_LEN - shared_len, dtype=np.int32)
+        tail = rng.integers(0, VOCAB, prompt_len - shared_len, dtype=np.int32)
         broker.produce(
             "bench", np.concatenate([shared, tail]).tobytes(),
             partition=i % 4,
@@ -54,7 +64,7 @@ def build_broker(tk, np, n: int, overlap: float, seed: int):
 
 
 def run_once(tk, np, jax, cfg, params, broker, slots: int, n: int,
-             pages: dict | None):
+             prompt_len: int, max_new: int, pages: dict | None):
     from torchkafka_tpu.serve import StreamingGenerator
 
     class PeakTracking(StreamingGenerator):
@@ -76,8 +86,8 @@ def run_once(tk, np, jax, cfg, params, broker, slots: int, n: int,
 
     consumer = tk.MemoryConsumer(broker, "bench", group_id="b")
     server = PeakTracking(
-        consumer, params, cfg, slots=slots, prompt_len=PROMPT_LEN,
-        max_new=MAX_NEW, commit_every=8, kv_pages=pages,
+        consumer, params, cfg, slots=slots, prompt_len=prompt_len,
+        max_new=max_new, commit_every=8, kv_pages=pages,
     )
     server.warmup()
     out = {}
@@ -98,8 +108,98 @@ def run_once(tk, np, jax, cfg, params, broker, slots: int, n: int,
         "elapsed_s": elapsed,
         "tok_s": toks / elapsed if elapsed else None,
         "cache": server.metrics.cache_summary(),
+        "chunked": server.metrics.chunk_summary(),
         "peak_blocks": server.peak_blocks,
     }
+
+
+def sweep(tk, np, jax, cfg, params, *, label, n, slots, prompt_len,
+          max_new, overlaps, chunks, slices, dense_blocks, block_bytes):
+    """One paired (overlap x chunk) grid at a fixed decode length.
+    The dense side runs once per (overlap, slice) and every chunk
+    width's paged run pairs against it back-to-back inside the slice —
+    exactness (tokens + commit ledger) asserted per cell."""
+    results = []
+    for overlap in overlaps:
+        per_chunk: dict = {}
+        cells: dict = {}
+        for s in range(slices):
+            dense = run_once(
+                tk, np, jax, cfg, params,
+                build_broker(tk, np, n, prompt_len, overlap, seed=s),
+                slots, n, prompt_len, max_new, None,
+            )
+            for chunk in chunks:
+                pages = {
+                    "block_size": BLOCK, "num_blocks": dense_blocks + 1,
+                    "prefill_chunk": chunk,
+                }
+                paged = run_once(
+                    tk, np, jax, cfg, params,
+                    build_broker(tk, np, n, prompt_len, overlap, seed=s),
+                    slots, n, prompt_len, max_new, pages,
+                )
+                assert set(dense["out"]) == set(paged["out"])
+                for k in dense["out"]:
+                    np.testing.assert_array_equal(
+                        dense["out"][k], paged["out"][k],
+                        err_msg=f"{label} overlap {overlap} chunk {chunk} "
+                                f"slice {s} prompt {k}",
+                    )
+                assert dense["committed"] == paged["committed"], (
+                    "commit ledgers diverged"
+                )
+                per_chunk.setdefault(chunk, []).append(
+                    paged["elapsed_s"] / dense["elapsed_s"]
+                )
+                cells[chunk] = (dense, paged)  # counts identical per slice
+        for chunk in chunks:
+            dense, paged = cells[chunk]
+            cache = paged["cache"]
+            prefill_dense = n * prompt_len
+            saved = 1 - cache["prefill_tokens"] / prefill_dense
+            headroom = dense_blocks / max(1, paged["peak_blocks"])
+            max_len = prompt_len + max_new
+            rec = {
+                "slice": label,
+                "overlap": overlap,
+                "prefill_chunk": "auto" if chunk is None else chunk,
+                "prompt_len": prompt_len,
+                "max_new": max_new,
+                "hit_rate": cache["hit_rate"],
+                "prefill_tokens_paged": cache["prefill_tokens"],
+                "prefill_tokens_dense": prefill_dense,
+                "prefix_tokens_saved": cache["prefix_tokens_saved"],
+                "saved_frac": round(saved, 4),
+                "evictions": cache["evictions"],
+                "deferrals": cache["deferrals"],
+                "chunk_ticks": paged["chunked"]["chunk_ticks"],
+                "stall_ticks": paged["chunked"]["stall_ticks"],
+                "peak_blocks": paged["peak_blocks"],
+                "dense_blocks": dense_blocks,
+                "pool_bytes": dense_blocks * block_bytes,
+                "context_headroom_x": round(headroom, 2),
+                "effective_max_len_at_dense_bytes": int(max_len * headroom),
+                "paged_over_dense_wall": round(
+                    float(np.median(per_chunk[chunk])), 2
+                ),
+                "dense_over_paged_wall": round(
+                    1.0 / float(np.median(per_chunk[chunk])), 2
+                ),
+                "dense_tok_s": round(dense["tok_s"], 1),
+                "paged_tok_s": round(paged["tok_s"], 1),
+            }
+            results.append(rec)
+            print(
+                f"| {label} | {overlap:.0%} | {rec['prefill_chunk']} | "
+                f"{(cache['hit_rate'] or 0):.2f} | "
+                f"{cache['prefill_tokens']} / {prefill_dense} | "
+                f"{saved:.0%} | "
+                f"{paged['peak_blocks']} / {dense_blocks} | "
+                f"{headroom:.2f}x | "
+                f"{rec['paged_over_dense_wall']:.2f}x |"
+            )
+    return results
 
 
 def main() -> None:
@@ -107,10 +207,23 @@ def main() -> None:
     ap.add_argument("--prompts", type=int, default=48)
     ap.add_argument("--slots", type=int, default=4)
     ap.add_argument("--overlaps", default="0,0.5,0.9")
+    ap.add_argument("--chunk", default="auto,0",
+                    help="comma list of chunked-admission widths: 'auto' "
+                    "(slots x prompt_len), ints, or 0 (legacy per-record "
+                    "PR-4 admission — the paired baseline)")
+    ap.add_argument("--max-new", type=int, default=16)
+    ap.add_argument("--prefill-heavy", action="store_true",
+                    help="add the admission-dominated slice (prompt 256, "
+                    "max_new 8, overlap 0.9 — the system-prompt storm "
+                    "regime the chunked tick exists to flip positive)")
     ap.add_argument("--slices", type=int, default=2)
     ap.add_argument("--json", default=None, help="also write the JSON here")
     args = ap.parse_args()
     overlaps = [float(x) for x in args.overlaps.split(",")]
+    chunks = [
+        None if c.strip() == "auto" else int(c)
+        for c in args.chunk.split(",")
+    ]
 
     import jax
     import jax.numpy as jnp
@@ -124,100 +237,55 @@ def main() -> None:
         TransformerConfig, init_params,
     )
 
-    cfg = TransformerConfig(
-        vocab_size=VOCAB, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
-        d_ff=128, max_seq_len=PROMPT_LEN + MAX_NEW, dtype=jnp.float32,
-    )
-    params = init_params(jax.random.key(0), cfg)
+    def model_for(prompt_len: int, max_new: int):
+        cfg = TransformerConfig(
+            vocab_size=VOCAB, d_model=64, n_layers=2, n_heads=4,
+            n_kv_heads=2, d_ff=128, max_seq_len=prompt_len + max_new,
+            dtype=jnp.float32,
+        )
+        return cfg, init_params(jax.random.key(0), cfg)
+
     n, slots = args.prompts, args.slots
-    max_len = PROMPT_LEN + MAX_NEW
-    nblk_slot = -(-max_len // BLOCK)
-    # The paged pool gets the DENSE pool's block-equivalent budget plus
-    # the sink: same bytes, so the memory rows compare at fixed budget.
-    dense_blocks = slots * nblk_slot
-    pages = {"block_size": BLOCK, "num_blocks": dense_blocks + 1}
-    kv_elem_bytes = jnp.dtype(cfg.dtype).itemsize
-    block_bytes = (
-        2 * cfg.n_layers * BLOCK * cfg.n_kv_heads * cfg.head_dim
-        * kv_elem_bytes
-    )
 
     print(
-        f"# bench_kvcache — {n} prompts, {slots} slots, prompt {PROMPT_LEN} "
-        f"+ new {MAX_NEW}, block {BLOCK}, {args.slices} paired slices",
+        f"# bench_kvcache — {n} prompts, {slots} slots, mixed slice "
+        f"prompt 32 + new {args.max_new} (prefill-heavy: 256 + 8), "
+        f"block {BLOCK}, chunks {args.chunk}, {args.slices} paired slices",
     )
-    header = (
-        "| overlap | hit rate | prefill tok (paged/dense) | saved | "
-        "peak blocks (vs dense) | context headroom | paged/dense wall |"
+    print(
+        "| slice | overlap | chunk | hit rate | prefill tok (paged/dense) "
+        "| saved | peak blocks | headroom | paged/dense wall |"
     )
-    print(header)
-    print("|---|---|---|---|---|---|---|")
+    print("|---|---|---|---|---|---|---|---|---|")
     results = []
-    for overlap in overlaps:
-        ratios, row = [], None
-        for s in range(args.slices):
-            # Fresh identical content per side, dense/paged back to back
-            # inside the slice (same box conditions).
-            dense = run_once(
-                tk, np, jax, cfg, params,
-                build_broker(tk, np, n, overlap, seed=s), slots, n, None,
-            )
-            paged = run_once(
-                tk, np, jax, cfg, params,
-                build_broker(tk, np, n, overlap, seed=s), slots, n, pages,
-            )
-            assert set(dense["out"]) == set(paged["out"])
-            for k in dense["out"]:
-                np.testing.assert_array_equal(
-                    dense["out"][k], paged["out"][k],
-                    err_msg=f"overlap {overlap} slice {s} prompt {k}",
-                )
-            assert dense["committed"] == paged["committed"], (
-                "commit ledgers diverged"
-            )
-            ratios.append(paged["elapsed_s"] / dense["elapsed_s"])
-            row = (dense, paged)  # counts identical across slices
-        dense, paged = row
-        cache = paged["cache"]
-        prefill_dense = n * PROMPT_LEN
-        saved = 1 - cache["prefill_tokens"] / prefill_dense
-        headroom = dense_blocks / max(1, paged["peak_blocks"])
-        rec = {
-            "overlap": overlap,
-            "hit_rate": cache["hit_rate"],
-            "prefill_tokens_paged": cache["prefill_tokens"],
-            "prefill_tokens_dense": prefill_dense,
-            "prefix_tokens_saved": cache["prefix_tokens_saved"],
-            "saved_frac": round(saved, 4),
-            "evictions": cache["evictions"],
-            "deferrals": cache["deferrals"],
-            "peak_blocks": paged["peak_blocks"],
-            "dense_blocks": dense_blocks,
-            "pool_bytes": dense_blocks * block_bytes,
-            "context_headroom_x": round(headroom, 2),
-            "effective_max_len_at_dense_bytes": int(max_len * headroom),
-            "paged_over_dense_wall": round(
-                float(np.median(ratios)), 2
-            ),
-            "dense_tok_s": round(dense["tok_s"], 1),
-            "paged_tok_s": round(paged["tok_s"], 1),
-        }
-        results.append(rec)
-        print(
-            f"| {overlap:.0%} | "
-            f"{(cache['hit_rate'] or 0):.2f} | "
-            f"{cache['prefill_tokens']} / {prefill_dense} | "
-            f"{saved:.0%} | "
-            f"{paged['peak_blocks']} / {dense_blocks} | "
-            f"{headroom:.2f}x (max_len {rec['effective_max_len_at_dense_bytes']}) | "
-            f"{rec['paged_over_dense_wall']:.2f}x |"
+    kv_elem_bytes = 4  # f32 toy
+    for label, prompt_len, max_new, ovl in (
+        ("mixed", 32, args.max_new, overlaps),
+        # The admission-dominated regime the chunked tick exists to
+        # flip: LONG shared-prefix prompts, short outputs — a tenant
+        # system-prompt storm.
+        *((("prefill_heavy", 256, 8, [0.9]),) if args.prefill_heavy else ()),
+    ):
+        cfg, params = model_for(prompt_len, max_new)
+        max_len = prompt_len + max_new
+        dense_blocks = slots * -(-max_len // BLOCK)
+        block_bytes = (
+            2 * cfg.n_layers * BLOCK * cfg.n_kv_heads * cfg.head_dim
+            * kv_elem_bytes
+        )
+        results += sweep(
+            tk, np, jax, cfg, params, label=label, n=n, slots=slots,
+            prompt_len=prompt_len, max_new=max_new, overlaps=ovl,
+            chunks=chunks, slices=args.slices, dense_blocks=dense_blocks,
+            block_bytes=block_bytes,
         )
     payload = {
         "bench": "kvcache",
-        "prompts": n, "slots": slots, "prompt_len": PROMPT_LEN,
-        "max_new": MAX_NEW, "block_size": BLOCK,
+        "prompts": n, "slots": slots,
+        "max_new": args.max_new, "block_size": BLOCK,
+        "chunks": [c if c is not None else "auto" for c in chunks],
         "slices": args.slices,
-        "token_exact_and_ledger_identical": True,  # asserted per slice
+        "token_exact_and_ledger_identical": True,  # asserted per cell
         "results": results,
     }
     print(json.dumps(payload))

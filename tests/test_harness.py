@@ -136,6 +136,33 @@ def test_scenario_12_prefix_cache_smoke():
     assert out["prefill_savings_pct"] > 0
 
 
+def test_scenario_14_chunked_prefill_storm():
+    """The tier-1 chunked-prefill smoke: a 4x-oversubscribed prompt
+    storm through a paged server with a one-block prefill chunk. The
+    PR-6 latency property holds — in-flight decode slots never lose a
+    single tick to admission (prefill rides the decode tick's own
+    program) — while the storm provably queues (stall ticks > 0) and
+    drains FIFO, with coverage and commits exact (the chunk-width
+    exactness differential lives in tests/test_kvcache.py)."""
+    out = run_scenario(14, "tiny")
+    assert out["scenario"] == "14:chunked-prefill-storm"
+    assert out["records"] == 16 and out["storm_factor"] == 4
+    assert out["coverage_complete"] is True
+    assert out["committed_complete"] is True
+    assert out["max_decode_stall_ticks"] == 0
+    assert out["fifo_activation"] is True
+    assert out["admission_stall_ticks"] > 0  # the storm really queued
+    assert out["chunk_ticks"] > 0
+    assert out["queue_tokens_end"] == 0
+    assert out["prefix_hit_rate"] > 0.5
+    assert out["prefill_tokens"] < out["prefill_tokens_dense"]
+
+
+def test_prefill_chunk_flag_scoping():
+    with pytest.raises(ValueError, match="prefill-chunk"):
+        run_scenario(12, "tiny", prefill_chunk=8)
+
+
 def test_scenario_7_sampled_serving():
     """--temperature/--top-k through the harness: the sampled serving row
     completes with exact commits and reports its sampling knobs."""

@@ -359,6 +359,252 @@ class TestPagedServer:
         assert s["hits"] + s["misses"] == 6
 
 
+def _chunk_pages(chunk, num_blocks=40):
+    return {"block_size": BS, "num_blocks": num_blocks,
+            "prefill_chunk": chunk}
+
+
+class TestChunkedPrefill:
+    """PR-6: chunked prefill fused into the decode tick. Admission
+    enqueues uncached suffixes; every tick carries a bounded chunk of
+    them alongside all decode slots in ONE static jitted program. Pins:
+
+    - token-exactness + commit-ledger identity vs the DENSE server AND
+      vs the PR-4 per-record paged path (``prefill_chunk=0``), across
+      chunk widths {1 token, half a prompt, auto} and greedy / seeded
+      sampling / speculative serving — each chunk query attends exactly
+      [0, position] of its slot's view, so the math is bitwise identical
+      at any width;
+    - the jit-zoo fix: admission compiles O(1) programs across 50
+      mixed-suffix-length admissions (the legacy path's per-(suffix,
+      start) cache is the contrast);
+    - the prompt-storm latency bound: 4x-oversubscribed admissions never
+      add a single tick to any in-flight slot's inter-token gap, and the
+      queue drains FIFO with no deferral starvation."""
+
+    @pytest.fixture(scope="class")
+    def runs(self, model):
+        cfg, params = model
+        prompts = _prompts(10)
+        dense = _serve(cfg, params, prompts)
+        legacy = _serve(cfg, params, prompts, kv_pages=_chunk_pages(0))
+        return prompts, dense, legacy
+
+    @pytest.mark.parametrize(
+        "chunk", [1, P // 2, None], ids=["1tok", "half", "auto"]
+    )
+    def test_token_exact_vs_dense_and_pr4_paged(self, model, runs, chunk):
+        cfg, params = model
+        prompts, (base, cb, _), (legacy, cl, sl) = runs
+        got, cg, sg = _serve(
+            cfg, params, prompts, kv_pages=_chunk_pages(chunk)
+        )
+        assert set(got) == set(base)
+        for k in base:
+            np.testing.assert_array_equal(got[k], base[k], err_msg=str(k))
+            np.testing.assert_array_equal(got[k], legacy[k], err_msg=str(k))
+        assert cg == cb == cl
+        # Same radix work and the same total prefilled tokens as the
+        # per-record path — only the dispatch structure changed.
+        cs, ls = sg.metrics.cache_summary(), sl.metrics.cache_summary()
+        assert cs["prefill_tokens"] == ls["prefill_tokens"]
+        assert cs["hits"] == ls["hits"]
+        assert sg.metrics.chunk_ticks.count > 0
+        assert sg.pending_admissions == 0
+        assert not sg._prefill_queue  # chunk queue fully drained
+
+    def test_token_exact_seeded_sampling_chunked(self, model):
+        cfg, params = model
+        prompts = _prompts(8)
+        kw = dict(temperature=0.9, top_k=16)
+        base, cb, _ = _serve(cfg, params, prompts, rng=jax.random.key(11),
+                             **kw)
+        got, cg, _ = _serve(
+            cfg, params, prompts, kv_pages=_chunk_pages(3),
+            rng=jax.random.key(11), **kw,
+        )
+        for k in base:
+            np.testing.assert_array_equal(got[k], base[k], err_msg=str(k))
+        assert cg == cb
+
+    def test_spec_rides_the_chunked_program(self, model):
+        """Spec chunked serving: token-exact vs the plain DENSE server
+        (the spec contract composed with chunking), admission compiled
+        into the tick program (no suffix-prefill jit zoo)."""
+        cfg, params = model
+        prompts = _prompts(8)
+        base, cb, _ = _serve(cfg, params, prompts)
+        spec, cs, ss = _serve(
+            cfg, params, prompts, cls=SpecStreamingGenerator, k=2,
+            kv_pages=_chunk_pages(5, num_blocks=48),
+        )
+        for k in base:
+            np.testing.assert_array_equal(spec[k], base[k], err_msg=str(k))
+        assert cs == cb
+        assert ss.spec_stats()["proposed"] > 0
+        assert ss.metrics.chunk_ticks.count > 0
+        assert len(ss._paged_prefill_jits) == 0
+        assert ss._tick_chunk_jit._cache_size() == 1
+
+    def test_admission_compiles_o1_programs(self, model):
+        """50 admissions with MIXED suffix lengths (varying radix match
+        depths): the chunked tick set stays at one program per role —
+        the fused chunk tick, the decode-only tick, the sampling merge —
+        while the legacy path specialises per (suffix, start) pair."""
+        cfg, params = model
+        rng = np.random.default_rng(3)
+        fams = _prompts(4, shared_prefix_len=0, seed=13)
+        rows = []
+        for i in range(50):
+            t = fams[i % 4].copy()
+            cut = int(rng.integers(1, P))
+            t[cut:] = rng.integers(0, VOCAB, P - cut, dtype=np.int32)
+            rows.append(t)
+        prompts = np.stack(rows)
+        _, _, s = _serve(
+            cfg, params, prompts, kv_pages=_chunk_pages(None, 160)
+        )
+        assert s._tick_chunk_jit._cache_size() == 1
+        assert s._tick_jit._cache_size() <= 1
+        assert len(s._paged_prefill_jits) == 0
+        # The legacy contrast: one specialisation per distinct
+        # (suffix, start) — the zoo this PR deletes from the hot path.
+        _, _, sl = _serve(
+            cfg, params, prompts, kv_pages=_chunk_pages(0, 160)
+        )
+        assert len(sl._paged_prefill_jits) > 1
+
+    def test_prompt_storm_decode_latency_bounded_and_fifo(self, model):
+        """4x oversubscription with in-flight decode: a 1-block chunk
+        width forces the storm to drain over many ticks, and every
+        in-flight slot must still emit exactly one token per tick
+        (completion_tick - activation_tick == tokens - 1: ZERO decode
+        stall), while admissions activate in offer order (FIFO, no
+        starvation) and the queue + deferrals drain to empty."""
+        cfg, params = model
+        n, slots = 16, 4
+        prompts = _prompts(n, shared_prefix_len=0, seed=31)
+        broker = tk.InMemoryBroker()
+        _topic(broker, prompts)
+        consumer = tk.MemoryConsumer(broker, "p", group_id="gstorm")
+
+        activation: dict = {}
+        act_order: list = []
+
+        class Instrumented(StreamingGenerator):
+            def _activate_chunk_finishers(self, finishers):
+                for e, _row in finishers:
+                    key = (e.rec.partition, e.rec.offset)
+                    activation[key] = self._tick_counter
+                    act_order.append(key)
+                super()._activate_chunk_finishers(finishers)
+
+        server = Instrumented(
+            consumer, params, cfg, slots=slots, prompt_len=P,
+            max_new=MAX_NEW, commit_every=4, ticks_per_sync=1,
+            kv_pages=_chunk_pages(BS, num_blocks=80),
+        )
+        offered: list = []
+        completion: dict = {}
+        while len(completion) < n:
+            room = server.free_slots() - server.pending_admissions
+            recs = (
+                consumer.poll(max_records=room, timeout_ms=0) if room else []
+            )
+            if recs:
+                server.note_fetched(recs)
+                offered.extend((r.partition, r.offset) for r in recs)
+                server.admit_records(recs)
+            elif server.pending_admissions and server.free_slots():
+                server.admit_records([])
+            for rec, toks in server.step():
+                completion[(rec.partition, rec.offset)] = (
+                    server._tick_counter, len(np.asarray(toks))
+                )
+        server.flush_commits()
+        assert len(completion) == n
+        # Decode never stalled: every record's decode span is exactly
+        # its token count minus the admit-tick token 0.
+        for key, (done_tick, n_toks) in completion.items():
+            assert done_tick - activation[key] == n_toks - 1, key
+        # FIFO activation, no starvation: offer order IS activation
+        # order (deferred/queued admissions re-offer first).
+        assert act_order == offered
+        m = server.metrics
+        assert m.admission_stall_ticks.count > 0  # the storm really queued
+        assert not server._prefill_queue and server.pending_admissions == 0
+        assert m.chunk_summary()["queue_tokens"] == 0
+        consumer.close()
+
+    def test_metrics_exposition_includes_chunk_counters(self, model):
+        cfg, params = model
+        prompts = _prompts(6)
+        _, _, sp = _serve(cfg, params, prompts, kv_pages=_chunk_pages(3))
+        text = sp.metrics.render_prometheus()
+        for name in (
+            "chunk_ticks_total", "admission_stall_ticks_total",
+            "admission_queue_tokens", "chunk_utilization",
+            "prefill_tokens_per_chunk_tick",
+        ):
+            assert f"torchkafka_serve_{name}" in text, name
+        for line in text.strip().split("\n"):
+            if not line.startswith("#"):
+                float(line.rsplit(" ", 1)[1])
+        cs = sp.metrics.chunk_summary()
+        assert cs["chunk_ticks"] > 0 and cs["utilization"] > 0
+
+
+class TestInt8Paged:
+    """The int8 paged pool: block pools store int8 payloads + the SAME
+    group-wise (position, head) absmax scales as the dense int8 slot
+    pool (models.quant.quant_kv_groups), so int8-paged serving is
+    token-exact vs int8-DENSE serving (the int8-vs-bf16 error is the
+    opt-in tradeoff, unchanged); the Pallas block-table kernel read
+    (ops/kvattn v4) is exact vs the XLA gathered read through the whole
+    serving differential."""
+
+    def _run(self, cfg, params, prompts, **kw):
+        return _serve(cfg, params, prompts, **kw)
+
+    def test_int8_paged_token_exact_vs_int8_dense(self, model):
+        cfg, params = model
+        prompts = _prompts(8)
+        dense, cd, _ = self._run(cfg, params, prompts, kv_dtype="int8")
+        paged, cp, sp = self._run(
+            cfg, params, prompts, kv_dtype="int8", kv_pages=PAGES
+        )
+        assert set(paged) == set(dense)
+        for k in dense:
+            np.testing.assert_array_equal(paged[k], dense[k], err_msg=str(k))
+        assert cp == cd
+        assert sp.metrics.cache_summary()["hits"] > 0  # radix still works
+
+    def test_int8_paged_kernel_serving_exact(self, model):
+        """kv_kernel=True + kv_pages: the decode ticks read through the
+        Pallas block-table kernel (interpret mode off-TPU) and the
+        serving output matches the XLA-read int8 paged server and the
+        int8 dense server."""
+        cfg, params = model
+        prompts = _prompts(6)
+        dense, cd, _ = self._run(cfg, params, prompts, kv_dtype="int8")
+        kern, ck, sk = self._run(
+            cfg, params, prompts, kv_dtype="int8", kv_kernel=True,
+            kv_pages=PAGES,
+        )
+        assert sk._kv_kernel is True
+        for k in dense:
+            np.testing.assert_array_equal(kern[k], dense[k], err_msg=str(k))
+        assert ck == cd
+
+    def test_legacy_admission_rejects_int8(self, model):
+        cfg, params = model
+        with pytest.raises(ValueError, match="prefill_chunk"):
+            _serve(
+                cfg, params, _prompts(2), kv_dtype="int8",
+                kv_pages=_chunk_pages(0),
+            )
+
+
 class TestStaleTailInvariant:
     """The serve.py docstring hazard as an asserted invariant: a recycled
     slot/block never attends over stale positions. Every cache position

@@ -440,6 +440,69 @@ class TestInt8DecodeAttentionKernel:
                 err_msg=f"block={mb}",
             )
 
+    def test_paged_kernel_matches_gathered_read(self):
+        """v4 (block-table read: the v3 watermark-DMA structure through
+        per-slot block tables) against the XLA gathered scale-folded
+        read, with slots sharing physical prefix blocks, watermarks at
+        block edges and mid-block, and free/garbage blocks the tables
+        never reference (the kernel must not touch them)."""
+        import jax.numpy as jnp
+
+        from torchkafka_tpu.models.generate import _attend_cached
+        from torchkafka_tpu.models.quant import quant_kv_groups
+        from torchkafka_tpu.ops.kvattn import (
+            int8_paged_decode_attention, paged_gather_kmajor,
+        )
+
+        rng = np.random.default_rng(5)
+        NB, bs, K, rep, Dh = 12, 8, 2, 2, 16
+        B, nblk = 4, 4  # logical view 32 positions per slot
+        H = K * rep
+
+        class _Cfg:
+            dtype = jnp.float32
+            head_dim = Dh
+
+        q = jnp.asarray(rng.normal(size=(B, 1, H, Dh)), jnp.float32)
+        raw_k = rng.normal(size=(NB, bs, K, Dh)) * 2
+        raw_v = rng.normal(size=(NB, bs, K, Dh)) * 2
+        # K-major-per-block pools, garbage everywhere (unreferenced
+        # blocks included — the gather mask and the kernel's block loop
+        # must both ignore them).
+        kq, ks = quant_kv_groups(jnp.asarray(raw_k, jnp.float32))
+        vq, vs = quant_kv_groups(jnp.asarray(raw_v, jnp.float32))
+        kqT, vqT = (jnp.swapaxes(a, 1, 2) for a in (kq, vq))  # [NB, K, bs, Dh]
+        ksT, vsT = (jnp.swapaxes(a, 1, 2) for a in (ks, vs))  # [NB, K, bs]
+        # Slots 0/1 share block 3 as a cached prefix (the radix shape);
+        # block 0 is the sink, blocks 9-11 are free garbage.
+        table = jnp.asarray([
+            [3, 1, 2, 4], [3, 5, 6, 7], [8, 2, 1, 5], [4, 6, 3, 8],
+        ], jnp.int32)
+        pos = jnp.asarray([0, 7, 12, 31])  # block edges and mid-block
+        # Reference: gathered view + scale-folded _attend_cached. The
+        # attention tail needs layer weights; compare pre-tail by using
+        # an identity-free spelling — reimplement the fold directly.
+        ck = paged_gather_kmajor(kqT, table).astype(jnp.float32)
+        cv = paged_gather_kmajor(vqT, table).astype(jnp.float32)
+        cks = paged_gather_kmajor(ksT, table)
+        cvs = paged_gather_kmajor(vsT, table)
+        M = nblk * bs
+        qg = q[:, 0].reshape(B, K, rep, Dh)
+        scores = jnp.einsum("bkre,bmke->bkrm", qg, ck)
+        scores = scores * cks.transpose(0, 2, 1)[:, :, None, :]
+        scores = scores / jnp.sqrt(jnp.float32(Dh))
+        valid = jnp.arange(M)[None, :] <= pos[:, None]
+        scores = jnp.where(valid[:, None, None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        probs = probs * cvs.transpose(0, 2, 1)[:, :, None, :]
+        ref = jnp.einsum("bkrm,bmke->bkre", probs, cv).reshape(B, 1, H, Dh)
+        out = int8_paged_decode_attention(
+            q, kqT, ksT, vqT, vsT, table, pos, interpret=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=3e-5, rtol=3e-5
+        )
+
     def test_kernel_gates(self):
         """v3's scratch is block-sized, so LONG pools are supported (the
         v2 VMEM bound is gone from serving); pools that only tile at

@@ -96,6 +96,21 @@ class FleetMetrics:
             "fallbacks": sum(m.cache_fallbacks.count for m in gens),
             "pool_occupancy": round(sum(occ) / len(occ), 3) if occ else 0.0,
         }
+        chunk_ticks = sum(m.chunk_ticks.count for m in gens)
+        chunk_prefill_tokens = sum(m.prefill_tokens.count for m in gens)
+        chunked = {
+            "chunk_ticks": chunk_ticks,
+            "prefill_tokens_per_tick": (
+                round(chunk_prefill_tokens / chunk_ticks, 2)
+                if chunk_ticks else None
+            ),
+            "stall_ticks": sum(
+                m.admission_stall_ticks.count for m in gens
+            ),
+            "queue_tokens": int(sum(
+                m.admission_queue_tokens.value for m in gens
+            )),
+        }
         journal = {
             "handoffs": self.journal_handoffs.count,
             "drain_timeout_kills": self.drain_timeout_kills.count,
@@ -108,6 +123,7 @@ class FleetMetrics:
         }
         return {
             "prefix_cache": cache,
+            "chunked_prefill": chunked,
             "journal": journal,
             "completions": self.completions.count,
             "completions_per_s": round(self.completions.rate(), 1),
@@ -147,7 +163,13 @@ class FleetMetrics:
     ) -> str:
         s = self.summary(replicas)
         pc = s["prefix_cache"]
+        cp = s["chunked_prefill"]
         return render_exposition(prefix, [
+            ("chunk_ticks_total", "counter", cp["chunk_ticks"]),
+            ("admission_stall_ticks_total", "counter", cp["stall_ticks"]),
+            ("admission_queue_tokens", "gauge", cp["queue_tokens"]),
+            ("prefill_tokens_per_chunk_tick", "gauge",
+             cp["prefill_tokens_per_tick"] or 0.0),
             ("completions_total", "counter", s["completions"]),
             ("duplicate_completions_total", "counter", s["duplicates"]),
             ("backpressure_pauses_total", "counter", s["backpressure_pauses"]),

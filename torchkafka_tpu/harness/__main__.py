@@ -60,6 +60,11 @@ def main() -> None:
     ap.add_argument("--replicas", type=int, default=2,
                     help="scenarios 10/11/12/13 (serving fleet / chaos soak / "
                     "prefix-cache fleet / warm failover): replica count")
+    ap.add_argument("--prefill-chunk", type=int, default=None,
+                    help="scenario 14 (chunked-prefill storm): suffix "
+                    "tokens the fused tick carries alongside decode "
+                    "(default: one block) — smaller bounds per-tick "
+                    "prefill work, the decode-latency lever")
     args = ap.parse_args()
     if args.scenario:
         nums = [args.scenario]
@@ -76,7 +81,7 @@ def main() -> None:
             spec=args.spec, spec_k=args.spec_k,
             spec_draft_layers=args.spec_draft_layers,
             temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
-            replicas=args.replicas,
+            replicas=args.replicas, prefill_chunk=args.prefill_chunk,
         )))
 
 

@@ -1297,6 +1297,122 @@ def scenario_13(size: str = "tiny", replicas: int = 2) -> dict:
     }
 
 
+def scenario_14(size: str = "tiny", prefill_chunk: int | None = None) -> dict:
+    """Chunked-prefill prompt-storm smoke (serve.py kv_pages chunked
+    mode): a 4x-oversubscribed admission wave — duplicate-heavy tenant
+    prompts, all produced up front — through a paged server whose
+    admission is CHUNKED into the decode tick (one static program per
+    tick carrying a bounded chunk of queued suffix tokens alongside all
+    decode slots). The tier-1 guard for the PR-6 latency property:
+    decode inter-token latency must stay EXACTLY one tick per token for
+    every in-flight slot while the storm drains FIFO through the chunk
+    queue (``max_decode_stall_ticks == 0``), with coverage/commit
+    exactness and the chunk counters live. ``prefill_chunk`` defaults
+    to one block per tick — small enough that the storm provably queues
+    (admission_stall_ticks > 0). The exactness differential across
+    chunk widths is tests/test_kvcache.py; the wall-clock story is
+    benchmarks/bench_kvcache.py --chunk."""
+    import time as _time
+
+    import torchkafka_tpu as tk
+    from torchkafka_tpu.serve import StreamingGenerator
+    from torchkafka_tpu.source.records import TopicPartition
+
+    prompt_len, max_new = (16, 8) if size == "tiny" else (64, 32)
+    block = 4 if size == "tiny" else 16
+    slots = 4
+    n = 4 * slots  # the 4x storm
+    chunk = prefill_chunk if prefill_chunk else block
+    cfg, params, label = _serving_model(size, None, prompt_len, max_new)
+    broker = tk.InMemoryBroker()
+    broker.create_topic("t14", partitions=4)
+    rng = np.random.default_rng(0)
+    sys_len = 2 * block
+    system = rng.integers(0, cfg.vocab_size, sys_len, dtype=np.int32)
+    for i in range(n):
+        prompt = np.concatenate([
+            system,
+            rng.integers(0, cfg.vocab_size, prompt_len - sys_len,
+                         dtype=np.int32),
+        ])
+        broker.produce("t14", prompt.tobytes(), partition=i % 4)
+
+    activation: dict = {}
+    act_order: list = []
+    enq_order: list = []
+
+    class Instrumented(StreamingGenerator):
+        def admit_records(self, records):
+            before = len(self._prefill_queue)
+            out = super().admit_records(records)
+            enq_order.extend(
+                (e.rec.partition, e.rec.offset)
+                for e in self._prefill_queue[before:]
+            )
+            return out
+
+        def _activate_chunk_finishers(self, finishers):
+            for e, _row in finishers:
+                key = (e.rec.partition, e.rec.offset)
+                activation[key] = self._tick_counter
+                act_order.append(key)
+            super()._activate_chunk_finishers(finishers)
+
+    consumer = tk.MemoryConsumer(broker, "t14", group_id="s14")
+    server = Instrumented(
+        consumer, params, cfg, slots=slots, prompt_len=prompt_len,
+        max_new=max_new, commit_every=4, ticks_per_sync=1,
+        kv_pages={
+            "block_size": block,
+            "num_blocks": slots * -(-(prompt_len + max_new) // block) + 12,
+            "prefill_chunk": chunk,
+        },
+    )
+    server.warmup()
+    t0 = _time.perf_counter()
+    completion: dict = {}
+    for rec, toks in server.run(max_records=n):
+        completion[(rec.partition, rec.offset)] = (
+            server._tick_counter, int(np.asarray(toks).shape[0])
+        )
+    elapsed = _time.perf_counter() - t0
+    committed_complete = all(
+        broker.committed("s14", TopicPartition("t14", p))
+        == broker.end_offset(TopicPartition("t14", p))
+        for p in range(4)
+    )
+    # Zero decode stall: each record's decode span is exactly its token
+    # count minus the activation tick's token 0.
+    stalls = [
+        done_tick - activation[k] - (n_toks - 1)
+        for k, (done_tick, n_toks) in completion.items()
+    ]
+    m = server.metrics
+    cs = m.chunk_summary()
+    cache = m.cache_summary()
+    consumer.close()
+    return {
+        "scenario": "14:chunked-prefill-storm",
+        "model_scale": label,
+        "records": len(completion),
+        "elapsed_s": round(elapsed, 3),
+        "storm_factor": n // slots,
+        "prefill_chunk": chunk,
+        "coverage_complete": len(completion) == n,
+        "committed_complete": committed_complete,
+        "max_decode_stall_ticks": max(stalls) if stalls else None,
+        "fifo_activation": act_order == enq_order,
+        "chunk_ticks": cs["chunk_ticks"],
+        "prefill_tokens_per_tick": cs["prefill_tokens_per_tick"],
+        "admission_stall_ticks": cs["stall_ticks"],
+        "chunk_utilization": cs["utilization"],
+        "queue_tokens_end": cs["queue_tokens"],
+        "prefix_hit_rate": cache["hit_rate"],
+        "prefill_tokens": cache["prefill_tokens"],
+        "prefill_tokens_dense": n * prompt_len,
+    }
+
+
 def scenario_8(size: str = "tiny") -> dict:
     """Streaming CTR: DLRM-style recommender trained from a Kafka event
     stream — label + dense features + hashed categorical ids per record,
@@ -1665,6 +1781,7 @@ SCENARIOS = {
     11: scenario_11,
     12: scenario_12,
     13: scenario_13,
+    14: scenario_14,
 }
 
 
@@ -1676,9 +1793,17 @@ def run_scenario(
     spec_draft_layers: int | None = None,
     temperature: float = 0.0, top_k: int | None = None,
     top_p: float | None = None, replicas: int = 2,
+    prefill_chunk: int | None = None,
 ) -> dict:
     if size not in _SIZES:
         raise ValueError(f"size must be one of {_SIZES}")
+    if prefill_chunk is not None and num != 14:
+        raise ValueError(
+            "--prefill-chunk applies to scenario 14 (the chunked-prefill "
+            "storm smoke)"
+        )
+    if num == 14:
+        return SCENARIOS[14](size, prefill_chunk=prefill_chunk)
     if serve_eos and (num != 7 or model_scale is None):
         raise ValueError("--serve-eos applies to scenario 7 at a model scale")
     if quantized is not None and (model_scale is None or num not in (5, 7)):

@@ -50,10 +50,29 @@ class PagedKVConfig:
     (``ceil(max_len / block_size)`` blocks) cannot serve at all —
     the server then falls back to the dense cache-off path
     (gracefully, with a warning) rather than deadlocking admission.
+
+    ``prefill_chunk``: admission mode. ``None`` (default) = CHUNKED
+    prefill fused into the decode tick — admission enqueues each
+    prompt's uncached suffix host-side and every tick processes a
+    bounded, statically-shaped chunk of those tokens ALONGSIDE all
+    decode slots in ONE jitted program (Sarathi-style: prefill rides
+    the weight stream decode already pays for), with the chunk width
+    auto-sized to ``slots * prompt_len`` (every admission a single
+    serving quantum can offer completes in one tick, preserving the
+    per-record completion timing of the per-record path shifted by
+    exactly one tick). An explicit int >= 1 fixes the chunk width —
+    smaller widths bound how much prefill work any one tick carries
+    (the decode-latency lever under prompt storms; a prompt storm
+    then drains FIFO at ``prefill_chunk`` tokens per tick while
+    in-flight decode keeps emitting one token per slot per tick).
+    ``0`` = the LEGACY per-record admission (one suffix-prefill
+    dispatch per record, a jit specialisation per suffix length) —
+    kept as the measured PR-4 baseline and differential reference.
     """
 
     block_size: int
     num_blocks: int
+    prefill_chunk: int | None = None
 
     def __post_init__(self) -> None:
         if self.block_size < 1:
@@ -62,6 +81,11 @@ class PagedKVConfig:
             raise ValueError(
                 f"num_blocks must be >= 2 (block 0 is the sink), "
                 f"got {self.num_blocks}"
+            )
+        if self.prefill_chunk is not None and self.prefill_chunk < 0:
+            raise ValueError(
+                f"prefill_chunk must be None (auto), 0 (legacy per-record "
+                f"admission) or >= 1, got {self.prefill_chunk}"
             )
 
     def blocks_per_slot(self, max_len: int) -> int:

@@ -61,6 +61,23 @@ def embed_rows(w, tokens, dtype):
     return w[tokens].astype(dtype)
 
 
+def quant_kv_groups(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Group-wise symmetric absmax int8 over the LAST (head_dim) axis:
+    [..., Dh] → (int8 [..., Dh], f32 scale [...]) — one scale per
+    (position, head) group, the KV-cache analog of ``quantize``'s
+    per-output-channel weight scheme. Shared by the dense int8 slot
+    pool (serve._slot_layer_step_q) and the int8 PAGED pool (the block
+    pools quantize each written position through the same groups, so
+    int8-paged serving is token-exact vs int8-dense serving — the
+    groups, not just the scheme, are identical)."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.maximum(amax / 127.0, 1e-12)
+    q = jnp.clip(
+        jnp.round(x.astype(jnp.float32) / scale[..., None]), -127, 127
+    ).astype(jnp.int8)
+    return q, scale
+
+
 # Contraction axes per weight name (stacked [L, ...] layout); embeddings are
 # per-row (the gather output dim).
 _LAYER_AXES = {

@@ -348,6 +348,75 @@ def paged_scatter(
     return pool.at[blk, off].set(values.astype(pool.dtype))
 
 
+def paged_gather_kmajor(pool: jax.Array, table: jax.Array) -> jax.Array:
+    """``paged_gather`` for K-MAJOR-PER-BLOCK pools.
+
+    pool: [NB, K, bs, ...rest] (payload rest=(Dh,), scales rest=());
+    table: [B, nblk] int32 → [B, nblk * bs, K, ...rest]. The int8 paged
+    pool stores each block K-major so the Pallas block-table kernel's
+    per-block tiles are the v3 kernel's [K, bs, Dh] shape (one batched
+    dot over (slot, head), no per-head relayout); the XLA read pays one
+    transpose of the gathered view to recover the logical
+    [B, M', K, ...] layout ``_attend_cached`` expects."""
+    b, nblk = table.shape
+    v = jnp.swapaxes(pool[table], 2, 3)  # [B, nblk, bs, K, ...rest]
+    return v.reshape(b, nblk * pool.shape[2], *v.shape[3:])
+
+
+def paged_scatter_kmajor(
+    pool: jax.Array, table: jax.Array, positions: jax.Array,
+    values: jax.Array,
+) -> jax.Array:
+    """``paged_scatter`` for K-major-per-block pools: ``values``
+    [B, S, K, ...rest] written at logical ``positions`` [B, S] through
+    ``table`` into ``pool`` [NB, K, bs, ...rest]. Same ownership rules
+    as ``paged_scatter`` (sink-routed idle writes, private-block-only
+    live writes)."""
+    bs = pool.shape[2]
+    blk = jnp.take_along_axis(table, positions // bs, axis=1)  # [B, S]
+    off = positions % bs
+    # Advanced indices separated by the K slice broadcast to the front:
+    # pool[blk, :, off] is [B, S, K, ...rest], matching ``values``.
+    return pool.at[blk, :, off].set(values.astype(pool.dtype))
+
+
+def block_table_attention_q8(
+    x, q, k_new, v_new, pool_kq, pool_ks, pool_vq, pool_vs, table,
+    positions, layer, cfg,
+):
+    """``block_table_attention`` over the INT8 paged pool: fresh k/v are
+    quantized into the shared group-wise scheme (``models.quant.
+    quant_kv_groups`` — one absmax scale per (position, head), the same
+    groups the dense int8 slot pool stores, which is what makes
+    int8-paged serving token-exact vs int8-DENSE serving), scattered
+    K-major-per-block (payload [NB, K, bs, Dh] + scales [NB, K, bs]),
+    and read back through the scale-folded ``_attend_cached`` on the
+    gathered logical view. Returns (x, pool_kq, pool_ks, pool_vq,
+    pool_vs). The Pallas block-table kernel replaces only this READ on
+    the decode path (``int8_paged_decode_attention``); the write
+    half is shared."""
+    from torchkafka_tpu.models.generate import _attend_cached
+    from torchkafka_tpu.models.quant import quant_kv_groups
+
+    kq, ks = quant_kv_groups(k_new)  # [B, S, K, Dh] int8, [B, S, K] f32
+    vq, vs = quant_kv_groups(v_new)
+    pool_kq = paged_scatter_kmajor(pool_kq, table, positions, kq)
+    pool_ks = paged_scatter_kmajor(pool_ks, table, positions, ks)
+    pool_vq = paged_scatter_kmajor(pool_vq, table, positions, vq)
+    pool_vs = paged_scatter_kmajor(pool_vs, table, positions, vs)
+    ck = paged_gather_kmajor(pool_kq, table)  # [B, M', K, Dh] int8
+    cv = paged_gather_kmajor(pool_vq, table)
+    cks = paged_gather_kmajor(pool_ks, table)  # [B, M', K] f32
+    cvs = paged_gather_kmajor(pool_vs, table)
+    valid = (
+        jnp.arange(ck.shape[1])[None, None, :] <= positions[:, :, None]
+    )  # [B, S, M']
+    x = _attend_cached(
+        x, q, ck, cv, valid, layer, cfg, k_scale=cks, v_scale=cvs
+    )
+    return x, pool_kq, pool_ks, pool_vq, pool_vs
+
+
 def block_table_attention(
     x, q, k_new, v_new, pool_k, pool_v, table, positions, layer, cfg,
 ):
@@ -557,4 +626,186 @@ def int8_decode_attention_dynlen(
         **kw,
     )(pos.astype(jnp.int32), qg, ck_q, ck_s.astype(jnp.float32), cv_q,
       cv_s.astype(jnp.float32))
+    return out.reshape(b, 1, h, dh)
+
+
+# ------------------------------------------------------------------ v4
+# Block-table read: the v3 watermark-DMA structure extended to read
+# THROUGH per-slot block tables (the int8 PAGED pool). Both the pool
+# watermarks (pos) and the block tables arrive by scalar prefetch; the
+# per-slot block loop DMAs exactly ceil((pos+1)/bs) physical blocks —
+# ``pool_kq.at[table[b, j]]`` — so HBM traffic scales with each slot's
+# live length AND the host-side indirection (which physical block backs
+# which logical position) never materialises a gathered per-slot view
+# the way the XLA spelling must (paged_gather copies the view every
+# layer, every tick). The pool is K-MAJOR-PER-BLOCK ([NB, K, bs, Dh] /
+# [NB, K, bs]) so each block tile is exactly the v3 kernel's [K, mb,
+# Dh] shape: one batched dot over (slot, head), no per-head relayout
+# (the v1 postmortem's rule). Cross-program first-block prefetch and
+# global buffer parity are carried over from v3 verbatim — parity is
+# the prefix-sum of per-slot block counts, computable by any program
+# from the prefetched watermarks.
+
+
+def _kvattn_paged_kernel(
+    pos_ref, table_ref, q_ref, kq_hbm, ks_hbm, vq_hbm, vs_hbm, o_ref,
+    kt, st, vt, wt, sems, *, bs: int, inv_sqrt_dh: float,
+):
+    b = pl.program_id(0)
+    nb = pl.num_programs(0)
+    pos = pos_ref[b]
+    n_blocks = (pos + bs) // bs  # ceil((pos + 1) / bs), pos >= 0
+    q = q_ref[0]  # [K, rep, Dh] compute dtype
+    n_kv, rep, dh = q.shape
+
+    def blocks_of(t):
+        return (pos_ref[t] + bs) // bs
+
+    parity0 = jax.lax.fori_loop(
+        0, b, lambda t, acc: acc + blocks_of(t), jnp.int32(0)
+    ) % 2
+
+    def dmas(slot, row, j):
+        blk = table_ref[row, j]  # physical block id — the indirection
+        return (
+            pltpu.make_async_copy(
+                kq_hbm.at[blk], kt.at[slot], sems.at[slot, 0],
+            ),
+            pltpu.make_async_copy(
+                ks_hbm.at[blk], st.at[slot], sems.at[slot, 1],
+            ),
+            pltpu.make_async_copy(
+                vq_hbm.at[blk], vt.at[slot], sems.at[slot, 2],
+            ),
+            pltpu.make_async_copy(
+                vs_hbm.at[blk], wt.at[slot], sems.at[slot, 3],
+            ),
+        )
+
+    @pl.when(b == 0)
+    def _():  # no predecessor: start our own first block
+        for d in dmas(parity0 % 2, b, 0):
+            d.start()
+
+    m0 = jnp.full((n_kv, rep), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((n_kv, rep), jnp.float32)
+    a0 = jnp.zeros((n_kv, rep, dh), jnp.float32)
+
+    def body(j, carry):
+        m, l, acc = carry
+        slot = (parity0 + j) % 2
+
+        @pl.when(j + 1 < n_blocks)
+        def _():
+            for d in dmas((parity0 + j + 1) % 2, b, j + 1):
+                d.start()
+
+        @pl.when((j + 1 == n_blocks) & (b + 1 < nb))
+        def _():  # prefetch the NEXT program's first block
+            for d in dmas((parity0 + n_blocks) % 2, b + 1, 0):
+                d.start()
+
+        for d in dmas(slot, b, j):
+            d.wait()
+        kk = kt[slot].astype(q.dtype)  # [K, bs, Dh]
+        s = jax.lax.dot_general(
+            q, kk, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )  # [K, rep, bs]
+        s = s * st[slot][:, None, :] * inv_sqrt_dh
+        col = jax.lax.broadcasted_iota(jnp.int32, (n_kv, rep, bs), 2) + j * bs
+        s = jnp.where(col <= pos, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m_new)  # first block: exp(-inf - m) = 0
+        p = jnp.exp(s - m_new[..., None])
+        l = l * alpha + jnp.sum(p, axis=-1)
+        pw = (p * wt[slot][:, None, :]).astype(q.dtype)
+        vv = vt[slot].astype(q.dtype)
+        acc = acc * alpha[..., None] + jax.lax.dot_general(
+            pw, vv, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l, acc
+
+    m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, a0))
+    o_ref[0] = (acc / l[..., None]).astype(o_ref.dtype)
+
+
+def paged_kernel_applicable(head_dim: int, block_size: int) -> bool:
+    """Compiled-Mosaic tiling constraints for the block-table read:
+    lane-aligned head_dim and sublane-aligned block size (the [K, bs]
+    scale tiles need bs % 8; Dh is the lane dim of the payload tiles).
+    Interpret mode accepts anything; tests force it. Callers should
+    additionally require a reasonable block size (>= 256) on TPU —
+    skipping works at block granularity, but tiny blocks drown in
+    per-block DMA/recurrence overhead (the dynlen_block lesson)."""
+    return head_dim % 128 == 0 and block_size % 8 == 0
+
+
+def int8_paged_decode_attention(
+    q: jax.Array,
+    pool_kq: jax.Array,
+    pool_ks: jax.Array,
+    pool_vq: jax.Array,
+    pool_vs: jax.Array,
+    table: jax.Array,
+    pos: jax.Array,
+    *,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """q [B, 1, H, Dh] against the int8 PAGED pool — K-major-per-block
+    payloads pool_kq/pool_vq [NB, K, bs, Dh] with scales pool_ks/pool_vs
+    [NB, K, bs] (f32) — read through per-slot block tables ``table``
+    [B, nblk] (int32) at per-slot watermarks ``pos`` [B] (positions
+    [0, pos[b]] readable) → attn [B, 1, H, Dh].
+
+    Only ceil((pos+1)/bs) physical blocks are DMA'd per slot, each by
+    table indirection, so HBM traffic scales with live tokens and no
+    gathered per-slot view is ever materialised (the XLA block-table
+    read copies one per layer per tick). Exact w.r.t. the scale-folded
+    gathered read restricted to valid positions (flash-style online
+    softmax; differential-tested against ``paged_gather_kmajor`` +
+    ``_attend_cached``)."""
+    b, s, h, dh = q.shape
+    if s != 1:
+        raise ValueError(f"decode attention is one query per slot, got S={s}")
+    n_kv, bs = pool_kq.shape[1], pool_kq.shape[2]
+    rep = h // n_kv
+    if interpret is None:
+        interpret = _default_interpret()
+    qg = q[:, 0].reshape(b, n_kv, rep, dh)
+    # SEQUENTIAL grid ("arbitrary"): cross-program prefetch, as v3.
+    kw = {} if interpret else tpu_compiler_params(("arbitrary",))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # watermarks AND block tables
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, n_kv, rep, dh), lambda i, pos, tbl: (i, 0, 0, 0)),
+            pl.BlockSpec(memory_space=_ANY),
+            pl.BlockSpec(memory_space=_ANY),
+            pl.BlockSpec(memory_space=_ANY),
+            pl.BlockSpec(memory_space=_ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, n_kv, rep, dh), lambda i, pos, tbl: (i, 0, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, n_kv, bs, dh), jnp.int8),   # k tiles
+            pltpu.VMEM((2, n_kv, bs), jnp.float32),    # k scales
+            pltpu.VMEM((2, n_kv, bs, dh), jnp.int8),   # v tiles
+            pltpu.VMEM((2, n_kv, bs), jnp.float32),    # v scales
+            pltpu.SemaphoreType.DMA((2, 4)),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _kvattn_paged_kernel, bs=bs,
+            inv_sqrt_dh=float(1.0 / np.sqrt(dh)),
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, n_kv, rep, dh), q.dtype),
+        interpret=interpret,
+        **kw,
+    )(pos.astype(jnp.int32), table.astype(jnp.int32), qg, pool_kq,
+      pool_ks.astype(jnp.float32), pool_vq, pool_vs.astype(jnp.float32))
     return out.reshape(b, 1, h, dh)

@@ -139,13 +139,13 @@ def _pick_slots(logits, key_data, idx, *, temperature, top_k, top_p):
 
 def _quant_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
     """Symmetric absmax int8 over the last (head_dim) axis:
-    [..., Dh] → (int8 [..., Dh], f32 scale [...])."""
-    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
-    scale = jnp.maximum(amax / 127.0, 1e-12)
-    q = jnp.clip(
-        jnp.round(x.astype(jnp.float32) / scale[..., None]), -127, 127
-    ).astype(jnp.int8)
-    return q, scale
+    [..., Dh] → (int8 [..., Dh], f32 scale [...]). The shared
+    ``models.quant.quant_kv_groups`` scheme — the int8 PAGED pool
+    quantizes through the same (position, head) groups, which is what
+    keeps int8-paged serving token-exact vs int8-dense serving."""
+    from torchkafka_tpu.models.quant import quant_kv_groups
+
+    return quant_kv_groups(x)
 
 
 def _slot_layer_step_q(
@@ -244,6 +244,18 @@ class ServeMetrics:
         # pressure (records re-offered FIFO once blocks free)
         self.cache_fallbacks = RateMeter()  # paged → dense cache-off fallbacks
         self.cache_pool_occupancy = Gauge()  # allocated / usable blocks
+        # Chunked prefill (kv_pages with prefill_chunk != 0): admission
+        # enqueues uncached suffixes and every tick carries a bounded
+        # chunk of them alongside decode. All zero in legacy/dense modes.
+        self.chunk_ticks = RateMeter()  # ticks that carried prefill chunk rows
+        self.admission_stall_ticks = RateMeter()  # EXTRA ticks admissions
+        # queued beyond the one-tick minimum (0 when every admission's
+        # suffix fits the chunk a single tick carries — the prompt-storm
+        # regression bound)
+        self.admission_queue_tokens = Gauge()  # uncached suffix tokens still
+        # queued for chunk prefill, sampled after each tick
+        self.chunk_utilization = Gauge()  # cumulative prefill tokens /
+        # (chunk ticks x chunk width): how full the static chunk rides
         # Decode journal / warm failover (torchkafka_tpu/journal): all zero
         # without a journal or resume hints.
         self.decoded_tokens = RateMeter()  # tokens produced by decode ticks
@@ -283,7 +295,20 @@ class ServeMetrics:
             "commit": self.commit_latency.summary(),
             "slot_occupancy": round(self.slot_occupancy.value, 3),
             "prefix_cache": self.cache_summary(),
+            "chunked_prefill": self.chunk_summary(),
             "journal": self.journal_summary(),
+        }
+
+    def chunk_summary(self) -> dict:
+        ticks = self.chunk_ticks.count
+        return {
+            "chunk_ticks": ticks,
+            "prefill_tokens_per_tick": (
+                round(self.prefill_tokens.count / ticks, 2) if ticks else None
+            ),
+            "stall_ticks": self.admission_stall_ticks.count,
+            "queue_tokens": int(self.admission_queue_tokens.value),
+            "utilization": round(self.chunk_utilization.value, 4),
         }
 
     def journal_summary(self) -> dict:
@@ -319,7 +344,14 @@ class ServeMetrics:
         s = self.summary()
         pc = s["prefix_cache"]
         jn = s["journal"]
+        cp = s["chunked_prefill"]
         return render_exposition(prefix, [
+            ("chunk_ticks_total", "counter", cp["chunk_ticks"]),
+            ("admission_stall_ticks_total", "counter", cp["stall_ticks"]),
+            ("admission_queue_tokens", "gauge", cp["queue_tokens"]),
+            ("chunk_utilization", "gauge", cp["utilization"]),
+            ("prefill_tokens_per_chunk_tick", "gauge",
+             cp["prefill_tokens_per_tick"] or 0.0),
             ("decoded_tokens_total", "counter", jn["decoded_tokens"]),
             ("warm_resumes_total", "counter", jn["warm_resumes"]),
             ("journal_tokens_restored_total", "counter", jn["tokens_restored"]),
@@ -375,6 +407,30 @@ def _slot_layer_step(x, layer, cache_k, cache_v, pos_b, cfg):
     valid = jnp.arange(cache_k.shape[1])[None, :] <= pos_b[:, None]  # [B, M]
     x = _attend_cached(x, q, cache_k, cache_v, valid, layer, cfg)
     return x, cache_k, cache_v
+
+
+class _PendingPrefill:
+    """One admission's queued chunk-prefill work (paged chunked mode).
+
+    The slot and its blocks are already reserved (table linked, radix
+    inserted); ``seq`` is the UNCACHED suffix still to be written —
+    ``seq[off:]`` remains — with ``seq[0]`` sitting at logical position
+    ``start``. ``resume`` carries a journal warm-resume's emitted
+    tokens (activation restores state instead of sampling token 0);
+    None for a cold admission."""
+
+    __slots__ = ("slot", "rec", "seq", "off", "start", "key_np", "resume",
+                 "enq_tick")
+
+    def __init__(self, slot, rec, seq, start, key_np, resume, enq_tick):
+        self.slot = slot
+        self.rec = rec
+        self.seq = seq
+        self.off = 0
+        self.start = start
+        self.key_np = key_np
+        self.resume = resume
+        self.enq_tick = enq_tick
 
 
 def _default_decode_prompt(prompt_len: int) -> Callable[[Record], np.ndarray]:
@@ -522,11 +578,40 @@ class StreamingGenerator:
         just re-prefills). Pool pressure defers admissions (FIFO
         re-offer once blocks free); a pool too small for even one slot
         falls back to dense cache-off serving with a warning
-        (``metrics.cache_fallbacks``). Single-device, compute-dtype
-        only (mesh / int8-KV / Pallas-kernel composition validated out),
-        and not MoE (the paged prefill routes experts densely — decode's
-        rule — which would break exactness vs the training-dispatch
-        dense prefill).
+        (``metrics.cache_fallbacks``). Single-device, and not MoE (the
+        paged prefill routes experts densely — decode's rule — which
+        would break exactness vs the training-dispatch dense prefill).
+
+        Admission is CHUNKED by default (``prefill_chunk`` on the
+        config): instead of one suffix-prefill dispatch per record (the
+        PR-4 path, kept at ``prefill_chunk=0``), admission reserves the
+        slot + blocks and enqueues the uncached suffix host-side; every
+        decode tick then carries a bounded, statically-shaped chunk of
+        queued suffix tokens ALONGSIDE all decode slots in the SAME
+        jitted program (Sarathi-style — prefill rides the weight stream
+        decode already pays for). Consequences: admission compiles O(1)
+        programs regardless of suffix-length mix (the per-(suffix,
+        start) jit zoo is gone), decode inter-token latency stays one
+        tick per token under prompt storms (the chunk bounds prefill
+        work per tick; the queue drains FIFO), and per-record outputs
+        stay bitwise identical to the dense and per-record paths (each
+        chunk query attends exactly [0, position] of its slot's logical
+        view — the same math at every chunk width).
+
+        ``kv_dtype="int8"`` composes with ``kv_pages`` (chunked mode):
+        the block pools store int8 payloads + group-wise absmax scales
+        (``models.quant.quant_kv_groups`` — the same (position, head)
+        groups as the dense int8 pool, so int8-paged is token-exact vs
+        int8-DENSE serving), ~52% of the compute-dtype pool bytes.
+        ``kv_kernel`` then selects the Pallas BLOCK-TABLE read for the
+        decode ticks (``ops.kvattn.int8_paged_decode_attention`` — the
+        v3 watermark-DMA structure reading through per-slot block
+        tables, so HBM traffic scales with live tokens and no gathered
+        view is materialised); "auto" engages it on TPU at pools >=
+        1024 tokens with tiling shapes, True requires it (raises when
+        it cannot be honored), chunk-carrying ticks read via the XLA
+        gather either way (the multi-query chunk needs the gathered
+        view).
 
         ``journal``: a ``journal.DecodeJournal`` — record, per in-flight
         slot, the minimal resumable state (record identity + payload CRC,
@@ -612,16 +697,12 @@ class StreamingGenerator:
         if kv_pages is not None:
             if isinstance(kv_pages, dict):
                 kv_pages = PagedKVConfig(**kv_pages)
-            if kv_dtype is not None:
+            if kv_pages.prefill_chunk == 0 and kv_dtype is not None:
                 raise ValueError(
-                    "kv_pages serves the compute-dtype pool: int8 paging "
-                    "is not implemented (pick one capacity lever)"
-                )
-            if kv_kernel is True:
-                raise ValueError(
-                    "kv_kernel=True cannot be honored with kv_pages: the "
-                    "paged read is the XLA block-table gather, not the "
-                    "Pallas contiguous-pool kernel"
+                    "legacy per-record paged admission (prefill_chunk=0) "
+                    "is the PR-4 compute-dtype baseline; the int8 paged "
+                    "pool requires the chunked tick (prefill_chunk None "
+                    "or >= 1)"
                 )
             if mesh is not None:
                 raise ValueError(
@@ -638,6 +719,15 @@ class StreamingGenerator:
                 )
         self._kv_pages = kv_pages
         self._paged_deferred: list[Record] = []
+        # Chunked-prefill host state (paged mode; see _paged_setup).
+        # Defined unconditionally so free_slots/has_active/step are
+        # mode-blind: a slot is BUSY while reserved-and-prefilling just
+        # as while decoding.
+        self._prefilling = np.zeros((slots,), bool)
+        self._prefill_queue: list[_PendingPrefill] = []
+        self._chunked = False
+        self._tick_counter = 0
+        self._paged_table_idx = 2  # the table's slot in the state tuple
         self._kv_int8 = kv_dtype == "int8"
         self._kv_kernel_opt = kv_kernel
         self._max_send_failure_streak = max_send_failure_streak
@@ -1001,23 +1091,132 @@ class StreamingGenerator:
         self._kv_radix = RadixCache(self._kv_alloc, pages.block_size)
         self._table_np = np.zeros((self._slots, nblk), np.int32)  # all sink
         self._paged_prefill_jits: dict[tuple[int, int], Callable] = {}
+        # Chunked admission (the default; prefill_chunk=0 keeps the
+        # legacy per-record dispatch). The auto width covers every
+        # admission one serving quantum can offer (<= slots records,
+        # <= prompt_len uncached tokens each) so default-config
+        # admissions complete their prefill in the single next tick —
+        # CAPPED at 256 rows: past that the fused pass's per-chunk-row
+        # gather dominates the tick (each chunk row materialises its
+        # slot's whole logical view per layer), and a long-prompt storm
+        # is exactly where bounded per-tick prefill work is the point.
+        self._chunked = pages.prefill_chunk != 0
+        self._prefill_chunk = pages.prefill_chunk or min(
+            self._slots * self._prompt_len, max(256, 2 * pages.block_size)
+        )
+        self._prefill_queue = []
+        self._prefilling = np.zeros((self._slots,), bool)
+        self._tick_counter = 0
         return True
 
     def _build_paged(self) -> None:
-        from torchkafka_tpu.ops.kvattn import block_table_attention
+        from torchkafka_tpu.ops.kvattn import (
+            block_table_attention,
+            block_table_attention_q8,
+            int8_paged_decode_attention,
+            paged_kernel_applicable,
+            paged_scatter_kmajor,
+        )
+        from torchkafka_tpu.models.quant import quant_kv_groups
 
         cfg = self._cfg
         B, P = self._slots, self._prompt_len
         bs = self._kv_pages.block_size
         NB = self._kv_pages.num_blocks
+        nblk = self._blocks_per_slot
         nl, kh, dh = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
         temp = self._temperature
-        self._kv_kernel = False  # the base flag; never engaged here
+        kv_int8 = self._kv_int8
+        self._paged_table_idx = 4 if kv_int8 else 2
+
+        # Pallas BLOCK-TABLE read (ops/kvattn.py v4): the v3 watermark-
+        # DMA kernel reading through per-slot block tables, int8 pools
+        # only. Decode-only ticks read through it; chunk-carrying ticks
+        # use the XLA gather (the multi-query chunk needs the gathered
+        # view, and a storm tick is prefill-dominated anyway). Same
+        # engagement discipline as the dense kernel: "auto" only in the
+        # measured-win regime (TPU, long pools), True = require-or-raise
+        # so a benchmark never misattributes the gather's numbers.
+        if kv_int8 and self._kv_kernel_opt:
+            on_tpu = jax.default_backend() == "tpu"
+            # Tiling shapes gate COMPILED Mosaic only; off-TPU the kernel
+            # runs in Pallas interpret mode (correct but slow — the
+            # tests' differential path), which accepts any shape.
+            honorable = not on_tpu or (
+                paged_kernel_applicable(dh, bs) and bs >= 256
+            )
+            if self._kv_kernel_opt == "auto":
+                kv_kernel = (
+                    honorable and on_tpu
+                    and self._max_len >= _KV_KERNEL_AUTO_MIN_POOL
+                )
+            else:
+                if not honorable:
+                    raise ValueError(
+                        "kv_kernel=True cannot be honored on this paged "
+                        f"pool: it needs tiling shapes (head_dim={dh} % "
+                        f"128, block_size={bs} % 8) and a block size "
+                        ">= 256 (per-block DMA overhead drowns tiny "
+                        "blocks)"
+                    )
+                kv_kernel = True
+        else:
+            kv_kernel = False
+        self._kv_kernel = kv_kernel
 
         pick_rows = functools.partial(
             _pick_slots, temperature=temp, top_k=self._top_k,
             top_p=self._top_p,
         )
+
+        def layer_pass(params, x, positions, tables, pools, *,
+                       decode_kernel=False, pos_b=None):
+            """All layers' write-then-attend over the paged pool(s) for
+            a batch of query rows. x: [Bq, S, D]; positions: [Bq, S];
+            tables: [Bq, nblk] PER-ROW block tables — decode rows carry
+            the slot table, chunk rows their own freshly-linked rows, so
+            one call serves any mix. int8 pools ride as a 4-tuple
+            (payload+scale, K-major-per-block); ``decode_kernel`` reads
+            through the Pallas block-table kernel at watermarks
+            ``pos_b`` (S=1 rows only)."""
+
+            def body(x, inputs):
+                layer = inputs[0]
+                q, k, v = _project_qkv(x, layer, cfg)
+                q = _rope(q, positions, cfg.rope_theta)
+                k = _rope(k, positions, cfg.rope_theta)
+                if kv_int8:
+                    pkq, pks, pvq, pvs = inputs[1:]
+                    if decode_kernel:
+                        kq, ks = quant_kv_groups(k)
+                        vq, vs = quant_kv_groups(v)
+                        pkq = paged_scatter_kmajor(pkq, tables, positions, kq)
+                        pks = paged_scatter_kmajor(pks, tables, positions, ks)
+                        pvq = paged_scatter_kmajor(pvq, tables, positions, vq)
+                        pvs = paged_scatter_kmajor(pvs, tables, positions, vs)
+                        attn = int8_paged_decode_attention(
+                            q, pkq, pks, pvq, pvs, tables, pos_b
+                        )
+                        x = _attn_tail(x, attn, layer, cfg)
+                    else:
+                        x, pkq, pks, pvq, pvs = block_table_attention_q8(
+                            x, q, k, v, pkq, pks, pvq, pvs, tables,
+                            positions, layer, cfg,
+                        )
+                    return x, (pkq, pks, pvq, pvs)
+                pk, pv = inputs[1:]
+                x, pk, pv = block_table_attention(
+                    x, q, k, v, pk, pv, tables, positions, layer, cfg
+                )
+                return x, (pk, pv)
+
+            return lax.scan(body, x, (params["layers"],) + tuple(pools))
+
+        def logits_head(params, x_last):
+            return jnp.einsum(
+                "bd,dv->bv", x_last, load_weight(params["lm_head"], cfg.dtype),
+                preferred_element_type=jnp.float32,
+            )
 
         def suffix_prefill(params, pool_k, pool_v, table_row, toks, *, start):
             """Chunked prefill of ONE slot's uncached prompt suffix.
@@ -1073,82 +1272,188 @@ class StreamingGenerator:
         self._paged_merge = jax.jit(admit_merge)
 
         K = self._ticks_per_sync
+        ti = self._paged_table_idx
+
+        def decode_bookkeep(logits, skey, act, last_tok, pos, gen,
+                            done_latch, n_out):
+            """The decode tick's sampling/EOS/position bookkeeping over
+            per-slot logits — identical to the dense tick body's tail
+            (see the dense ``tick_block`` for the measured rationale on
+            the one-hot gen write)."""
+            tok = pick_rows(logits, skey, pos - P + 1)
+            t = pos - P  # decode ticks completed before this one
+            idx = jnp.minimum(t + 1, self._max_new - 1)
+            onehot = jnp.arange(self._max_new)[None, :] == idx[:, None]
+            gen = jnp.where(onehot & act[:, None], tok[:, None], gen)
+            hit_eos = (
+                (tok == self._eos_id) if self._eos_id is not None
+                else jnp.zeros_like(act)
+            )
+            done_now = act & (hit_eos | (t + 2 >= self._max_new))
+            pos = jnp.where(act & ~done_now, pos + 1, pos)
+            last_tok = jnp.where(act, tok, last_tok)
+            n_out = jnp.where(
+                done_now, jnp.minimum(t + 2, self._max_new), n_out
+            )
+            done_latch = done_latch | done_now
+            return last_tok, pos, gen, done_latch, n_out
+
+        def decode_one(params, pools, table, carry):
+            """One decode tick over the paged pool: the dense tick body
+            with the block-table scatter/gather (or the Pallas block-
+            table read when the kernel is engaged). Inactive slots still
+            write their frozen position — their DEVICE table rows point
+            at the sink (idle AND still-prefilling slots; see
+            _device_table), so the write can never corrupt a block
+            another slot holds (pinned by the stale-tail regression in
+            tests/test_kvcache.py)."""
+            last_tok, pos, gen, done_latch, n_out, active_in, skey = carry
+            act = active_in & ~done_latch
+            x = embed_rows(params["embed"], last_tok, cfg.dtype)[:, None, :]
+            x, pools = layer_pass(
+                params, x, pos[:, None], table, pools,
+                decode_kernel=kv_kernel, pos_b=pos,
+            )
+            x = _rms_norm(x, params["ln_f"])
+            logits = logits_head(params, x[:, 0])
+            last_tok, pos, gen, done_latch, n_out = decode_bookkeep(
+                logits, skey, act, last_tok, pos, gen, done_latch, n_out
+            )
+            return pools, (
+                last_tok, pos, gen, done_latch, n_out, active_in, skey,
+            )
 
         def tick_block(params, caches, last_tok, pos, gen, active_in, skey):
-            """The dense tick_block over the paged pool: same K-chained
-            latched-done structure and bookkeeping (see the dense body
-            for the measured rationale); only the cache write/read is the
-            block-table scatter/gather. The table passes through the
-            donated state unchanged. Inactive slots still write their
-            frozen position — their table rows point at the sink block,
-            so the write can never corrupt a block re-allocated to a
-            live slot (kvcache.blocks docstring; pinned by the stale-
-            tail regression in tests/test_kvcache.py)."""
-            pool_k, pool_v, table = caches
-
-            def one(carry, _):
-                pool_k, pool_v, last_tok, pos, gen, done_latch, n_out = carry
-                act = active_in & ~done_latch
-                x = embed_rows(params["embed"], last_tok, cfg.dtype)[:, None, :]
-
-                def body(x, inputs):
-                    layer, pk, pv = inputs
-                    q, k, v = _project_qkv(x, layer, cfg)
-                    q = _rope(q, pos[:, None], cfg.rope_theta)
-                    k = _rope(k, pos[:, None], cfg.rope_theta)
-                    x, pk, pv = block_table_attention(
-                        x, q, k, v, pk, pv, table, pos[:, None], layer, cfg
-                    )
-                    return x, (pk, pv)
-
-                x, (pool_k, pool_v) = lax.scan(
-                    body, x, (params["layers"], pool_k, pool_v)
-                )
-                x = _rms_norm(x, params["ln_f"])
-                logits = jnp.einsum(
-                    "bd,dv->bv", x[:, 0],
-                    load_weight(params["lm_head"], cfg.dtype),
-                    preferred_element_type=jnp.float32,
-                )
-                tok = pick_rows(logits, skey, pos - P + 1)
-                t = pos - P  # decode ticks completed before this one
-                idx = jnp.minimum(t + 1, self._max_new - 1)
-                onehot = jnp.arange(self._max_new)[None, :] == idx[:, None]
-                gen = jnp.where(onehot & act[:, None], tok[:, None], gen)
-                hit_eos = (
-                    (tok == self._eos_id) if self._eos_id is not None
-                    else jnp.zeros_like(act)
-                )
-                done_now = act & (hit_eos | (t + 2 >= self._max_new))
-                pos = jnp.where(act & ~done_now, pos + 1, pos)
-                last_tok = jnp.where(act, tok, last_tok)
-                n_out = jnp.where(
-                    done_now, jnp.minimum(t + 2, self._max_new), n_out
-                )
-                done_latch = done_latch | done_now
-                return (
-                    pool_k, pool_v, last_tok, pos, gen, done_latch, n_out,
-                ), None
-
+            """K decode-only ticks in ONE dispatch — the dense
+            tick_block's K-chained latched-done structure over the paged
+            pool. The table passes through the donated state
+            unchanged."""
+            pools, table = caches[:ti], caches[ti]
             done0 = jnp.zeros((B,), bool)
             n0 = jnp.zeros((B,), jnp.int32)
-            (pool_k, pool_v, last_tok, pos, gen, done, n_out), _ = lax.scan(
+
+            def one(carry, _):
+                pools, rest = carry
+                pools, rest = decode_one(params, pools, table, rest)
+                return (pools, rest), None
+
+            (pools, rest), _ = lax.scan(
                 one,
-                (pool_k, pool_v, last_tok, pos, gen, done0, n0),
+                (tuple(pools), (last_tok, pos, gen, done0, n0, active_in,
+                                skey)),
                 None, length=K,
             )
-            return (pool_k, pool_v, table), last_tok, pos, gen, done, n_out
+            last_tok, pos, gen, done, n_out = rest[:5]
+            return (
+                tuple(pools) + (table,) + caches[ti + 1:],
+                last_tok, pos, gen, done, n_out,
+            )
+
+        C = self._prefill_chunk
+
+        def tick_chunk_block(params, caches, last_tok, pos, gen, active_in,
+                             skey, ctok, ctable, cpos, fin_mask, fin_row):
+            """THE fused tick: one static program carrying a bounded
+            prefill chunk alongside all decode slots. The first inner
+            tick concatenates the B decode rows with the C chunk rows
+            into ONE [B + C]-row layer sweep — every weight tensor is
+            read once for both workloads (the Sarathi property: prefill
+            rides the stream decode already pays for); the remaining
+            K - 1 ticks are decode-only. Each chunk row is one suffix
+            token (ctok) of a reserved-but-prefilling slot, writing at
+            its logical position (cpos) through its OWN table row
+            (ctable — the device-state table masks prefilling slots to
+            the sink, so only the chunk rows can touch their freshly
+            linked blocks), attending causally over exactly
+            [0, position] of its slot's view — bitwise the same math as
+            the dense prefill at any chunk width. Padding rows carry
+            all-sink tables (writes land harmlessly; their logits are
+            ignored host-side). Returns the chunk rows' logits so the
+            host can sample token 0 for admissions whose suffix
+            completed this tick.
+
+            ACTIVATION rides the same dispatch: ``fin_mask``/``fin_row``
+            [B] mark slots whose LAST suffix token sits at chunk row
+            ``fin_row[b]`` — after the decode ticks, token 0 is sampled
+            from that row's logits (index-0 per-record-key draw, the
+            same merge math as the dense admit, so sampling parity is
+            bitwise) and the slot's last-token/position/gen state is
+            merged in, ready to decode NEXT dispatch. Cold-admission
+            activation therefore costs ZERO extra dispatches; only the
+            rare journal warm-resume restores state host-side."""
+            pools, table = caches[:ti], caches[ti]
+            done0 = jnp.zeros((B,), bool)
+            n0 = jnp.zeros((B,), jnp.int32)
+            act = active_in
+            toks_all = jnp.concatenate([last_tok, ctok])
+            x = embed_rows(params["embed"], toks_all, cfg.dtype)[:, None, :]
+            tables_all = jnp.concatenate([table, ctable], axis=0)
+            pos_all = jnp.concatenate([pos, cpos])
+            x, pools = layer_pass(
+                params, x, pos_all[:, None], tables_all, tuple(pools)
+            )
+            x = _rms_norm(x, params["ln_f"])
+            logits_all = logits_head(params, x[:, 0])  # [B + C, V]
+            chunk_logits = logits_all[B:]
+            last_tok, pos, gen, done, n_out = decode_bookkeep(
+                logits_all[:B], skey, act, last_tok, pos, gen, done0, n0
+            )
+
+            def one(carry, _):
+                pools, rest = carry
+                pools, rest = decode_one(params, pools, table, rest)
+                return (pools, rest), None
+
+            (pools, rest), _ = lax.scan(
+                one,
+                (tuple(pools), (last_tok, pos, gen, done, n_out, active_in,
+                                skey)),
+                None, length=K - 1,
+            )
+            last_tok, pos, gen, done, n_out = rest[:5]
+            tok0 = pick_rows(
+                chunk_logits[fin_row], skey, jnp.zeros((B,), jnp.int32)
+            )
+            last_tok = jnp.where(fin_mask, tok0, last_tok)
+            pos = jnp.where(fin_mask, P, pos)
+            gen = jnp.where(fin_mask[:, None], 0, gen)
+            gen = gen.at[:, 0].set(jnp.where(fin_mask, tok0, gen[:, 0]))
+            return (
+                tuple(pools) + (table,) + caches[ti + 1:],
+                last_tok, pos, gen, done, n_out,
+            )
 
         _tick = jax.jit(tick_block, donate_argnums=(1,))
+        self._tick_jit = _tick
         self._tick_block_raw = tick_block
         self._tick_fn = lambda *a: _tick(self._params, *a)
+        if self._chunked:
+            _tick_chunk = jax.jit(tick_chunk_block, donate_argnums=(1,))
+            self._tick_chunk_jit = _tick_chunk
+            self._tick_chunk_fn = lambda *a: _tick_chunk(self._params, *a)
+        else:
+            self._tick_chunk_fn = None
         self._admit_fn = None  # paged admission is host-orchestrated
-        self._resume_exec = None  # paged resume rides the suffix prefill
-        self._caches = (
-            jnp.zeros((nl, NB, bs, kh, dh), cfg.dtype),
-            jnp.zeros((nl, NB, bs, kh, dh), cfg.dtype),
-            jnp.asarray(self._table_np),
-        )
+        self._resume_exec = None  # paged resume rides the chunk/suffix path
+        # _table_np.copy(): jnp.asarray may ZERO-COPY an aligned host
+        # buffer on the CPU backend; admissions mutate _table_np in
+        # place, which would rewrite this device table from under the
+        # tick (prefilling slots lose their sink mask and idle
+        # frozen-pos writes corrupt freshly linked blocks).
+        if kv_int8:
+            self._caches = (
+                jnp.zeros((nl, NB, kh, bs, dh), jnp.int8),
+                jnp.zeros((nl, NB, kh, bs), jnp.float32),
+                jnp.zeros((nl, NB, kh, bs, dh), jnp.int8),
+                jnp.zeros((nl, NB, kh, bs), jnp.float32),
+                jnp.asarray(self._table_np.copy()),
+            )
+        else:
+            self._caches = (
+                jnp.zeros((nl, NB, bs, kh, dh), cfg.dtype),
+                jnp.zeros((nl, NB, bs, kh, dh), cfg.dtype),
+                jnp.asarray(self._table_np.copy()),
+            )
         self._last_tok = jnp.zeros((B,), jnp.int32)
         self._pos = jnp.zeros((B,), jnp.int32)
         self._gen = jnp.zeros((B, self._max_new), jnp.int32)
@@ -1177,8 +1482,29 @@ class StreamingGenerator:
 
     def _paged_set_table(self, caches, table_dev):
         """Rebind the device block table inside the state tuple (the
-        table's slot in the tuple differs for the spec server)."""
-        return caches[:2] + (table_dev,) + caches[3:]
+        table's slot in the tuple differs by pool mode — after the 2
+        compute-dtype pools, the 4 int8 pools, or the spec server's 4
+        two-model pools)."""
+        i = self._paged_table_idx
+        return caches[:i] + (table_dev,) + caches[i + 1:]
+
+    def _device_table(self) -> jax.Array:
+        """The block table the DEVICE state carries. In chunked mode the
+        rows of reserved-but-still-prefilling slots are masked to the
+        sink: an inactive decode row still writes its frozen position
+        unconditionally, and that write must never land in the freshly
+        linked blocks the chunk rows are filling (the chunk rows carry
+        their REAL rows separately, as the ctable operand)."""
+        if self._chunked:
+            t = np.where(
+                self._active[:, None], self._table_np, SINK_BLOCK
+            ).astype(np.int32)
+            return jnp.asarray(t)
+        # .copy(): jnp.asarray may ZERO-COPY an aligned host buffer on
+        # the CPU backend, and _table_np is mutated in place by later
+        # admissions/releases — the device table must be a snapshot,
+        # never a live view (alignment-dependent corruption otherwise).
+        return jnp.asarray(self._table_np.copy())
 
     def _release_slot_blocks(self, i: int) -> None:
         """Drop a retired slot's references; its table row falls back to
@@ -1187,6 +1513,83 @@ class StreamingGenerator:
         if row:
             self._kv_alloc.decref(row)
         self._table_np[i, :] = SINK_BLOCK
+
+    def _pack_chunk(self):
+        """Fill the static chunk operands from the FIFO prefill queue:
+        up to ``prefill_chunk`` suffix tokens, taken strictly in queue
+        order (the ordering the radix-insert-at-admit safety argument
+        stands on), each row carrying its token, logical position, and
+        its slot's REAL table row. Padding rows point at the sink.
+        Returns (ctok, ctable, cpos, fin_mask, fin_row, packed,
+        finishers) — finishers are (entry, last_row_index) for
+        admissions whose suffix completes in this chunk; cold finishers
+        additionally mark ``fin_mask``/``fin_row`` so the fused program
+        samples token 0 and merges the activation state IN-DISPATCH
+        (journal resumes restore state host-side instead)."""
+        C = self._prefill_chunk
+        B = self._slots
+        nblk = self._blocks_per_slot
+        ctok = np.zeros((C,), np.int32)
+        cpos = np.zeros((C,), np.int32)
+        ctable = np.full((C, nblk), SINK_BLOCK, np.int32)
+        fin_mask = np.zeros((B,), bool)
+        fin_row = np.zeros((B,), np.int32)
+        finishers: list[tuple[_PendingPrefill, int]] = []
+        packed = 0
+        while packed < C and self._prefill_queue:
+            e = self._prefill_queue[0]
+            n = min(C - packed, len(e.seq) - e.off)
+            ctok[packed:packed + n] = e.seq[e.off:e.off + n]
+            cpos[packed:packed + n] = e.start + e.off + np.arange(n)
+            ctable[packed:packed + n] = self._table_np[e.slot]
+            e.off += n
+            packed += n
+            if e.off == len(e.seq):
+                finishers.append((e, packed - 1))
+                if e.resume is None:
+                    fin_mask[e.slot] = True
+                    fin_row[e.slot] = packed - 1
+                self._prefill_queue.pop(0)
+        return ctok, ctable, cpos, fin_mask, fin_row, packed, finishers
+
+    def _activate_chunk_finishers(self, finishers) -> None:
+        """Host bookkeeping for slots whose suffix prefill completed
+        this tick: flip them active (their first decode tick is the
+        NEXT dispatch — the in-program fin merge already sampled token
+        0 for cold admissions), restore journal warm-resume state
+        (rare; host-side), and push the device table so the newly
+        active rows unmask from the sink."""
+        B = self._slots
+        res_mask = np.zeros((B,), bool)
+        res_last = np.zeros((B,), np.int32)
+        res_pos = np.zeros((B,), np.int32)
+        res_gen = np.zeros((B, self._max_new), np.int32)
+        for e, _row_idx in finishers:
+            self._prefilling[e.slot] = False
+            self._active[e.slot] = True
+            # Extra ticks spent queued beyond the one-tick minimum — 0
+            # when the admission's whole suffix rode the first chunk.
+            self.metrics.admission_stall_ticks.add(
+                max(0, self._tick_counter - e.enq_tick - 1)
+            )
+            if e.resume is not None:
+                emitted = e.resume
+                res_mask[e.slot] = True
+                res_last[e.slot] = emitted[-1]
+                res_pos[e.slot] = self._prompt_len + len(emitted) - 1
+                res_gen[e.slot, : len(emitted)] = emitted
+        if res_mask.any():
+            m = jnp.asarray(res_mask)
+            self._last_tok = jnp.where(
+                m, jnp.asarray(res_last), self._last_tok
+            )
+            self._pos = jnp.where(m, jnp.asarray(res_pos), self._pos)
+            self._gen = jnp.where(
+                m[:, None], jnp.asarray(res_gen), self._gen
+            )
+        self._caches = self._paged_set_table(
+            self._caches, self._device_table()
+        )
 
     @property
     def pending_admissions(self) -> int:
@@ -1200,17 +1603,38 @@ class StreamingGenerator:
     def _admit_records_paged(self, records: list[Record]) -> int:
         """Paged admission: per record — radix longest-prefix match, link
         the shared blocks, allocate private blocks (LRU-evicting
-        unreferenced cached prefixes under pressure), prefill ONLY the
-        uncached suffix, then register the prompt's whole blocks for
-        future reuse. Sequential per record so a duplicate prompt inside
-        one batch hits its predecessor's freshly inserted prefix. Ends
-        with the same [B, V] per-record-key sampling merge as the dense
-        admit. A record carrying a journal resume hint prefills
+        unreferenced cached prefixes under pressure), then hand the
+        uncached suffix to the PREFILL path. Sequential per record so a
+        duplicate prompt inside one batch hits its predecessor's freshly
+        inserted prefix.
+
+        CHUNKED mode (the default): the slot is reserved and the suffix
+        ENQUEUED — the decode tick's fused program processes it a
+        bounded chunk at a time (step → _pack_chunk), and the slot
+        activates (token 0 sampled with the same per-record-key
+        discipline, or journal state restored) the tick its last suffix
+        token lands. Admission itself dispatches NOTHING and compiles
+        nothing: O(1) programs across any suffix-length mix. The radix
+        insert happens here, at reservation time — a later admission
+        matching these still-being-filled blocks is safe because the
+        chunk queue is strictly FIFO, so the matched positions are
+        always written in an earlier (or the same, write-before-attend)
+        dispatch than any query that attends over them.
+
+        LEGACY mode (``prefill_chunk=0``, the PR-4 baseline): one
+        suffix-prefill dispatch per record (a jit specialisation per
+        (suffix, start) pair), ending with the same [B, V] sampling
+        merge as the dense admit.
+
+        A record carrying a journal resume hint prefills
         ``prompt + emitted_tokens`` instead (the cached prompt prefix
         still radix-hits) and restores position/RNG state host-side — no
         token 0 to sample; a FINISHED hint consumes no slot at all (the
         completion re-serves from the journal at the next step)."""
-        phys_free = [i for i in range(self._slots) if not self._active[i]]
+        phys_free = [
+            i for i in range(self._slots)
+            if not self._active[i] and not self._prefilling[i]
+        ]
         if len(records) + len(self._paged_deferred) > len(phys_free):
             raise ValueError(
                 f"offered {len(records)} records with "
@@ -1230,6 +1654,7 @@ class StreamingGenerator:
         slot_ids: list[int] = []
         logits_rows: list = []
         resumed: list[tuple[int, np.ndarray]] = []
+        reserved = 0  # chunked-mode reservations (prefill enqueued)
         journal_dirty = False
         caches = self._caches
         slot_iter = iter(phys_free)
@@ -1292,16 +1717,13 @@ class StreamingGenerator:
             row = matched + priv
             self._table_np[i, :] = row
             start = len(matched) * bs
-            table_row = jnp.asarray(self._table_np[i][None, :])
-            logits, caches = self._paged_prefill_call(
-                caches, table_row, jnp.asarray(seq[None, start:]),
-                total_len=len(seq),
-            )
             # Register the PROMPT's matchable whole blocks for reuse
             # (existing nodes are the ones we just matched; new nodes
-            # adopt this slot's freshly prefilled private blocks).
-            # Emitted-token blocks are never cached: offsets are unique,
-            # so they could only ever match their own redelivery.
+            # adopt this slot's freshly linked private blocks — in
+            # chunked mode still being FILLED, safe by chunk-queue FIFO:
+            # see the method docstring). Emitted-token blocks are never
+            # cached: offsets are unique, so they could only ever match
+            # their own redelivery.
             cacheable = RadixCache.matchable_blocks(len(toks), bs)
             self._kv_radix.insert(toks, row[:cacheable])
             if matched:
@@ -1309,9 +1731,7 @@ class StreamingGenerator:
                 self.metrics.prefix_tokens_saved.add(start)
             else:
                 self.metrics.prefix_misses.add(1)
-            self.metrics.prefill_tokens.add(len(seq) - start)
             self._slot_rec[i] = rec
-            self._active[i] = True
             key_np = (
                 np.asarray(hint.key_data, np.uint32)
                 if hint is not None and hint.key_data is not None else kd
@@ -1319,16 +1739,12 @@ class StreamingGenerator:
             keys_np[i] = key_np
             key_mask[i] = True
             if hint is None:
-                admit_mask[i] = True
-                slot_ids.append(i)
-                logits_rows.append(logits)
                 self._slot_emitted[i] = 0
                 self._slot_journaled[i] = 0
                 if self._journal is not None:
                     self._journal_record(rec, kd, (), False)
                     journal_dirty = True
             else:
-                resumed.append((i, emitted))
                 self._slot_emitted[i] = len(emitted)
                 self._slot_journaled[i] = len(emitted)
                 self.metrics.warm_resumes.add(1)
@@ -1336,6 +1752,32 @@ class StreamingGenerator:
                 if self._journal is not None:
                     self._journal_record(rec, key_np, emitted, False)
                     journal_dirty = True
+            if self._chunked:
+                # Reserve, enqueue, dispatch nothing: the tick's fused
+                # program prefills this suffix chunk by chunk and the
+                # slot activates the tick its last token lands.
+                self._prefilling[i] = True
+                self._prefill_queue.append(_PendingPrefill(
+                    i, rec, np.asarray(seq[start:], np.int32), start,
+                    key_np, emitted, self._tick_counter,
+                ))
+                reserved += 1
+                continue
+            # LEGACY: one suffix-prefill dispatch per record (a jit
+            # specialisation per suffix length) + the batched merge.
+            self.metrics.prefill_tokens.add(len(seq) - start)
+            self._active[i] = True
+            table_row = jnp.asarray(self._table_np[i][None, :].copy())
+            logits, caches = self._paged_prefill_call(
+                caches, table_row, jnp.asarray(seq[None, start:]),
+                total_len=len(seq),
+            )
+            if hint is None:
+                admit_mask[i] = True
+                slot_ids.append(i)
+                logits_rows.append(logits)
+            else:
+                resumed.append((i, emitted))
         if queue:  # defensive: slots exhausted with records left
             self._paged_deferred.extend(queue)
         # Count records ENTERING the deferred state, not retry spins: the
@@ -1346,13 +1788,15 @@ class StreamingGenerator:
             self.metrics.admission_deferrals.add(newly_deferred)
         self.metrics.cache_pool_occupancy.set(self._kv_alloc.occupancy())
         admitted = int(admit_mask.sum())
-        filled = admitted + len(resumed)
+        filled = admitted + len(resumed) + reserved
         if filled:
             if in_flight > 0:
                 self.metrics.readmissions.add(filled)
-            caches = self._paged_set_table(
-                caches, jnp.asarray(self._table_np)
-            )
+            if not self._chunked:
+                # Chunked reservations push nothing: the device table
+                # keeps prefilling rows masked to the sink until
+                # activation (_device_table).
+                caches = self._paged_set_table(caches, self._device_table())
             self._slot_keys = jnp.where(
                 jnp.asarray(key_mask)[:, None], jnp.asarray(keys_np),
                 self._slot_keys,
@@ -1583,15 +2027,30 @@ class StreamingGenerator:
         # no-op shape.
         key = self._slot_keys
         if self._kv_pages is not None:
-            # Compile the miss-path suffix prefill (S = prompt_len — the
-            # most common specialisation), the sampling merge, and the
-            # tick. All writes land in the sink block (the warmup table
-            # row is all-sink) and the all-False merge admits nothing.
-            table_row = jnp.zeros((1, self._blocks_per_slot), jnp.int32)
-            toks = jnp.zeros((1, self._prompt_len), jnp.int32)
-            _logits, self._caches = self._paged_prefill_call(
-                self._caches, table_row, toks
-            )
+            # Compile every program a paged serve can dispatch: the
+            # fused chunk tick (chunked; an all-padding chunk — writes
+            # land in the sink) OR the legacy miss-path suffix prefill,
+            # plus the sampling merge (all-False mask admits nothing)
+            # and the decode-only tick. Chunked admission compiles
+            # NOTHING later — these are the whole program set, whatever
+            # suffix-length mix arrives (the jit-zoo fix).
+            if self._chunked:
+                C, nblk = self._prefill_chunk, self._blocks_per_slot
+                out = self._tick_chunk_fn(
+                    self._caches, self._last_tok, self._pos, self._gen,
+                    none, key, jnp.zeros((C,), jnp.int32),
+                    jnp.full((C, nblk), SINK_BLOCK, jnp.int32),
+                    jnp.zeros((C,), jnp.int32), none,
+                    jnp.zeros((B,), jnp.int32),
+                )
+                self._caches, self._last_tok, self._pos, self._gen = out[:4]
+                jax.device_get(out[4])
+            else:
+                table_row = jnp.zeros((1, self._blocks_per_slot), jnp.int32)
+                toks = jnp.zeros((1, self._prompt_len), jnp.int32)
+                _logits, self._caches = self._paged_prefill_call(
+                    self._caches, table_row, toks
+                )
             logits_b = jnp.zeros((B, self._cfg.vocab_size), jnp.float32)
             self._last_tok, self._pos, self._gen = self._paged_merge(
                 self._last_tok, self._pos, self._gen, logits_b, none, key
@@ -1627,12 +2086,15 @@ class StreamingGenerator:
         return self._slots
 
     def free_slots(self) -> int:
-        """Slots currently available for admission."""
-        return int((~self._active).sum())
+        """Slots currently available for admission (a reserved-but-
+        still-prefilling chunked admission holds its slot)."""
+        return int((~(self._active | self._prefilling)).sum())
 
     def has_active(self) -> bool:
-        """True while any generation is in flight."""
-        return bool(self._active.any())
+        """True while any generation is in flight — decoding OR still
+        chunk-prefilling (the drain/idle loops must keep ticking until
+        queued admissions activate and retire)."""
+        return bool(self._active.any() or self._prefilling.any())
 
     def note_fetched(self, records: list[Record]) -> None:
         """Register polled records with the ledger BEFORE queueing them.
@@ -1936,11 +2398,35 @@ class StreamingGenerator:
             ready, self._journal_ready = self._journal_ready, []
             for rec, out in ready:
                 self._retire_completion(rec, out, completions)
-        if self._active.any():
-            caches, last_tok, pos, gen, done, n_out = self._tick_fn(
-                self._caches, self._last_tok, self._pos, self._gen,
-                jnp.asarray(self._active), self._slot_keys,
-            )
+        run_chunk = self._chunked and bool(self._prefill_queue)
+        if self._active.any() or run_chunk:
+            self._tick_counter += 1
+            finishers = None
+            if run_chunk:
+                # The fused program: a bounded chunk of queued suffix
+                # tokens rides this tick's layer sweep alongside every
+                # decode slot — admission work never preempts a decode
+                # tick, it shares one.
+                (ctok, ctable, cpos, fin_mask, fin_row, packed,
+                 finishers) = self._pack_chunk()
+                caches, last_tok, pos, gen, done, n_out = self._tick_chunk_fn(
+                    self._caches, self._last_tok, self._pos, self._gen,
+                    jnp.asarray(self._active.copy()), self._slot_keys,
+                    jnp.asarray(ctok), jnp.asarray(ctable),
+                    jnp.asarray(cpos), jnp.asarray(fin_mask),
+                    jnp.asarray(fin_row),
+                )
+                self.metrics.chunk_ticks.add(1)
+                self.metrics.prefill_tokens.add(packed)
+                self.metrics.chunk_utilization.set(
+                    self.metrics.prefill_tokens.count
+                    / (self.metrics.chunk_ticks.count * self._prefill_chunk)
+                )
+            else:
+                caches, last_tok, pos, gen, done, n_out = self._tick_fn(
+                    self._caches, self._last_tok, self._pos, self._gen,
+                    jnp.asarray(self._active.copy()), self._slot_keys,
+                )
             self._caches, self._last_tok, self._pos, self._gen = (
                 caches, last_tok, pos, gen
             )
@@ -2004,11 +2490,20 @@ class StreamingGenerator:
                     self._retire_completion(rec, out, completions)
                 if self._kv_pages is not None:
                     self._caches = self._paged_set_table(
-                        self._caches, jnp.asarray(self._table_np)
+                        self._caches, self._device_table()
                     )
                     self.metrics.cache_pool_occupancy.set(
                         self._kv_alloc.occupancy()
                     )
+            if finishers:
+                # AFTER the done bookkeeping above (which must see the
+                # pre-activation active set and its fetched state):
+                # completed prefills activate for the NEXT tick.
+                self._activate_chunk_finishers(finishers)
+            if run_chunk:
+                self.metrics.admission_queue_tokens.set(float(sum(
+                    len(e.seq) - e.off for e in self._prefill_queue
+                )))
         if (
             completions
             and self._uncommitted >= self._commit_every

@@ -548,15 +548,69 @@ class SpecStreamingGenerator(StreamingGenerator):
                 last_tok, pos, gen, done, n_out,
             )
 
+        def tick_chunk_block(params_pair, caches, last_tok, pos, gen,
+                             active_in, key, ctok, ctable, cpos,
+                             fin_mask, fin_row):
+            """The chunked tick, spec flavor: the SAME jitted program
+            first pushes this tick's prefill chunk through BOTH models'
+            block pools (each chunk row one suffix token of a
+            reserved-but-prefilling slot, writing through its own table
+            row — ``multi_step_paged`` with S=1 rows IS the chunk
+            stage), then runs the K speculative rounds over the active
+            slots. One dispatch per tick, O(1) compiled programs across
+            any suffix-length mix — the per-(suffix, start) jit zoo is
+            gone for spec serving too. Unlike the plain server's fused
+            pass the chunk stage is a separate layer sweep per model
+            (the verify's multi-query structure doesn't concatenate with
+            S=1 chunk rows); the dispatch-count win is identical, the
+            weight-stream sharing is plain-mode only. Activation rides
+            the dispatch too: ``fin_mask``/``fin_row`` mark slots whose
+            last suffix token landed this tick — token 0 is the
+            TARGET's argmax at that chunk row (greedy, like every spec
+            admission) and the slot state merges in, ready to join the
+            NEXT dispatch's rounds."""
+            tparams, dparams = params_pair
+            t_k, t_v, d_k, d_v, table, acc, prop, rounds = caches
+            t_logits_c, t_k, t_v = multi_step_paged(
+                tparams, cfg, t_k, t_v, ctable, ctok[:, None], cpos
+            )
+            _dl, d_k, d_v = multi_step_paged(
+                dparams, dcfg, d_k, d_v, ctable, ctok[:, None], cpos
+            )
+            chunk_logits = t_logits_c[:, -1]  # [C, V]
+            caches, last_tok, pos, gen, done, n_out = tick_block(
+                params_pair,
+                (t_k, t_v, d_k, d_v, table, acc, prop, rounds),
+                last_tok, pos, gen, active_in, key,
+            )
+            tok0 = jnp.argmax(chunk_logits[fin_row], axis=-1).astype(
+                jnp.int32
+            )
+            last_tok = jnp.where(fin_mask, tok0, last_tok)
+            pos = jnp.where(fin_mask, P, pos)
+            gen = jnp.where(fin_mask[:, None], 0, gen)
+            gen = gen.at[:, 0].set(jnp.where(fin_mask, tok0, gen[:, 0]))
+            return caches, last_tok, pos, gen, done, n_out
+
         _tick = jax.jit(tick_block, donate_argnums=(1,))
+        self._tick_jit = _tick
         self._tick_fn = lambda *a: _tick(
             (self._params, self._draft_params), *a
         )
+        if self._chunked:
+            _tick_chunk = jax.jit(tick_chunk_block, donate_argnums=(1,))
+            self._tick_chunk_jit = _tick_chunk
+            self._tick_chunk_fn = lambda *a: _tick_chunk(
+                (self._params, self._draft_params), *a
+            )
+        else:
+            self._tick_chunk_fn = None
         self._tick_block_raw = (
             lambda params, *a: tick_block((params, self._draft_params), *a)
         )
         self._admit_fn = None  # paged admission is host-orchestrated
-        self._resume_exec = None  # paged resume rides the suffix prefill
+        self._resume_exec = None  # paged resume rides the chunk/suffix path
+        self._paged_table_idx = 4
 
         nl, kh, dh = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
         dl, dkh, ddh = dcfg.n_layers, dcfg.n_kv_heads, dcfg.head_dim
@@ -565,7 +619,10 @@ class SpecStreamingGenerator(StreamingGenerator):
             jnp.zeros((nl, NB, bs, kh, dh), cfg.dtype),
             jnp.zeros((dl, NB, bs, dkh, ddh), dcfg.dtype),
             jnp.zeros((dl, NB, bs, dkh, ddh), dcfg.dtype),
-            jnp.asarray(self._table_np),
+            # .copy(): jnp.asarray may zero-copy an aligned host buffer
+            # (CPU backend) and _table_np is mutated in place at
+            # admission — snapshot, never a live view.
+            jnp.asarray(self._table_np.copy()),
             # accepted / proposed / rounds — distinct buffers (donated
             # tuple; one buffer donated thrice is an XLA error).
             jnp.zeros((), jnp.int32).copy(),
@@ -595,9 +652,6 @@ class SpecStreamingGenerator(StreamingGenerator):
             (self._params, self._draft_params), *caches[:4], table_row, toks
         )
         return logits, (t_k, t_v, d_k, d_v) + caches[4:]
-
-    def _paged_set_table(self, caches, table_dev):
-        return caches[:4] + (table_dev,) + caches[5:]
 
     def spec_stats(self) -> dict:
         """Measured speculation counters since construction (one device
