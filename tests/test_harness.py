@@ -181,6 +181,44 @@ def test_sampling_flag_scoping():
         run_scenario(7, "tiny", spec=True, top_k=4)
 
 
+def test_scenario_15_slo_observability():
+    """The tier-1 obs smoke: a keyed-tenant 2-replica traced fleet must
+    produce NON-DEGENERATE per-tenant SLO percentiles (every tenant has
+    TTFT and inter-token-latency samples, with real nonzero latencies),
+    full lane/replica label coverage, a balanced trace (every polled
+    record reaches committed, no open lifecycles at the end), and a live
+    Prometheus endpoint serving every metrics class from one scrape."""
+    out = run_scenario(15, "tiny")
+    assert out["scenario"] == "15:slo-observability"
+    assert out["replicas"] == 2
+    assert out["records"] == 24
+    assert out["coverage_complete"] is True
+    assert out["committed_complete"] is True
+    assert out["dropped"] == 0 and out["commit_failures"] == 0
+    # Per-tenant TTFT/ITL percentiles exist and are non-degenerate.
+    for tenant in ("alpha", "beta", "gamma"):
+        slo = out["tenant_slo"][tenant]
+        assert slo["ttft"]["count"] > 0
+        assert slo["itl"]["count"] > 0
+        assert 0 < slo["ttft"]["p50_ms"] <= slo["ttft"]["p99_ms"]
+        assert slo["itl"]["p99_ms"] > 0
+    assert out["ttft"]["count"] == 24  # one first token per record
+    assert out["itl"]["count"] > 24  # decode really streamed tokens
+    assert out["e2e"]["count"] == 24  # every record reached committed
+    assert out["queue_wait"]["count"] == 24  # QoS admitted every record
+    assert set(out["lanes_observed"]) == {"interactive", "batch"}
+    assert out["replicas_observed"] == ["0", "1"]
+    assert out["cache_hit_rate"] > 0.5  # tenant system prompts really hit
+    # Trace balance: lifecycle conservation, nothing left open.
+    st = out["trace_stages"]
+    assert st["polled"] == st["slot_active"] == st["committed"] == 24
+    assert out["open_records_end"] == 0
+    # Endpoint smoke: one scrape served every metrics class.
+    assert out["endpoint_status"] == 200
+    assert all(out["endpoint_has"].values())
+    assert out["endpoint_series"] > 100
+
+
 def test_scenario_13_warm_failover_smoke():
     """The tier-1 warm-failover smoke: a seeded mid-generation replica
     kill through a journaled 2-replica fleet. The survivor consults the

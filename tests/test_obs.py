@@ -1,0 +1,560 @@
+"""Record-lifecycle tracing, SLO histograms, and the unified exporter
+(torchkafka_tpu/obs).
+
+Pins the subsystem's four contracts:
+
+1. DERIVATION EXACTNESS — under a ManualClock, TTFT / inter-token latency
+   / queue wait / e2e are exact arithmetic over the injected timestamps,
+   and the ring/JSONL sinks preserve the event stream.
+2. TRACE DETERMINISM — the repo's differential style applied to
+   observability itself: a same-seed replica-kill chaos replay through a
+   2-replica paged fleet yields an IDENTICAL event sequence modulo
+   timestamps (and byte-identical including timestamps under a manual
+   clock); traced serving is token-exact and commit-ledger-identical vs
+   untraced.
+3. EXPOSITION CONFORMANCE — one parametrized grammar check across ALL
+   render_prometheus implementations (Stream/Serve/Fleet/Resilience +
+   the SLO tracer): HELP/TYPE lines for every metric, valid metric
+   names, counter naming, label escaping that survives hostile tenant
+   keys (tenants come straight from record keys).
+4. ENDPOINT — the stdlib HTTP exporter serves every registered source
+   from one scrape and survives a broken source.
+"""
+
+import re
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import torchkafka_tpu as tk
+from torchkafka_tpu.fleet import ReplicaChaos, ServingFleet
+from torchkafka_tpu.fleet.metrics import FleetMetrics
+from torchkafka_tpu.models.transformer import TransformerConfig, init_params
+from torchkafka_tpu.obs import (
+    MetricsExporter,
+    ObsConfig,
+    RecordTracer,
+    pooled_slo_summary,
+)
+from torchkafka_tpu.obs.trace import (
+    COMMITTED, FINISHED, POLLED, QOS_ADMITTED, SLOT_ACTIVE,
+)
+from torchkafka_tpu.resilience import ManualClock
+from torchkafka_tpu.serve import ServeMetrics, StreamingGenerator
+from torchkafka_tpu.source.records import Record
+from torchkafka_tpu.utils.metrics import (
+    ResilienceMetrics,
+    StreamMetrics,
+    escape_label_value,
+    format_labels,
+)
+from torchkafka_tpu.utils.tracing import ingest_lag_ms
+
+P, MAX_NEW, VOCAB = 8, 8, 64
+PAGES = {"block_size": 4, "num_blocks": 40}
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = TransformerConfig(
+        vocab_size=VOCAB, d_model=32, n_layers=2, n_heads=2, n_kv_heads=1,
+        d_ff=64, max_seq_len=P + MAX_NEW, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+def _rec(offset=0, key=b"tenantA", lane=b"interactive"):
+    return Record("t", 0, offset, b"payload", key=key,
+                  headers=(("lane", lane),))
+
+
+# --------------------------------------------------------------------------
+# 1. Derivation exactness under a manual clock
+# --------------------------------------------------------------------------
+
+
+class TestTracerDerivations:
+    def test_lifecycle_latencies_exact(self):
+        mc = ManualClock()
+        tr = RecordTracer(ObsConfig(clock=mc.now))
+        r = _rec()
+        tr.polled(r, replica=3)
+        mc.advance(0.010)
+        tr.qos_admitted(r, "interactive", 0.010, replica=3)
+        mc.advance(0.040)
+        tr.slot_active(r, replica=3)
+        mc.advance(0.006)
+        tr.tokens(r, 3, replica=3)  # 2ms/token at host-sync granularity
+        mc.advance(0.004)
+        tr.tokens(r, 2, replica=3)
+        tr.finished(r, 6, replica=3)
+        mc.advance(0.001)
+        tr.note_commit({("t", 0): 1})
+
+        view = tr.record_trace("t", 0, 0)
+        assert view.stages() == [
+            POLLED, QOS_ADMITTED, SLOT_ACTIVE, "tokens", "tokens",
+            FINISHED, COMMITTED,
+        ]
+        assert view.queue_wait_s == pytest.approx(0.010)
+        assert view.ttft_s == pytest.approx(0.050)
+        assert view.e2e_s == pytest.approx(0.061)
+        assert view.itl_s == pytest.approx([0.002] * 3 + [0.002] * 2)
+
+        slo = tr.slo
+        assert slo.hist("ttft").count == 1
+        assert slo.hist("ttft").percentile(50) == pytest.approx(0.050)
+        assert slo.hist("ttft", "tenant", "tenantA").count == 1
+        assert slo.hist("ttft", "lane", "interactive").count == 1
+        assert slo.hist("ttft", "replica", "3").count == 1
+        assert slo.hist("itl").count == 5
+        assert slo.hist("itl").percentile(99) == pytest.approx(0.002)
+        assert slo.hist("queue_wait").percentile(50) == pytest.approx(0.010)
+        assert slo.hist("e2e").percentile(50) == pytest.approx(0.061)
+        assert tr.summary()["open_records"] == 0
+
+    def test_commit_covers_only_finished_below_watermark(self):
+        mc = ManualClock()
+        tr = RecordTracer(ObsConfig(clock=mc.now))
+        done, in_flight, other_part = _rec(0), _rec(1), Record("t", 1, 0, b"x")
+        for r in (done, in_flight, other_part):
+            tr.polled(r)
+        tr.slot_active(done)
+        tr.finished(done, 4)
+        tr.slot_active(in_flight)  # active but not finished
+        tr.note_commit({("t", 0): 1})  # covers offset 0 only
+        stages = [e.stage for e in tr.events]
+        assert stages.count(COMMITTED) == 1
+        assert tr.record_trace("t", 0, 0).e2e_s is not None
+        assert tr.record_trace("t", 0, 1).e2e_s is None
+        assert tr.summary()["open_records"] == 2
+
+    def test_redelivery_restarts_lifecycle(self):
+        """A re-polled record (replica death) must time its TTFT from the
+        NEW poll, not the dead incarnation's."""
+        mc = ManualClock()
+        tr = RecordTracer(ObsConfig(clock=mc.now))
+        r = _rec()
+        tr.polled(r, replica=0)
+        mc.advance(5.0)  # first incarnation dies; much later...
+        tr.polled(r, replica=1)
+        mc.advance(0.020)
+        tr.slot_active(r, replica=1)
+        assert tr.slo.hist("ttft").percentile(50) == pytest.approx(0.020)
+
+    def test_warm_slot_active_skips_ttft(self):
+        """A warm resume's first token was decoded pre-kill; it must not
+        fabricate a TTFT sample."""
+        tr = RecordTracer(ObsConfig(clock=ManualClock().now))
+        r = _rec()
+        tr.polled(r)
+        tr.warm_resumed(r, 5)
+        tr.slot_active(r, warm=True)
+        assert tr.slo.hist("ttft").count == 0
+        tr.tokens(r, 2)
+        assert tr.slo.hist("itl").count == 2  # ITL still measured
+
+    def test_ring_bound_and_drop_counter(self):
+        tr = RecordTracer(ObsConfig(capacity=8, clock=ManualClock().now))
+        for i in range(20):
+            tr.polled(_rec(i))
+        assert len(tr.events) == 8
+        assert tr.dropped_events == 12
+        assert tr.emitted == 20
+        assert [e.offset for e in tr.events] == list(range(12, 20))
+
+    def test_jsonl_roundtrip_and_streaming_sink(self, tmp_path):
+        stream_path = tmp_path / "live.jsonl"
+        mc = ManualClock()
+        tr = RecordTracer(ObsConfig(clock=mc.now,
+                                    jsonl_path=str(stream_path)))
+        r = _rec()
+        tr.polled(r)
+        mc.advance(0.5)
+        tr.slot_active(r)
+        tr.finished(r, 2)
+        tr.close()
+        export_path = tmp_path / "ring.jsonl"
+        assert tr.export_jsonl(str(export_path)) == 3
+        for path in (stream_path, export_path):
+            loaded = RecordTracer.load_jsonl(str(path))
+            assert [e.signature for e in loaded] == tr.signature()
+            assert [e.t for e in loaded] == [e.t for e in tr.events]
+
+    def test_token_events_off_keeps_slo(self):
+        mc = ManualClock()
+        tr = RecordTracer(ObsConfig(clock=mc.now, token_events=False))
+        r = _rec()
+        tr.polled(r)
+        tr.slot_active(r)
+        mc.advance(0.004)
+        tr.tokens(r, 2)
+        assert all(e.stage != "tokens" for e in tr.events)
+        assert tr.slo.hist("itl").count == 2  # derived metric survives
+
+    def test_pooled_slo_summary(self):
+        mc = ManualClock()
+        a, b = (RecordTracer(ObsConfig(clock=mc.now)) for _ in range(2))
+        for tr, t in ((a, 0.010), (b, 0.030)):
+            r = _rec()
+            tr.polled(r)
+            mc.advance(t)
+            tr.slot_active(r)
+        pooled = pooled_slo_summary([a.slo, b.slo])
+        assert pooled["ttft"]["all"]["count"] == 2
+        assert pooled["ttft"]["by_tenant"]["tenantA"]["count"] == 2
+        assert pooled["ttft"]["all"]["p99_ms"] == pytest.approx(30.0)
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="capacity"):
+            ObsConfig(capacity=0)
+        with pytest.raises(TypeError):
+            MetricsExporter([object()])
+
+
+# --------------------------------------------------------------------------
+# 2. Trace determinism + traced-vs-untraced exactness
+# --------------------------------------------------------------------------
+
+
+def _topic(broker, prompts, key_fn=None):
+    broker.create_topic("p", partitions=2)
+    for i in range(prompts.shape[0]):
+        broker.produce(
+            "p", prompts[i].tobytes(), partition=i % 2,
+            key=key_fn(i) if key_fn else None,
+        )
+
+
+def _serve(cfg, params, prompts, tracer=None, **kw):
+    broker = tk.InMemoryBroker()
+    _topic(broker, prompts, key_fn=lambda i: b"ten%d" % (i % 2))
+    consumer = tk.MemoryConsumer(broker, "p", group_id="g")
+    server = StreamingGenerator(
+        consumer, params, cfg, slots=4, prompt_len=P, max_new=MAX_NEW,
+        commit_every=4, tracer=tracer, **kw,
+    )
+    out = {}
+    for rec, toks in server.run(max_records=prompts.shape[0]):
+        out[(rec.partition, rec.offset)] = np.asarray(toks)
+    committed = {
+        pt: broker.committed("g", tk.TopicPartition("p", pt)) for pt in (0, 1)
+    }
+    consumer.close()
+    return out, committed
+
+
+def _prompts(n, seed=7):
+    rng = np.random.default_rng(seed)
+    prompts = rng.integers(0, VOCAB, (n, P), dtype=np.int32)
+    prompts[:, :5] = np.arange(5, dtype=np.int32)  # shared radix prefix
+    return prompts
+
+
+class TestTracedServingExactness:
+    @pytest.mark.parametrize("kw", [
+        {}, {"kv_pages": PAGES},
+        {"temperature": 0.8, "top_k": 8, "rng": jax.random.key(3)},
+    ], ids=["dense-greedy", "paged-chunked", "dense-sampled"])
+    def test_traced_vs_untraced_token_and_ledger_identical(self, model, kw):
+        cfg, params = model
+        prompts = _prompts(8)
+        base, base_committed = _serve(cfg, params, prompts, **kw)
+        tr = RecordTracer(ObsConfig(clock=ManualClock().now))
+        traced, traced_committed = _serve(
+            cfg, params, prompts, tracer=tr, **kw
+        )
+        assert set(base) == set(traced)
+        for k in base:
+            np.testing.assert_array_equal(base[k], traced[k], err_msg=str(k))
+        assert base_committed == traced_committed
+        # The trace is balanced: every record polled, activated,
+        # finished, and committed exactly once.
+        sig = tr.signature()
+        for stage in (POLLED, SLOT_ACTIVE, FINISHED, COMMITTED):
+            assert sum(s[0] == stage for s in sig) == 8, stage
+        assert tr.summary()["open_records"] == 0
+
+
+class TestTraceDeterminism:
+    """Same-seed chaos replay → identical trace, the kvcache fleet
+    differential's fixture shape with the tracer riding along."""
+
+    def _chaos_run(self, cfg, params, obs):
+        broker = tk.InMemoryBroker()
+        broker.create_topic("t", partitions=4)
+        prompts = _prompts(16, seed=21)
+        for i in range(16):
+            broker.produce(
+                "t", prompts[i].tobytes(),
+                key=b"tenant-%d" % (i % 2), partition=i % 4,
+            )
+        fleet = ServingFleet(
+            lambda rid: tk.MemoryConsumer(broker, "t", group_id="gc"),
+            params, cfg, replicas=2, prompt_len=P, max_new=MAX_NEW,
+            slots=2, commit_every=2, gen_kwargs={"kv_pages": dict(PAGES)},
+            obs=obs,
+        )
+        chaos = ReplicaChaos(seed=5, min_completions=2, max_completions=6)
+        outputs: dict = {}
+        order = []
+        for _rid, rec, toks in fleet.serve(idle_timeout_ms=2000, chaos=chaos):
+            key = (rec.partition, rec.offset)
+            order.append(key)
+            outputs.setdefault(key, []).append(np.asarray(toks))
+        committed = {
+            pt: broker.committed("gc", tk.TopicPartition("t", pt))
+            for pt in range(4)
+        }
+        tracer = fleet.tracer
+        fleet.close()
+        return outputs, order, committed, chaos.killed, tracer
+
+    def test_same_seed_chaos_trace_identical(self, model):
+        cfg, params = model
+        # Manual clocks: byte-identical traces INCLUDING timestamps.
+        a = self._chaos_run(
+            cfg, params, RecordTracer(ObsConfig(clock=ManualClock().now))
+        )
+        b = self._chaos_run(
+            cfg, params, RecordTracer(ObsConfig(clock=ManualClock().now))
+        )
+        assert a[3] == b[3] and len(a[3]) == 1  # same seeded kill fired
+        assert a[1] == b[1]  # same completion order (duplicates included)
+        assert a[4].signature() == b[4].signature()  # modulo timestamps
+        assert list(a[4].events) == list(b[4].events)  # byte-identical
+        # The chaos branches really traced: a redelivered prompt was
+        # re-polled, so polled > unique records.
+        sig = a[4].signature()
+        polled = sum(s[0] == POLLED for s in sig)
+        assert polled > 16 or any(len(v) > 1 for v in a[0].values())
+
+    def test_traced_chaos_fleet_matches_untraced(self, model):
+        cfg, params = model
+        off = self._chaos_run(cfg, params, None)
+        on = self._chaos_run(
+            cfg, params, RecordTracer(ObsConfig(clock=ManualClock().now))
+        )
+        assert on[3] == off[3]
+        assert on[1] == off[1]
+        assert set(on[0]) == set(off[0]) and len(on[0]) == 16
+        for key in off[0]:
+            for x, y in zip(on[0][key], off[0][key]):
+                np.testing.assert_array_equal(x, y, err_msg=str(key))
+        assert on[2] == off[2]  # committed watermarks byte-identical
+
+
+# --------------------------------------------------------------------------
+# 3. Exposition conformance across ALL render_prometheus implementations
+# --------------------------------------------------------------------------
+
+_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_LABEL = r'[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\["\\n])*"'
+_SAMPLE_RE = re.compile(
+    rf"^({_NAME})(\{{{_LABEL}(?:,{_LABEL})*\}})? (\S+)$"
+)
+EVIL_TENANT = 'ev"il\\ten\nant'  # quote, backslash, newline — all from a key
+
+
+def _assert_conformant(text: str) -> int:
+    """Validate one exposition: every sample parses, carries HELP + TYPE,
+    counters end _total, values are floats. Returns the sample count."""
+    helped, typed = set(), {}
+    samples = 0
+    for line in text.strip().split("\n"):
+        if line.startswith("# HELP "):
+            name, sep, help_text = line[len("# HELP "):].partition(" ")
+            assert re.fullmatch(_NAME, name), line
+            assert sep and help_text.strip(), f"empty HELP: {line!r}"
+            helped.add(name)
+            continue
+        if line.startswith("# TYPE "):
+            name, _, mtype = line[len("# TYPE "):].partition(" ")
+            assert mtype in ("counter", "gauge"), line
+            typed[name] = mtype
+            continue
+        assert not line.startswith("#"), f"unknown comment: {line!r}"
+        m = _SAMPLE_RE.match(line)
+        assert m, f"unparsable sample line: {line!r}"
+        name, _labels, value = m.group(1), m.group(2), m.group(3)
+        float(value)  # must be a number
+        assert name in helped, f"sample without HELP: {name}"
+        assert name in typed, f"sample without TYPE: {name}"
+        if typed[name] == "counter":
+            assert name.endswith("_total"), f"counter not _total: {name}"
+        samples += 1
+    assert samples > 0
+    return samples
+
+
+def _stream_metrics():
+    m = StreamMetrics()
+    m.records.add(100)
+    m.commit_latency.observe(0.01)
+    m.ingest_lag_ms.set(12.5)
+    return m.render_prometheus()
+
+
+def _serve_metrics():
+    m = ServeMetrics()
+    m.completions.add(3)
+    m.tokens.add(24)
+    m.commit_latency.observe(0.002)
+    m.slot_occupancy.set(0.5)
+    m.prefix_hits.add(2)
+    return m.render_prometheus()
+
+
+def _fleet_metrics():
+    m = FleetMetrics()
+    m.completions.add(5)
+    m.tenant_admitted(EVIL_TENANT).add(2)
+    m.tenant_throttled(EVIL_TENANT).add(1)
+    m.tenant_queue_depth(EVIL_TENANT).set(3)
+    m.lane_wait("interactive").observe(0.004)
+    m.replica_occupancy(0).set(0.75)
+    m.replica_completions(0).add(5)
+    return m.render_prometheus(replicas=None)
+
+
+def _resilience_metrics():
+    m = ResilienceMetrics()
+    m.retries.add(2)
+    m.circuit_opens.add(1)
+    m.circuit_state.set(0.5)
+    return m.render_prometheus()
+
+
+def _slo_tracer():
+    mc = ManualClock()
+    tr = RecordTracer(ObsConfig(clock=mc.now))
+    r = Record("t", 0, 0, b"x", key=EVIL_TENANT.encode(),
+               headers=(("lane", b"interactive"),))
+    tr.polled(r, replica=0)
+    mc.advance(0.02)
+    tr.qos_admitted(r, "interactive", 0.02, replica=0)
+    tr.slot_active(r, replica=0)
+    mc.advance(0.001)
+    tr.tokens(r, 2, replica=0)
+    tr.finished(r, 3, replica=0)
+    tr.note_commit({("t", 0): 1})
+    return tr.render_prometheus()
+
+
+@pytest.mark.parametrize("render", [
+    _stream_metrics, _serve_metrics, _fleet_metrics, _resilience_metrics,
+    _slo_tracer,
+], ids=["stream", "serve", "fleet", "resilience", "slo"])
+def test_exposition_conformance(render):
+    """The one grammar every exposition must satisfy — so the shared
+    endpoint can't drift per class, and hostile tenant keys (quotes,
+    backslashes, newlines) can't break a scrape."""
+    text = render()
+    _assert_conformant(text)
+
+
+def test_exposition_label_escaping_roundtrip():
+    assert escape_label_value('a"b\\c\nd') == 'a\\"b\\\\c\\nd'
+    body = format_labels(tenant=EVIL_TENANT, percentile="p50")
+    assert "\n" not in body
+    # The fleet's rendered evil-tenant sample must still parse.
+    text = _fleet_metrics()
+    evil_lines = [
+        line for line in text.splitlines()
+        if "tenant_admitted_total{" in line
+    ]
+    assert evil_lines and all(_SAMPLE_RE.match(li) for li in evil_lines)
+
+
+def test_combined_exposition_has_no_duplicate_metric_families():
+    """One scrape of every class must not define the same metric name
+    twice (Prometheus rejects duplicate families) — the prefixes keep
+    the families disjoint."""
+    text = "".join((
+        _stream_metrics(), _serve_metrics(), _fleet_metrics(),
+        _resilience_metrics(), _slo_tracer(),
+    ))
+    names = re.findall(r"^# TYPE (\S+)", text, re.M)
+    assert len(names) == len(set(names))
+    _assert_conformant(text)
+
+
+# --------------------------------------------------------------------------
+# 4. The HTTP endpoint
+# --------------------------------------------------------------------------
+
+
+class TestExporter:
+    def test_serves_all_sources_and_survives_broken_one(self):
+        m = StreamMetrics()
+        m.records.add(7)
+        tr = _slo_tracer  # callable source returning exposition text
+
+        def broken():
+            raise RuntimeError("scrape me not")
+
+        with MetricsExporter([m, tr, broken]) as exporter:
+            with urllib.request.urlopen(exporter.url, timeout=10) as resp:
+                assert resp.status == 200
+                assert resp.headers["Content-Type"].startswith("text/plain")
+                body = resp.read().decode()
+        assert "torchkafka_records_total 7" in body
+        assert "torchkafka_slo_ttft_ms" in body
+        assert "# source error: RuntimeError" in body
+        _assert_conformant(
+            "\n".join(li for li in body.splitlines()
+                      if not li.startswith("# source error")) + "\n"
+        )
+
+    def test_404_off_path_and_restartable(self):
+        exporter = MetricsExporter([StreamMetrics()]).start()
+        try:
+            url = f"http://127.0.0.1:{exporter.port}/nope"
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(url, timeout=10)
+        finally:
+            exporter.stop()
+        with pytest.raises(RuntimeError, match="not started"):
+            _ = exporter.port
+
+
+# --------------------------------------------------------------------------
+# Satellite: ingest lag through the injectable clock
+# --------------------------------------------------------------------------
+
+
+class TestIngestLagClock:
+    def test_helper_uses_injected_clock(self):
+        mc = ManualClock(start=2.0)  # "epoch" 2s = 2000ms
+        assert ingest_lag_ms(500, clock=mc.now) == pytest.approx(1500.0)
+        mc.advance(1.0)
+        assert ingest_lag_ms(500, clock=mc.now) == pytest.approx(2500.0)
+        assert ingest_lag_ms(0, clock=mc.now) == 0.0  # no timestamp
+        assert ingest_lag_ms(500, now_ms=700.0) == pytest.approx(200.0)
+
+    def test_stream_lag_gauge_is_exact_under_manual_clock(self):
+        broker = tk.InMemoryBroker()
+        broker.create_topic("lag", partitions=1)
+        for i in range(4):
+            # Records appended at t=1.0s on the synthetic timeline.
+            broker.produce(
+                "lag", np.arange(4, dtype=np.int32).tobytes(),
+                partition=0, timestamp_ms=1000 + i,
+            )
+        mc = ManualClock(start=2.5)  # poll happens at t=2.5s
+        consumer = tk.MemoryConsumer(broker, "lag", group_id="glag")
+        with tk.KafkaStream(
+            consumer, tk.fixed_width(4, np.int32), batch_size=4,
+            prefetch=0, to_device=False, idle_timeout_ms=1,
+            owns_consumer=True, clock=mc.now,
+        ) as stream:
+            batch, token = next(iter(stream))
+            token.commit()
+            # newest record stamped 1003ms, clock reads 2500ms.
+            assert stream.metrics.ingest_lag_ms.value == pytest.approx(
+                2500.0 - 1003.0
+            )

@@ -30,6 +30,12 @@ from torchkafka_tpu.errors import (
     TpuKafkaError,
 )
 from torchkafka_tpu.journal import DecodeJournal, JournalEntry
+from torchkafka_tpu.obs import (
+    MetricsExporter,
+    ObsConfig,
+    RecordTrace,
+    RecordTracer,
+)
 from torchkafka_tpu.parallel import batch_sharding, global_batch, make_mesh
 from torchkafka_tpu.pipeline import KafkaStream, stream
 from torchkafka_tpu.resilience import (
@@ -72,7 +78,7 @@ from torchkafka_tpu.transform import (
     raw_bytes,
 )
 
-__version__ = "0.10.0"
+__version__ = "0.11.0"
 
 __all__ = [
     "BarrierError",
@@ -99,12 +105,16 @@ __all__ = [
     "ManualClock",
     "MemoryConsumer",
     "MemoryProducer",
+    "MetricsExporter",
+    "ObsConfig",
     "OutputDeliveryError",
     "PoisonQuarantine",
     "PoisonRecordError",
     "Producer",
     "ProducerClosedError",
     "RecordMetadata",
+    "RecordTrace",
+    "RecordTracer",
     "ResilientConsumer",
     "RetryPolicy",
     "dead_letter_to_topic",

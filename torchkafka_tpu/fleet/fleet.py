@@ -178,12 +178,38 @@ class ServingFleet:
         journal_dir: str | os.PathLike | None = None,
         journal_cadence: int = 8,
         drain_timeout_s: float | None = None,
+        obs=None,
     ) -> None:
+        """``obs``: record-lifecycle tracing + SLO histograms for the
+        whole fleet (torchkafka_tpu/obs). ``True`` builds a tracer on
+        the fleet's own injectable ``clock`` (so ManualClock fleets get
+        deterministic timestamps for free); an ``obs.ObsConfig`` sets
+        policy (ring capacity, JSONL sink, token events); an existing
+        ``obs.RecordTracer`` is shared as-is. The ONE tracer spans every
+        replica — events tag the replica id, the SLO histograms label by
+        lane/tenant/replica, and ``metrics.summary()`` gains an ``slo``
+        section. None (default): zero tracing, guard-only cost."""
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
         self._qos = qos or QoSConfig()
         self._clock = clock
         self.metrics = FleetMetrics()
+        self.tracer = None
+        if obs is not None and obs is not False:
+            from torchkafka_tpu.obs import ObsConfig, RecordTracer
+
+            if isinstance(obs, RecordTracer):
+                self.tracer = obs
+            elif isinstance(obs, ObsConfig):
+                self.tracer = RecordTracer(obs)
+            elif obs is True:
+                self.tracer = RecordTracer(ObsConfig(clock=clock))
+            else:
+                raise TypeError(
+                    "obs must be True, an ObsConfig, or a RecordTracer, "
+                    f"got {type(obs).__name__}"
+                )
+            self.metrics.attach_slo(self.tracer.slo)
         self._buckets = TenantBuckets(self._qos, clock)
         self._journal_paths: dict[int, str] = {}
         carried_hints: dict = {}
@@ -210,6 +236,9 @@ class ServingFleet:
                 kw["journal"] = DecodeJournal(
                     self._journal_paths[rid], cadence=journal_cadence
                 )
+            if self.tracer is not None:
+                kw.setdefault("tracer", self.tracer)
+                kw.setdefault("trace_replica", rid)
             gen = generator_cls(
                 consumer, params, cfg,
                 slots=slots, prompt_len=prompt_len, max_new=max_new,
@@ -223,7 +252,8 @@ class ServingFleet:
             if carried_hints:
                 gen.add_resume_hints(carried_hints)
             queue = AdmissionQueue(
-                self._qos, self._buckets, self.metrics, clock
+                self._qos, self._buckets, self.metrics, clock,
+                tracer=self.tracer, replica=rid,
             )
             self.replicas.append(Replica(
                 rid, gen, consumer, queue, self._qos, self.metrics,

@@ -10,6 +10,7 @@ from torchkafka_tpu.utils.metrics import (
     Gauge,
     LatencyHistogram,
     RateMeter,
+    format_labels,
     merge_latency_summaries,
     render_exposition,
 )
@@ -43,6 +44,14 @@ class FleetMetrics:
         self._lane_wait: dict[str, LatencyHistogram] = {}
         self._replica_occupancy: dict[int, Gauge] = {}
         self._replica_completions: dict[int, RateMeter] = {}
+        self._slo = None  # obs.SLOHistograms, attached by a traced fleet
+
+    def attach_slo(self, slo) -> None:
+        """Bind the fleet tracer's derived SLO histograms
+        (``obs.SLOHistograms``) so TTFT / inter-token latency / queue
+        wait / e2e percentiles per lane+tenant+replica ride this class's
+        ``summary()`` and Prometheus exposition alongside the counters."""
+        self._slo = slo
 
     # ------------------------------------------------------ lazy accessors
 
@@ -122,6 +131,7 @@ class FleetMetrics:
             "resume_rejected": sum(m.resume_rejected.count for m in gens),
         }
         return {
+            "slo": self._slo.summary() if self._slo is not None else None,
             "prefix_cache": cache,
             "chunked_prefill": chunked,
             "journal": journal,
@@ -164,7 +174,7 @@ class FleetMetrics:
         s = self.summary(replicas)
         pc = s["prefix_cache"]
         cp = s["chunked_prefill"]
-        return render_exposition(prefix, [
+        series = [
             ("chunk_ticks_total", "counter", cp["chunk_ticks"]),
             ("admission_stall_ticks_total", "counter", cp["stall_ticks"]),
             ("admission_queue_tokens", "gauge", cp["queue_tokens"]),
@@ -188,28 +198,30 @@ class FleetMetrics:
              s["journal"]["resume_rejected"]),
             ("completions_per_second", "gauge", s["completions_per_s"]),
             ("tenant_admitted_total", "counter", [
-                (f'tenant="{t}"', v["admitted"]) for t, v in s["tenants"].items()
+                (format_labels(tenant=t), v["admitted"])
+                for t, v in s["tenants"].items()
             ] or 0),
             ("tenant_throttled_total", "counter", [
-                (f'tenant="{t}"', v["throttled"]) for t, v in s["tenants"].items()
+                (format_labels(tenant=t), v["throttled"])
+                for t, v in s["tenants"].items()
             ] or 0),
             ("tenant_queue_depth", "gauge", [
-                (f'tenant="{t}"', v["queue_depth"])
+                (format_labels(tenant=t), v["queue_depth"])
                 for t, v in s["tenants"].items()
             ] or 0),
             ("lane_queue_wait_ms", "gauge", [
-                (f'lane="{lane}",percentile="p50"', v["p50_ms"])
+                (format_labels(lane=lane, percentile="p50"), v["p50_ms"])
                 for lane, v in s["lanes"].items()
             ] + [
-                (f'lane="{lane}",percentile="p99"', v["p99_ms"])
+                (format_labels(lane=lane, percentile="p99"), v["p99_ms"])
                 for lane, v in s["lanes"].items()
             ] or 0),
             ("replica_slot_occupancy", "gauge", [
-                (f'replica="{rid}"', v["slot_occupancy"])
+                (format_labels(replica=rid), v["slot_occupancy"])
                 for rid, v in s["replicas"].items()
             ] or 0),
             ("replica_completions_total", "counter", [
-                (f'replica="{rid}"', v["completions"])
+                (format_labels(replica=rid), v["completions"])
                 for rid, v in s["replicas"].items()
             ] or 0),
             ("commit_latency_ms", "gauge", [
@@ -224,4 +236,7 @@ class FleetMetrics:
             ("admission_deferrals_total", "counter", pc["deferrals"]),
             ("prefix_cache_hit_rate", "gauge", pc["hit_rate"] or 0.0),
             ("kvcache_pool_occupancy", "gauge", pc["pool_occupancy"]),
-        ])
+        ]
+        if self._slo is not None:
+            series.extend(self._slo.series())
+        return render_exposition(prefix, series)

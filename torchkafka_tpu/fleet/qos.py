@@ -158,11 +158,18 @@ class AdmissionQueue:
         buckets: TenantBuckets,
         metrics,
         clock: Callable[[], float] = time.monotonic,
+        *,
+        tracer=None,
+        replica: int | None = None,
     ) -> None:
         self._cfg = cfg
         self._buckets = buckets
         self._metrics = metrics
         self._clock = clock
+        # Lifecycle tracing (obs.RecordTracer): a pop from this queue is
+        # the record's qos_admitted stage boundary; ``replica`` tags it.
+        self._tracer = tracer
+        self._replica = replica
         # lane -> tenant -> deque[(record, enqueue_time)]
         self._q: dict[str, dict[str, deque]] = {INTERACTIVE: {}, BATCH: {}}
         self._rr: dict[str, int] = {INTERACTIVE: 0, BATCH: 0}
@@ -244,6 +251,11 @@ class AdmissionQueue:
                         self.tenant_depth(tenant)
                     )
                     self._metrics.lane_wait(lane).observe(max(0.0, now - t_enq))
+                    if self._tracer is not None:
+                        self._tracer.qos_admitted(
+                            rec, lane, max(0.0, now - t_enq),
+                            replica=self._replica,
+                        )
                     out.append(rec)
                     progressed = True
                 if not progressed:

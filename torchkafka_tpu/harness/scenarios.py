@@ -1413,6 +1413,155 @@ def scenario_14(size: str = "tiny", prefill_chunk: int | None = None) -> dict:
     }
 
 
+def scenario_15(size: str = "tiny", replicas: int = 2) -> dict:
+    """SLO observability smoke (torchkafka_tpu/obs): a keyed-tenant
+    2-replica fleet — three tenants on fixed system prompts (the
+    scenario-12 cache shape), both QoS lanes — served with the record
+    lifecycle tracer on, then the SLO report production watches: per-
+    tenant/per-lane time-to-first-token and inter-token-latency p50/p99,
+    admission queue wait, e2e poll→commit, and the prefix-cache hit
+    rate, all read back from ``FleetMetrics.summary()``. Plus the
+    endpoint smoke: a ``MetricsExporter`` on an ephemeral port scraped
+    over real HTTP, every metrics class (fleet + per-replica serve +
+    SLO tracer) riding the one /metrics exposition. The tier-1 guard
+    for the obs stack; trace determinism lives in tests/test_obs.py and
+    the overhead numbers in benchmarks/bench_obs.py."""
+    import time as _time
+    import urllib.request
+
+    import torchkafka_tpu as tk
+    from torchkafka_tpu.fleet import QoSConfig, ServingFleet
+    from torchkafka_tpu.obs import MetricsExporter
+    from torchkafka_tpu.source.records import TopicPartition
+
+    prompt_len, max_new = (16, 8) if size == "tiny" else (64, 32)
+    n = 24 if size == "tiny" else 128
+    block = 4 if size == "tiny" else 16
+    sys_len = 2 * block
+    parts = 4
+    cfg, params, label = _serving_model(size, None, prompt_len, max_new)
+    broker = tk.InMemoryBroker()
+    broker.create_topic("t15", partitions=parts)
+    rng = np.random.default_rng(0)
+    tenants = ("alpha", "beta", "gamma")
+    system = {
+        t: rng.integers(0, cfg.vocab_size, sys_len, dtype=np.int32)
+        for t in tenants
+    }
+    produced = []
+    for i in range(n):
+        t = tenants[i % len(tenants)]
+        prompt = np.concatenate([
+            system[t],
+            rng.integers(0, cfg.vocab_size, prompt_len - sys_len,
+                         dtype=np.int32),
+        ])
+        rec = broker.produce(
+            "t15", prompt.tobytes(), key=t.encode(),
+            headers=(
+                ("lane", b"interactive" if t == "alpha" else b"batch"),
+            ),
+        )
+        produced.append((rec.partition, rec.offset))
+    slots = 4
+    pages = {
+        "block_size": block,
+        "num_blocks": slots * -(-(prompt_len + max_new) // block) + 16,
+    }
+    fleet = ServingFleet(
+        lambda rid: tk.MemoryConsumer(broker, "t15", group_id="s15"),
+        params, cfg, replicas=replicas, prompt_len=prompt_len,
+        max_new=max_new, slots=slots, qos=QoSConfig(), commit_every=4,
+        gen_kwargs={"kv_pages": pages}, obs=True,
+    )
+    fleet.warmup()
+    t0 = _time.perf_counter()
+    served = fleet.serve_all(idle_timeout_ms=2000)
+    elapsed = _time.perf_counter() - t0
+    keys = {(r.partition, r.offset) for _rid, r, _t in served}
+    committed_complete = all(
+        broker.committed("s15", TopicPartition("t15", p))
+        == broker.end_offset(TopicPartition("t15", p))
+        for p in {p for p, _ in produced}
+    )
+    s = fleet.metrics.summary(fleet.replicas)
+    slo = s["slo"]
+
+    def pct(leaf):
+        return {
+            "count": leaf["count"],
+            "p50_ms": round(leaf["p50_ms"], 3),
+            "p99_ms": round(leaf["p99_ms"], 3),
+        }
+
+    report = {
+        t: {
+            "ttft": pct(slo["ttft"]["by_tenant"].get(
+                t, {"count": 0, "p50_ms": 0.0, "p99_ms": 0.0})),
+            "itl": pct(slo["itl"]["by_tenant"].get(
+                t, {"count": 0, "p50_ms": 0.0, "p99_ms": 0.0})),
+        }
+        for t in tenants
+    }
+    # The endpoint smoke: every metrics class through ONE exposition,
+    # scraped over real HTTP on an ephemeral port.
+    exporter = MetricsExporter()
+    exporter.add(lambda: fleet.metrics.render_prometheus(
+        replicas=fleet.replicas))
+    for rep in fleet.replicas:
+        exporter.add(rep.gen.metrics)
+    exporter.add(fleet.tracer)
+    with exporter:
+        with urllib.request.urlopen(exporter.url, timeout=10) as resp:
+            endpoint_status = resp.status
+            body = resp.read().decode("utf-8")
+    fleet.close()
+    fleet.tracer.close()
+    trace_summary = fleet.tracer.summary()
+    return {
+        "scenario": "15:slo-observability",
+        "model_scale": label,
+        "replicas": replicas,
+        "records": len(served),
+        "elapsed_s": round(elapsed, 3),
+        "records_per_s": round(len(served) / elapsed, 1) if elapsed else None,
+        "coverage_complete": keys == set(produced),
+        "committed_complete": committed_complete,
+        "tenant_slo": report,
+        "ttft": pct(slo["ttft"]["all"]),
+        "itl": pct(slo["itl"]["all"]),
+        "queue_wait": pct(slo["queue_wait"]["all"]),
+        "e2e": pct(slo["e2e"]["all"]),
+        "lanes_observed": sorted(slo["ttft"]["by_lane"]),
+        "replicas_observed": sorted(slo["ttft"]["by_replica"]),
+        "cache_hit_rate": s["prefix_cache"]["hit_rate"],
+        "trace_events": trace_summary["events"],
+        "trace_stages": trace_summary["stages"],
+        "open_records_end": trace_summary["open_records"],
+        "endpoint_status": endpoint_status,
+        "endpoint_bytes": len(body),
+        "endpoint_series": sum(
+            1 for line in body.splitlines()
+            if line and not line.startswith("#")
+        ),
+        "endpoint_has": {
+            name: (name in body) for name in (
+                "torchkafka_fleet_ttft_ms",
+                "torchkafka_fleet_itl_ms",
+                "torchkafka_fleet_tenant_admitted_total",
+                "torchkafka_serve_tokens_total",
+                "torchkafka_slo_trace_events_total",
+            )
+        },
+        "dropped": sum(
+            rep.gen.metrics.dropped.count for rep in fleet.replicas
+        ),
+        "commit_failures": sum(
+            rep.gen.metrics.commit_failures.count for rep in fleet.replicas
+        ),
+    }
+
+
 def scenario_8(size: str = "tiny") -> dict:
     """Streaming CTR: DLRM-style recommender trained from a Kafka event
     stream — label + dense features + hashed categorical ids per record,
@@ -1782,6 +1931,7 @@ SCENARIOS = {
     12: scenario_12,
     13: scenario_13,
     14: scenario_14,
+    15: scenario_15,
 }
 
 
@@ -1830,7 +1980,7 @@ def run_scenario(
         )
     sample_kw = dict(temperature=temperature, top_k=top_k, top_p=top_p)
     spec_kw = dict(spec=spec, spec_k=spec_k, spec_draft_layers=spec_draft_layers)
-    if num in (10, 11, 12, 13):
+    if num in (10, 11, 12, 13, 15):
         return SCENARIOS[num](size, replicas=replicas)
     if model_scale is not None:
         if num not in (5, 7):

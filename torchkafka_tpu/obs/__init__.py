@@ -1,0 +1,45 @@
+"""Record-lifecycle observability (torchkafka_tpu/obs).
+
+The reference has zero instrumentation (PAPER.md §5 tracing row) and the
+repo's pre-existing metrics are four counter bags that can say *how many*
+but never *where the time went for one record*. This package closes that
+gap with three cooperating layers:
+
+- ``trace`` — per-record lifecycle tracing keyed by the identity the whole
+  repo already uses, ``(topic, partition, offset)``: typed span events at
+  every stage boundary (polled → QoS-admitted → prefill-queued →
+  chunk-scheduled → slot-active/first-token → token ticks → finished →
+  committed, plus the warm-resume / journal-served / DLQ / deferral
+  branches), through an injectable monotonic clock so same-seed chaos
+  replays produce identical traces — the repo's differential style applied
+  to observability itself. Bounded ring-buffer sink, JSONL export.
+- ``slo`` — histograms DERIVED from the trace stream: time-to-first-token,
+  inter-token latency, admission queue wait, end-to-end poll→commit,
+  labeled by lane / tenant key / replica and pooled fleet-wide with the
+  same sample-window merge the commit-latency percentiles use.
+- ``exporter`` — one pull-based Prometheus/OpenMetrics HTTP endpoint
+  (stdlib ``http.server``, opt-in) exposing every metrics class through
+  the shared renderer instead of four ad-hoc ``render_prometheus`` call
+  sites.
+"""
+
+from torchkafka_tpu.obs.exporter import MetricsExporter
+from torchkafka_tpu.obs.slo import SLOHistograms, pooled_slo_summary
+from torchkafka_tpu.obs.trace import (
+    STAGES,
+    ObsConfig,
+    RecordTrace,
+    RecordTracer,
+    TraceEvent,
+)
+
+__all__ = [
+    "MetricsExporter",
+    "ObsConfig",
+    "RecordTrace",
+    "RecordTracer",
+    "SLOHistograms",
+    "STAGES",
+    "TraceEvent",
+    "pooled_slo_summary",
+]

@@ -44,6 +44,7 @@ from torchkafka_tpu.source.consumer import Consumer
 from torchkafka_tpu.transform.batcher import Batch, Batcher
 from torchkafka_tpu.transform.processor import Processor
 from torchkafka_tpu.utils.metrics import StreamMetrics
+from torchkafka_tpu.utils.tracing import ingest_lag_ms
 
 _logger = logging.getLogger(__name__)
 
@@ -118,6 +119,11 @@ class KafkaStream:
     barrier_timeout_s / on_barrier_timeout: the default pod watchdog's
         timeout and optional extra callback (ignored when ``barrier`` is
         passed explicitly).
+    clock: seconds-since-epoch clock for the ``ingest_lag_ms`` gauge
+        (record append time -> poll time); default ``time.time``. Inject a
+        ``resilience.ManualClock.now`` (with records produced at explicit
+        ``timestamp_ms``) and consumer lag becomes exactly testable
+        instead of wall-clock-dependent (utils.tracing.ingest_lag_ms).
     """
 
     def __init__(
@@ -144,6 +150,7 @@ class KafkaStream:
         quarantine: Any | None = None,
         buckets: Any | None = None,
         bucket_pad_value: int = 0,
+        clock: Any | None = None,
     ) -> None:
         if on_processor_error not in ("raise", "drop", "quarantine"):
             raise ValueError(
@@ -172,6 +179,7 @@ class KafkaStream:
         self._poll_timeout_ms = poll_timeout_ms
         self._idle_timeout_ms = idle_timeout_ms
         self._owns_consumer = owns_consumer
+        self._clock = clock or time
         self._on_processor_error = on_processor_error
         self._dead_letter = dead_letter
         self._quarantine = quarantine
@@ -315,7 +323,11 @@ class KafkaStream:
         self.metrics.records.add(len(records))
         newest = records[-1].timestamp_ms
         if newest:
-            self.metrics.ingest_lag_ms.set(max(0.0, time() * 1e3 - newest))
+            # Through the shared helper + the injectable clock, never a
+            # bare wall-clock read: ManualClock tests pin lag exactly.
+            self.metrics.ingest_lag_ms.set(
+                ingest_lag_ms(newest, clock=self._clock)
+            )
         self._ledger.fetched_many(records)
         if self._chunked:
             # Vectorized path: one processor call per poll chunk, one

@@ -73,6 +73,7 @@ from torchkafka_tpu.models.generate import (
 from torchkafka_tpu.models.quant import embed_rows, load_weight
 from torchkafka_tpu.models.transformer import TransformerConfig, _rms_norm, _rope
 from torchkafka_tpu.source.records import Record
+from torchkafka_tpu.utils import tracing as xprof
 from torchkafka_tpu.utils.metrics import Gauge, LatencyHistogram, RateMeter
 
 _logger = logging.getLogger(__name__)
@@ -480,6 +481,8 @@ class StreamingGenerator:
         kv_kernel: bool | str = "auto",
         kv_pages: PagedKVConfig | dict | None = None,
         journal: DecodeJournal | None = None,
+        tracer=None,
+        trace_replica: int | None = None,
     ) -> None:
         """``ticks_per_sync``: decode ticks chained per device dispatch
         (and per host sync of the done mask). Higher amortises dispatch
@@ -630,6 +633,15 @@ class StreamingGenerator:
         on one device (``kv_dtype=None``, ``mesh=None``); hints are
         ignored (cold replay, still correct) otherwise.
 
+        ``tracer``: an ``obs.RecordTracer`` — per-record lifecycle span
+        events (polled → admitted → first token → per-token ticks →
+        finished → committed, plus warm-resume/DLQ/deferral branches)
+        emitted at every stage boundary this server crosses, keyed by
+        the record's (topic, partition, offset) identity;
+        ``trace_replica`` tags the events (the fleet sets it per
+        replica). None (the default) costs only the per-site ``is not
+        None`` guards — measured in benchmarks/bench_obs.py.
+
         ``quarantine``: a ``resilience.PoisonQuarantine``. Without it, an
         undecodable prompt is retired immediately as dropped (the
         original policy — no durable copy). With it, each decode failure
@@ -752,6 +764,8 @@ class StreamingGenerator:
         # host-side per-slot emitted-token mirrors that drive journal
         # cadence and the decoded-token accounting.
         self._journal = journal
+        self._tracer = tracer
+        self._trace_replica = trace_replica
         self._resume_hints: dict[tuple[str, int, int], JournalEntry] = {}
         self._journal_ready: list[tuple[Record, np.ndarray]] = []
         self._slot_emitted = np.zeros((slots,), np.int64)
@@ -1475,9 +1489,10 @@ class StreamingGenerator:
                 donate_argnums=(1, 2),
             )
             self._paged_prefill_jits[(s, start)] = fn
-        logits, pool_k, pool_v = fn(
-            self._params, caches[0], caches[1], table_row, toks
-        )
+        with xprof.span(xprof.SPAN_ADMIT):
+            logits, pool_k, pool_v = fn(
+                self._params, caches[0], caches[1], table_row, toks
+            )
         return logits, (pool_k, pool_v) + caches[2:]
 
     def _paged_set_table(self, caches, table_dev):
@@ -1539,6 +1554,11 @@ class StreamingGenerator:
         while packed < C and self._prefill_queue:
             e = self._prefill_queue[0]
             n = min(C - packed, len(e.seq) - e.off)
+            if e.off == 0 and self._tracer is not None:
+                # First suffix tokens riding a fused tick for this record.
+                self._tracer.chunk_scheduled(
+                    e.rec, replica=self._trace_replica
+                )
             ctok[packed:packed + n] = e.seq[e.off:e.off + n]
             cpos[packed:packed + n] = e.start + e.off + np.arange(n)
             ctable[packed:packed + n] = self._table_np[e.slot]
@@ -1567,6 +1587,13 @@ class StreamingGenerator:
         for e, _row_idx in finishers:
             self._prefilling[e.slot] = False
             self._active[e.slot] = True
+            if self._tracer is not None:
+                # Token 0 was sampled in the activating dispatch (cold) or
+                # restored from the journal (warm): TTFT closes here.
+                self._tracer.slot_active(
+                    e.rec, replica=self._trace_replica,
+                    warm=e.resume is not None,
+                )
             # Extra ticks spent queued beyond the one-tick minimum — 0
             # when the admission's whole suffix rode the first chunk.
             self.metrics.admission_stall_ticks.add(
@@ -1670,6 +1697,10 @@ class StreamingGenerator:
                 out = np.asarray(hint.tokens, np.int32)
                 self._journal_ready.append((rec, out))
                 self.metrics.journal_served.add(1)
+                if self._tracer is not None:
+                    self._tracer.journal_served(
+                        rec, len(out), replica=self._trace_replica
+                    )
                 if self._journal is not None:
                     self._journal_record(rec, hint.key_data or kd, out, True)
                     journal_dirty = True
@@ -1710,6 +1741,8 @@ class StreamingGenerator:
                     self._resume_hints[
                         (rec.topic, rec.partition, rec.offset)
                     ] = hint
+                if self._tracer is not None:
+                    self._tracer.deferred(rec, replica=self._trace_replica)
                 self._paged_deferred.append(rec)
                 self._paged_deferred.extend(queue)
                 queue = []
@@ -1749,6 +1782,10 @@ class StreamingGenerator:
                 self._slot_journaled[i] = len(emitted)
                 self.metrics.warm_resumes.add(1)
                 self.metrics.journal_tokens_restored.add(len(emitted))
+                if self._tracer is not None:
+                    self._tracer.warm_resumed(
+                        rec, len(emitted), replica=self._trace_replica
+                    )
                 if self._journal is not None:
                     self._journal_record(rec, key_np, emitted, False)
                     journal_dirty = True
@@ -1761,6 +1798,10 @@ class StreamingGenerator:
                     i, rec, np.asarray(seq[start:], np.int32), start,
                     key_np, emitted, self._tick_counter,
                 ))
+                if self._tracer is not None:
+                    self._tracer.prefill_queued(
+                        rec, len(seq) - start, replica=self._trace_replica
+                    )
                 reserved += 1
                 continue
             # LEGACY: one suffix-prefill dispatch per record (a jit
@@ -1811,6 +1852,11 @@ class StreamingGenerator:
                 self._last_tok, self._pos, self._gen, logits_b,
                 jnp.asarray(admit_mask), jnp.asarray(keys_np),
             )
+            if self._tracer is not None:
+                for i in slot_ids:
+                    self._tracer.slot_active(
+                        self._slot_rec[i], replica=self._trace_replica
+                    )
         if resumed:
             res_mask = np.zeros((B,), bool)
             res_last = np.zeros((B,), np.int32)
@@ -1829,6 +1875,12 @@ class StreamingGenerator:
             self._gen = jnp.where(
                 m[:, None], jnp.asarray(res_gen), self._gen
             )
+            if self._tracer is not None:
+                for i, _emitted in resumed:
+                    self._tracer.slot_active(
+                        self._slot_rec[i], replica=self._trace_replica,
+                        warm=True,
+                    )
         self._caches = caches
         if journal_dirty:
             self._journal.flush()
@@ -2109,6 +2161,10 @@ class StreamingGenerator:
         # them but the broker's uncommitted offsets).
         crash_hook("post_poll")
         self._ledger.fetched_many(records)
+        tr = self._tracer
+        if tr is not None:
+            for r in records:
+                tr.polled(r, replica=self._trace_replica)
 
     def _next_decodable(self, queue: list[Record]):
         """Pop ``queue`` until a record decodes; returns (record, tokens)
@@ -2131,6 +2187,10 @@ class StreamingGenerator:
                         if not self._quarantine.note_failure(rec, exc):
                             continue  # budget left: re-attempt in place
                         self.metrics.quarantined.add(1)
+                        if self._tracer is not None:
+                            self._tracer.quarantined(
+                                rec, replica=self._trace_replica
+                            )
                         # DLQ copy acknowledged durable; the offset has
                         # NOT retired yet — the crash window where
                         # redelivery must re-quarantine idempotently.
@@ -2140,6 +2200,10 @@ class StreamingGenerator:
                             "dropping undecodable prompt %s@%s:%s",
                             rec.topic, rec.partition, rec.offset,
                         )
+                        if self._tracer is not None:
+                            self._tracer.dropped(
+                                rec, replica=self._trace_replica
+                            )
                     self._ledger.dropped(rec)
                     self.metrics.dropped.add(1)
                     break  # next record
@@ -2223,6 +2287,11 @@ class StreamingGenerator:
         self._slot_journaled[i] = g
         self.metrics.warm_resumes.add(1)
         self.metrics.journal_tokens_restored.add(g)
+        if self._tracer is not None:
+            self._tracer.warm_resumed(rec, g, replica=self._trace_replica)
+            self._tracer.slot_active(
+                rec, replica=self._trace_replica, warm=True
+            )
         if self._journal is not None:
             self._journal_record(rec, key_np, emitted, False)
 
@@ -2267,6 +2336,10 @@ class StreamingGenerator:
                 out = np.asarray(hint.tokens, np.int32)
                 self._journal_ready.append((rec, out))
                 self.metrics.journal_served.add(1)
+                if self._tracer is not None:
+                    self._tracer.journal_served(
+                        rec, len(out), replica=self._trace_replica
+                    )
                 if self._journal is not None:
                     self._journal_record(rec, hint.key_data or kd, out, True)
                     journal_dirty = True
@@ -2309,17 +2382,23 @@ class StreamingGenerator:
                 self._slot_keys,
             )
         if admitted:
-            out = self._admit_fn(
-                self._caches, self._last_tok, self._pos, self._gen,
-                jnp.asarray(prompts), jnp.asarray(admit_mask),
-                jnp.asarray(keys_np),
-            )
+            with xprof.span(xprof.SPAN_ADMIT):
+                out = self._admit_fn(
+                    self._caches, self._last_tok, self._pos, self._gen,
+                    jnp.asarray(prompts), jnp.asarray(admit_mask),
+                    jnp.asarray(keys_np),
+                )
             # Rebind self state after every dispatch: admit/tick DONATE
             # the pool, so the old self._caches handles are dead buffers —
             # without this, anything reading server state afterwards (a
             # second run, decode_roofline, spec_stats) holds deleted
             # arrays.
             self._caches, self._last_tok, self._pos, self._gen = out
+            if self._tracer is not None:
+                for i in np.nonzero(admit_mask)[0]:
+                    self._tracer.slot_active(
+                        self._slot_rec[i], replica=self._trace_replica
+                    )
         if journal_dirty:
             self._journal.flush()
         return filled
@@ -2336,6 +2415,10 @@ class StreamingGenerator:
         self.metrics.tokens.add(len(out))
         if len(out) < self._max_new:
             self.metrics.truncated.add(1)
+        if self._tracer is not None:
+            self._tracer.finished(
+                rec, len(out), replica=self._trace_replica
+            )
         sent_ok = True
         if self._output_producer is not None:
             # Async send; durability is settled in _commit (flush
@@ -2407,15 +2490,19 @@ class StreamingGenerator:
                 # tokens rides this tick's layer sweep alongside every
                 # decode slot — admission work never preempts a decode
                 # tick, it shares one.
-                (ctok, ctable, cpos, fin_mask, fin_row, packed,
-                 finishers) = self._pack_chunk()
-                caches, last_tok, pos, gen, done, n_out = self._tick_chunk_fn(
-                    self._caches, self._last_tok, self._pos, self._gen,
-                    jnp.asarray(self._active.copy()), self._slot_keys,
-                    jnp.asarray(ctok), jnp.asarray(ctable),
-                    jnp.asarray(cpos), jnp.asarray(fin_mask),
-                    jnp.asarray(fin_row),
-                )
+                with xprof.span(xprof.SPAN_CHUNK_PACK):
+                    (ctok, ctable, cpos, fin_mask, fin_row, packed,
+                     finishers) = self._pack_chunk()
+                with xprof.span(xprof.SPAN_TICK):
+                    caches, last_tok, pos, gen, done, n_out = (
+                        self._tick_chunk_fn(
+                            self._caches, self._last_tok, self._pos,
+                            self._gen, jnp.asarray(self._active.copy()),
+                            self._slot_keys, jnp.asarray(ctok),
+                            jnp.asarray(ctable), jnp.asarray(cpos),
+                            jnp.asarray(fin_mask), jnp.asarray(fin_row),
+                        )
+                    )
                 self.metrics.chunk_ticks.add(1)
                 self.metrics.prefill_tokens.add(packed)
                 self.metrics.chunk_utilization.set(
@@ -2423,19 +2510,21 @@ class StreamingGenerator:
                     / (self.metrics.chunk_ticks.count * self._prefill_chunk)
                 )
             else:
-                caches, last_tok, pos, gen, done, n_out = self._tick_fn(
-                    self._caches, self._last_tok, self._pos, self._gen,
-                    jnp.asarray(self._active.copy()), self._slot_keys,
-                )
+                with xprof.span(xprof.SPAN_TICK):
+                    caches, last_tok, pos, gen, done, n_out = self._tick_fn(
+                        self._caches, self._last_tok, self._pos, self._gen,
+                        jnp.asarray(self._active.copy()), self._slot_keys,
+                    )
             self._caches, self._last_tok, self._pos, self._gen = (
                 caches, last_tok, pos, gen
             )
             # ONE host sync per tick block: done/n_out/gen/pos fetched
             # together (separate np.asarray calls are separate round trips
             # on high-latency transports).
-            done_h, n_out_h, gen_h, pos_h = jax.device_get(
-                (done, n_out, gen, pos)
-            )
+            with xprof.span(xprof.SPAN_SYNC):
+                done_h, n_out_h, gen_h, pos_h = jax.device_get(
+                    (done, n_out, gen, pos)
+                )
             crash_hook("mid_tick")
             self.metrics.slot_occupancy.set(float(self._active.mean()))
             # Per-slot emitted-token mirrors: decoded-token accounting
@@ -2450,7 +2539,13 @@ class StreamingGenerator:
                     n_out_h[i] if done_h[i]
                     else pos_h[i] - self._prompt_len + 1
                 )
-                decoded += cnt - int(self._slot_emitted[i])
+                new_toks = cnt - int(self._slot_emitted[i])
+                decoded += new_toks
+                if self._tracer is not None and new_toks > 0:
+                    self._tracer.tokens(
+                        self._slot_rec[i], new_toks,
+                        replica=self._trace_replica,
+                    )
                 self._slot_emitted[i] = cnt
                 if self._journal is not None:
                     rec = self._slot_rec[i]
@@ -2640,12 +2735,16 @@ class StreamingGenerator:
         # replay (duplicates on the output topic), never lose.
         crash_hook("pre_commit")
         try:
-            self._consumer.commit(snapshot)
+            with xprof.span(xprof.SPAN_COMMIT):
+                self._consumer.commit(snapshot)
             self.metrics.commit_latency.observe(time.perf_counter() - t0)
         except CommitFailedError:
             self.metrics.commit_failures.add(1)
             _logger.exception("offset commit failed; prompts will re-deliver")
             return False
+        if self._tracer is not None:
+            # Durably committed: close every covered record's e2e span.
+            self._tracer.note_commit(snapshot)
         if self._journal is not None:
             # Journal GC at commit flush: entries below the committed
             # watermark are durable history — pruning here is what bounds

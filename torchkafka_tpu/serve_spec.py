@@ -69,6 +69,7 @@ from torchkafka_tpu.models.quant import embed_rows, load_weight
 from torchkafka_tpu.models.spec_decode import _multi_step, truncated_draft
 from torchkafka_tpu.models.transformer import _rms_norm, _rope
 from torchkafka_tpu.serve import StreamingGenerator
+from torchkafka_tpu.utils import tracing as xprof
 
 
 class SpecStreamingGenerator(StreamingGenerator):
@@ -648,9 +649,11 @@ class SpecStreamingGenerator(StreamingGenerator):
                 donate_argnums=(1, 2, 3, 4),
             )
             self._paged_prefill_jits[(s, start)] = fn
-        logits, t_k, t_v, d_k, d_v = fn(
-            (self._params, self._draft_params), *caches[:4], table_row, toks
-        )
+        with xprof.span(xprof.SPAN_ADMIT):
+            logits, t_k, t_v, d_k, d_v = fn(
+                (self._params, self._draft_params), *caches[:4], table_row,
+                toks,
+            )
         return logits, (t_k, t_v, d_k, d_v) + caches[4:]
 
     def spec_stats(self) -> dict:

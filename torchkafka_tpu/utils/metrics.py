@@ -58,6 +58,13 @@ class LatencyHistogram:
             self._samples.append(seconds)
             self._total += 1
 
+    def observe_many(self, seconds: float, n: int) -> None:
+        """``n`` identical samples under one lock acquisition (the SLO
+        inter-token path observes per token at host-sync granularity)."""
+        with self._lock:
+            self._samples.extend([seconds] * n)
+            self._total += n
+
     def percentile(self, q: float) -> float:
         with self._lock:
             if not self._samples:
@@ -123,19 +130,51 @@ class Gauge:
             return self._value
 
 
+def escape_label_value(value) -> str:
+    """Prometheus exposition label-value escaping: backslash, double
+    quote, and newline must be escaped or the sample line is unparsable.
+    Label VALUES may otherwise be any UTF-8 — tenant names come straight
+    from record keys, so this is load-bearing, not cosmetic."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def format_labels(**labels) -> str:
+    """Render a label set for ``render_exposition`` entries with values
+    escaped: ``format_labels(tenant='a"b', percentile="p50")`` →
+    ``tenant="a\\"b",percentile="p50"``. Insertion-ordered (callers pick
+    the display order); None values are skipped."""
+    return ",".join(
+        f'{k}="{escape_label_value(v)}"'
+        for k, v in labels.items() if v is not None
+    )
+
+
 def render_exposition(prefix: str, series: list[tuple]) -> str:
     """Prometheus text exposition shared by every metrics set. ``series``:
-    (name, type, value) — value a number, or a list of (labels, number)
-    where labels is e.g. 'percentile="p50"'. Counters follow the _total
-    convention at the call site; gauges format with :.6g."""
+    (name, type, value) or (name, type, value, help) — value a number, or
+    a list of (labels, number) where labels is a pre-rendered label body
+    (build dynamic ones with ``format_labels`` so values are escaped).
+    Every metric gets a ``# HELP`` and ``# TYPE`` line (help defaults to
+    the name with underscores spaced — enough for the conformance
+    contract; pass real help text where it adds signal). Counters follow
+    the _total convention at the call site; gauges format with :.6g."""
     lines = []
-    for name, mtype, value in series:
-        lines.append(f"# TYPE {prefix}_{name} {mtype}")
+    for entry in series:
+        name, mtype, value = entry[:3]
+        help_text = entry[3] if len(entry) > 3 else name.replace("_", " ")
+        full = f"{prefix}_{name}"
+        lines.append(f"# HELP {full} {help_text}")
+        lines.append(f"# TYPE {full} {mtype}")
         entries = value if isinstance(value, list) else [("", value)]
         for labels, v in entries:
             label_part = f"{{{labels}}}" if labels else ""
             v_part = f"{v:.6g}" if mtype == "gauge" else f"{v}"
-            lines.append(f"{prefix}_{name}{label_part} {v_part}")
+            lines.append(f"{full}{label_part} {v_part}")
     return "\n".join(lines) + "\n"
 
 

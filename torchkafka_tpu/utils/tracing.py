@@ -1,9 +1,10 @@
-"""Profiler hooks: XLA traces and named spans around the ingest loop.
+"""Profiler hooks: XLA traces and named spans for ingest AND serving.
 
 The reference has no instrumentation at all (SURVEY.md §5 tracing row). On
 TPU the tool that matters is the XLA profiler — these helpers wire the
-ingest loop into it so a trace shows host poll/decode time, transfer, the
-step, and the commit barrier as separate named spans on the timeline.
+host loops into it so a trace shows the named host stages on the timeline.
+
+Training ingest:
 
     with tracing.trace_session("/tmp/trace"):
         for i, (batch, token) in enumerate(stream):
@@ -11,14 +12,36 @@ step, and the commit barrier as separate named spans on the timeline.
                 loss = train_step(batch.data)
                 token.commit(wait_for=loss)
     # then: xprof / tensorboard --logdir /tmp/trace
+
+Serving: ``serve.py`` threads ``span``s through its own hot path — wrap
+the run in ``trace_session`` and the timeline shows the serving stages as
+named host regions around the device programs:
+
+    tk_serve:admit        prefill-admission dispatch (dense / legacy paged)
+    tk_serve:chunk_pack   host packing of the fused tick's prefill chunk
+    tk_serve:tick         the decode (or fused chunk) tick dispatch
+    tk_serve:sync         the once-per-tick-block host sync (device_get)
+    tk_serve:commit       output flush + durability waits + offset commit
+
+Record-level lifecycle tracing (who waited where, per record) is the
+separate ``torchkafka_tpu.obs`` subsystem; these annotations are the
+profiler-timeline complement.
 """
 
 from __future__ import annotations
 
 import contextlib
-from typing import Iterator
+import time
+from typing import Callable, Iterator
 
 import jax
+
+# Serving span names (one place, so the README recipe and serve.py agree).
+SPAN_ADMIT = "tk_serve:admit"
+SPAN_CHUNK_PACK = "tk_serve:chunk_pack"
+SPAN_TICK = "tk_serve:tick"
+SPAN_SYNC = "tk_serve:sync"
+SPAN_COMMIT = "tk_serve:commit"
 
 
 @contextlib.contextmanager
@@ -41,11 +64,19 @@ def span(name: str):
     return jax.profiler.TraceAnnotation(name)
 
 
-def ingest_lag_ms(record_timestamp_ms: int, now_ms: float | None = None) -> float:
+def ingest_lag_ms(
+    record_timestamp_ms: int,
+    now_ms: float | None = None,
+    clock: Callable[[], float] | None = None,
+) -> float:
     """End-to-end lag: record append time -> now. The streaming SLO metric
-    (how far behind the head of the topic the consumer is running)."""
-    import time
+    (how far behind the head of the topic the consumer is running).
 
+    ``clock`` returns SECONDS on the same timeline record timestamps are
+    stamped from (epoch seconds for real brokers) — inject a
+    ``resilience.ManualClock.now`` and lag becomes exactly testable
+    instead of wall-clock-dependent; ``now_ms`` overrides both (legacy
+    spelling, kept for callers that already hold a reading)."""
     if now_ms is None:
-        now_ms = time.time() * 1e3
+        now_ms = (clock() if clock is not None else time.time()) * 1e3
     return max(0.0, now_ms - record_timestamp_ms) if record_timestamp_ms else 0.0
