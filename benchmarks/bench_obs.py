@@ -37,10 +37,14 @@ _BATCH = 16  # records per poll/commit quantum in the modeled hot path
 def _disabled_loop(tracer, iters: int) -> float:
     """The disabled path's guard pattern at the server's ACTUAL call-site
     granularity (serve.py with defaults, max_new=8, ticks_per_sync=4):
-    per record — one QoS-select guard, two token-sync guards, one
-    retire guard; per 16-record batch — the hoisted note_fetched guard,
-    the post-dispatch slot_active guard, and the commit-cadence guard.
-    With ``tracer=None`` every guard is one ``is not None`` check."""
+    per record — one QoS-select guard, the overload-hook guard next to
+    it (fleet/qos.py ``overload is not None``), two token-sync guards,
+    two output-budget guards (serve.py ``max_new_of is not None`` at the
+    same syncs), one retire guard; per 16-record batch — the hoisted
+    note_fetched guard, the post-dispatch slot_active guard, the
+    commit-cadence guard, and the fleet round's burn-monitor guard
+    (fleet.py ``monitor is not None``). With ``tracer=None`` every
+    guard is one ``is not None`` check."""
     t0 = time.perf_counter()
     done = 0
     while done < iters:
@@ -49,15 +53,23 @@ def _disabled_loop(tracer, iters: int) -> float:
         for _ in range(_BATCH):
             if tracer is not None:  # AdmissionQueue.select, per record
                 pass
+            if tracer is not None:  # overload hook, same select sweep
+                pass
             if tracer is not None:  # step token sync 1 (K of max_new)
                 pass
+            if tracer is not None:  # output budget check, sync 1
+                pass
             if tracer is not None:  # step token sync 2
+                pass
+            if tracer is not None:  # output budget check, sync 2
                 pass
             if tracer is not None:  # _retire_completion
                 pass
         if tracer is not None:  # admit dispatch slot_active block
             pass
         if tracer is not None:  # _commit note_commit (cadence)
+            pass
+        if tracer is not None:  # fleet round burn-monitor evaluate
             pass
         done += _BATCH
     return time.perf_counter() - t0

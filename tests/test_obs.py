@@ -34,18 +34,23 @@ from torchkafka_tpu.fleet import ReplicaChaos, ServingFleet
 from torchkafka_tpu.fleet.metrics import FleetMetrics
 from torchkafka_tpu.models.transformer import TransformerConfig, init_params
 from torchkafka_tpu.obs import (
+    BurnRateMonitor,
     MetricsExporter,
     ObsConfig,
     RecordTracer,
+    SLOHistograms,
+    SLOTarget,
     pooled_slo_summary,
 )
+from torchkafka_tpu.obs.burn import BURNING, OK, SHEDDING, WARNING
 from torchkafka_tpu.obs.trace import (
-    COMMITTED, FINISHED, POLLED, QOS_ADMITTED, SLOT_ACTIVE,
+    BURN_STATE, COMMITTED, FINISHED, POLLED, QOS_ADMITTED, SLOT_ACTIVE,
 )
 from torchkafka_tpu.resilience import ManualClock
 from torchkafka_tpu.serve import ServeMetrics, StreamingGenerator
 from torchkafka_tpu.source.records import Record
 from torchkafka_tpu.utils.metrics import (
+    LatencyHistogram,
     ResilienceMetrics,
     StreamMetrics,
     escape_label_value,
@@ -212,8 +217,197 @@ class TestTracerDerivations:
     def test_config_validation(self):
         with pytest.raises(ValueError, match="capacity"):
             ObsConfig(capacity=0)
+        with pytest.raises(ValueError, match="window_s"):
+            ObsConfig(window_s=0)
         with pytest.raises(TypeError):
             MetricsExporter([object()])
+
+
+# --------------------------------------------------------------------------
+# 1b. Sliding-window SLO views — exact under a manual clock
+# --------------------------------------------------------------------------
+
+
+class TestWindowedHistograms:
+    def test_windowed_percentiles_exact(self):
+        """Samples land in clock-indexed buckets; a horizon covers the
+        current partial bucket plus the completed ones intersecting it —
+        exact arithmetic under a ManualClock."""
+        mc = ManualClock()
+        h = LatencyHistogram(window_s=1.0, n_windows=4, clock=mc.now)
+        h.observe(0.010)           # bucket 0
+        mc.advance(1.0)
+        h.observe(0.020)           # bucket 1
+        h.observe_many(0.030, 2)   # bucket 1
+        mc.advance(1.0)            # now t=2.0, bucket 2 current (empty)
+        # Horizon 1s: bucket 2 (empty) + bucket 1.
+        w = h.windowed_summary(1.0)
+        assert w["count"] == 3
+        assert w["p50_ms"] == pytest.approx(30.0)
+        # Horizon 2s reaches bucket 0 as well.
+        assert h.windowed_summary(2.0)["count"] == 4
+        # Cumulative view is untouched.
+        assert h.count == 4
+
+    def test_window_roll_evicts_old_buckets(self):
+        mc = ManualClock()
+        h = LatencyHistogram(window_s=1.0, n_windows=2, clock=mc.now)
+        for i in range(5):
+            h.observe(0.001 * (i + 1))
+            mc.advance(1.0)
+        # Ring bound 2: only the last two buckets survive, regardless of
+        # the horizon asked for.
+        assert len(h.windowed_snapshot(100.0)) == 2
+        assert h.count == 5  # cumulative never forgets
+
+    def test_requires_windowing(self):
+        h = LatencyHistogram()
+        with pytest.raises(ValueError, match="window_s"):
+            h.windowed_snapshot()
+        with pytest.raises(ValueError, match="window_s"):
+            LatencyHistogram(window_s=0.0)
+        with pytest.raises(ValueError, match="expose_windows"):
+            SLOHistograms(expose_windows=(1.0,))
+
+    def test_slo_windowed_summary_per_label(self):
+        mc = ManualClock()
+        slo = SLOHistograms(window_s=1.0, clock=mc.now)
+        slo.observe("ttft", 0.010, tenant="a", lane="interactive")
+        mc.advance(3.0)
+        slo.observe("ttft", 0.050, tenant="a", lane="interactive")
+        w = slo.windowed_summary(1.0)
+        assert w["ttft"]["all"]["count"] == 1
+        assert w["ttft"]["by_tenant"]["a"]["p50_ms"] == pytest.approx(50.0)
+        cum = slo.summary()
+        assert cum["ttft"]["all"]["count"] == 2
+
+    def test_tracer_windowed_view_from_config(self):
+        mc = ManualClock()
+        tr = RecordTracer(ObsConfig(clock=mc.now, window_s=2.0))
+        r = _rec()
+        tr.polled(r)
+        mc.advance(0.040)
+        tr.slot_active(r)
+        assert tr.slo.windowed
+        assert tr.slo.hist("ttft").windowed_summary(2.0)["count"] == 1
+        mc.advance(50.0)
+        assert tr.slo.hist("ttft").windowed_summary(2.0)["count"] == 0
+        # The exposition grew the *_window_ms families.
+        text = tr.render_prometheus()
+        assert "torchkafka_slo_ttft_window_ms{" in text
+
+
+# --------------------------------------------------------------------------
+# 1c. Burn-rate monitor: ladder, transitions, goodput
+# --------------------------------------------------------------------------
+
+
+def _burn_fixture(objective=0.9, **kw):
+    mc = ManualClock()
+    tr = RecordTracer(ObsConfig(clock=mc.now, window_s=0.5))
+    target = SLOTarget(
+        metric="ttft", threshold_s=0.010, objective=objective,
+        fast_window_s=1.0, slow_window_s=4.0, min_samples=2, **kw,
+    )
+    mon = BurnRateMonitor(tr.slo, [target], tracer=tr)
+    tr.attach_monitor(mon)
+    return mc, tr, mon
+
+
+def _observe_ttft(tr, mc, n, seconds, lane="batch", tenant="t"):
+    for _ in range(n):
+        r = Record("t", 0, _observe_ttft.seq, b"x", key=tenant.encode(),
+                   headers=(("lane", lane.encode()),))
+        _observe_ttft.seq += 1
+        tr.polled(r)
+        mc.advance(seconds)
+        tr.slot_active(r)
+
+
+_observe_ttft.seq = 0
+
+
+class TestBurnRateMonitor:
+    def test_state_ladder_and_typed_transitions(self):
+        mc, tr, mon = _burn_fixture(objective=0.75)  # budget 0.25
+        # All samples violating → fast burn 4.0, slow burn 4.0 → shedding.
+        _observe_ttft(tr, mc, 6, 0.050)
+        states = mon.evaluate()
+        assert states[("ttft", "", "")] == SHEDDING
+        assert mon.transitions >= 1
+        burn_events = [e for e in tr.events if e.stage == BURN_STATE]
+        assert burn_events
+        attrs = dict(burn_events[0].attrs)
+        assert attrs["from"] == OK and attrs["to"] == SHEDDING
+        assert burn_events[0].topic == "slo"
+        # Re-evaluating without new samples adds no transitions.
+        before = mon.transitions
+        mon.evaluate()
+        assert mon.transitions == before
+        # Fast window drains first: advance past fast, not slow.
+        mc.advance(2.0)
+        assert mon.evaluate()[("ttft", "", "")] == OK
+
+    def test_warning_needs_only_fast_burn(self):
+        mc, tr, mon = _burn_fixture(objective=0.5)  # budget 0.5
+        # Half the samples violate → burn 1.0 → warning, not burning.
+        _observe_ttft(tr, mc, 3, 0.002)
+        _observe_ttft(tr, mc, 3, 0.050)
+        assert mon.evaluate()[("ttft", "", "")] == WARNING
+
+    def test_min_samples_guard(self):
+        mc, tr, mon = _burn_fixture()
+        _observe_ttft(tr, mc, 1, 0.050)  # below min_samples=2
+        assert mon.evaluate()[("ttft", "", "")] == OK
+
+    def test_lane_scoped_target(self):
+        mc, tr, mon = _burn_fixture(objective=0.75, lane="batch")
+        _observe_ttft(tr, mc, 6, 0.050, lane="interactive")
+        # The violating lane is interactive; a batch-scoped target must
+        # not fire (and only monitors its own scope).
+        states = mon.evaluate()
+        assert list(states) == [("ttft", "lane", "batch")]
+        assert states[("ttft", "lane", "batch")] == OK
+
+    def test_goodput_classification(self):
+        mc, tr, mon = _burn_fixture()
+        # One within (2ms <= 10ms), one violating (50ms), one warm
+        # resume (no TTFT → vacuously within).
+        start = _observe_ttft.seq
+        _observe_ttft(tr, mc, 1, 0.002, tenant="a")
+        _observe_ttft(tr, mc, 1, 0.050, tenant="a")
+        warm = Record("t", 0, 10**6, b"x", key=b"a")
+        tr.polled(warm)
+        tr.slot_active(warm, warm=True)
+        for off in range(start, _observe_ttft.seq):
+            r = Record("t", 0, off, b"x", key=b"a")
+            tr.finished(r, 2)
+        tr.finished(warm, 2)
+        tr.note_commit({("t", 0): 10**6 + 1})
+        g = mon.goodput_summary()
+        assert g["tenants"]["a"]["completed"] == 3
+        assert g["tenants"]["a"]["within_slo"] == 2
+        mon.note_deferred("a", 5)
+        mon.note_quarantined("a")
+        g = mon.goodput_summary()
+        assert g["tenants"]["a"]["deferred"] == 5
+        assert g["tenants"]["a"]["quarantined"] == 1
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="objective"):
+            SLOTarget(objective=1.0)
+        with pytest.raises(ValueError, match="metric"):
+            SLOTarget(metric="nope")
+        with pytest.raises(ValueError, match="fast_window_s"):
+            SLOTarget(fast_window_s=10.0, slow_window_s=5.0)
+        with pytest.raises(ValueError, match="warn_burn"):
+            SLOTarget(warn_burn=3.0, burning_burn=2.0)
+        slo = SLOHistograms()  # not windowed
+        with pytest.raises(ValueError, match="window_s"):
+            BurnRateMonitor(slo, [SLOTarget()])
+        with pytest.raises(ValueError, match="SLOTarget"):
+            BurnRateMonitor(SLOHistograms(window_s=1.0), [])
+        assert BURNING in ("burning",)  # ladder constant exported
 
 
 # --------------------------------------------------------------------------
@@ -406,6 +600,13 @@ def _serve_metrics():
     m.commit_latency.observe(0.002)
     m.slot_occupancy.set(0.5)
     m.prefix_hits.add(2)
+    # PR-8 families: per-tick step time / tokens-per-tick, output caps,
+    # per-tenant cache locality (hostile tenant key included).
+    m.tick_time.observe(0.004)
+    m.tokens_per_tick.set(3.0)
+    m.output_capped.add(1)
+    m.tenant_prefix_hits(EVIL_TENANT).add(2)
+    m.tenant_prefix_misses(EVIL_TENANT).add(1)
     return m.render_prometheus()
 
 
@@ -414,11 +615,67 @@ def _fleet_metrics():
     m.completions.add(5)
     m.tenant_admitted(EVIL_TENANT).add(2)
     m.tenant_throttled(EVIL_TENANT).add(1)
+    m.tenant_deferred(EVIL_TENANT).add(1)
     m.tenant_queue_depth(EVIL_TENANT).set(3)
     m.lane_wait("interactive").observe(0.004)
     m.replica_occupancy(0).set(0.75)
     m.replica_completions(0).add(5)
     return m.render_prometheus(replicas=None)
+
+
+def _burn_monitor():
+    mc, tr, mon = _burn_fixture(objective=0.75)
+    start = _observe_ttft.seq
+    _observe_ttft(tr, mc, 6, 0.050, tenant=EVIL_TENANT)
+    mon.evaluate()
+    for off in range(start, _observe_ttft.seq):
+        r = Record("t", 0, off, b"x", key=EVIL_TENANT.encode())
+        tr.finished(r, 2)
+    tr.note_commit({("t", 0): 10**6})
+    mon.note_deferred(EVIL_TENANT, 2)
+    mon.note_quarantined(EVIL_TENANT)
+    return mon.render_prometheus()
+
+
+def _windowed_slo_tracer():
+    """A windowed tracer: the *_window_ms families must render on the
+    same grammar as everything else."""
+    mc = ManualClock()
+    tr = RecordTracer(ObsConfig(clock=mc.now, window_s=1.0,
+                                expose_windows=(1.0, 4.0)))
+    r = Record("t", 0, 0, b"x", key=EVIL_TENANT.encode(),
+               headers=(("lane", b"interactive"),))
+    tr.polled(r, replica=0)
+    mc.advance(0.02)
+    tr.qos_admitted(r, "interactive", 0.02, replica=0)
+    tr.slot_active(r, replica=0)
+    mc.advance(0.001)
+    tr.tokens(r, 2, replica=0)
+    tr.finished(r, 3, replica=0)
+    tr.note_commit({("t", 0): 1})
+    return tr.render_prometheus(prefix="torchkafka_wslo")
+
+
+def _traced_fleet_metrics():
+    """FleetMetrics with the full PR-8 attachment set — windowed SLO +
+    burn monitor + goodput + step-time aggregation — on ONE exposition,
+    rendered under a distinct prefix so the combined scrape stays
+    duplicate-free."""
+    mc, tr, mon = _burn_fixture(objective=0.75)
+    start = _observe_ttft.seq
+    _observe_ttft(tr, mc, 4, 0.050, tenant=EVIL_TENANT)
+    mon.evaluate()
+    for off in range(start, _observe_ttft.seq):
+        tr.finished(Record("t", 0, off, b"x",
+                           key=EVIL_TENANT.encode()), 2)
+    tr.note_commit({("t", 0): 10**6})
+    m = FleetMetrics()
+    m.attach_slo(tr.slo)
+    m.attach_burn(mon)
+    m.completions.add(4)
+    m.tenant_admitted(EVIL_TENANT).add(4)
+    m.tenant_deferred(EVIL_TENANT).add(2)
+    return m.render_prometheus(prefix="torchkafka_tfleet", replicas=None)
 
 
 def _resilience_metrics():
@@ -447,8 +704,9 @@ def _slo_tracer():
 
 @pytest.mark.parametrize("render", [
     _stream_metrics, _serve_metrics, _fleet_metrics, _resilience_metrics,
-    _slo_tracer,
-], ids=["stream", "serve", "fleet", "resilience", "slo"])
+    _slo_tracer, _burn_monitor, _windowed_slo_tracer, _traced_fleet_metrics,
+], ids=["stream", "serve", "fleet", "resilience", "slo", "burn",
+        "windowed-slo", "traced-fleet"])
 def test_exposition_conformance(render):
     """The one grammar every exposition must satisfy — so the shared
     endpoint can't drift per class, and hostile tenant keys (quotes,
@@ -476,7 +734,8 @@ def test_combined_exposition_has_no_duplicate_metric_families():
     the families disjoint."""
     text = "".join((
         _stream_metrics(), _serve_metrics(), _fleet_metrics(),
-        _resilience_metrics(), _slo_tracer(),
+        _resilience_metrics(), _slo_tracer(), _burn_monitor(),
+        _windowed_slo_tracer(), _traced_fleet_metrics(),
     ))
     names = re.findall(r"^# TYPE (\S+)", text, re.M)
     assert len(names) == len(set(names))

@@ -1,0 +1,406 @@
+"""The traffic observatory's workload half (torchkafka_tpu/workload).
+
+Pins the generator's contracts:
+
+1. SCHEDULE DETERMINISM — the arrival schedule is a pure function of the
+   seed (byte-identical digests), draw streams are independent (scaling
+   the offered load never reshuffles tenants/lanes/lengths), and every
+   draw honors its bounds and distributions.
+2. FULL-STACK REPLAY — same seed + ManualClock through the FULL stack
+   (fleet + QoS + paged chunked KV + resilience outage + journal kill +
+   tracer): byte-identical arrival schedule, identical completion order
+   (duplicates included), byte-identical tracer event stream INCLUDING
+   timestamps, identical commit ledger — with the chaos schedule firing.
+3. OUTPUT BUDGETS — ``max_new_of`` (the ``max_new`` header) bounds each
+   record's generation exactly, dense and paged.
+4. OVERLOAD — an aggressive SLO target under a storm drives the burn
+   monitor into shedding; batch admission defers (never drops) while
+   interactive keeps flowing, and everything still completes.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import torchkafka_tpu as tk
+from torchkafka_tpu.fleet import QoSConfig, ServingFleet
+from torchkafka_tpu.models.transformer import TransformerConfig, init_params
+from torchkafka_tpu.obs import ObsConfig, RecordTracer, SLOTarget
+from torchkafka_tpu.obs.burn import BurnRateMonitor, SHEDDING
+from torchkafka_tpu.resilience import ManualClock
+from torchkafka_tpu.serve import StreamingGenerator
+from torchkafka_tpu.source.records import Record, TopicPartition
+from torchkafka_tpu.workload import (
+    ChaosSchedule,
+    WorkloadConfig,
+    WorkloadGenerator,
+    header_max_new,
+    zipf_weights,
+)
+
+P, MAX_NEW, VOCAB = 16, 8, 64
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = TransformerConfig(
+        vocab_size=VOCAB, d_model=32, n_layers=2, n_heads=2, n_kv_heads=1,
+        d_ff=64, max_seq_len=P + MAX_NEW, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+def _gen(**kw):
+    base = dict(tenants=4, total_records=48, arrival_rate=300.0, seed=11)
+    base.update(kw)
+    return WorkloadGenerator(
+        WorkloadConfig(**base), prompt_len=P, max_new=MAX_NEW,
+        vocab_size=VOCAB,
+    )
+
+
+# --------------------------------------------------------------------------
+# 1. Schedule determinism + distribution contracts
+# --------------------------------------------------------------------------
+
+
+class TestSchedule:
+    def test_same_seed_byte_identical(self):
+        assert _gen().schedule_digest() == _gen().schedule_digest()
+
+    def test_different_seed_differs(self):
+        assert _gen(seed=1).schedule_digest() != _gen(seed=2).schedule_digest()
+
+    def test_offered_load_scaling_keeps_other_streams(self):
+        """1x vs 4x arrival rate: the SAME tenants, lanes, lengths, and
+        prompt payloads per sequence number — only arrival instants
+        change. This is the property that makes the overload sweep's
+        slices comparable (SeedSequence-spawned stream independence)."""
+        a = _gen(arrival_rate=100.0).schedule()
+        b = _gen(arrival_rate=400.0).schedule()
+        assert len(a) == len(b)
+        for ea, eb in zip(a, b):
+            assert (ea.tenant, ea.lane, ea.suffix_len, ea.out_len) == (
+                eb.tenant, eb.lane, eb.suffix_len, eb.out_len
+            ), ea.seq
+            np.testing.assert_array_equal(ea.prompt, eb.prompt)
+        # 4x the rate compresses the timeline ~4x.
+        assert b[-1].t_s < a[-1].t_s
+
+    def test_zipf_skew_and_weights(self):
+        w = zipf_weights(8, 1.2)
+        assert w.sum() == pytest.approx(1.0)
+        assert all(w[i] > w[i + 1] for i in range(7))
+        counts = _gen(total_records=256, zipf_s=1.5).tenant_counts()
+        assert counts["tenant-00"] > counts["tenant-03"]
+
+    @pytest.mark.parametrize("dist", ["lognormal", "pareto"])
+    def test_bounds_and_shapes(self, dist):
+        sched = _gen(length_dist=dist, total_records=128).schedule()
+        assert len(sched) == 128
+        assert all(
+            sched[i].t_s <= sched[i + 1].t_s for i in range(len(sched) - 1)
+        )
+        for ev in sched:
+            assert 1 <= ev.suffix_len <= P - 1
+            assert 1 <= ev.out_len <= MAX_NEW
+            assert ev.prompt.shape == (P,) and ev.prompt.dtype == np.int32
+            assert ev.lane in ("interactive", "batch")
+        # Heavy tails really produce a spread, not a constant.
+        assert len({ev.out_len for ev in sched}) > 2
+        assert len({ev.suffix_len for ev in sched}) > 2
+
+    def test_tenant_prefix_reuse(self):
+        """Two records of one tenant share the context stream up to the
+        shorter record's cached depth — the radix-locality contract."""
+        sched = _gen(total_records=96).schedule()
+        by_tenant: dict = {}
+        for ev in sched:
+            by_tenant.setdefault(ev.tenant, []).append(ev)
+        pairs = 0
+        for evs in by_tenant.values():
+            for a, b in zip(evs, evs[1:]):
+                depth = P - max(a.suffix_len, b.suffix_len)
+                np.testing.assert_array_equal(
+                    a.prompt[:depth], b.prompt[:depth]
+                )
+                pairs += 1
+        assert pairs > 0
+
+    def test_keyed_partition_pinning(self):
+        gen = _gen()
+        broker = tk.InMemoryBroker()
+        broker.create_topic("w", partitions=4)
+        cursor = gen.produce_due(broker, "w", float("inf"), 0)
+        assert cursor == len(gen.schedule())
+        seen: dict = {}
+        for p in range(4):
+            for rec in broker.fetch(TopicPartition("w", p), 0, 10_000):
+                tenant = rec.key.decode()
+                assert seen.setdefault(tenant, p) == p  # one partition each
+                assert header_max_new(rec) is not None
+
+    def test_header_max_new(self):
+        assert header_max_new(
+            Record("t", 0, 0, b"", headers=(("max_new", b"5"),))
+        ) == 5
+        assert header_max_new(Record("t", 0, 0, b"")) is None
+        assert header_max_new(
+            Record("t", 0, 0, b"", headers=(("max_new", b"junk"),))
+        ) is None
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="arrival_rate"):
+            WorkloadConfig(arrival_rate=0)
+        with pytest.raises(ValueError, match="length_dist"):
+            WorkloadConfig(length_dist="uniform")
+        with pytest.raises(ValueError, match="pareto_alpha"):
+            WorkloadConfig(length_dist="pareto", pareto_alpha=1.0)
+        with pytest.raises(ValueError, match="replica_kills"):
+            ChaosSchedule(replica_kills=((-1.0, 0),))
+        with pytest.raises(ValueError, match="broker_outages"):
+            ChaosSchedule(broker_outages=((0, 0),))
+
+
+# --------------------------------------------------------------------------
+# 2. Full-stack same-seed replay, chaos included
+# --------------------------------------------------------------------------
+
+
+def _full_stack_run(cfg, params):
+    wcfg = WorkloadConfig(
+        tenants=3, total_records=20, arrival_rate=400.0, seed=7,
+        chaos=ChaosSchedule(
+            replica_kills=((0.03, 0),), broker_outages=((12, 4),),
+        ),
+    )
+    gen = WorkloadGenerator(
+        wcfg, prompt_len=P, max_new=MAX_NEW, vocab_size=VOCAB
+    )
+    mc = ManualClock()
+    broker = tk.InMemoryBroker()
+    broker.create_topic("w", partitions=4)
+    pages = {
+        "block_size": 4,
+        "num_blocks": 4 * -(-(P + MAX_NEW) // 4) + 16,
+    }
+    fleet = ServingFleet(
+        gen.consumer_factory(broker, "w", "gw", clock=mc), params, cfg,
+        replicas=2, prompt_len=P, max_new=MAX_NEW, slots=4,
+        commit_every=4, clock=mc.now, qos=QoSConfig(),
+        gen_kwargs={"kv_pages": pages, "max_new_of": header_max_new},
+        obs=True,
+        slo_targets=[SLOTarget(
+            metric="ttft", threshold_s=0.05, objective=0.9,
+            fast_window_s=0.2, slow_window_s=0.8, min_samples=4,
+        )],
+    )
+    fleet.warmup()
+    report = gen.drive(fleet, broker, "w", clock=mc)
+    order = [
+        (rid, rec.partition, rec.offset, tuple(np.asarray(t).tolist()))
+        for rid, rec, t in report["completions"]
+    ]
+    committed = {
+        p: broker.committed("gw", tk.TopicPartition("w", p))
+        for p in range(4)
+    }
+    produced = {
+        (p, o) for p in range(4)
+        for o in range(broker.end_offset(TopicPartition("w", p)))
+    }
+    events = list(fleet.tracer.events)
+    fleet.close()
+    return {
+        "digest": gen.schedule_digest(),
+        "order": order,
+        "committed": committed,
+        "produced": produced,
+        "events": events,
+        "report": report,
+    }
+
+
+class TestFullStackReplay:
+    def test_same_seed_byte_identical_with_chaos(self, model):
+        cfg, params = model
+        a = _full_stack_run(cfg, params)
+        b = _full_stack_run(cfg, params)
+        # The chaos really fired on both runs, identically.
+        assert a["report"]["kills_fired"] == b["report"]["kills_fired"]
+        assert len(a["report"]["kills_fired"]) == 1
+        # Byte-identical arrival schedule, completion order (duplicates
+        # included), tracer stream INCLUDING timestamps, commit ledger.
+        assert a["digest"] == b["digest"]
+        assert a["order"] == b["order"]
+        assert a["events"] == b["events"]
+        assert a["committed"] == b["committed"]
+        # Zero lost records despite kill + outage: every produced record
+        # served at least once and durably committed.
+        served = {(p, o) for _rid, p, o, _t in a["order"]}
+        assert served == a["produced"]
+        assert a["report"]["all_arrived"] is True
+        for p, committed in a["committed"].items():
+            end = len([k for k in a["produced"] if k[0] == p])
+            assert (committed or 0) == end, p
+
+
+# --------------------------------------------------------------------------
+# 3. Per-record output budgets through the serving path
+# --------------------------------------------------------------------------
+
+
+class TestOutputBudget:
+    @pytest.mark.parametrize("paged", [False, True], ids=["dense", "paged"])
+    def test_max_new_of_bounds_each_record(self, model, paged):
+        cfg, params = model
+        broker = tk.InMemoryBroker()
+        broker.create_topic("b", partitions=2)
+        rng = np.random.default_rng(3)
+        budgets = {}
+        for i in range(10):
+            budget = int(rng.integers(1, MAX_NEW + 1))
+            rec = broker.produce(
+                "b", rng.integers(0, VOCAB, P, dtype=np.int32).tobytes(),
+                partition=i % 2,
+                headers=(("max_new", str(budget).encode()),),
+            )
+            budgets[(rec.partition, rec.offset)] = budget
+        consumer = tk.MemoryConsumer(broker, "b", group_id="g")
+        kw = {}
+        if paged:
+            kw["kv_pages"] = {
+                "block_size": 4,
+                "num_blocks": 4 * -(-(P + MAX_NEW) // 4) + 16,
+            }
+        server = StreamingGenerator(
+            consumer, params, cfg, slots=4, prompt_len=P, max_new=MAX_NEW,
+            commit_every=4, max_new_of=header_max_new, **kw,
+        )
+        out = {}
+        for rec, toks in server.run(max_records=10):
+            out[(rec.partition, rec.offset)] = np.asarray(toks)
+        consumer.close()
+        assert set(out) == set(budgets)
+        for key, toks in out.items():
+            assert len(toks) <= budgets[key], key
+        # Budgets below max_new really truncated (EOS could end a few
+        # early, but not every record at exactly its budget by chance).
+        assert any(
+            len(out[k]) == b for k, b in budgets.items() if b < MAX_NEW
+        )
+        assert server.metrics.output_capped.count > 0
+
+    def test_budget_equals_plain_prefix(self, model):
+        """A budgeted record's tokens are the PREFIX of its unbudgeted
+        generation — the budget truncates, never changes, decode."""
+        cfg, params = model
+
+        def serve(max_new_of):
+            broker = tk.InMemoryBroker()
+            broker.create_topic("b", partitions=1)
+            rng = np.random.default_rng(5)
+            for i in range(4):
+                broker.produce(
+                    "b",
+                    rng.integers(0, VOCAB, P, dtype=np.int32).tobytes(),
+                    partition=0, headers=(("max_new", b"3"),),
+                )
+            consumer = tk.MemoryConsumer(broker, "b", group_id="g")
+            server = StreamingGenerator(
+                consumer, params, cfg, slots=2, prompt_len=P,
+                max_new=MAX_NEW, commit_every=4, max_new_of=max_new_of,
+            )
+            out = {
+                rec.offset: np.asarray(toks)
+                for rec, toks in server.run(max_records=4)
+            }
+            consumer.close()
+            return out
+
+        plain = serve(None)
+        budgeted = serve(header_max_new)
+        for off, toks in budgeted.items():
+            assert len(toks) == min(3, len(plain[off]))
+            np.testing.assert_array_equal(toks, plain[off][: len(toks)])
+
+
+# --------------------------------------------------------------------------
+# 4. Overload: shedding defers batch, interactive flows, nothing lost
+# --------------------------------------------------------------------------
+
+
+class TestOverload:
+    def test_should_defer_semantics(self):
+        mc = ManualClock()
+        tr = RecordTracer(ObsConfig(clock=mc.now, window_s=0.5))
+        mon = BurnRateMonitor(tr.slo, [SLOTarget(
+            metric="ttft", threshold_s=0.01, objective=0.9,
+            fast_window_s=1.0, slow_window_s=2.0, min_samples=2,
+        )], tracer=tr)
+        # Feed violating TTFT samples into the batch lane + one tenant.
+        for i in range(8):
+            r = Record("t", 0, i, b"x", key=b"hog",
+                       headers=(("lane", b"batch"),))
+            tr.polled(r)
+            mc.advance(0.05)  # 50ms TTFT >> 10ms target
+            tr.slot_active(r)
+        states = mon.evaluate()
+        assert states[("ttft", "lane", "batch")] == SHEDDING
+        assert mon.should_defer("batch", "hog") is True
+        assert mon.should_defer("batch", "other") is True  # lane scope
+        assert mon.should_defer("interactive", "hog") is False  # protected
+        # Typed transitions landed in the trace stream.
+        burn = [e for e in tr.events if e.stage == "burn_state"]
+        assert burn and dict(burn[0].attrs)["to"] != "ok"
+        # Windows drain: advance past both horizons, states fall back.
+        mc.advance(5.0)
+        states = mon.evaluate()
+        assert states[("ttft", "lane", "batch")] == "ok"
+        assert mon.should_defer("batch", "hog") is False
+
+    def test_storm_defers_batch_but_completes_everything(self, model):
+        cfg, params = model
+        wcfg = WorkloadConfig(
+            tenants=3, total_records=24, arrival_rate=1500.0,
+            burst_mean=4.0, interactive_fraction=0.4,
+            mean_suffix=max(4.0, P / 3), mean_output=MAX_NEW * 0.75,
+            zipf_s=1.2, seed=16,
+        )
+        gen = WorkloadGenerator(
+            wcfg, prompt_len=P, max_new=MAX_NEW, vocab_size=VOCAB
+        )
+        mc = ManualClock()
+        broker = tk.InMemoryBroker()
+        broker.create_topic("s", partitions=4)
+        tick_dt = 0.002
+        pages = {
+            "block_size": 4,
+            "num_blocks": 2 * -(-(P + MAX_NEW) // 4) + 16,
+        }
+        fleet = ServingFleet(
+            gen.consumer_factory(broker, "s", "gs"), params, cfg,
+            replicas=2, prompt_len=P, max_new=MAX_NEW, slots=2,
+            commit_every=4, clock=mc.now, qos=QoSConfig(),
+            gen_kwargs={"kv_pages": pages, "max_new_of": header_max_new},
+            obs=True,
+            slo_targets=[SLOTarget(
+                metric="ttft", threshold_s=tick_dt * 12, objective=0.75,
+                fast_window_s=tick_dt * 32, slow_window_s=tick_dt * 128,
+                min_samples=4,
+            )],
+        )
+        fleet.warmup()
+        report = gen.drive(fleet, broker, "s", clock=mc, tick_dt=tick_dt)
+        g = fleet.monitor.goodput_summary()
+        fleet.close()
+        # The storm triggered real shedding decisions...
+        assert fleet.monitor.transitions > 0
+        assert g["deferred"] > 0
+        # ...but deferral means deferral: everything still completed.
+        assert report["all_arrived"] is True
+        assert report["unique_served"] == 24
+        assert g["completed"] == 24
+        assert 0 < g["within_slo"] <= 24

@@ -31,10 +31,12 @@ from torchkafka_tpu.errors import (
 )
 from torchkafka_tpu.journal import DecodeJournal, JournalEntry
 from torchkafka_tpu.obs import (
+    BurnRateMonitor,
     MetricsExporter,
     ObsConfig,
     RecordTrace,
     RecordTracer,
+    SLOTarget,
 )
 from torchkafka_tpu.parallel import batch_sharding, global_batch, make_mesh
 from torchkafka_tpu.pipeline import KafkaStream, stream
@@ -64,6 +66,11 @@ from torchkafka_tpu.source import (
     TopicPartition,
     partitions_for_process,
 )
+from torchkafka_tpu.workload import (
+    ChaosSchedule,
+    WorkloadConfig,
+    WorkloadGenerator,
+)
 from torchkafka_tpu.transform import (
     Batch,
     Batcher,
@@ -78,7 +85,7 @@ from torchkafka_tpu.transform import (
     raw_bytes,
 )
 
-__version__ = "0.11.0"
+__version__ = "0.12.0"
 
 __all__ = [
     "BarrierError",
@@ -112,11 +119,16 @@ __all__ = [
     "PoisonRecordError",
     "Producer",
     "ProducerClosedError",
+    "BurnRateMonitor",
+    "ChaosSchedule",
     "RecordMetadata",
     "RecordTrace",
     "RecordTracer",
     "ResilientConsumer",
     "RetryPolicy",
+    "SLOTarget",
+    "WorkloadConfig",
+    "WorkloadGenerator",
     "dead_letter_to_topic",
     "seek_to_timestamp",
     "OffsetLedger",

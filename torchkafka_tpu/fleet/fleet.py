@@ -179,6 +179,7 @@ class ServingFleet:
         journal_cadence: int = 8,
         drain_timeout_s: float | None = None,
         obs=None,
+        slo_targets=None,
     ) -> None:
         """``obs``: record-lifecycle tracing + SLO histograms for the
         whole fleet (torchkafka_tpu/obs). ``True`` builds a tracer on
@@ -188,13 +189,30 @@ class ServingFleet:
         ``obs.RecordTracer`` is shared as-is. The ONE tracer spans every
         replica — events tag the replica id, the SLO histograms label by
         lane/tenant/replica, and ``metrics.summary()`` gains an ``slo``
-        section. None (default): zero tracing, guard-only cost."""
+        section. None (default): zero tracing, guard-only cost.
+
+        ``slo_targets``: a list of ``obs.SLOTarget`` — builds a
+        ``BurnRateMonitor`` over the tracer's windowed SLO view
+        (requires ``obs``; with ``obs=True`` the window width defaults
+        to a quarter of the fastest target's fast window), evaluated
+        once per scheduling round. Its state transitions ride the trace
+        stream as typed ``burn_state`` events, its per-tenant goodput
+        ledger rides ``metrics.summary()``, and its shedding state
+        becomes the AdmissionQueue overload hook: batch-lane admission
+        DEFERS while the SLO burns, instead of the whole fleet
+        collapsing together. ``fleet.monitor`` exposes it."""
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
         self._qos = qos or QoSConfig()
         self._clock = clock
         self.metrics = FleetMetrics()
         self.tracer = None
+        self.monitor = None
+        if slo_targets and not obs:
+            raise ValueError(
+                "slo_targets need the tracer: pass obs=True (or an "
+                "ObsConfig with window_s set)"
+            )
         if obs is not None and obs is not False:
             from torchkafka_tpu.obs import ObsConfig, RecordTracer
 
@@ -203,13 +221,26 @@ class ServingFleet:
             elif isinstance(obs, ObsConfig):
                 self.tracer = RecordTracer(obs)
             elif obs is True:
-                self.tracer = RecordTracer(ObsConfig(clock=clock))
+                kw = {}
+                if slo_targets:
+                    kw["window_s"] = min(
+                        t.fast_window_s for t in slo_targets
+                    ) / 4.0
+                self.tracer = RecordTracer(ObsConfig(clock=clock, **kw))
             else:
                 raise TypeError(
                     "obs must be True, an ObsConfig, or a RecordTracer, "
                     f"got {type(obs).__name__}"
                 )
             self.metrics.attach_slo(self.tracer.slo)
+        if slo_targets:
+            from torchkafka_tpu.obs import BurnRateMonitor
+
+            self.monitor = BurnRateMonitor(
+                self.tracer.slo, slo_targets, tracer=self.tracer,
+            )
+            self.tracer.attach_monitor(self.monitor)
+            self.metrics.attach_burn(self.monitor)
         self._buckets = TenantBuckets(self._qos, clock)
         self._journal_paths: dict[int, str] = {}
         carried_hints: dict = {}
@@ -254,6 +285,14 @@ class ServingFleet:
             queue = AdmissionQueue(
                 self._qos, self._buckets, self.metrics, clock,
                 tracer=self.tracer, replica=rid,
+                overload=(
+                    self.monitor.should_defer
+                    if self.monitor is not None else None
+                ),
+                on_overload_defer=(
+                    self.monitor.note_deferred
+                    if self.monitor is not None else None
+                ),
             )
             self.replicas.append(Replica(
                 rid, gen, consumer, queue, self._qos, self.metrics,
@@ -377,6 +416,7 @@ class ServingFleet:
         idle_timeout_ms: int = 2000,
         shutdown=None,
         chaos: ReplicaChaos | None = None,
+        on_round: Callable[["ServingFleet", int], None] | None = None,
     ) -> Iterator[tuple[int, Record, np.ndarray]]:
         """Yield ``(replica_id, record, tokens)`` in fleet completion
         order until ``max_records`` completions, an idle timeout, or a
@@ -386,10 +426,16 @@ class ServingFleet:
         ``requested`` bool) — when it fires, the fleet drains gracefully
         and serve() returns after the last in-flight generation commits.
         ``chaos``: a ``ReplicaChaos`` schedule, evaluated once per
-        scheduling round."""
+        scheduling round. ``on_round(fleet, served)``: called once at
+        the top of every scheduling round — the workload driver's
+        injection point (advance a synthetic clock, produce due
+        arrivals, fire scheduled chaos) so open-loop load generation
+        stays deterministic against the cooperative scheduler."""
         served = 0
         exhausted_at: float | None = None
         while True:
+            if on_round is not None:
+                on_round(self, served)
             if (
                 shutdown is not None
                 and getattr(shutdown, "requested", False)
@@ -420,6 +466,11 @@ class ServingFleet:
                 for rec, toks in completions:
                     served += 1
                     yield rep.id, rec, toks
+            if self.monitor is not None:
+                # One burn-rate sweep per scheduling round: cheap (no
+                # new samples → no transitions), deterministic, and the
+                # NEXT round's admission sweeps see the fresh state.
+                self.monitor.evaluate()
             if chaos is not None:
                 chaos.maybe_kill(self, served)
             live = [r for r in self.replicas if r.runnable]
@@ -454,8 +505,9 @@ class ServingFleet:
     # Convenience for scripts/tests that just want everything served.
     def serve_all(
         self, max_records: int | None = None, idle_timeout_ms: int = 2000,
-        shutdown=None, chaos: ReplicaChaos | None = None,
+        shutdown=None, chaos: ReplicaChaos | None = None, on_round=None,
     ) -> list[tuple[int, Record, np.ndarray]]:
         return list(self.serve(
-            max_records, idle_timeout_ms, shutdown=shutdown, chaos=chaos
+            max_records, idle_timeout_ms, shutdown=shutdown, chaos=chaos,
+            on_round=on_round,
         ))

@@ -40,11 +40,15 @@ class FleetMetrics:
         # incarnation resumes warm)
         self._tenant_admitted: dict[str, RateMeter] = {}
         self._tenant_throttled: dict[str, RateMeter] = {}
+        self._tenant_deferred: dict[str, RateMeter] = {}  # burn-rate
+        # overload deferrals (AdmissionQueue's shedding hook) — distinct
+        # from bucket throttles: policy chose to wait, not the tenant's rate
         self._tenant_queue_depth: dict[str, Gauge] = {}
         self._lane_wait: dict[str, LatencyHistogram] = {}
         self._replica_occupancy: dict[int, Gauge] = {}
         self._replica_completions: dict[int, RateMeter] = {}
         self._slo = None  # obs.SLOHistograms, attached by a traced fleet
+        self._burn = None  # obs.BurnRateMonitor, attached alongside
 
     def attach_slo(self, slo) -> None:
         """Bind the fleet tracer's derived SLO histograms
@@ -53,6 +57,12 @@ class FleetMetrics:
         ``summary()`` and Prometheus exposition alongside the counters."""
         self._slo = slo
 
+    def attach_burn(self, monitor) -> None:
+        """Bind the fleet's ``obs.BurnRateMonitor`` so burn-rate states
+        and the per-tenant goodput ledger (completed / within-SLO /
+        deferred / quarantined) ride ``summary()`` and the exposition."""
+        self._burn = monitor
+
     # ------------------------------------------------------ lazy accessors
 
     def tenant_admitted(self, tenant: str) -> RateMeter:
@@ -60,6 +70,9 @@ class FleetMetrics:
 
     def tenant_throttled(self, tenant: str) -> RateMeter:
         return self._tenant_throttled.setdefault(tenant, RateMeter())
+
+    def tenant_deferred(self, tenant: str) -> RateMeter:
+        return self._tenant_deferred.setdefault(tenant, RateMeter())
 
     def tenant_queue_depth(self, tenant: str) -> Gauge:
         return self._tenant_queue_depth.setdefault(tenant, Gauge())
@@ -130,8 +143,32 @@ class FleetMetrics:
             "served_from_journal": sum(m.journal_served.count for m in gens),
             "resume_rejected": sum(m.resume_rejected.count for m in gens),
         }
+        # Device-side "where did the tick go": per-replica step times
+        # pooled with the same sample-window merge as the commit
+        # percentiles, tokens-per-tick averaged over replicas that ticked.
+        tpt = [
+            m.tokens_per_tick.value for m in gens if m.tick_time.count
+        ]
+        serving = {
+            "ticks": sum(m.tick_time.count for m in gens),
+            "step_time": merge_latency_summaries(
+                [m.tick_time for m in gens]
+            ),
+            "tokens_per_tick": (
+                round(sum(tpt) / len(tpt), 2) if tpt else 0.0
+            ),
+            "output_capped": sum(m.output_capped.count for m in gens),
+        }
         return {
             "slo": self._slo.summary() if self._slo is not None else None,
+            "burn": (
+                self._burn.summary() if self._burn is not None else None
+            ),
+            "goodput": (
+                self._burn.goodput_summary()
+                if self._burn is not None else None
+            ),
+            "serving": serving,
             "prefix_cache": cache,
             "chunked_prefill": chunked,
             "journal": journal,
@@ -147,10 +184,13 @@ class FleetMetrics:
                     "admitted": self.tenant_admitted(t).count,
                     "admitted_per_s": round(self.tenant_admitted(t).rate(), 2),
                     "throttled": self.tenant_throttled(t).count,
+                    "deferred": self.tenant_deferred(t).count,
                     "queue_depth": int(self.tenant_queue_depth(t).value),
                 }
                 for t in sorted(
-                    set(self._tenant_admitted) | set(self._tenant_throttled)
+                    set(self._tenant_admitted)
+                    | set(self._tenant_throttled)
+                    | set(self._tenant_deferred)
                 )
             },
             "lanes": {
@@ -174,7 +214,15 @@ class FleetMetrics:
         s = self.summary(replicas)
         pc = s["prefix_cache"]
         cp = s["chunked_prefill"]
+        sv = s["serving"]
         series = [
+            ("serve_ticks_total", "counter", sv["ticks"]),
+            ("step_time_ms", "gauge", [
+                ('percentile="p50"', sv["step_time"]["p50_ms"]),
+                ('percentile="p99"', sv["step_time"]["p99_ms"]),
+            ]),
+            ("tokens_per_tick", "gauge", sv["tokens_per_tick"]),
+            ("output_capped_total", "counter", sv["output_capped"]),
             ("chunk_ticks_total", "counter", cp["chunk_ticks"]),
             ("admission_stall_ticks_total", "counter", cp["stall_ticks"]),
             ("admission_queue_tokens", "gauge", cp["queue_tokens"]),
@@ -203,6 +251,10 @@ class FleetMetrics:
             ] or 0),
             ("tenant_throttled_total", "counter", [
                 (format_labels(tenant=t), v["throttled"])
+                for t, v in s["tenants"].items()
+            ] or 0),
+            ("tenant_deferred_total", "counter", [
+                (format_labels(tenant=t), v["deferred"])
                 for t, v in s["tenants"].items()
             ] or 0),
             ("tenant_queue_depth", "gauge", [
@@ -239,4 +291,9 @@ class FleetMetrics:
         ]
         if self._slo is not None:
             series.extend(self._slo.series())
+        if self._burn is not None:
+            series.extend(
+                (f"burn_{name}", *rest)
+                for name, *rest in self._burn.series()
+            )
         return render_exposition(prefix, series)

@@ -161,6 +161,8 @@ class AdmissionQueue:
         *,
         tracer=None,
         replica: int | None = None,
+        overload: Callable[[str, str], bool] | None = None,
+        on_overload_defer: Callable[[str, int], None] | None = None,
     ) -> None:
         self._cfg = cfg
         self._buckets = buckets
@@ -170,6 +172,15 @@ class AdmissionQueue:
         # the record's qos_admitted stage boundary; ``replica`` tags it.
         self._tracer = tracer
         self._replica = replica
+        # Burn-rate overload hook (obs.BurnRateMonitor.should_defer):
+        # ``overload(lane, tenant) -> True`` leaves that tenant's records
+        # QUEUED this sweep (deferral, never a drop — the watermark
+        # stalls below them exactly like a bucket throttle), so a
+        # shedding fleet sheds the batch lane instead of collapsing the
+        # interactive SLO with it. ``on_overload_defer(tenant, n)``
+        # reports each deferral decision for goodput accounting.
+        self._overload = overload
+        self._on_overload_defer = on_overload_defer
         # lane -> tenant -> deque[(record, enqueue_time)]
         self._q: dict[str, dict[str, deque]] = {INTERACTIVE: {}, BATCH: {}}
         self._rr: dict[str, int] = {INTERACTIVE: 0, BATCH: 0}
@@ -236,6 +247,17 @@ class AdmissionQueue:
                         break
                     q = lanes[tenant]
                     if not q:
+                        continue
+                    if self._overload is not None and self._overload(
+                        lane, tenant
+                    ):
+                        # Burn-rate shedding: defer (stay queued, one
+                        # decision counted per tenant per sweep, like
+                        # throttles) rather than admit into an already-
+                        # burning SLO or drop the record.
+                        self._metrics.tenant_deferred(tenant).add(1)
+                        if self._on_overload_defer is not None:
+                            self._on_overload_defer(tenant, 1)
                         continue
                     if not self._buckets.try_acquire(tenant):
                         # Out of tokens: the record stays queued (and the

@@ -1562,6 +1562,173 @@ def scenario_15(size: str = "tiny", replicas: int = 2) -> dict:
     }
 
 
+def _merge_tenant_cache(metrics_list) -> dict:
+    """Per-tenant prefix-cache hit rates merged across replicas
+    (count-weighted, like the fleet's global cache view)."""
+    merged: dict[str, dict] = {}
+    for m in metrics_list:
+        for t, v in m.tenant_cache_summary().items():
+            agg = merged.setdefault(t, {"hits": 0, "misses": 0})
+            agg["hits"] += v["hits"]
+            agg["misses"] += v["misses"]
+    for agg in merged.values():
+        total = agg["hits"] + agg["misses"]
+        agg["hit_rate"] = round(agg["hits"] / total, 4) if total else None
+    return merged
+
+
+def scenario_16(size: str = "tiny", replicas: int = 2) -> dict:
+    """Traffic-observatory smoke (torchkafka_tpu/workload + obs/burn): a
+    seeded Zipf 3-tenant Poisson burst storm — heavy-tailed prompt-
+    suffix and output lengths, mixed QoS lanes, keyed partition pinning
+    — driven on a ManualClock through a 2-replica traced fleet with the
+    paged cache + chunked prefill on, a burn-rate monitor evaluating a
+    TTFT SLO per round, and per-record output budgets enforced via the
+    ``max_new`` header. Prints the per-tenant goodput / burn-rate report
+    production watches; the tier-1 guard asserts non-degenerate
+    per-tenant SLOs, trace balance, and zero lost records. The same-seed
+    byte-identity differential lives in tests/test_workload.py and the
+    overload sweep in benchmarks/bench_traffic.py."""
+    import time as _time
+
+    import torchkafka_tpu as tk
+    from torchkafka_tpu.fleet import QoSConfig, ServingFleet
+    from torchkafka_tpu.obs import SLOTarget
+    from torchkafka_tpu.resilience import ManualClock
+    from torchkafka_tpu.source.records import TopicPartition
+    from torchkafka_tpu.workload import WorkloadConfig, WorkloadGenerator
+    from torchkafka_tpu.workload.generator import header_max_new
+
+    prompt_len, max_new = (16, 8) if size == "tiny" else (64, 32)
+    n = 24 if size == "tiny" else 128
+    block = 4 if size == "tiny" else 16
+    parts = 4
+    slots = 2  # small pool: the burst storm provably queues
+    tick_dt = 0.002
+    cfg, params, label = _serving_model(size, None, prompt_len, max_new)
+    wcfg = WorkloadConfig(
+        tenants=3, zipf_s=1.2, total_records=n,
+        arrival_rate=1500.0, burst_mean=4.0,  # a storm: well over service
+        interactive_fraction=0.4,
+        mean_suffix=max(4.0, prompt_len / 3),
+        mean_output=max_new * 0.75,
+        seed=16,
+    )
+    gen = WorkloadGenerator(
+        wcfg, prompt_len=prompt_len, max_new=max_new,
+        vocab_size=cfg.vocab_size,
+    )
+    mc = ManualClock()
+    broker = tk.InMemoryBroker()
+    broker.create_topic("t16", partitions=parts)
+    pages = {
+        "block_size": block,
+        "num_blocks": slots * -(-(prompt_len + max_new) // block) + 16,
+    }
+    targets = [SLOTarget(
+        metric="ttft", threshold_s=tick_dt * 12, objective=0.75,
+        fast_window_s=tick_dt * 32, slow_window_s=tick_dt * 128,
+        min_samples=4,
+    )]
+    fleet = ServingFleet(
+        gen.consumer_factory(broker, "t16", "s16", clock=mc),
+        params, cfg, replicas=replicas, prompt_len=prompt_len,
+        max_new=max_new, slots=slots, qos=QoSConfig(), commit_every=4,
+        clock=mc.now,
+        gen_kwargs={"kv_pages": pages, "max_new_of": header_max_new},
+        obs=True, slo_targets=targets,
+    )
+    fleet.warmup()
+    t0 = _time.perf_counter()
+    drive = gen.drive(fleet, broker, "t16", clock=mc, tick_dt=tick_dt)
+    elapsed = _time.perf_counter() - t0
+    served_keys = set(drive["served_keys"])
+    produced = {
+        (p, o) for p in range(parts)
+        for o in range(broker.end_offset(TopicPartition("t16", p)))
+    }
+    committed_complete = all(
+        broker.committed("s16", TopicPartition("t16", p))
+        == broker.end_offset(TopicPartition("t16", p))
+        for p in {p for p, _ in produced}  # keyed: only pinned partitions
+    )
+    s = fleet.metrics.summary(fleet.replicas)
+    slo = s["slo"]
+    mon = fleet.monitor.summary()
+
+    def pct(leaf):
+        return {
+            "count": leaf["count"],
+            "p50_ms": round(leaf["p50_ms"], 3),
+            "p99_ms": round(leaf["p99_ms"], 3),
+        }
+
+    zero = {"count": 0, "p50_ms": 0.0, "p99_ms": 0.0}
+    tenant_slo = {
+        t: {
+            "ttft": pct(slo["ttft"]["by_tenant"].get(t, zero)),
+            "itl": pct(slo["itl"]["by_tenant"].get(t, zero)),
+        }
+        for t in gen.tenant_names
+    }
+    out_lens = sorted(
+        {len(np.asarray(t)) for _rid, _r, t in drive["completions"]}
+    )
+    trace_summary = fleet.tracer.summary()
+    fleet.close()
+    fleet.tracer.close()
+    return {
+        "scenario": "16:traffic-observatory",
+        "model_scale": label,
+        "replicas": replicas,
+        "records": drive["unique_served"],
+        "elapsed_s": round(elapsed, 3),
+        "records_per_s": (
+            round(drive["unique_served"] / elapsed, 1) if elapsed else None
+        ),
+        "schedule_digest": gen.schedule_digest()[:16],
+        "tenant_arrivals": gen.tenant_counts(),
+        "all_arrived": drive["all_arrived"],
+        "coverage_complete": served_keys == produced,
+        "committed_complete": committed_complete,
+        "duplicates": drive["duplicates"],
+        "synthetic_span_s": round(drive["end_time_s"], 3),
+        "tenant_slo": tenant_slo,
+        "ttft": pct(slo["ttft"]["all"]),
+        "itl": pct(slo["itl"]["all"]),
+        "queue_wait": pct(slo["queue_wait"]["all"]),
+        "e2e": pct(slo["e2e"]["all"]),
+        "lanes_observed": sorted(slo["ttft"]["by_lane"]),
+        "goodput": s["goodput"],
+        "burn_states": mon["states"],
+        "burn_transitions": mon["transitions"],
+        "burn_evaluations": mon["evaluations"],
+        "overload_deferrals": sum(
+            v["deferred"] for v in s["goodput"]["tenants"].values()
+        ),
+        "output_len_spread": out_lens,
+        "output_capped": s["serving"]["output_capped"],
+        "step_time": {
+            "ticks": s["serving"]["ticks"],
+            "p50_ms": round(s["serving"]["step_time"]["p50_ms"], 3),
+            "p99_ms": round(s["serving"]["step_time"]["p99_ms"], 3),
+        },
+        "cache_hit_rate": s["prefix_cache"]["hit_rate"],
+        "tenant_cache": _merge_tenant_cache(
+            [rep.gen.metrics for rep in fleet.replicas]
+        ),
+        "trace_events": trace_summary["events"],
+        "trace_stages": trace_summary["stages"],
+        "open_records_end": trace_summary["open_records"],
+        "dropped": sum(
+            rep.gen.metrics.dropped.count for rep in fleet.replicas
+        ),
+        "commit_failures": sum(
+            rep.gen.metrics.commit_failures.count for rep in fleet.replicas
+        ),
+    }
+
+
 def scenario_8(size: str = "tiny") -> dict:
     """Streaming CTR: DLRM-style recommender trained from a Kafka event
     stream — label + dense features + hashed categorical ids per record,
@@ -1932,6 +2099,7 @@ SCENARIOS = {
     13: scenario_13,
     14: scenario_14,
     15: scenario_15,
+    16: scenario_16,
 }
 
 
@@ -1980,7 +2148,7 @@ def run_scenario(
         )
     sample_kw = dict(temperature=temperature, top_k=top_k, top_p=top_p)
     spec_kw = dict(spec=spec, spec_k=spec_k, spec_draft_layers=spec_draft_layers)
-    if num in (10, 11, 12, 13, 15):
+    if num in (10, 11, 12, 13, 15, 16):
         return SCENARIOS[num](size, replicas=replicas)
     if model_scale is not None:
         if num not in (5, 7):

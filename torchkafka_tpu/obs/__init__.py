@@ -17,12 +17,18 @@ gap with three cooperating layers:
   inter-token latency, admission queue wait, end-to-end poll→commit,
   labeled by lane / tenant key / replica and pooled fleet-wide with the
   same sample-window merge the commit-latency percentiles use.
+- ``burn`` — burn-rate overload detection over the windowed SLO view:
+  per-scope error-budget burn over fast/slow trailing windows, a typed
+  ok → warning → burning → shedding state machine whose transitions ride
+  the trace stream, per-tenant goodput accounting, and the overload hook
+  the fleet's AdmissionQueue consumes to prefer deferral over collapse.
 - ``exporter`` — one pull-based Prometheus/OpenMetrics HTTP endpoint
   (stdlib ``http.server``, opt-in) exposing every metrics class through
   the shared renderer instead of four ad-hoc ``render_prometheus`` call
   sites.
 """
 
+from torchkafka_tpu.obs.burn import BurnRateMonitor, SLOTarget
 from torchkafka_tpu.obs.exporter import MetricsExporter
 from torchkafka_tpu.obs.slo import SLOHistograms, pooled_slo_summary
 from torchkafka_tpu.obs.trace import (
@@ -34,11 +40,13 @@ from torchkafka_tpu.obs.trace import (
 )
 
 __all__ = [
+    "BurnRateMonitor",
     "MetricsExporter",
     "ObsConfig",
     "RecordTrace",
     "RecordTracer",
     "SLOHistograms",
+    "SLOTarget",
     "STAGES",
     "TraceEvent",
     "pooled_slo_summary",

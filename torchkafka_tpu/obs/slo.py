@@ -32,13 +32,37 @@ class SLOHistograms:
 
     Label children are created lazily on first observation — the tenant
     population never needs declaring up front, exactly like the fleet's
-    per-tenant counters."""
+    per-tenant counters.
 
-    def __init__(self, window: int = 8192) -> None:
+    ``window_s`` (+ the injectable ``clock``) turns every child into a
+    time-windowed histogram as well (see ``LatencyHistogram``): a bounded
+    ring of per-window sample deltas, so ``windowed_summary(seconds)``
+    reports percentiles "over the last S seconds" per metric/label — the
+    live view the burn-rate monitor evaluates, next to the cumulative
+    one. ``expose_windows`` lists the horizons (seconds) ``series()``
+    renders as ``<metric>_window_ms{window="..."}`` Prometheus families."""
+
+    def __init__(self, window: int = 8192, *, window_s: float | None = None,
+                 n_windows: int = 16, clock=None,
+                 expose_windows: tuple = ()) -> None:
         self._window = window
+        self._window_s = window_s
+        self._n_windows = n_windows
+        self._clock = clock
+        if expose_windows and window_s is None:
+            raise ValueError("expose_windows requires window_s")
+        self.expose_windows = tuple(float(w) for w in expose_windows)
         self._lock = threading.Lock()
         # (metric, dim, label) -> LatencyHistogram; dim "" label "" = all.
         self._h: dict[tuple[str, str, str], LatencyHistogram] = {}
+
+    @property
+    def windowed(self) -> bool:
+        return self._window_s is not None
+
+    @property
+    def window_s(self) -> float | None:
+        return self._window_s
 
     def hist(self, metric: str, dim: str = "", label: str = ""
              ) -> LatencyHistogram:
@@ -48,7 +72,10 @@ class SLOHistograms:
         with self._lock:
             h = self._h.get(key)
             if h is None:
-                h = self._h[key] = LatencyHistogram(self._window)
+                h = self._h[key] = LatencyHistogram(
+                    self._window, window_s=self._window_s,
+                    n_windows=self._n_windows, clock=self._clock,
+                )
             return h
 
     def observe(self, metric: str, seconds: float, *, lane=None, tenant=None,
@@ -94,25 +121,56 @@ class SLOHistograms:
                 }
         return out
 
+    def windowed_summary(self, seconds: float | None = None) -> dict:
+        """Same nested shape as ``summary()`` but over the last
+        ``seconds`` only (default: one ``window_s`` bucket) — requires
+        time-windowing (``window_s=``)."""
+        out: dict = {}
+        for metric in METRICS:
+            out[metric] = {
+                "all": self.hist(metric).windowed_summary(seconds)
+            }
+            for dim in DIMS:
+                out[metric][f"by_{dim}"] = {
+                    label: self.hist(metric, dim, label).windowed_summary(
+                        seconds
+                    )
+                    for label in self.labels(metric, dim)
+                }
+        return out
+
     def series(self) -> list[tuple]:
         """Exposition series for ``utils.metrics.render_exposition``:
         one ``<metric>_ms`` gauge per SLO quantity with percentile +
-        dimension labels, plus the sample-count counters."""
+        dimension labels, plus the sample-count counters; when
+        ``expose_windows`` is set, one ``<metric>_window_ms`` gauge per
+        horizon with a ``window`` label (seconds) next to them."""
         from torchkafka_tpu.utils.metrics import format_labels
 
         out: list[tuple] = []
         for metric in METRICS:
             entries = []
             counts = []
-            all_s = self.hist(metric).summary()
+            windowed = []
+            all_h = self.hist(metric)
+            all_s = all_h.summary()
             for pct in ("p50", "p99"):
                 entries.append(
                     (format_labels(percentile=pct), all_s[f"{pct}_ms"])
                 )
             counts.append(("", all_s["count"]))
+            for horizon in self.expose_windows:
+                w = all_h.windowed_summary(horizon)
+                for pct in ("p50", "p99"):
+                    windowed.append((
+                        format_labels(window=f"{horizon:g}",
+                                      percentile=pct),
+                        w[f"{pct}_ms"],
+                    ))
             for dim in DIMS:
                 for label in self.labels(metric, dim):
-                    s = self.hist(metric, dim, label).summary()
+                    h = self.hist(metric, dim, label)
+                    s = h.summary()
                     for pct in ("p50", "p99"):
                         entries.append((
                             format_labels(**{dim: label, "percentile": pct}),
@@ -121,6 +179,16 @@ class SLOHistograms:
                     counts.append(
                         (format_labels(**{dim: label}), s["count"])
                     )
+                    for horizon in self.expose_windows:
+                        w = h.windowed_summary(horizon)
+                        for pct in ("p50", "p99"):
+                            windowed.append((
+                                format_labels(**{
+                                    dim: label, "window": f"{horizon:g}",
+                                    "percentile": pct,
+                                }),
+                                w[f"{pct}_ms"],
+                            ))
             help_name = metric.replace("_", " ")
             out.append((
                 f"{metric}_ms", "gauge", entries,
@@ -130,6 +198,12 @@ class SLOHistograms:
                 f"{metric}_observations_total", "counter", counts,
                 f"{help_name} samples observed",
             ))
+            if windowed:
+                out.append((
+                    f"{metric}_window_ms", "gauge", windowed,
+                    f"{help_name} latency percentiles over the trailing "
+                    "window (ms)",
+                ))
         return out
 
     def pooled(self, metric: str, dim: str = "", label: str = "") -> dict:

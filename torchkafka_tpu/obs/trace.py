@@ -60,11 +60,16 @@ JOURNAL_SERVED = "journal_served"
 COMMITTED = "committed"
 QUARANTINED = "quarantined"
 DROPPED = "dropped"
+# Not a record stage: a BurnRateMonitor state transition, riding the
+# same event stream (topic "slo", offset = transition sequence) so
+# overload state changes land in the trace, ordered against the record
+# lifecycles that caused them — and replay byte-identically.
+BURN_STATE = "burn_state"
 
 STAGES = (
     POLLED, QOS_ADMITTED, DEFERRED, PREFILL_QUEUED, CHUNK_SCHEDULED,
     WARM_RESUMED, SLOT_ACTIVE, TOKENS, FINISHED, JOURNAL_SERVED, COMMITTED,
-    QUARANTINED, DROPPED,
+    QUARANTINED, DROPPED, BURN_STATE,
 )
 
 
@@ -98,7 +103,13 @@ class ObsConfig:
     when set, every event is ALSO appended to this file as one JSON line
     at emit time (offline analysis; the measured-cost tier above the
     ring). ``token_events``: emit per-tick ``tokens`` events (the ITL
-    source); off keeps only stage-boundary events for long soaks."""
+    source); off keeps only stage-boundary events for long soaks.
+
+    ``window_s``: bucket width (seconds) for the TIME-windowed SLO view
+    (obs/slo.py): percentiles "over the last S seconds" next to the
+    cumulative ones — required by a ``BurnRateMonitor``. ``n_windows``
+    bounds the delta ring; ``expose_windows`` lists horizons the
+    Prometheus exposition renders (default: one ``window_s``)."""
 
     capacity: int = 65536
     clock: Callable[[], float] | None = None
@@ -106,10 +117,15 @@ class ObsConfig:
     token_events: bool = True
     tenant_of: Callable[[Record], str] = _default_tenant
     lane_of: Callable[[Record], str] = _default_lane
+    window_s: float | None = None
+    n_windows: int = 16
+    expose_windows: tuple = ()
 
     def __post_init__(self) -> None:
         if self.capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {self.capacity}")
+        if self.window_s is not None and self.window_s <= 0:
+            raise ValueError(f"window_s must be > 0, got {self.window_s}")
 
 
 class TraceEvent(NamedTuple):
@@ -201,7 +217,7 @@ class _Lifecycle:
     """Open per-record state between POLLED and a terminal stage."""
 
     __slots__ = ("lane", "tenant", "replica", "polled_t", "active_t",
-                 "last_tok_t", "finished", "tokens")
+                 "last_tok_t", "finished", "tokens", "warm", "queue_wait")
 
     def __init__(self, lane: str, tenant: str, replica, t: float) -> None:
         self.lane = lane
@@ -212,6 +228,8 @@ class _Lifecycle:
         self.last_tok_t: float | None = None
         self.finished = False
         self.tokens = 0
+        self.warm = False  # first token predates this poll (warm resume)
+        self.queue_wait: float | None = None
 
 
 class RecordTracer:
@@ -228,10 +246,26 @@ class RecordTracer:
         self.dropped_events = 0  # emitted beyond the ring's capacity
         self._emitted = 0
         self._open: dict[tuple[str, int, int], _Lifecycle] = {}
-        self.slo = SLOHistograms()
+        cfg = self.config
+        self.slo = SLOHistograms(
+            window_s=cfg.window_s, n_windows=cfg.n_windows,
+            clock=self._clock,
+            expose_windows=cfg.expose_windows or (
+                (cfg.window_s,) if cfg.window_s is not None else ()
+            ),
+        )
+        # Optional obs.BurnRateMonitor: receives per-completion goodput
+        # classifications (note_commit) and quarantine events.
+        self._monitor = None
         self._jsonl = None
         if self.config.jsonl_path is not None:
             self._jsonl = open(self.config.jsonl_path, "a", encoding="utf-8")
+
+    def attach_monitor(self, monitor) -> None:
+        """Bind a ``BurnRateMonitor``: committed lifecycles feed its
+        goodput ledger, and its state transitions ride this tracer's
+        event stream (``burn_state``)."""
+        self._monitor = monitor
 
     # -------------------------------------------------------------- emit
 
@@ -282,8 +316,9 @@ class RecordTracer:
             self._emit(QOS_ADMITTED, rec.topic, rec.partition, rec.offset, (
                 ("lane", lane), ("replica", replica),
             ))
+            life.queue_wait = max(0.0, wait_s)
             self.slo.observe(
-                "queue_wait", max(0.0, wait_s), lane=lane,
+                "queue_wait", life.queue_wait, lane=lane,
                 tenant=life.tenant, replica=life.replica,
             )
 
@@ -324,6 +359,7 @@ class RecordTracer:
             life.active_t = t
             life.last_tok_t = t
             life.tokens = max(life.tokens, 1)
+            life.warm = warm
             if not warm:
                 # A warm resume's "first token" was decoded by the dead
                 # replica pre-kill; timing it from THIS poll would report
@@ -375,6 +411,8 @@ class RecordTracer:
             self._emit(QUARANTINED, rec.topic, rec.partition, rec.offset,
                        (("replica", replica),))
             self._open.pop((rec.topic, rec.partition, rec.offset), None)
+            if self._monitor is not None:
+                self._monitor.note_quarantined(self.config.tenant_of(rec))
 
     def dropped(self, rec: Record, replica=None) -> None:
         with self._lock:
@@ -399,11 +437,34 @@ class RecordTracer:
             for (topic, partition, offset), life in done:
                 t = self._emit(COMMITTED, topic, partition, offset,
                                (("replica", life.replica),))
+                e2e = max(0.0, t - life.polled_t)
                 self.slo.observe(
-                    "e2e", max(0.0, t - life.polled_t), lane=life.lane,
+                    "e2e", e2e, lane=life.lane,
                     tenant=life.tenant, replica=life.replica,
                 )
+                if self._monitor is not None:
+                    ttft = (
+                        None
+                        if life.warm or life.active_t is None
+                        else max(0.0, life.active_t - life.polled_t)
+                    )
+                    self._monitor.note_completed(
+                        life.lane, life.tenant, ttft_s=ttft, e2e_s=e2e,
+                        queue_wait_s=life.queue_wait,
+                    )
                 del self._open[(topic, partition, offset)]
+
+    def burn_state(self, seq: int, metric: str, dim: str, label: str,
+                   old: str, new: str, fast: float, slow: float) -> None:
+        """A BurnRateMonitor state transition as a typed event on the
+        shared stream: topic ``slo``, offset = the monitor's transition
+        sequence, burn rates rounded so JSONL round-trips byte-exact."""
+        with self._lock:
+            self._emit(BURN_STATE, "slo", 0, seq, (
+                ("dim", dim), ("fast", round(fast, 4)), ("from", old),
+                ("label", label), ("metric", metric),
+                ("slow", round(slow, 4)), ("to", new),
+            ))
 
     # -------------------------------------------------------------- read
 

@@ -234,6 +234,14 @@ class ServeMetrics:
         self.commit_latency = LatencyHistogram()  # full commit path: output
         # flush + durability waits + offset commit (see _commit docstring)
         self.slot_occupancy = Gauge()  # active slots / pool size, last tick
+        # Per-tick serving step time (host-observed: chunk pack + device
+        # dispatch + sync) and tokens surfaced per tick block — the
+        # device-side "where did the tick go" companion to the obs
+        # layer's host-side record spans.
+        self.tick_time = LatencyHistogram()
+        self.tokens_per_tick = Gauge()
+        self.output_capped = RateMeter()  # slots force-finished by a
+        # per-record output budget (max_new_of) at sync granularity
         # Paged prefix cache (kv_pages=, torchkafka_tpu/kvcache): all zero
         # on the dense path.
         self.prefix_hits = RateMeter()  # admissions that reused cached blocks
@@ -270,6 +278,34 @@ class ServeMetrics:
         # completions re-served straight from the journal (zero re-decode)
         self.resume_rejected = RateMeter()  # hints discarded (payload CRC /
         # sampling-contract mismatch, or an unsupported pool mode)
+        # Per-tenant prefix-cache counters (lazy label children, tenant =
+        # record key): the "cache hit by tenant locality" observable the
+        # traffic bench reads. Empty on the dense path.
+        self._tenant_prefix_hits: dict[str, RateMeter] = {}
+        self._tenant_prefix_misses: dict[str, RateMeter] = {}
+
+    def tenant_prefix_hits(self, tenant: str) -> RateMeter:
+        return self._tenant_prefix_hits.setdefault(tenant, RateMeter())
+
+    def tenant_prefix_misses(self, tenant: str) -> RateMeter:
+        return self._tenant_prefix_misses.setdefault(tenant, RateMeter())
+
+    def tenant_cache_summary(self) -> dict:
+        out = {}
+        for t in sorted(
+            set(self._tenant_prefix_hits) | set(self._tenant_prefix_misses)
+        ):
+            hits = self.tenant_prefix_hits(t).count
+            misses = self.tenant_prefix_misses(t).count
+            out[t] = {
+                "hits": hits,
+                "misses": misses,
+                "hit_rate": (
+                    round(hits / (hits + misses), 4)
+                    if hits + misses else None
+                ),
+            }
+        return out
 
     def reset(self) -> None:
         """Zero the rate clocks — called at run() start so compile/warmup
@@ -295,7 +331,12 @@ class ServeMetrics:
             "output_send_failures": self.output_send_failures.count,
             "commit": self.commit_latency.summary(),
             "slot_occupancy": round(self.slot_occupancy.value, 3),
+            "ticks": self.tick_time.count,
+            "step_time": self.tick_time.summary(),
+            "tokens_per_tick": round(self.tokens_per_tick.value, 2),
+            "output_capped": self.output_capped.count,
             "prefix_cache": self.cache_summary(),
+            "tenant_cache": self.tenant_cache_summary(),
             "chunked_prefill": self.chunk_summary(),
             "journal": self.journal_summary(),
         }
@@ -340,7 +381,10 @@ class ServeMetrics:
     def render_prometheus(self, prefix: str = "torchkafka_serve") -> str:
         """Prometheus text exposition — same conventions (and shared
         renderer) as StreamMetrics.render_prometheus."""
-        from torchkafka_tpu.utils.metrics import render_exposition
+        from torchkafka_tpu.utils.metrics import (
+            format_labels,
+            render_exposition,
+        )
 
         s = self.summary()
         pc = s["prefix_cache"]
@@ -372,6 +416,21 @@ class ServeMetrics:
             ("completions_per_second", "gauge", s["completions_per_s"]),
             ("tokens_per_second", "gauge", s["tokens_per_s"]),
             ("slot_occupancy", "gauge", s["slot_occupancy"]),
+            ("serve_ticks_total", "counter", s["ticks"]),
+            ("step_time_ms", "gauge", [
+                ('percentile="p50"', s["step_time"]["p50_ms"]),
+                ('percentile="p99"', s["step_time"]["p99_ms"]),
+            ]),
+            ("tokens_per_tick", "gauge", s["tokens_per_tick"]),
+            ("output_capped_total", "counter", s["output_capped"]),
+            ("tenant_prefix_cache_hits_total", "counter", [
+                (format_labels(tenant=t), v["hits"])
+                for t, v in s["tenant_cache"].items()
+            ] or 0),
+            ("tenant_prefix_cache_misses_total", "counter", [
+                (format_labels(tenant=t), v["misses"])
+                for t, v in s["tenant_cache"].items()
+            ] or 0),
             ("prefix_cache_hits_total", "counter", pc["hits"]),
             ("prefix_cache_misses_total", "counter", pc["misses"]),
             ("prefix_tokens_saved_total", "counter", pc["prefix_tokens_saved"]),
@@ -434,6 +493,17 @@ class _PendingPrefill:
         self.enq_tick = enq_tick
 
 
+def _record_tenant(record: Record) -> str:
+    """Tenant = the record key (the rule fleet/qos.py and obs/trace.py
+    admit and label by), for the per-tenant cache-locality counters."""
+    if record.key is None:
+        return "anon"
+    try:
+        return record.key.decode("utf-8")
+    except UnicodeDecodeError:
+        return record.key.hex()
+
+
 def _default_decode_prompt(prompt_len: int) -> Callable[[Record], np.ndarray]:
     def decode(record: Record) -> np.ndarray:
         toks = np.frombuffer(record.value, dtype=np.int32)[:prompt_len]
@@ -483,6 +553,7 @@ class StreamingGenerator:
         journal: DecodeJournal | None = None,
         tracer=None,
         trace_replica: int | None = None,
+        max_new_of: Callable[[Record], int | None] | None = None,
     ) -> None:
         """``ticks_per_sync``: decode ticks chained per device dispatch
         (and per host sync of the done mask). Higher amortises dispatch
@@ -766,6 +837,15 @@ class StreamingGenerator:
         self._journal = journal
         self._tracer = tracer
         self._trace_replica = trace_replica
+        # Per-record output budget: ``max_new_of(record) -> n`` bounds
+        # that record's generation to n tokens (clamped to [1, max_new]).
+        # Enforced host-side at sync granularity: when a slot's emitted
+        # count reaches its budget it is force-finished exactly like a
+        # device ``done`` (output truncated to the budget, slot freed,
+        # journal finished) — the static tick program never changes, so
+        # heavy-tailed per-record output lengths (workload generation,
+        # user-requested max_tokens) cost nothing when None.
+        self._max_new_of = max_new_of
         self._resume_hints: dict[tuple[str, int, int], JournalEntry] = {}
         self._journal_ready: list[tuple[Record, np.ndarray]] = []
         self._slot_emitted = np.zeros((slots,), np.int64)
@@ -1759,11 +1839,14 @@ class StreamingGenerator:
             # their own redelivery.
             cacheable = RadixCache.matchable_blocks(len(toks), bs)
             self._kv_radix.insert(toks, row[:cacheable])
+            tenant = _record_tenant(rec)
             if matched:
                 self.metrics.prefix_hits.add(1)
+                self.metrics.tenant_prefix_hits(tenant).add(1)
                 self.metrics.prefix_tokens_saved.add(start)
             else:
                 self.metrics.prefix_misses.add(1)
+                self.metrics.tenant_prefix_misses(tenant).add(1)
             self._slot_rec[i] = rec
             key_np = (
                 np.asarray(hint.key_data, np.uint32)
@@ -2484,6 +2567,7 @@ class StreamingGenerator:
         run_chunk = self._chunked and bool(self._prefill_queue)
         if self._active.any() or run_chunk:
             self._tick_counter += 1
+            tick_t0 = time.perf_counter()
             finishers = None
             if run_chunk:
                 # The fused program: a bounded chunk of queued suffix
@@ -2525,8 +2609,14 @@ class StreamingGenerator:
                 done_h, n_out_h, gen_h, pos_h = jax.device_get(
                     (done, n_out, gen, pos)
                 )
+            self.metrics.tick_time.observe(time.perf_counter() - tick_t0)
             crash_hook("mid_tick")
             self.metrics.slot_occupancy.set(float(self._active.mean()))
+            if self._max_new_of is not None:
+                # device_get may hand back non-writable views; the budget
+                # clamp below mutates the done/count mirrors.
+                done_h = np.array(done_h)
+                n_out_h = np.array(n_out_h)
             # Per-slot emitted-token mirrors: decoded-token accounting
             # (the cold-vs-warm replay differential) and the journal's
             # token cadence both read them. Counted BEFORE retirement so
@@ -2539,6 +2629,20 @@ class StreamingGenerator:
                     n_out_h[i] if done_h[i]
                     else pos_h[i] - self._prompt_len + 1
                 )
+                if self._max_new_of is not None:
+                    budget = self._max_new_of(self._slot_rec[i])
+                    if budget is not None:
+                        budget = max(1, min(int(budget), self._max_new))
+                        if cnt >= budget:
+                            # Budget reached (tick blocks may overshoot
+                            # by up to ticks_per_sync - 1 tokens; the
+                            # overshoot is truncated): force-finish this
+                            # slot exactly like a device done.
+                            cnt = budget
+                            if not done_h[i]:
+                                self.metrics.output_capped.add(1)
+                            done_h[i] = True
+                            n_out_h[i] = budget
                 new_toks = cnt - int(self._slot_emitted[i])
                 decoded += new_toks
                 if self._tracer is not None and new_toks > 0:
@@ -2561,6 +2665,7 @@ class StreamingGenerator:
                         journal_dirty = True
             if decoded > 0:
                 self.metrics.decoded_tokens.add(decoded)
+            self.metrics.tokens_per_tick.set(float(decoded))
             if journal_dirty:
                 # Synchronous at the cadence point: the whole point is
                 # that a SIGKILL one instruction later finds these tokens

@@ -44,19 +44,50 @@ class LatencyHistogram:
 
     Bounded (ring buffer) because streams may run forever
     (idle_timeout_ms=None); recent-window percentiles are also what an
-    operator actually wants from a long-lived pipeline."""
+    operator actually wants from a long-lived pipeline.
 
-    def __init__(self, window: int = 8192) -> None:
+    ``window_s`` (with an injectable ``clock``) adds a TIME-windowed view
+    on top of the cumulative one: samples also land in a bounded ring of
+    per-window delta buckets (bucket width ``window_s``, ``n_windows``
+    retained), so ``windowed_summary(seconds)`` reports percentiles "over
+    the last N seconds" — the signal a burn-rate monitor needs, which the
+    cumulative window cannot provide (it never forgets). Window roll is
+    clock-driven and bucket-granular: a horizon of S seconds covers the
+    current (partial) bucket plus ``ceil(S / window_s) `` completed ones,
+    exact under a ManualClock. None (default) keeps the class byte-for-
+    byte on its original cumulative-only behavior and cost."""
+
+    def __init__(self, window: int = 8192, *, window_s: float | None = None,
+                 n_windows: int = 16, clock=None) -> None:
         from collections import deque
 
         self._lock = threading.Lock()
         self._samples: "deque[float]" = deque(maxlen=window)
         self._total = 0
+        if window_s is not None and window_s <= 0:
+            raise ValueError(f"window_s must be > 0, got {window_s}")
+        if n_windows < 1:
+            raise ValueError(f"n_windows must be >= 1, got {n_windows}")
+        self._window_s = window_s
+        self._clock = clock or time.monotonic
+        # (bucket_index, [samples]) newest last; bounded by n_windows.
+        self._buckets: "deque[tuple[int, list[float]]]" = deque(
+            maxlen=n_windows
+        )
+
+    def _bucket(self, now: float) -> list:
+        """The current window's sample list (lock held)."""
+        idx = int(now // self._window_s)
+        if not self._buckets or self._buckets[-1][0] != idx:
+            self._buckets.append((idx, []))
+        return self._buckets[-1][1]
 
     def observe(self, seconds: float) -> None:
         with self._lock:
             self._samples.append(seconds)
             self._total += 1
+            if self._window_s is not None:
+                self._bucket(self._clock()).append(seconds)
 
     def observe_many(self, seconds: float, n: int) -> None:
         """``n`` identical samples under one lock acquisition (the SLO
@@ -64,6 +95,43 @@ class LatencyHistogram:
         with self._lock:
             self._samples.extend([seconds] * n)
             self._total += n
+            if self._window_s is not None:
+                self._bucket(self._clock()).extend([seconds] * n)
+
+    def windowed_snapshot(self, seconds: float | None = None) -> list[float]:
+        """Samples observed within the last ``seconds`` (default: one
+        ``window_s``), at bucket granularity: the current partial bucket
+        plus every completed bucket whose window intersects
+        ``(now - seconds, now]``. Raises unless time-windowing is on."""
+        if self._window_s is None:
+            raise ValueError(
+                "time-windowed view requires LatencyHistogram(window_s=...)"
+            )
+        horizon = self._window_s if seconds is None else float(seconds)
+        with self._lock:
+            now = self._clock()
+            # A bucket [idx*w, (idx+1)*w) intersects (now-horizon, now]
+            # iff its END is past the horizon start.
+            min_idx = int((now - horizon) // self._window_s)
+            out: list[float] = []
+            for idx, samples in self._buckets:
+                if idx >= min_idx:
+                    out.extend(samples)
+            return out
+
+    def windowed_summary(self, seconds: float | None = None) -> dict:
+        """count/p50_ms/p99_ms over the last ``seconds`` (see
+        ``windowed_snapshot`` for the bucket-granular roll contract)."""
+        samples = self.windowed_snapshot(seconds)
+        if not samples:
+            return {"count": 0, "p50_ms": 0.0, "p99_ms": 0.0}
+        s = sorted(samples)
+
+        def pct(q: float) -> float:
+            idx = min(len(s) - 1, int(round(q / 100.0 * (len(s) - 1))))
+            return s[idx] * 1e3
+
+        return {"count": len(s), "p50_ms": pct(50), "p99_ms": pct(99)}
 
     def percentile(self, q: float) -> float:
         with self._lock:

@@ -1,0 +1,29 @@
+"""Deterministic multi-tenant load generation (torchkafka_tpu/workload).
+
+The "traffic survived" half of the observability story: a seeded,
+injectable-clock workload generator — Zipf tenants with keyed partition
+pinning, Poisson burst arrivals, heavy-tailed prompt/output lengths,
+mixed QoS lanes, scheduled mid-run chaos — that drives the FULL serving
+stack (fleet + QoS + paged/chunked KV cache + resilience + journal +
+tracer) and replays byte-identically at the same seed. See
+``generator.py`` for the draw-stream contract and ``obs/burn.py`` for
+the burn-rate engine its traffic is measured against.
+"""
+
+from torchkafka_tpu.workload.generator import (
+    ArrivalEvent,
+    ChaosSchedule,
+    WorkloadConfig,
+    WorkloadGenerator,
+    header_max_new,
+    zipf_weights,
+)
+
+__all__ = [
+    "ArrivalEvent",
+    "ChaosSchedule",
+    "WorkloadConfig",
+    "WorkloadGenerator",
+    "header_max_new",
+    "zipf_weights",
+]
