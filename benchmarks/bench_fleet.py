@@ -24,12 +24,30 @@ tokens re-decoded after the death, cold vs warm — the journal's whole
 value proposition — with byte-exactness vs a no-kill reference ASSERTED
 for every run before its numbers count (a fast failover that changed an
 output would be a bug, not a result).
+
+``--procs 1,2,4``: the REAL-PROCESS fleet curve (fleet/supervisor.py):
+R worker processes over the socket broker, one consumer group, measured
+from all-ready (per-process jit warmup excluded via the readiness
+markers) to fully-committed. Paired interleaved slices; per-slice
+exactness asserted against the in-process reference before any number
+counts. NOTE the honest caveat: on an N-core box this measures real
+OS-process scheduling + socket-RPC overhead — R processes only scale
+when R cores exist (a 1-core container shows ≈flat-to-negative, and
+PERF.md says so).
+
+``--procs-failover``: the CROSS-PROCESS warm-failover differential — a
+real SIGKILL of one worker process mid-storm, journals shared (warm:
+the survivor loads the victim's file across the process boundary) vs
+private-throwaway (cold), paired per slice. Signal: survivor-side
+decoded tokens, cold vs warm; exactness asserted every run. Appends
+rows to FAILOVER_BENCH.json via --json-out.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -141,6 +159,222 @@ def run_failover(tk, cfg, params, args, vocab: int, prompt_len: int,
     }), file=sys.stderr)
 
 
+MODEL_SPEC = dict(seed=0, vocab_size=512, d_model=64, n_layers=2,
+                  n_heads=4, n_kv_heads=2, d_ff=128)
+
+
+def _proc_reference(tk, cfg, params, prompts, parts, max_new):
+    from torchkafka_tpu.serve import StreamingGenerator
+
+    broker = tk.InMemoryBroker()
+    broker.create_topic("ref", partitions=parts)
+    for i in range(prompts.shape[0]):
+        broker.produce("ref", prompts[i].tobytes(), partition=i % parts,
+                       key=str(i).encode())
+    c = tk.MemoryConsumer(broker, "ref", group_id="ref")
+    gen = StreamingGenerator(c, params, cfg, slots=4,
+                             prompt_len=prompts.shape[1], max_new=max_new,
+                             commit_every=8, ticks_per_sync=1)
+    ref = {rec.key: toks for rec, toks in gen.run(idle_timeout_ms=400)}
+    c.close()
+    return ref
+
+
+def _build_proc_fleet(tk, workdir, replicas, parts, prompt_len, max_new,
+                      journal=True, commit_every=8):
+    from torchkafka_tpu.fleet import ProcessFleet
+
+    spec = dict(MODEL_SPEC, max_seq_len=prompt_len + max_new)
+    return ProcessFleet(
+        spec, topic="bench", prompt_len=prompt_len, max_new=max_new,
+        workdir=workdir, replicas=replicas, partitions=parts, slots=4,
+        commit_every=commit_every, session_timeout_s=5.0,
+        heartbeat_interval_s=0.25, journal_cadence=2, journal=journal,
+        respawn=False, group="bench",
+    )
+
+
+def _assert_exact(res, ref, n):
+    import numpy as np
+
+    assert set(res) == {str(i).encode() for i in range(n)}, (
+        "coverage broken", len(res), n,
+    )
+    for k, copies in res.items():
+        for _member, toks in copies:
+            np.testing.assert_array_equal(toks, ref[k], err_msg=str(k))
+
+
+def run_procs(tk, cfg, params, args, prompt_len, max_new) -> None:
+    import tempfile
+
+    import numpy as np
+
+    counts = [int(x) for x in args.procs.split(",")]
+    n, parts = args.prompts, max(4, max(counts))
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(0, cfg.vocab_size, (n, prompt_len),
+                           dtype=np.int32)
+    ref = _proc_reference(tk, cfg, params, prompts, parts, max_new)
+    per: dict[int, list[float]] = {r: [] for r in counts}
+    for s in range(args.slices):
+        for r in counts:  # interleaved: every config samples every window
+            with tempfile.TemporaryDirectory() as td:
+                fleet = _build_proc_fleet(
+                    tk, td, r, parts, prompt_len, max_new
+                )
+                try:
+                    fleet.start()
+                    fleet.wait_ready(timeout_s=600)
+                    # Measured window: all replicas warm → storm produced
+                    # → every prompt durably committed.
+                    t0 = time.perf_counter()
+                    for i in range(n):
+                        fleet.broker.produce(
+                            "bench", prompts[i].tobytes(),
+                            partition=i % parts, key=str(i).encode(),
+                        )
+                    fleet.wait(lambda f: f.fully_committed(),
+                               timeout_s=600)
+                    dt = time.perf_counter() - t0
+                    _assert_exact(fleet.results(), ref, n)
+                finally:
+                    fleet.close()
+            per[r].append(n / dt)
+            print(f"slice {s} procs {r}: {per[r][-1]:,.1f} prompts/s",
+                  file=sys.stderr)
+
+    base = [per[counts[0]][i] for i in range(args.slices)]
+    print("| replica processes | prompts/s (median) | ratio vs "
+          f"{counts[0]} (median of paired) |")
+    print("|---|---|---|")
+    out = {}
+    for r in counts:
+        rates = per[r]
+        ratios = [rates[i] / base[i] for i in range(args.slices)]
+        out[r] = {
+            "prompts_per_s": float(np.median(rates)),
+            "ratio": float(np.median(ratios)),
+            "slices": [round(x, 1) for x in rates],
+        }
+        print(f"| {r} | {out[r]['prompts_per_s']:,.1f} "
+              f"| {out[r]['ratio']:.2f}× |")
+    print(json.dumps({
+        "mode": "procs", "prompts": n, "max_new": max_new,
+        "cores": os.cpu_count(), "per_procs": out,
+        "exactness": "asserted vs in-process reference, every slice",
+    }), file=sys.stderr)
+
+
+def run_procs_failover(tk, cfg, params, args, prompt_len, max_new) -> None:
+    import tempfile
+
+    import numpy as np
+
+    from torchkafka_tpu.source.records import TopicPartition
+
+    n, parts = args.prompts, 4
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(0, cfg.vocab_size, (n, prompt_len),
+                           dtype=np.int32)
+    ref = _proc_reference(tk, cfg, params, prompts, parts, max_new)
+
+    def killed_run(warm: bool):
+        with tempfile.TemporaryDirectory() as td:
+            fleet = _build_proc_fleet(
+                tk, td, 2, parts, prompt_len, max_new, journal=warm,
+                # Large cadence: the kill provably re-delivers (nothing
+                # committed mid-storm), maximizing the journal's window.
+                commit_every=10**6,
+            )
+            try:
+                fleet.start()
+                fleet.wait_ready(timeout_s=600)
+                for i in range(n):
+                    fleet.broker.produce(
+                        "bench", prompts[i].tobytes(),
+                        partition=i % parts, key=str(i).encode(),
+                    )
+                victim = None
+                deadline = time.monotonic() + 300
+                while victim is None:
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(fleet.diagnose())
+                    res = fleet.results()
+                    if len(res) >= n:
+                        raise RuntimeError("storm drained pre-kill")
+                    served = {m for v in res.values() for m, _ in v}
+                    for inc in fleet.live():
+                        if inc.member in served:
+                            victim = fleet.kill_replica(inc.idx)
+                            break
+                    time.sleep(0.01)
+                fleet.wait(
+                    lambda f: set(f.results())
+                    == {str(i).encode() for i in range(n)},
+                    timeout_s=600,
+                )
+                fleet.drain()
+                fleet.wait(
+                    lambda f: all(not i.running for i in f.incarnations),
+                    timeout_s=300,
+                )
+                fleet.poll_once()
+                assert fleet.fully_committed()
+                res = fleet.results()
+                _assert_exact(res, ref, n)
+                wm = fleet.worker_metrics()
+                survivor_decoded = sum(m["decoded_tokens"] for m in wm)
+                restored = sum(m["tokens_restored"] for m in wm)
+                jserved = sum(m["served_from_journal"] for m in wm)
+                dups = sum(len(v) - 1 for v in res.values())
+            finally:
+                fleet.close()
+        return survivor_decoded, restored, jserved, dups
+
+    cold, warm = [], []
+    rows = []
+    for s in range(args.slices):
+        c, _, _, cd = killed_run(warm=False)
+        w, restored, jserved, wd = killed_run(warm=True)
+        cold.append(c)
+        warm.append(w)
+        rows.append({
+            "slice": s, "cold_survivor_decoded": c,
+            "warm_survivor_decoded": w, "tokens_restored": restored,
+            "journal_served": jserved,
+            "duplicates": {"cold": cd, "warm": wd},
+        })
+        print(f"slice {s}: survivor decoded cold {c} warm {w} "
+              f"(restored {restored}, journal-served {jserved})",
+              file=sys.stderr)
+    med_c, med_w = float(np.median(cold)), float(np.median(warm))
+    print("| cross-process failover | survivor decoded tokens (median) "
+          "| vs cold |")
+    print("|---|---|---|")
+    print(f"| cold (private journals) | {med_c:,.0f} | 1.00× |")
+    print(f"| warm (shared journal dir, cadence 2) | {med_w:,.0f} | "
+          f"{med_w / med_c:.2f}× |")
+    doc = {
+        "mode": "procs-failover", "prompts": n, "max_new": max_new,
+        "slices": rows, "median_cold": med_c, "median_warm": med_w,
+        "ratio": med_w / med_c if med_c else None,
+        "exactness": "asserted vs in-process reference, every run",
+    }
+    print(json.dumps(doc), file=sys.stderr)
+    if args.json_out:
+        try:
+            with open(args.json_out, encoding="utf-8") as f:
+                existing = json.load(f)
+        except (OSError, ValueError):
+            existing = {}
+        existing["cross_process"] = doc
+        with open(args.json_out, "w", encoding="utf-8") as f:
+            json.dump(existing, f, indent=1)
+        print(f"appended cross_process rows to {args.json_out}",
+              file=sys.stderr)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--replicas", default="1,2,4")
@@ -150,6 +384,12 @@ def main() -> None:
                     help="paired cold-vs-warm failover differential")
     ap.add_argument("--cadence", type=int, default=4,
                     help="--failover: journal token cadence")
+    ap.add_argument("--procs", default=None,
+                    help="real-process fleet curve, e.g. 1,2,4")
+    ap.add_argument("--procs-failover", action="store_true",
+                    help="cross-process SIGKILL cold-vs-warm differential")
+    ap.add_argument("--json-out", default=None,
+                    help="--procs-failover: FAILOVER_BENCH.json to append")
     args = ap.parse_args()
     counts = [int(x) for x in args.replicas.split(",")]
 
@@ -172,6 +412,12 @@ def main() -> None:
     )
     params = init_params(jax.random.key(0), cfg)
 
+    if args.procs:
+        run_procs(tk, cfg, params, args, prompt_len, max_new=16)
+        return
+    if args.procs_failover:
+        run_procs_failover(tk, cfg, params, args, prompt_len, max_new=16)
+        return
     if args.failover:
         run_failover(tk, cfg, params, args, vocab, prompt_len, max_new=16)
         return

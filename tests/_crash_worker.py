@@ -18,6 +18,15 @@ Modes:
           commit its offsets, then StreamCheckpointer.save — resuming
           from the newest complete checkpoint at startup. Covers
           post_commit_pre_checkpoint and checkpoint_mid_write.
+  fleet — one PROCESS-FLEET replica incarnation (fleet/proc.py's
+          run_replica_worker, heartbeats in loop mode so crash arrivals
+          count pump progress): group membership + per-pump lease
+          renewal + peer-journal scans. Covers heartbeat_pre_send and
+          journal_handoff_pre_load.
+  sweep — a supervisor's lease sweep against a zombie member that
+          joined and never heartbeated: observes the expired lease via
+          membership(), then fences. Covers lease_expired_pre_fence
+          (the kill lands between observation and the fence).
 
 Importable from test_crash_matrix.py: the mode functions double as the
 parent's no-kill reference and recovery runners (identical logic, same
@@ -125,6 +134,91 @@ def run_serve(broker, workdir: str) -> None:
     consumer.close()
 
 
+FLEET_TOPIC, FLEET_OUT = "ft", "fout"
+FLEET_GROUP = "fg"
+FLEET_PARTS = 2
+FLEET_PROMPTS = 8
+SWEEP_GROUP = "zg"
+SWEEP_TIMEOUT_S = 0.5
+
+
+def prime_fleet_topics(broker):
+    """Prompt/output topics for the fleet-mode matrix (no poison: the
+    quarantine path has its own serve-mode coverage). Prompt i →
+    partition i % FLEET_PARTS, key = i as ascii."""
+    import numpy as np
+
+    broker.create_topic(FLEET_TOPIC, partitions=FLEET_PARTS)
+    broker.create_topic(FLEET_OUT, partitions=1)
+    rng = np.random.default_rng(11)
+    prompts = rng.integers(0, VOCAB, (FLEET_PROMPTS, P), dtype=np.int32)
+    for i in range(FLEET_PROMPTS):
+        broker.produce(
+            FLEET_TOPIC, prompts[i].tobytes(), partition=i % FLEET_PARTS,
+            key=str(i).encode(),
+        )
+    return prompts
+
+
+def run_fleet(broker, workdir: str, member: str = "m0") -> int:
+    """One process-fleet replica incarnation over ``broker``. Loop-mode
+    heartbeats: one lease renewal per pump, so an armed
+    ``heartbeat_pre_send`` arrival count tracks serving progress
+    deterministically. The startup + assignment-gain journal scans pass
+    through ``journal_handoff_pre_load``."""
+    from torchkafka_tpu.fleet.proc import run_replica_worker
+
+    spec = {
+        "member_id": member,
+        "replica_index": 0,
+        "topic": FLEET_TOPIC,
+        "group": FLEET_GROUP,
+        "out_topic": FLEET_OUT,
+        "ready_topic": None,
+        "journal_dir": os.path.join(workdir, "journals"),
+        "journal_cadence": 2,
+        "model": {
+            "seed": 0, "vocab_size": VOCAB, "d_model": 32, "n_layers": 2,
+            "n_heads": 2, "n_kv_heads": 1, "d_ff": 64,
+            "max_seq_len": P + MAX_NEW,
+        },
+        "prompt_len": P,
+        "max_new": MAX_NEW,
+        "slots": SLOTS,
+        "commit_every": COMMIT_EVERY,
+        "ticks_per_sync": 1,
+        "max_poll_records": SLOTS,
+        "heartbeat_interval_s": 0.0,
+        "heartbeat_mode": "loop",
+        "idle_exit_ms": 400,
+    }
+    return run_replica_worker(spec, broker=broker)
+
+
+def run_sweep(broker) -> None:
+    """The supervisor side of lease fencing: a zombie joined (directly,
+    no consumer loop, no heartbeats), its lease expires on the broker's
+    real clock, and the sweep observes-then-fences — the armed
+    ``lease_expired_pre_fence`` kill lands between the two."""
+    import time
+
+    from torchkafka_tpu.fleet.supervisor import sweep_expired
+
+    broker.join(SWEEP_GROUP, "zombie", frozenset({FLEET_TOPIC}))
+    deadline = time.monotonic() + 30.0
+    while True:
+        info = broker.membership(SWEEP_GROUP)
+        lease = info["leases"].get("zombie")
+        if lease is not None and lease <= 0:
+            break
+        if "zombie" not in info["members"]:
+            return  # already reaped by other traffic: nothing to sweep
+        if time.monotonic() > deadline:
+            raise RuntimeError("zombie lease never expired")
+        time.sleep(0.02)
+    sweep_expired(broker, SWEEP_GROUP)
+
+
 def run_ckpt(broker, workdir: str) -> None:
     """One training-shaped incarnation: resume from the newest complete
     checkpoint, then chunks of poll → commit → save. The commit-then-
@@ -176,6 +270,10 @@ def main() -> int:
             run_serve(client, workdir)
         elif mode == "ckpt":
             run_ckpt(client, workdir)
+        elif mode == "fleet":
+            run_fleet(client, workdir)
+        elif mode == "sweep":
+            run_sweep(client)
         else:
             raise ValueError(f"unknown mode {mode!r}")
     finally:

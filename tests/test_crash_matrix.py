@@ -59,6 +59,13 @@ MATRIX: dict[str, tuple[str, int]] = {
     "journal_mid_write": ("serve", 3),
     "post_commit_pre_checkpoint": ("ckpt", 2),
     "checkpoint_mid_write": ("ckpt", 2),
+    # Process-fleet liveness windows (fleet/proc.py + fleet/supervisor.py):
+    # heartbeats run in loop mode there, one renewal per pump, so the
+    # arrival count tracks serving progress — 12 lands mid-stream with
+    # completions emitted and work in flight.
+    "heartbeat_pre_send": ("fleet", 12),
+    "journal_handoff_pre_load": ("fleet", 2),
+    "lease_expired_pre_fence": ("sweep", 1),
 }
 
 # The tier-1 representative subset: one mid-serve death (commit path) and
@@ -248,6 +255,138 @@ def _run_ckpt_case(tmp_path, point: str, at: int):
     )
 
 
+@pytest.fixture(scope="module")
+def fleet_reference(tmp_path_factory):
+    """The no-kill fleet-mode run: key → completion tokens."""
+    broker = tk.InMemoryBroker()
+    W.prime_fleet_topics(broker)
+    rc = W.run_fleet(broker, str(tmp_path_factory.mktemp("fleet-ref")))
+    assert rc == 0
+    outs = _fleet_outputs(broker)
+    assert set(outs) == {str(i).encode() for i in range(W.FLEET_PROMPTS)}
+    return {k: v[0] for k, v in outs.items()}
+
+
+def _fleet_outputs(broker):
+    tp = TopicPartition(W.FLEET_OUT, 0)
+    out: dict[bytes, list] = {}
+    for rec in broker.fetch(tp, 0, 100000):
+        out.setdefault(rec.key, []).append(
+            np.frombuffer(rec.value, dtype=np.int32)
+        )
+    return out
+
+
+def _run_fleet_case(tmp_path, fleet_reference, point: str, at: int):
+    """A process-fleet replica SIGKILLed at a liveness crash point: the
+    at-least-once audit (commit never covers a prompt without durable
+    output), then recovery as a FRESH incarnation (new member id, same
+    shared journal dir — the startup scan IS the cross-process
+    handoff), byte-identical and fully committed."""
+    broker = tk.InMemoryBroker()
+    W.prime_fleet_topics(broker)
+    workdir = str(tmp_path / point)
+    os.makedirs(workdir, exist_ok=True)
+    with tk.BrokerServer(broker) as server:
+        proc, marker = _spawn("fleet", server.port, workdir, point, at)
+        proc.wait(timeout=180)
+    with open(os.path.join(workdir, "child.log"), "rb") as f:
+        log = f.read().decode(errors="replace")
+    assert proc.returncode == -signal.SIGKILL, (
+        f"worker exited {proc.returncode}, not SIGKILL — point {point!r} "
+        f"never reached?\n{log}"
+    )
+    with open(marker) as f:
+        assert f.read().strip() == f"{point}:{at}"
+    _reap_group(broker, W.FLEET_GROUP)
+
+    # ---- invariants at the moment of death ------------------------------
+    outs = _fleet_outputs(broker)
+    for p in range(W.FLEET_PARTS):
+        tp = TopicPartition(W.FLEET_TOPIC, p)
+        wm = broker.committed(W.FLEET_GROUP, tp) or 0
+        assert wm <= broker.end_offset(tp)
+        for off in range(wm):
+            key = str(off * W.FLEET_PARTS + p).encode()
+            assert key in outs, (
+                f"committed {p}:{off} (prompt {key}) has no durable output"
+            )
+    # The corpse's journal parses (or is absent) — never wedges recovery.
+    DecodeJournal.load(os.path.join(workdir, "journals", "m0.json"))
+
+    # ---- recovery: a fresh incarnation, in-process ----------------------
+    rc = W.run_fleet(broker, workdir, member="m1")
+    assert rc == 0
+    outs = _fleet_outputs(broker)
+    assert set(outs) == set(fleet_reference), (
+        f"lost completions: {set(fleet_reference) ^ set(outs)}"
+    )
+    for key, copies in outs.items():
+        for c in copies:  # duplicates allowed, divergence not
+            np.testing.assert_array_equal(
+                c, fleet_reference[key], err_msg=str(key)
+            )
+    for p in range(W.FLEET_PARTS):
+        tp = TopicPartition(W.FLEET_TOPIC, p)
+        assert (broker.committed(W.FLEET_GROUP, tp) or 0) \
+            == broker.end_offset(tp), f"partition {p} not fully committed"
+
+
+def _run_sweep_case(tmp_path, point: str, at: int):
+    """A supervisor dies BETWEEN observing an expired lease and fencing:
+    the zombie stays a member — yet its own post-mortem commit
+    self-fences (commit-time reap) with the watermark unmoved, and a
+    recovery sweep finishes the fencing idempotently."""
+    from torchkafka_tpu.errors import CommitFailedError
+    from torchkafka_tpu.fleet.supervisor import sweep_expired
+
+    broker = tk.InMemoryBroker(session_timeout_s=W.SWEEP_TIMEOUT_S)
+    W.prime_fleet_topics(broker)
+    workdir = str(tmp_path / point)
+    os.makedirs(workdir, exist_ok=True)
+    with tk.BrokerServer(broker) as server:
+        proc, marker = _spawn("sweep", server.port, workdir, point, at)
+        proc.wait(timeout=120)
+    with open(os.path.join(workdir, "child.log"), "rb") as f:
+        log = f.read().decode(errors="replace")
+    assert proc.returncode == -signal.SIGKILL, (
+        f"sweeper exited {proc.returncode}; point {point!r} never "
+        f"reached?\n{log}"
+    )
+    with open(marker) as f:
+        assert f.read().strip() == f"{point}:{at}"
+
+    # ---- the window: observed-expired, not yet fenced -------------------
+    info = broker.membership(W.SWEEP_GROUP)
+    assert info["members"] == ["zombie"], info
+    assert info["leases"]["zombie"] <= 0
+    join_gen = info["generation"]
+    # The zombie's own commit self-fences — watermark untouched.
+    tp = TopicPartition(W.FLEET_TOPIC, 0)
+    with pytest.raises(CommitFailedError):
+        broker.commit(W.SWEEP_GROUP, {tp: 1}, member_id="zombie",
+                      generation=join_gen)
+    assert broker.committed(W.SWEEP_GROUP, tp) is None
+    assert "zombie" in broker.membership(W.SWEEP_GROUP)["fenced"]
+
+    # ---- recovery: the sweep is idempotent; the group serves on --------
+    assert sweep_expired(broker, W.SWEEP_GROUP) == []
+    c = tk.MemoryConsumer(broker, W.FLEET_TOPIC, group_id=W.SWEEP_GROUP,
+                          member_id="fresh")
+    got = []
+    while True:
+        records = c.poll(max_records=64, timeout_ms=100)
+        if not records:
+            break
+        got.extend(records)
+        c.commit()
+    c.close()
+    assert len(got) == W.FLEET_PROMPTS
+    for p in range(W.FLEET_PARTS):
+        tp = TopicPartition(W.FLEET_TOPIC, p)
+        assert broker.committed(W.SWEEP_GROUP, tp) == broker.end_offset(tp)
+
+
 FULL_POINTS = [p for p in MATRIX if p not in TIER1]
 
 
@@ -265,23 +404,35 @@ class TestCrashMatrix:
 
     @pytest.mark.chaos
     @pytest.mark.parametrize("point", TIER1)
-    def test_crash_point_tier1(self, tmp_path, reference, point):
+    def test_crash_point_tier1(self, tmp_path, request, point):
         """The tier-1 representative deaths: one mid-serve (outputs
         durable, offsets not yet committed), one mid-checkpoint (torn
         step dir)."""
-        mode, at = MATRIX[point]
-        if mode == "serve":
-            _run_serve_case(tmp_path, reference, point, at)
-        else:
-            _run_ckpt_case(tmp_path, point, at)
+        _dispatch_case(tmp_path, request, point)
 
     @pytest.mark.chaos
     @pytest.mark.slow
     @pytest.mark.parametrize("point", FULL_POINTS)
-    def test_crash_point_full(self, tmp_path, reference, point):
+    def test_crash_point_full(self, tmp_path, request, point):
         """The rest of the matrix (run with ``-m chaos``)."""
-        mode, at = MATRIX[point]
-        if mode == "serve":
-            _run_serve_case(tmp_path, reference, point, at)
-        else:
-            _run_ckpt_case(tmp_path, point, at)
+        _dispatch_case(tmp_path, request, point)
+
+
+def _dispatch_case(tmp_path, request, point: str) -> None:
+    # getfixturevalue keeps each mode's module-scoped reference lazy: a
+    # fleet-only run never pays for the serve-mode reference build.
+    mode, at = MATRIX[point]
+    if mode == "serve":
+        _run_serve_case(
+            tmp_path, request.getfixturevalue("reference"), point, at
+        )
+    elif mode == "ckpt":
+        _run_ckpt_case(tmp_path, point, at)
+    elif mode == "fleet":
+        _run_fleet_case(
+            tmp_path, request.getfixturevalue("fleet_reference"), point, at
+        )
+    elif mode == "sweep":
+        _run_sweep_case(tmp_path, point, at)
+    else:  # pragma: no cover - matrix typo guard
+        raise ValueError(f"unknown matrix mode {mode!r}")

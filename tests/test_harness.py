@@ -276,6 +276,41 @@ def test_scenario_16_traffic_observatory():
     assert out["open_records_end"] == 0
 
 
+def test_scenario_17_process_fleet_kill_storm():
+    """The tier-1 process-fleet smoke: two REAL OS-process replicas over
+    the socket broker (own BrokerClient, own jit state, own on-disk
+    journal, heartbeat leases); one is SIGKILLed while provably holding
+    served-but-uncommitted work. Asserts the acceptance contract: zero
+    lost records, every completion byte-identical to the no-kill
+    reference, duplicates within the fleet-wide uncommitted-work bound,
+    the victim's journal handed off across the process boundary and
+    provably used, and the zombie's stale-generation post-mortem commit
+    rejected with the watermark unmoved."""
+    out = run_scenario(17, "tiny")
+    assert out["scenario"] == "17:process-fleet-kill-storm"
+    assert out["replicas"] == 2
+    assert out["victim_sigkilled"] is True  # a real SIGKILL corpse
+    assert out["fence_count"] == 1
+    assert out["zero_lost"] is True
+    assert out["identical_to_no_kill"] is True
+    assert out["duplicates_within_bound"] is True, (
+        out["duplicates"], out["duplicate_bound"],
+    )
+    # Cross-process warm failover: the victim's on-disk journal reached
+    # the survivor and drove the recovery (partial warm resume or a
+    # finished-uncommitted zero-re-decode serve — the kill's timing
+    # picks which).
+    assert out["journal_handoff_entries"] > 0
+    assert out["warm_resumes_plus_journal_served"] > 0
+    # Zombie fencing: the killed member's generation is dead.
+    assert out["zombie_commit_rejected"] is True
+    assert out["watermark_unmoved_by_zombie"] is True
+    # The survivor drained cleanly; the victim shows the SIGKILL rc.
+    codes = out["exit_codes"]
+    assert codes[out["victim"]] == -9
+    assert sorted(codes.values()) == [-9, 0]
+
+
 def test_scenario_13_warm_failover_smoke():
     """The tier-1 warm-failover smoke: a seeded mid-generation replica
     kill through a journaled 2-replica fleet. The survivor consults the

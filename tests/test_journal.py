@@ -333,6 +333,12 @@ class TestCrashPoints:
             "post_poll", "pre_commit", "post_commit_pre_checkpoint",
             "mid_tick", "post_dlq_pre_retire", "journal_mid_write",
             "checkpoint_mid_write",
+            # The process-fleet liveness windows (ISSUE 10): a replica
+            # dying before its lease renewal, a supervisor dying between
+            # observing an expired lease and fencing, and a loader dying
+            # inside the cross-process journal scan.
+            "heartbeat_pre_send", "lease_expired_pre_fence",
+            "journal_handoff_pre_load",
         }
 
 

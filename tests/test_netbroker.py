@@ -1,15 +1,25 @@
 """Socket-RPC broker transport: the same broker surface across connections.
 
 The unit tier for source/netbroker.py (the multi-PROCESS elastic test lives
-in tests/test_pod.py): protocol roundtrip, exception marshalling, and the
-property the transport exists for — two ``MemoryConsumer``s on separate
-client connections share ONE consumer group with real rebalances.
+in tests/test_pod.py and tests/test_procfleet.py): protocol roundtrip,
+exception marshalling, the property the transport exists for — two
+``MemoryConsumer``s on separate client connections share ONE consumer
+group with real rebalances — plus the liveness layer the process fleet
+runs on: heartbeat leases, zombie fencing (a stale-generation commit
+NEVER moves the watermark), and reconnect-with-backoff through
+``resilience.RetryPolicy``.
 """
 
 import pytest
 
 import torchkafka_tpu as tk
-from torchkafka_tpu.errors import CommitFailedError, UnknownTopicError
+from torchkafka_tpu.errors import (
+    BrokerUnavailableError,
+    CommitFailedError,
+    FencedMemberError,
+    UnknownTopicError,
+)
+from torchkafka_tpu.resilience import ManualClock, RetryPolicy
 from torchkafka_tpu.source.records import TopicPartition
 
 
@@ -93,3 +103,224 @@ class TestSharedGroupAcrossConnections:
         assert len(c1.assignment()) == 2  # absorbed
         redelivered = [r for r in c1.poll(max_records=100) if r.partition == tp2.partition]
         assert [r.offset for r in redelivered] == [2, 3]
+
+
+class TestZombieFencing:
+    """The satellite regression ISSUE 10 names: today only the happy
+    rebalance path is asserted — these pin the UNHAPPY one. A member
+    that keeps serving after a rebalance took its partitions (a zombie)
+    must have its commit rejected AND the ledger watermark unaffected."""
+
+    def test_stale_generation_commit_rejected_watermark_unaffected(
+        self, server
+    ):
+        server.broker.create_topic("t", partitions=2)
+        for p in (0, 1):
+            for i in range(4):
+                server.broker.produce("t", bytes([i]), partition=p)
+        c1 = tk.MemoryConsumer(_client(server), "t", group_id="g",
+                               member_id="m0")
+        # m0 consumes its whole assignment, commits nothing yet.
+        polled = c1.poll(max_records=100)
+        assert polled
+        # A second member joins: eager rebalance bumps the generation
+        # underneath m0 (which has NOT synced — the zombie window).
+        with _client(server) as admin:
+            admin.join("g", "m1", frozenset({"t"}))
+            before = {
+                p: admin.committed("g", TopicPartition("t", p))
+                for p in (0, 1)
+            }
+            with pytest.raises(CommitFailedError):
+                # The zombie commit: issued with the pre-rebalance
+                # generation, against offsets it genuinely consumed.
+                admin.commit(
+                    "g", {TopicPartition("t", 0): 4},
+                    member_id="m0", generation=1,
+                )
+            after = {
+                p: admin.committed("g", TopicPartition("t", p))
+                for p in (0, 1)
+            }
+        assert before == after == {0: None, 1: None}, (
+            "a rejected zombie commit must never move the watermark"
+        )
+        c1.close()
+
+    def test_evicted_member_commit_rejected_even_with_current_generation(
+        self, server
+    ):
+        """A fenced member that somehow reads the CURRENT generation
+        still cannot commit: membership, not generation guessing, is
+        the gate."""
+        server.broker.create_topic("t")
+        with _client(server) as c:
+            c.join("g", "m0", frozenset({"t"}))
+            c.join("g", "m1", frozenset({"t"}))
+            c.fence("g", "m0")
+            gen = c.membership("g")["generation"]
+            with pytest.raises(CommitFailedError, match="fenced"):
+                c.commit("g", {TopicPartition("t", 0): 1},
+                         member_id="m0", generation=gen)
+            assert c.committed("g", TopicPartition("t", 0)) is None
+
+
+class TestHeartbeatLeases:
+    """Lease mechanics over the socket, on an injected ManualClock."""
+
+    def _leased_server(self, timeout_s=2.0):
+        mc = ManualClock()
+        broker = tk.InMemoryBroker(
+            session_timeout_s=timeout_s, clock=mc.now
+        )
+        return mc, tk.BrokerServer(broker)
+
+    def test_heartbeat_renews_past_timeout(self):
+        mc, server = self._leased_server()
+        with server, _client(server) as c:
+            c.create_topic("t")
+            c.join("g", "m0", frozenset({"t"}))
+            for _ in range(5):
+                mc.advance(1.5)  # would expire without renewal
+                assert c.heartbeat("g", "m0") == 1
+            assert c.membership("g")["members"] == ["m0"]
+
+    def test_missed_heartbeats_fence_via_peer_traffic(self):
+        """A SIGKILLed (or wedged) member stops renewing; any PEER's
+        heartbeat reaps it — partitions rebalance to survivors with no
+        supervisor in the loop, and the zombie's own calls get
+        FencedMemberError / CommitFailedError across the wire."""
+        mc, server = self._leased_server()
+        with server, _client(server) as c:
+            c.create_topic("t", partitions=2)
+            gen0 = c.join("g", "live", frozenset({"t"}))
+            c.join("g", "zombie", frozenset({"t"}))
+            mc.advance(1.0)
+            c.heartbeat("g", "live")
+            mc.advance(1.5)  # zombie lease (joined at 0, 2s) expires
+            gen = c.heartbeat("g", "live")  # the reaping sweep
+            info = c.membership("g")
+            assert info["members"] == ["live"]
+            assert info["fenced"] == ["zombie"] and info["fence_count"] == 1
+            assert gen > gen0
+            with pytest.raises(FencedMemberError):
+                c.heartbeat("g", "zombie")
+            with pytest.raises(CommitFailedError):
+                c.commit("g", {TopicPartition("t", 0): 1},
+                         member_id="zombie", generation=gen0 + 1)
+            assert c.committed("g", TopicPartition("t", 0)) is None
+
+    def test_slow_member_fenced_on_its_own_commit_not_corrupted(self):
+        """The graceful-degradation clause: a member that is merely SLOW
+        (missed heartbeats, still running) is fenced BY its own commit —
+        a clean CommitFailedError, records re-deliver, watermark
+        untouched. Never merged."""
+        mc, server = self._leased_server()
+        with server, _client(server) as c:
+            c.create_topic("t")
+            gen = c.join("g", "slow", frozenset({"t"}))
+            mc.advance(3.0)  # no reaping traffic: still a member on paper
+            assert c.membership("g")["members"] == ["slow"]
+            assert c.membership("g")["leases"]["slow"] <= 0
+            with pytest.raises(CommitFailedError, match="fenced"):
+                c.commit("g", {TopicPartition("t", 0): 1},
+                         member_id="slow", generation=gen)
+            assert c.committed("g", TopicPartition("t", 0)) is None
+            assert c.membership("g")["members"] == []
+
+    def test_rejoin_after_fencing_is_fresh_membership(self):
+        mc, server = self._leased_server()
+        with server, _client(server) as c:
+            c.create_topic("t")
+            c.join("g", "m0", frozenset({"t"}))
+            mc.advance(3.0)
+            c.fence("g", "m0")
+            assert "m0" in c.membership("g")["fenced"]
+            c.join("g", "m0", frozenset({"t"}))
+            info = c.membership("g")
+            assert info["members"] == ["m0"]
+            assert info["fenced"] == []  # the fenced mark cleared
+            assert c.heartbeat("g", "m0") == info["generation"]
+
+    def test_membership_observes_without_reaping(self):
+        """The supervisor contract: reading membership must NOT race the
+        observer's own fencing response — an expired lease stays visible
+        (negative remaining) until group-mutating traffic acts."""
+        mc, server = self._leased_server()
+        with server, _client(server) as c:
+            c.create_topic("t")
+            c.join("g", "m0", frozenset({"t"}))
+            mc.advance(5.0)
+            for _ in range(3):  # repeated reads change nothing
+                info = c.membership("g")
+                assert info["members"] == ["m0"]
+                assert info["leases"]["m0"] <= 0
+
+
+class TestReconnect:
+    """BrokerClient transport faults are retryable BrokerUnavailableError
+    (the satellite: a socket drop mid-serve used to surface raw), and a
+    RetryPolicy turns them into jittered reconnects."""
+
+    def test_midflight_drop_raises_broker_unavailable(self, server):
+        c = _client(server)
+        c.create_topic("t")
+        server.close()
+        with pytest.raises(BrokerUnavailableError) as ei:
+            c.partitions_for("t")
+        assert ei.value.retryable is True
+
+    def test_closed_server_stops_accepting(self, server):
+        """Regression for the listener-zombie bug this PR found: close()
+        must shutdown() the listening socket, else the accept thread's
+        in-progress syscall keeps the 'closed' server answering — a
+        zombie broker under the fencing tests' feet."""
+        port = server.port
+        server.close()
+        with pytest.raises(BrokerUnavailableError):
+            tk.BrokerClient(server.host, port)
+
+    def test_connect_refused_is_broker_unavailable(self):
+        with pytest.raises(BrokerUnavailableError):
+            tk.BrokerClient("127.0.0.1", 1, timeout_s=1.0)
+
+    def test_reconnect_with_backoff_through_retry_policy(self):
+        """Server dies mid-session and comes back during the backoff
+        window (restarted inside the policy's injected sleep — fully
+        deterministic): the SAME client resumes, same broker state,
+        same group membership."""
+        broker = tk.InMemoryBroker()
+        broker.create_topic("t")
+        s1 = tk.BrokerServer(broker)
+        port = s1.port
+        mc = ManualClock()
+        state = {"server": s1, "restarts": 0}
+
+        def sleep(seconds):
+            mc.sleep(seconds)
+            if state["restarts"] == 0:
+                state["server"] = tk.BrokerServer(broker, port=port)
+                state["restarts"] += 1
+
+        pol = RetryPolicy(max_attempts=5, clock=mc.now, sleep=sleep,
+                          deadline_s=None)
+        c = tk.BrokerClient("127.0.0.1", port, retry=pol)
+        c.join("g", "m0", frozenset({"t"}))
+        s1.close()
+        # The drop is absorbed: one failed attempt, a backoff that
+        # restarts the server, a reconnect — and the call lands with
+        # membership intact.
+        assert c.heartbeat("g", "m0") == 1
+        assert state["restarts"] == 1
+        assert c.membership("g")["members"] == ["m0"]
+        state["server"].close()
+        c.close()
+
+    def test_no_policy_still_translates_but_does_not_retry(self):
+        broker = tk.InMemoryBroker()
+        s = tk.BrokerServer(broker)
+        c = tk.BrokerClient(s.host, s.port)
+        s.close()
+        with pytest.raises(BrokerUnavailableError):
+            c.wait_for_data(0.01)
+        c.close()

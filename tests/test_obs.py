@@ -44,7 +44,8 @@ from torchkafka_tpu.obs import (
 )
 from torchkafka_tpu.obs.burn import BURNING, OK, SHEDDING, WARNING
 from torchkafka_tpu.obs.trace import (
-    BURN_STATE, COMMITTED, FINISHED, POLLED, QOS_ADMITTED, SLOT_ACTIVE,
+    BURN_STATE, COMMITTED, FINISHED, JOURNAL_HANDOFF, POLLED, QOS_ADMITTED,
+    REPLICA_FENCED, REPLICA_JOINED, SLOT_ACTIVE,
 )
 from torchkafka_tpu.resilience import ManualClock
 from torchkafka_tpu.serve import ServeMetrics, StreamingGenerator
@@ -620,6 +621,13 @@ def _fleet_metrics():
     m.lane_wait("interactive").observe(0.004)
     m.replica_occupancy(0).set(0.75)
     m.replica_completions(0).add(5)
+    # ISSUE-10 liveness families: joins / fences counters and the
+    # per-member lease-age gauge (member ids are operator-chosen strings
+    # — hostile ones must escape like tenant keys do).
+    m.replica_joins.add(3)
+    m.replica_fences.add(1)
+    m.member_lease_age("r0i0").set(0.4)
+    m.member_lease_age(EVIL_TENANT).set(1.25)
     return m.render_prometheus(replicas=None)
 
 
@@ -713,6 +721,71 @@ def test_exposition_conformance(render):
     backslashes, newlines) can't break a scrape."""
     text = render()
     _assert_conformant(text)
+
+
+def test_membership_events_ride_the_trace_stream():
+    """ISSUE-10 membership observability: replica_joined /
+    replica_fenced / journal_handoff are typed events on the SAME
+    stream as record lifecycles (topic "fleet", sequential offsets),
+    deterministic under a manual clock, with the fencing reason and
+    lease age in the attrs — and they open no record lifecycle."""
+    mc = ManualClock()
+    tr = RecordTracer(ObsConfig(clock=mc.now))
+    tr.replica_joined("r0i0", replica=0)
+    mc.advance(1.0)
+    tr.replica_fenced("r0i0", reason="lease_expired", lease_age_s=2.5,
+                      replica=0)
+    tr.journal_handoff("r0i0", entries=3, replica=0)
+    evs = list(tr.events)
+    assert [e.stage for e in evs] == [
+        REPLICA_JOINED, REPLICA_FENCED, JOURNAL_HANDOFF,
+    ]
+    assert [e.key for e in evs] == [("fleet", 0, 0), ("fleet", 0, 1),
+                                    ("fleet", 0, 2)]
+    fenced = dict(evs[1].attrs)
+    assert fenced["reason"] == "lease_expired"
+    assert fenced["lease_age_s"] == 2.5
+    assert dict(evs[2].attrs)["entries"] == 3
+    assert tr.summary()["open_records"] == 0
+    # Same-seed determinism: a replay emits identical signatures.
+    tr2 = RecordTracer(ObsConfig(clock=ManualClock().now))
+    tr2.replica_joined("r0i0", replica=0)
+    tr2.replica_fenced("r0i0", reason="lease_expired", lease_age_s=2.5,
+                       replica=0)
+    tr2.journal_handoff("r0i0", entries=3, replica=0)
+    assert tr2.signature() == tr.signature()
+
+
+def test_in_process_fleet_emits_membership_events(tmp_path):
+    """A traced ServingFleet narrates its own membership: joins at
+    construction, a fence + journal handoff on kill_replica — and the
+    liveness counters ride FleetMetrics.summary()."""
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=1, n_heads=2, n_kv_heads=1,
+        d_ff=64, max_seq_len=12, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.key(0), cfg)
+    broker = tk.InMemoryBroker()
+    broker.create_topic("t", partitions=2)
+    rng = np.random.default_rng(0)
+    for i in range(4):
+        broker.produce("t", rng.integers(0, 64, 4, np.int32).tobytes(),
+                       partition=i % 2)
+    fleet = ServingFleet(
+        lambda rid: tk.MemoryConsumer(broker, "t", group_id="g"),
+        params, cfg, replicas=2, prompt_len=4, max_new=4, slots=2,
+        journal_dir=tmp_path, journal_cadence=1, obs=True,
+    )
+    stages = [e.stage for e in fleet.tracer.events]
+    assert stages.count(REPLICA_JOINED) == 2
+    served = fleet.serve_all(max_records=2, idle_timeout_ms=500)
+    assert served
+    fleet.kill_replica(0)
+    stages = [e.stage for e in fleet.tracer.events]
+    assert stages.count(REPLICA_FENCED) == 1
+    mem = fleet.metrics.summary(fleet.replicas)["membership"]
+    assert mem["joins"] == 2 and mem["fences"] == 1
+    fleet.close()
 
 
 def test_exposition_label_escaping_roundtrip():

@@ -191,11 +191,16 @@ class TestStreamingGenerator:
         consumer.close()
 
     def test_commit_failure_survivable(self, model, caplog):
-        """A rebalance mid-serving (second consumer joins the group) makes
-        the next commit raise CommitFailedError — the server must log and
-        continue, not die: uncommitted prompts simply re-deliver
-        (the reference's contract, kafka_dataset.py:131-135)."""
+        """A CommitFailedError during flush (a commit racing a rebalance
+        the client has not yet synced — injected here, since _commit now
+        pre-syncs the group and drops departed partitions, the Kafka-
+        client discipline that closes the deterministic window this test
+        once rode) must be logged and survived, not die: uncommitted
+        prompts simply re-deliver (the reference's contract,
+        kafka_dataset.py:131-135)."""
         import logging
+
+        from torchkafka_tpu.errors import CommitFailedError
 
         caplog.set_level(logging.ERROR, logger="torchkafka_tpu.serve")
         cfg, params = model
@@ -206,26 +211,41 @@ class TestStreamingGenerator:
             broker.produce(
                 "p", rng.integers(0, VOCAB, P, dtype=np.int32).tobytes()
             )
-        c1 = tk.MemoryConsumer(broker, "p", group_id="gr")
+
+        class _RaceyConsumer(tk.MemoryConsumer):
+            """First commit races a rebalance: the broker rejects it
+            after the sync (exactly what a coordinator that finished a
+            rebalance mid-RPC does); later commits land."""
+
+            fail_next = True
+
+            def commit(self, offsets=None):
+                if _RaceyConsumer.fail_next:
+                    _RaceyConsumer.fail_next = False
+                    raise CommitFailedError(
+                        "generation bumped mid-commit (injected race)"
+                    )
+                return super().commit(offsets)
+
+        c1 = _RaceyConsumer(broker, "p", group_id="gr")
         server = StreamingGenerator(
             c1, params, cfg, slots=2, prompt_len=P, max_new=MAX_NEW,
             commit_every=1,
         )
-        outs = []
-        c2 = None
-        for rec, _toks in server.run(max_records=4, idle_timeout_ms=500):
-            outs.append((rec.partition, rec.offset))
-            if c2 is None:
-                # Join the group mid-serving: bumps the generation, so the
-                # server's next commit (stale generation) must fail.
-                c2 = tk.MemoryConsumer(broker, "p", group_id="gr")
-        assert len(outs) >= 2  # served past the failed commit without dying
+        outs = [
+            (rec.partition, rec.offset)
+            for rec, _toks in server.run(max_records=4, idle_timeout_ms=500)
+        ]
+        assert len(outs) == 4  # served past the failed commit without dying
+        assert not _RaceyConsumer.fail_next, "the injected race never fired"
         assert any(
             "commit failed" in r.message for r in caplog.records
-        ), "rebalance never failed a commit: test is vacuous"
+        ), "the failed commit was never logged"
+        assert server.metrics.commit_failures.count == 1
+        # The retry discipline healed the watermark: the final flush
+        # covered everything (nothing would re-deliver on restart).
+        assert c1.committed(tk.TopicPartition("p", 0)) == 4
         c1.close()
-        if c2 is not None:
-            c2.close()
 
     def test_tp_sharded_params(self, model):
         """Serving with tensor-parallel-sharded params: the server's jitted

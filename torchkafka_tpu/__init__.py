@@ -24,6 +24,8 @@ from torchkafka_tpu.errors import (
     BrokerUnavailableError,
     CommitFailedError,
     ConsumerClosedError,
+    FencedMemberError,
+    JournalLockedError,
     OutputDeliveryError,
     PoisonRecordError,
     ProducerClosedError,
@@ -85,7 +87,7 @@ from torchkafka_tpu.transform import (
     raw_bytes,
 )
 
-__version__ = "0.12.0"
+__version__ = "0.13.0"
 
 __all__ = [
     "BarrierError",
@@ -101,7 +103,9 @@ __all__ = [
     "Consumer",
     "ConsumerClosedError",
     "DecodeJournal",
+    "FencedMemberError",
     "JournalEntry",
+    "JournalLockedError",
     "BrokerClient",
     "BrokerServer",
     "InMemoryBroker",

@@ -83,6 +83,27 @@ class BrokerUnavailableError(TpuKafkaError):
     retryable = True
 
 
+class FencedMemberError(TpuKafkaError):
+    """This group member has been FENCED: its heartbeat lease expired (or
+    a supervisor fenced it explicitly) and the broker evicted it from the
+    group. TERMINAL for the member: the rebalance already bumped the
+    generation and handed its partitions to survivors, so nothing it does
+    with its old identity can be honored — commits fail generation-checked
+    (``CommitFailedError``), heartbeats raise this. The only valid
+    responses are to re-join as a fresh member or to exit and let a
+    supervisor respawn. Kafka's UNKNOWN_MEMBER_ID, with the lease made
+    explicit."""
+
+
+class JournalLockedError(TpuKafkaError):
+    """A decode journal file is exclusively owned by another LIVE process.
+    Journal files are single-writer (one replica incarnation each);
+    two live processes writing one file would interleave tmp-renames and
+    corrupt the warm-failover state. A lock held by a dead process (or by
+    this same process) is stale and silently stolen — SIGKILL leaves no
+    chance to clean up."""
+
+
 class PoisonRecordError(TpuKafkaError):
     """A record's *payload* cannot be processed (undecodable bytes,
     schema violation, a processor crash specific to this record).

@@ -1,9 +1,13 @@
 """Serving fleet: partitioned multi-replica serving with QoS admission,
 replica failover, and graceful drain (see fleet/fleet.py for the design).
+``ProcessFleet`` (fleet/supervisor.py) is the REAL-PROCESS deployment of
+the same group: one OS process per replica over the socket broker, with
+heartbeat leases, zombie fencing, and cross-process warm failover.
 """
 
 from torchkafka_tpu.fleet.fleet import ReplicaChaos, ServingFleet
 from torchkafka_tpu.fleet.metrics import FleetMetrics
+from torchkafka_tpu.fleet.supervisor import ProcessFleet, sweep_expired
 from torchkafka_tpu.fleet.qos import (
     BATCH,
     INTERACTIVE,
@@ -21,10 +25,12 @@ __all__ = [
     "BATCH",
     "FleetMetrics",
     "INTERACTIVE",
+    "ProcessFleet",
     "QoSConfig",
     "Replica",
     "ReplicaChaos",
     "ServingFleet",
+    "sweep_expired",
     "TenantBuckets",
     "TokenBucket",
     "default_lane",

@@ -299,6 +299,9 @@ class ServingFleet:
                 commit_every=commit_every,
                 max_poll_records=max_poll_records, clock=clock,
             ))
+            self.metrics.replica_joins.add(1)
+            if self.tracer is not None:
+                self.tracer.replica_joined(f"replica-{rid}", replica=rid)
         self._draining = False
         self._drain_timeout_s = drain_timeout_s
         self._drain_started: float | None = None
@@ -362,6 +365,11 @@ class ServingFleet:
         copies on the other survivors sit harmlessly."""
         self.replicas[rid].kill()
         self.metrics.replica_deaths.add(1)
+        self.metrics.replica_fences.add(1)
+        if self.tracer is not None:
+            self.tracer.replica_fenced(
+                f"replica-{rid}", reason="kill", replica=rid,
+            )
         self._install_journal_hints(rid)
 
     def _install_journal_hints(self, rid: int) -> None:
@@ -375,6 +383,10 @@ class ServingFleet:
         for rep in survivors:
             rep.gen.add_resume_hints(hints)
         self.metrics.journal_handoffs.add(len(hints))
+        if self.tracer is not None:
+            self.tracer.journal_handoff(
+                f"replica-{rid}", len(hints), replica=rid,
+            )
         _logger.info(
             "replica %d death: %d journal entries handed to %d "
             "survivor(s) for warm resume", rid, len(hints), len(survivors),

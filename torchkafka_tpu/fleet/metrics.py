@@ -38,6 +38,14 @@ class FleetMetrics:
         self.drain_timeout_kills = RateMeter()  # replicas killed for
         # overrunning the drain timeout (journal synced first, so the next
         # incarnation resumes warm)
+        self.replica_joins = RateMeter()  # members that joined the group
+        # (initial spawn, respawn after fencing, scale-up)
+        self.replica_fences = RateMeter()  # members evicted involuntarily:
+        # lease expiry (real process death or a zombie too slow to renew),
+        # kill, or drain-timeout escalation
+        self._member_lease_age: dict[str, Gauge] = {}  # seconds since the
+        # member's last successful lease renewal (age = session timeout
+        # minus observed remaining; 0 right after a heartbeat)
         self._tenant_admitted: dict[str, RateMeter] = {}
         self._tenant_throttled: dict[str, RateMeter] = {}
         self._tenant_deferred: dict[str, RateMeter] = {}  # burn-rate
@@ -85,6 +93,9 @@ class FleetMetrics:
 
     def replica_completions(self, rid: int) -> RateMeter:
         return self._replica_completions.setdefault(rid, RateMeter())
+
+    def member_lease_age(self, member: str) -> Gauge:
+        return self._member_lease_age.setdefault(member, Gauge())
 
     # ----------------------------------------------------------- reporting
 
@@ -159,7 +170,16 @@ class FleetMetrics:
             ),
             "output_capped": sum(m.output_capped.count for m in gens),
         }
+        membership = {
+            "joins": self.replica_joins.count,
+            "fences": self.replica_fences.count,
+            "lease_age_s": {
+                m: round(g.value, 3)
+                for m, g in sorted(self._member_lease_age.items())
+            },
+        }
         return {
+            "membership": membership,
             "slo": self._slo.summary() if self._slo is not None else None,
             "burn": (
                 self._burn.summary() if self._burn is not None else None
@@ -234,6 +254,12 @@ class FleetMetrics:
             ("backpressure_resumes_total", "counter", s["backpressure_resumes"]),
             ("replica_deaths_total", "counter", s["replica_deaths"]),
             ("replica_drains_total", "counter", s["drains"]),
+            ("replica_joins_total", "counter", s["membership"]["joins"]),
+            ("replica_fences_total", "counter", s["membership"]["fences"]),
+            ("member_lease_age_seconds", "gauge", [
+                (format_labels(member=m), age)
+                for m, age in s["membership"]["lease_age_s"].items()
+            ] or 0),
             ("journal_handoffs_total", "counter", s["journal"]["handoffs"]),
             ("drain_timeout_kills_total", "counter",
              s["journal"]["drain_timeout_kills"]),

@@ -1,0 +1,363 @@
+"""One serving replica as a real OS process: the process fleet's worker.
+
+``run_replica_worker(spec)`` is a complete replica incarnation — its own
+``BrokerClient`` over the supervisor's socket broker, its own jit state
+(params rebuilt deterministically from the spec's model seed, so every
+process decodes identically), its own on-disk ``DecodeJournal`` — driven
+by the same ``Replica`` pump the in-process fleet uses, plus the three
+things only a real process needs:
+
+- **heartbeat leases**: every ``heartbeat_interval_s`` the worker renews
+  its broker-side lease (``MemoryConsumer.heartbeat``, crash point
+  ``heartbeat_pre_send``). A worker that dies — or stalls past the
+  session timeout — is FENCED: evicted with a rebalance, its partitions
+  re-delivered to survivors, its stale-generation commits rejected. A
+  fenced worker learns its fate from ``FencedMemberError`` and exits
+  ``EXIT_FENCED`` so a supervisor can respawn a fresh incarnation.
+- **cross-process warm failover**: at startup and on every observed
+  assignment change (a rebalance means someone died or scaled), the
+  worker rescans the shared ``journal_dir`` (``DecodeJournal.scan_dir``,
+  crash point ``journal_handoff_pre_load``) and installs every peer
+  journal's live entries as warm-resume hints — the victim's in-flight
+  generations resume on the survivor byte-identical, bounded re-decode.
+- **reconnect-with-backoff**: the ``BrokerClient`` runs behind a
+  ``resilience.RetryPolicy``, so a socket drop mid-serve is a retryable
+  ``BrokerUnavailableError`` absorbed by jittered reconnects — an outage
+  longer than the session timeout still ends in a clean fencing, never
+  corruption.
+
+Runnable as ``python -m torchkafka_tpu.fleet.proc <spec.json>`` (the
+supervisor writes the spec); importable so the crash matrix and tests can
+run the SAME incarnation logic in-process as the recovery run.
+
+Outputs are produced to ``spec["out_topic"]`` keyed by the prompt
+record's key, with a ``member`` header naming the serving incarnation —
+so a supervisor (or a test) can attribute every completion, count
+duplicates, and pick a mid-generation victim without reaching into the
+worker's memory.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+EXIT_CLEAN = 0
+EXIT_FENCED = 3  # this incarnation was fenced; respawn a fresh member
+
+
+class _HeartbeatSender(threading.Thread):
+    """The lease keeper, on its own thread — Kafka's own split between
+    session liveness (the background heartbeat) and processing liveness
+    (max.poll.interval): a replica mid-jit-warmup or mid-tick on a
+    contended core is SLOW, not DEAD, and must not fence itself. The
+    thread renews every ``interval_s``; the serving loop only reads
+    ``fenced`` at its own safe points. Transport faults ride the
+    client's retry policy; an outage that outlives the session timeout
+    ends in FencedMemberError here — observed, flagged, thread exits."""
+
+    def __init__(self, consumer, interval_s: float) -> None:
+        super().__init__(name="replica-heartbeat", daemon=True)
+        self._consumer = consumer
+        self._interval_s = interval_s
+        self._stop = threading.Event()
+        self.fenced = False
+        self.error: BaseException | None = None
+
+    def run(self) -> None:
+        from torchkafka_tpu.errors import FencedMemberError
+
+        while not self._stop.is_set():
+            try:
+                self._consumer.heartbeat()
+            except FencedMemberError:
+                self.fenced = True
+                return
+            except Exception as exc:  # noqa: BLE001 - flagged, loop decides
+                # Retries exhausted (long outage) or a teardown race: the
+                # serving loop surfaces it at its next safe point.
+                self.error = exc
+                return
+            self._stop.wait(self._interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def build_model(model_spec: dict):
+    """Deterministic params from the spec — every process that holds the
+    same model spec decodes identically (greedy) or samples identically
+    (the per-record key schedule folds from record identity)."""
+    import jax
+    import jax.numpy as jnp
+
+    from torchkafka_tpu.models.transformer import (
+        TransformerConfig,
+        init_params,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=int(model_spec["vocab_size"]),
+        d_model=int(model_spec["d_model"]),
+        n_layers=int(model_spec["n_layers"]),
+        n_heads=int(model_spec["n_heads"]),
+        n_kv_heads=int(model_spec["n_kv_heads"]),
+        d_ff=int(model_spec["d_ff"]),
+        max_seq_len=int(model_spec["max_seq_len"]),
+        dtype=jnp.float32,
+    )
+    params = init_params(jax.random.key(int(model_spec.get("seed", 0))), cfg)
+    return cfg, params
+
+
+class _TaggingProducer:
+    """Wrap a producer so every output record carries a ``member`` header
+    naming this incarnation — the supervisor's attribution handle."""
+
+    def __init__(self, inner, member: str) -> None:
+        self._inner = inner
+        self._member = member.encode()
+
+    def send(self, topic, value, *, key=None, partition=None,
+             timestamp_ms=None, headers=()):
+        return self._inner.send(
+            topic, value, key=key, partition=partition,
+            timestamp_ms=timestamp_ms,
+            headers=tuple(headers) + (("member", self._member),),
+        )
+
+    def flush(self, timeout_s=None):
+        return self._inner.flush(timeout_s)
+
+    def close(self):
+        return self._inner.close()
+
+
+def _dump_metrics(spec: dict, gen, fleet_metrics, exit_code: int) -> None:
+    path = spec.get("metrics_path")
+    if not path:
+        return
+    m = gen.metrics
+    doc = {
+        "member": spec["member_id"],
+        "exit": exit_code,
+        "decoded_tokens": m.decoded_tokens.count,
+        "warm_resumes": m.warm_resumes.count,
+        "tokens_restored": m.journal_tokens_restored.count,
+        "served_from_journal": m.journal_served.count,
+        "resume_rejected": m.resume_rejected.count,
+        "completions": fleet_metrics.completions.count,
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+
+
+def run_replica_worker(spec: dict, broker=None, shutdown=None) -> int:
+    """One replica incarnation over ``broker`` (a ``BrokerClient`` built
+    from the spec when None — the subprocess path; pass an
+    ``InMemoryBroker`` directly for in-process recovery runs). Returns
+    the process exit code: ``EXIT_CLEAN`` after a drain (idle-exit or
+    SIGTERM via ``shutdown``), ``EXIT_FENCED`` when the broker evicted
+    this member."""
+    from torchkafka_tpu.errors import FencedMemberError
+    from torchkafka_tpu.fleet.metrics import FleetMetrics
+    from torchkafka_tpu.fleet.qos import AdmissionQueue, QoSConfig, TenantBuckets
+    from torchkafka_tpu.fleet.replica import Replica, SERVING
+    from torchkafka_tpu.journal import DecodeJournal
+    from torchkafka_tpu.serve import StreamingGenerator
+    from torchkafka_tpu.source.memory import MemoryConsumer
+    from torchkafka_tpu.source.producer import MemoryProducer
+
+    own_client = broker is None
+    if own_client:
+        from torchkafka_tpu.resilience import RetryPolicy
+        from torchkafka_tpu.source.netbroker import BrokerClient
+
+        b = spec["broker"]
+        broker = BrokerClient(
+            b["host"], int(b["port"]),
+            timeout_s=float(spec.get("connect_timeout_s", 30.0)),
+            retry=RetryPolicy(
+                max_attempts=int(spec.get("reconnect_attempts", 6)),
+                base_delay_s=0.05, max_delay_s=1.0,
+                deadline_s=float(spec.get("reconnect_deadline_s", 15.0)),
+            ),
+        )
+
+    member = spec["member_id"]
+    jdir = spec["journal_dir"]
+    jpath = os.path.join(jdir, f"{member}.json")
+    consumer = None
+    gen = None
+    journal = None
+    hb = None
+    metrics = FleetMetrics()
+    exit_code = EXIT_CLEAN
+    try:
+        # Model first (slow: jax import + init): the lease clock must not
+        # run against compile time we have not even joined for yet.
+        cfg, params = build_model(spec["model"])
+        import jax
+
+        consumer = MemoryConsumer(
+            broker, spec["topic"], group_id=spec["group"], member_id=member,
+        )
+        hb_interval = spec.get("heartbeat_interval_s", 0.25)
+        # "thread" (default, Kafka's own split: session liveness on a
+        # background sender, so warmup/tick stalls are SLOW, not dead) or
+        # "loop" (renew once per pump — deterministic arrival counts, the
+        # crash matrix's mode; pair it with a generous session timeout).
+        hb_mode = spec.get("heartbeat_mode", "thread")
+        if hb_interval is not None and hb_mode == "thread":
+            hb = _HeartbeatSender(consumer, float(hb_interval))
+            hb.start()
+        producer = _TaggingProducer(MemoryProducer(broker), member)
+        journal = DecodeJournal(
+            jpath, cadence=int(spec.get("journal_cadence", 4)),
+        )
+
+        gen = StreamingGenerator(
+            consumer, params, cfg,
+            slots=int(spec.get("slots", 2)),
+            prompt_len=int(spec["prompt_len"]),
+            max_new=int(spec["max_new"]),
+            eos_id=spec.get("eos_id"),
+            # The worker loop owns the cadence (commit-follows-completion
+            # via Replica.maybe_flush); the generator never self-commits.
+            commit_every=2**31 - 1,
+            ticks_per_sync=int(spec.get("ticks_per_sync", 1)),
+            max_poll_records=int(spec.get("max_poll_records", 64)),
+            temperature=float(spec.get("temperature", 0.0)),
+            top_k=spec.get("top_k"),
+            top_p=spec.get("top_p"),
+            rng=jax.random.key(int(spec.get("sampling_seed", 0))),
+            output_producer=producer,
+            output_topic=spec["out_topic"],
+            journal=journal,
+        )
+        # Cross-process warm failover, incarnation-start edition: every
+        # journal a previous incarnation (own or peer) left in the shared
+        # dir becomes a resume hint — CRC-gated at apply, so stale or
+        # already-served entries sit harmlessly.
+        hints = DecodeJournal.scan_dir(jdir, exclude=(jpath,))
+        if hints:
+            gen.add_resume_hints(hints)
+        gen.warmup()
+        if spec.get("ready_topic"):
+            # Readiness marker: lets a supervisor (or a paired bench)
+            # exclude per-process jit warmup from the measured window.
+            MemoryProducer(broker).send(
+                spec["ready_topic"], member.encode()
+            )
+        qos = QoSConfig()
+        queue = AdmissionQueue(qos, TenantBuckets(qos), metrics)
+        rep = Replica(
+            int(spec.get("replica_index", 0)), gen, consumer, queue, qos,
+            metrics,
+            commit_every=int(spec.get("commit_every", 8)),
+            max_poll_records=int(spec.get("max_poll_records", 64)),
+        )
+
+        idle_exit_ms = spec.get("idle_exit_ms")
+        last_assign: frozenset = frozenset()
+        idle_since: float | None = None
+        while True:
+            now = time.monotonic()
+            if shutdown is not None and shutdown.requested:
+                if rep.state == SERVING:
+                    rep.start_drain()
+            if hb is not None and hb.fenced:
+                # The broker already gave our partitions away: stop at
+                # this safe point — serving on would be zombie work whose
+                # commits are all doomed (and whose outputs survivors are
+                # already regenerating byte-identically).
+                raise FencedMemberError(
+                    f"member {member!r} fenced (observed by heartbeat)"
+                )
+            if hb is not None and hb.error is not None:
+                raise hb.error
+            if hb is None and hb_interval is not None:
+                consumer.heartbeat()  # loop mode: one renewal per pump
+            assigned = frozenset(consumer.assignment())
+            if assigned != last_assign:
+                if assigned - last_assign:
+                    # Gained partitions: a peer died or the fleet
+                    # rescaled. Its journal, read FROM DISK across the
+                    # process boundary, is the warm-failover handoff.
+                    fresh = DecodeJournal.scan_dir(jdir, exclude=(jpath,))
+                    if fresh:
+                        gen.add_resume_hints(fresh)
+                last_assign = assigned
+            completions = rep.pump()
+            rep.maybe_flush()
+            if rep.drain_idle:
+                rep.finish_drain()
+                return EXIT_CLEAN
+            if completions or gen.has_active() or queue.depth():
+                idle_since = None
+            elif rep.state == SERVING:
+                if idle_since is None:
+                    idle_since = now
+                elif (
+                    idle_exit_ms is not None
+                    and (now - idle_since) * 1e3 >= idle_exit_ms
+                ):
+                    rep.start_drain()
+                    continue
+                time.sleep(0.002)
+    except FencedMemberError:
+        exit_code = EXIT_FENCED
+        # Best-effort journal flush: we are a zombie for the GROUP, but
+        # our disk state is still the freshest record of the in-flight
+        # work survivors are about to redo — a current journal shrinks
+        # their re-decode (CRC/identity gating keeps stale entries inert).
+        try:
+            if gen is not None:
+                gen.sync_journal()
+        except Exception:  # noqa: BLE001 - fenced exit must not mask
+            pass
+        return EXIT_FENCED
+    finally:
+        if hb is not None:
+            hb.stop()
+        if gen is not None:
+            _dump_metrics(spec, gen, metrics, exit_code)
+        if journal is not None:
+            try:
+                journal.close()  # flush + release the single-writer lock
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+        if consumer is not None:
+            try:
+                consumer.close()
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+        if own_client:
+            try:
+                broker.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def main(argv: list[str]) -> int:
+    spec_path = argv[1]
+    with open(spec_path, encoding="utf-8") as f:
+        spec = json.load(f)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from torchkafka_tpu.resilience.crashpoint import arm_from_env
+    from torchkafka_tpu.utils.shutdown import ShutdownSignal
+
+    arm_from_env()
+    with ShutdownSignal() as stop:
+        return run_replica_worker(spec, shutdown=stop)
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -1,0 +1,572 @@
+"""The process-fleet supervisor: real OS-process replicas over the socket
+broker, with heartbeat leases, zombie fencing, and warm failover.
+
+``ProcessFleet`` is the serving analog of the elastic multi-process
+consumer-group tier the ingest path already has (tests/test_pod.py over
+``BrokerServer``): it hosts an ``InMemoryBroker`` with a session timeout
+behind a ``BrokerServer`` socket, spawns each replica as a REAL process
+(``python -m torchkafka_tpu.fleet.proc`` — its own ``BrokerClient``, its
+own jit state, its own on-disk ``DecodeJournal``), and supervises
+liveness through the broker's heartbeat leases:
+
+- a replica that dies (SIGKILL, OOM, crash) stops renewing its lease;
+  the supervisor's sweep — or any survivor's heartbeat — FENCES it:
+  eviction + rebalance, so its partitions re-deliver to survivors and
+  every commit it might still issue carries a dead generation and is
+  rejected (the zombie can stall, never corrupt);
+- the victim's journal is read FROM DISK across the process boundary
+  (survivors rescan the shared journal dir on every rebalance —
+  ``DecodeJournal.scan_dir``), so its in-flight prompts resume warm and
+  byte-identical instead of re-decoding from token 0;
+- ``respawn=True`` keeps the fleet at its target size: a fenced member
+  is replaced by a FRESH incarnation (new member id, new journal file)
+  that also scans the shared dir at startup — a replacement is a
+  survivor too;
+- ``scale(n)`` is elastic membership mid-serve: scale-up spawns joiners
+  (the rebalance hands them partitions), scale-down SIGTERMs the newest
+  incarnations, which drain cooperatively — finish in-flight work,
+  commit, leave — so a scale-down loses nothing and (with per-partition
+  FIFO admission) replays nothing.
+
+The supervisor is deliberately OUTSIDE the data path: prompts flow
+broker → worker → output topic; the supervisor only watches membership,
+fences, respawns, and narrates (``FleetMetrics`` counters + optional
+``RecordTracer`` membership events: ``replica_joined`` /
+``replica_fenced`` / ``journal_handoff``). Everything it knows, it knows
+from the broker and the filesystem — exactly what a survivor of ITS
+death would know.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass
+from typing import Callable
+
+import numpy as np
+
+from torchkafka_tpu.journal import DecodeJournal
+from torchkafka_tpu.resilience.crashpoint import crash_hook
+from torchkafka_tpu.source.records import TopicPartition
+
+_logger = logging.getLogger(__name__)
+
+
+def sweep_expired(broker, group: str, on_fence=None) -> list[str]:
+    """Fence every member of ``group`` whose lease has expired. The
+    supervisor's liveness sweep, importable so any process holding a
+    broker surface (object or ``BrokerClient``) can run it. Observation
+    and action are deliberately split — ``membership`` reaps nothing —
+    and the ``lease_expired_pre_fence`` crash point sits exactly in the
+    gap: a sweeper that dies there leaves the zombie a member, yet the
+    zombie's own next commit still self-fences (commit-time reap), so
+    the watermark is safe either way. Returns the fenced member ids."""
+    info = broker.membership(group)
+    fenced = []
+    for member, remaining in info["leases"].items():
+        if remaining is not None and remaining <= 0:
+            crash_hook("lease_expired_pre_fence")
+            broker.fence(group, member)
+            fenced.append(member)
+            if on_fence is not None:
+                on_fence(member, -remaining)
+    return fenced
+
+
+LIVE = "live"
+DRAINING = "draining"
+ZOMBIE = "zombie"  # fenced by the broker; process may still be running
+DEAD = "dead"  # involuntary end (SIGKILL, crash, fenced exit)
+DONE = "done"  # voluntary clean exit (drain)
+
+
+@dataclass
+class _Incarnation:
+    idx: int
+    member: str
+    proc: subprocess.Popen | None
+    spec_path: str
+    journal_path: str
+    log_path: str
+    metrics_path: str
+    state: str = LIVE
+    seen_in_group: bool = False
+    exit_code: int | None = None
+    fence_reason: str | None = None
+    handoff_entries: int = 0
+
+    @property
+    def running(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+
+class ProcessFleet:
+    """Spawn and supervise R real-process serving replicas.
+
+    ``model``: the JSON-serializable model spec ``fleet.proc.build_model``
+    consumes (seed + TransformerConfig fields) — every worker rebuilds
+    identical params from it. ``broker``: pass an existing
+    ``InMemoryBroker`` (it must have been built with
+    ``session_timeout_s``) or let the fleet build one. Topics must exist
+    before ``start()`` unless created here via ``partitions``.
+    """
+
+    def __init__(
+        self,
+        model: dict,
+        *,
+        topic: str,
+        prompt_len: int,
+        max_new: int,
+        workdir: str | os.PathLike,
+        replicas: int = 2,
+        out_topic: str = "fleet-out",
+        ready_topic: str | None = "fleet-ready",
+        group: str = "pfleet",
+        partitions: int | None = 4,
+        slots: int = 2,
+        commit_every: int = 8,
+        journal_cadence: int = 4,
+        session_timeout_s: float = 2.0,
+        heartbeat_interval_s: float = 0.2,
+        temperature: float = 0.0,
+        top_k: int | None = None,
+        top_p: float | None = None,
+        sampling_seed: int = 0,
+        eos_id: int | None = None,
+        idle_exit_ms: int | None = None,
+        ticks_per_sync: int = 1,
+        respawn: bool = True,
+        journal: bool = True,
+        broker=None,
+        metrics=None,
+        tracer=None,
+    ) -> None:
+        from torchkafka_tpu.fleet.metrics import FleetMetrics
+        from torchkafka_tpu.source.memory import InMemoryBroker
+        from torchkafka_tpu.source.netbroker import BrokerServer
+
+        self.workdir = os.fspath(workdir)
+        os.makedirs(self.workdir, exist_ok=True)
+        self.journal_dir = os.path.join(self.workdir, "journals")
+        os.makedirs(self.journal_dir, exist_ok=True)
+        self.group = group
+        self.topic = topic
+        self.out_topic = out_topic
+        self.ready_topic = ready_topic
+        self.session_timeout_s = session_timeout_s
+        self.respawn = respawn
+        self._journal_on = journal
+        self.broker = broker if broker is not None else InMemoryBroker(
+            session_timeout_s=session_timeout_s
+        )
+        for t, p in ((topic, partitions), (out_topic, 1),
+                     (ready_topic, 1)):
+            if t is None or p is None:
+                continue
+            try:
+                self.broker.create_topic(t, partitions=p)
+            except ValueError:
+                pass  # caller already created (and maybe filled) it
+        self.server = BrokerServer(self.broker)
+        self.metrics = metrics if metrics is not None else FleetMetrics()
+        self.tracer = tracer
+        self._target = replicas
+        self._seq = 0
+        self._spec_base = {
+            "broker": {"host": self.server.host, "port": self.server.port},
+            "topic": topic,
+            "group": group,
+            "out_topic": out_topic,
+            "ready_topic": ready_topic,
+            "journal_dir": self.journal_dir,
+            "journal_cadence": journal_cadence,
+            "model": dict(model),
+            "prompt_len": prompt_len,
+            "max_new": max_new,
+            "slots": slots,
+            "commit_every": commit_every,
+            "ticks_per_sync": ticks_per_sync,
+            "temperature": temperature,
+            "top_k": top_k,
+            "top_p": top_p,
+            "sampling_seed": sampling_seed,
+            "eos_id": eos_id,
+            "heartbeat_interval_s": heartbeat_interval_s,
+            "idle_exit_ms": idle_exit_ms,
+        }
+        self.incarnations: list[_Incarnation] = []
+        self.victims: list[dict] = []  # kill_replica forensics
+
+    # ------------------------------------------------------------ spawning
+
+    def _spawn(self, idx: int) -> _Incarnation:
+        # Member ids sort by replica INDEX first (r0i* < r1i* < ...), and
+        # the broker range-assigns over sorted member ids — so a
+        # respawned incarnation slots into its predecessor's position and
+        # inherits the same partition range. That bias is what makes the
+        # victim's journal (and its radix prefix locality) land where the
+        # redelivered prompts do.
+        member = f"r{idx:03d}i{self._seq:03d}"  # zero-padded: lexicographic
+        self._seq += 1                          # order == numeric order
+        spec = dict(self._spec_base)
+        spec["member_id"] = member
+        spec["replica_index"] = idx
+        spec["metrics_path"] = os.path.join(
+            self.workdir, f"{member}.metrics.json"
+        )
+        if not self._journal_on:
+            # Journals off (cold-failover baseline for the bench): point
+            # each worker at a private throwaway dir so nothing is
+            # written where survivors scan.
+            spec["journal_dir"] = os.path.join(
+                self.workdir, "no-journals", member
+            )
+        spec_path = os.path.join(self.workdir, f"{member}.spec.json")
+        with open(spec_path, "w", encoding="utf-8") as f:
+            json.dump(spec, f)
+        log_path = os.path.join(self.workdir, f"{member}.log")
+        env = dict(os.environ)
+        # Children configure jax themselves (CPU); scrub anything that
+        # could force a tunneled TPU platform into the worker.
+        env.pop("JAX_PLATFORMS", None)
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__import__("torchkafka_tpu").__file__)
+        ))
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        log = open(log_path, "wb")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "torchkafka_tpu.fleet.proc", spec_path],
+            env=env, stdout=log, stderr=subprocess.STDOUT,
+        )
+        log.close()  # the child holds its own fd
+        inc = _Incarnation(
+            idx=idx, member=member, proc=proc, spec_path=spec_path,
+            journal_path=os.path.join(spec["journal_dir"], f"{member}.json"),
+            log_path=log_path,
+            metrics_path=spec["metrics_path"],
+        )
+        self.incarnations.append(inc)
+        self.metrics.replica_joins.add(1)
+        if self.tracer is not None:
+            self.tracer.replica_joined(member, replica=idx)
+        return inc
+
+    def start(self) -> "ProcessFleet":
+        for idx in range(self._target):
+            self._spawn(idx)
+        return self
+
+    def wait_ready(self, timeout_s: float = 120.0) -> None:
+        """Block until every live incarnation produced its readiness
+        marker (post-warmup) — the paired bench's measured window starts
+        here, so per-process jit compile never pollutes a slice."""
+        if self.ready_topic is None:
+            raise ValueError("fleet was built with ready_topic=None")
+        deadline = time.monotonic() + timeout_s
+        tp = TopicPartition(self.ready_topic, 0)
+        while True:
+            ready = {
+                r.value.decode()
+                for r in self.broker.fetch(tp, 0, 100000)
+            }
+            waiting = [
+                inc for inc in self.incarnations
+                if inc.state in (LIVE, DRAINING) and inc.member not in ready
+            ]
+            if not waiting:
+                return
+            crashed = [inc for inc in waiting if not inc.running]
+            if crashed:
+                raise RuntimeError(
+                    "replica(s) died before ready: "
+                    + ", ".join(
+                        f"{i.member} rc={i.proc.returncode} "
+                        f"(log: {i.log_path})" for i in crashed
+                    )
+                )
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"replicas not ready after {timeout_s}s: "
+                    + ", ".join(i.member for i in waiting)
+                )
+            time.sleep(0.05)
+
+    # ---------------------------------------------------------- liveness
+
+    def live(self) -> list[_Incarnation]:
+        return [i for i in self.incarnations if i.state in (LIVE, DRAINING)]
+
+    def poll_once(self) -> None:
+        """One supervision round: sweep expired leases (fencing), update
+        lease-age gauges, reap exited children, observe broker-side
+        fencings of still-running processes (stalled zombies), trigger
+        journal-handoff accounting, and respawn toward the target."""
+        info = self.broker.membership(self.group)
+        timeout = info["session_timeout_s"]
+        for member, remaining in info["leases"].items():
+            if remaining is not None and timeout is not None:
+                self.metrics.member_lease_age(member).set(
+                    max(0.0, timeout - remaining)
+                )
+        swept = sweep_expired(
+            self.broker, self.group,
+            on_fence=lambda member, age: self._note_fence(
+                member, "lease_expired", age
+            ),
+        )
+        if swept:
+            info = self.broker.membership(self.group)
+        fenced_members = set(info["fenced"])
+        for inc in self.incarnations:
+            if inc.state not in (LIVE, DRAINING, ZOMBIE):
+                continue
+            if inc.member in info["members"]:
+                inc.seen_in_group = True
+            if inc.proc is not None and inc.proc.poll() is not None:
+                inc.exit_code = inc.proc.returncode
+                if inc.exit_code == 0:
+                    inc.state = DONE
+                    self.metrics.drains.add(1)
+                else:
+                    # SIGKILL (negative rc), crash, or EXIT_FENCED: an
+                    # involuntary end. Make the broker-side fencing
+                    # explicit if the sweep has not already done it.
+                    was = inc.state
+                    inc.state = DEAD
+                    if inc.member not in fenced_members:
+                        self.broker.fence(self.group, inc.member)
+                    if was != ZOMBIE and inc.fence_reason is None:
+                        self._note_fence(
+                            inc.member,
+                            "exit_fenced" if inc.exit_code == 3
+                            else "process_death",
+                            None,
+                        )
+                    self._handoff(inc)
+                    self._maybe_respawn(inc)
+            elif inc.state != ZOMBIE and inc.member in fenced_members:
+                # Fenced broker-side while the process still runs: a
+                # stalled (SIGSTOP, GC-of-death, netsplit) zombie. The
+                # sweep may not have done it — any survivor's heartbeat
+                # reaps expired peers too — so note the fence HERE. Its
+                # partitions are already gone; it will learn via
+                # heartbeat and exit EXIT_FENCED on its own. Replace it
+                # now — the group must not run short while it stalls.
+                inc.state = ZOMBIE
+                self._note_fence(inc.member, "lease_expired", None)
+                self._handoff(inc)
+                self._maybe_respawn(inc)
+
+    def _note_fence(self, member: str, reason: str,
+                    lease_age_s: float | None) -> None:
+        inc = self._by_member(member)
+        if inc is not None and inc.fence_reason is not None:
+            return  # already noted (sweep + observation can both fire)
+        self.metrics.replica_fences.add(1)
+        if inc is not None:
+            inc.fence_reason = reason
+        if self.tracer is not None:
+            self.tracer.replica_fenced(
+                member, reason=reason, lease_age_s=lease_age_s,
+                replica=inc.idx if inc is not None else None,
+            )
+
+    def _by_member(self, member: str) -> _Incarnation | None:
+        for inc in self.incarnations:
+            if inc.member == member:
+                return inc
+        return None
+
+    def _handoff(self, inc: _Incarnation) -> None:
+        """Account the victim's on-disk journal as handed off. The ACTUAL
+        hint application happens inside the surviving worker processes —
+        they rescan the shared journal dir when the rebalance changes
+        their assignment; the supervisor only narrates what disk state
+        the death left for them."""
+        entries = len(DecodeJournal.load(inc.journal_path))
+        inc.handoff_entries = entries
+        if entries:
+            self.metrics.journal_handoffs.add(entries)
+            if self.tracer is not None:
+                self.tracer.journal_handoff(
+                    inc.member, entries, replica=inc.idx
+                )
+
+    def _maybe_respawn(self, dead: _Incarnation) -> None:
+        if not self.respawn:
+            return
+        alive = len(self.live())
+        if alive < self._target:
+            _logger.info(
+                "respawning replica %d (member %s %s)",
+                dead.idx, dead.member, dead.state,
+            )
+            self._spawn(dead.idx)
+
+    # ----------------------------------------------------------- control
+
+    def kill_replica(self, idx: int) -> dict:
+        """SIGKILL the newest live incarnation of replica ``idx`` — a
+        REAL unclean process death (no handlers, no flushes; the decode
+        journal is whatever the last cadence fsync left on disk).
+        Returns forensics for the zombie-fencing assertions: the victim
+        member id and the group generation it held, so a test can forge
+        its post-mortem commit and watch it bounce."""
+        victims = [
+            i for i in self.incarnations
+            if i.idx == idx and i.state in (LIVE, DRAINING) and i.running
+        ]
+        if not victims:
+            raise ValueError(f"no live process for replica {idx}")
+        inc = victims[-1]
+        generation = self.broker.membership(self.group)["generation"]
+        inc.proc.send_signal(signal.SIGKILL)
+        inc.proc.wait()
+        forensics = {
+            "member": inc.member, "idx": idx, "generation": generation,
+            "journal_path": inc.journal_path,
+        }
+        self.victims.append(forensics)
+        return forensics
+
+    def scale(self, n: int) -> None:
+        """Elastic membership mid-serve. Scale-UP spawns fresh members
+        (the rebalance hands them partitions — and their startup journal
+        scan makes them failover-capable immediately). Scale-DOWN
+        SIGTERMs the newest live incarnations: each drains cooperatively
+        (finish in-flight generations, commit, sync journal, leave), so
+        nothing is lost and nothing replays."""
+        if n < 1:
+            raise ValueError(f"scale target must be >= 1, got {n}")
+        cur = self.live()
+        if n > len(cur):
+            used = {i.idx for i in cur}
+            idx = 0
+            for _ in range(n - len(cur)):
+                while idx in used:
+                    idx += 1
+                used.add(idx)
+                self._spawn(idx)
+        elif n < len(cur):
+            # Drain the NEWEST incarnations first (LIFO): the longest-
+            # lived members keep their partition/cache locality.
+            to_drain = sorted(
+                cur, key=lambda i: self.incarnations.index(i)
+            )[n:]
+            for inc in to_drain:
+                if inc.running:
+                    inc.proc.send_signal(signal.SIGTERM)
+                inc.state = DRAINING
+        self._target = n
+
+    def drain(self) -> None:
+        """SIGTERM every live worker: fleet-wide cooperative drain."""
+        for inc in self.live():
+            if inc.running:
+                inc.proc.send_signal(signal.SIGTERM)
+            inc.state = DRAINING
+        self._target = 0
+
+    def wait(
+        self,
+        until: Callable[["ProcessFleet"], bool],
+        timeout_s: float = 120.0,
+        poll_interval_s: float = 0.05,
+    ) -> None:
+        """Supervision loop: ``poll_once`` until ``until(self)`` or
+        timeout (raises TimeoutError with per-worker log tails)."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            self.poll_once()
+            if until(self):
+                return
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"fleet condition not reached in {timeout_s}s\n"
+                    + self.diagnose()
+                )
+            time.sleep(poll_interval_s)
+
+    def fully_committed(self) -> bool:
+        """True when the group's committed watermark covers every prompt
+        partition end-to-end — the zero-lost condition."""
+        n = self.broker.partitions_for(self.topic)
+        for p in range(n):
+            tp = TopicPartition(self.topic, p)
+            if (self.broker.committed(self.group, tp) or 0) \
+                    < self.broker.end_offset(tp):
+                return False
+        return True
+
+    # ------------------------------------------------------------ results
+
+    def results(self) -> dict[bytes, list[tuple[str, np.ndarray]]]:
+        """Output-topic completions grouped by prompt key:
+        ``key -> [(serving member, tokens), ...]`` in produce order —
+        duplicates visible, attribution explicit."""
+        out: dict[bytes, list[tuple[str, np.ndarray]]] = {}
+        for p in range(self.broker.partitions_for(self.out_topic)):
+            for rec in self.broker.fetch(
+                TopicPartition(self.out_topic, p), 0, 1000000
+            ):
+                member = dict(rec.headers).get("member", b"?").decode()
+                out.setdefault(rec.key, []).append(
+                    (member, np.frombuffer(rec.value, dtype=np.int32))
+                )
+        return out
+
+    def worker_metrics(self) -> list[dict]:
+        """Per-incarnation metric dumps (written by workers at clean or
+        fenced exit; SIGKILLed victims leave none — honestly)."""
+        out = []
+        for inc in self.incarnations:
+            try:
+                with open(inc.metrics_path, encoding="utf-8") as f:
+                    out.append(json.load(f))
+            except (OSError, ValueError):
+                continue
+        return out
+
+    def diagnose(self) -> str:
+        parts = []
+        for inc in self.incarnations:
+            rc = inc.proc.poll() if inc.proc is not None else None
+            try:
+                with open(inc.log_path, "rb") as f:
+                    tail = f.read()[-2000:].decode(errors="replace")
+            except OSError:
+                tail = "<no log>"
+            parts.append(
+                f"--- {inc.member} state={inc.state} rc={rc} ---\n{tail}"
+            )
+        return "\n".join(parts)
+
+    # ------------------------------------------------------------ teardown
+
+    def close(self, grace_s: float = 5.0) -> None:
+        for inc in self.incarnations:
+            if inc.running:
+                inc.proc.send_signal(signal.SIGTERM)
+        deadline = time.monotonic() + grace_s
+        for inc in self.incarnations:
+            if inc.proc is None:
+                continue
+            while inc.proc.poll() is None and time.monotonic() < deadline:
+                time.sleep(0.02)
+            if inc.proc.poll() is None:
+                inc.proc.kill()
+                inc.proc.wait()
+        self.server.close()
+
+    def __enter__(self) -> "ProcessFleet":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1729,6 +1729,213 @@ def scenario_16(size: str = "tiny", replicas: int = 2) -> dict:
     }
 
 
+def scenario_17(size: str = "tiny", replicas: int = 2) -> dict:
+    """Process-fleet kill storm (torchkafka_tpu/fleet/supervisor): R
+    REAL OS-process replicas over the socket broker — each with its own
+    BrokerClient, its own jit state, its own on-disk decode journal —
+    under heartbeat leases; one replica is SIGKILLed mid-storm while it
+    provably holds uncommitted served work. The supervisor fences the
+    corpse, the rebalance re-delivers its partitions, and the survivor
+    loads the victim's journal FROM DISK across the process boundary to
+    resume warm. Audited: zero lost records (committed watermark covers
+    every prompt after drain), every completion — duplicates included —
+    BYTE-IDENTICAL to an in-process no-kill reference, duplicates within
+    the fleet-wide uncommitted-work bound, the victim's journal provably
+    handed off, and a post-mortem commit forged from the victim's stale
+    generation REJECTED with the watermark unmoved. The full matrix
+    (crash points, SIGSTOP zombies, elastic scale) lives in
+    tests/test_procfleet.py and tests/test_crash_matrix.py."""
+    import tempfile
+    import time as _time
+
+    import jax
+
+    import torchkafka_tpu as tk
+    from torchkafka_tpu.errors import CommitFailedError
+    from torchkafka_tpu.fleet import ProcessFleet
+    from torchkafka_tpu.models.transformer import init_params
+    from torchkafka_tpu.serve import StreamingGenerator
+    from torchkafka_tpu.source.records import TopicPartition
+
+    prompt_len, max_new = (8, 16) if size == "tiny" else (32, 32)
+    n = 12 if size == "tiny" else 48
+    parts, slots, commit_every = 4, 2, 4
+    cfg, params, label = _serving_model(size, None, prompt_len, max_new)
+    model_spec = dict(
+        seed=0, vocab_size=cfg.vocab_size, d_model=cfg.d_model,
+        n_layers=cfg.n_layers, n_heads=cfg.n_heads,
+        n_kv_heads=cfg.n_kv_heads, d_ff=cfg.d_ff,
+        max_seq_len=cfg.max_seq_len,
+    )
+    rng = np.random.default_rng(17)
+    prompts = rng.integers(0, cfg.vocab_size, (n, prompt_len),
+                           dtype=np.int32)
+
+    # In-process no-kill reference: greedy decode is a pure function of
+    # (params, prompt), so one local server defines byte-truth for every
+    # process in the fleet.
+    rb = tk.InMemoryBroker()
+    rb.create_topic("t17", partitions=parts)
+    for i in range(n):
+        rb.produce("t17", prompts[i].tobytes(), partition=i % parts,
+                   key=str(i).encode())
+    rc = tk.MemoryConsumer(rb, "t17", group_id="ref17")
+    ref_gen = StreamingGenerator(
+        rc, params, cfg, slots=slots, prompt_len=prompt_len,
+        max_new=max_new, commit_every=commit_every, ticks_per_sync=1,
+    )
+    ref = {rec.key: toks for rec, toks in ref_gen.run(idle_timeout_ms=400)}
+    rc.close()
+
+    t0 = _time.perf_counter()
+    with tempfile.TemporaryDirectory() as td:
+        fleet = ProcessFleet(
+            model_spec, topic="t17", prompt_len=prompt_len,
+            max_new=max_new, workdir=td, replicas=replicas,
+            partitions=parts, slots=slots, commit_every=commit_every,
+            session_timeout_s=3.0, heartbeat_interval_s=0.2,
+            journal_cadence=1, respawn=False, group="s17",
+        )
+        try:
+            fleet.start()
+            fleet.wait_ready(timeout_s=300)
+            ready_s = _time.perf_counter() - t0
+            # Produce AFTER every member joined: the storm hits a settled
+            # 2-way partition split, not whichever process won the warmup
+            # race.
+            for i in range(n):
+                fleet.broker.produce(
+                    "t17", prompts[i].tobytes(), partition=i % parts,
+                    key=str(i).encode(),
+                )
+
+            def key_offset(key: bytes) -> tuple[int, int]:
+                i = int(key.decode())
+                return i % parts, i // parts
+
+            def uncommitted_output_of(member: str) -> bool:
+                wm = {
+                    p: fleet.broker.committed(
+                        "s17", TopicPartition("t17", p)
+                    ) or 0
+                    for p in range(parts)
+                }
+                for key, copies in fleet.results().items():
+                    p, off = key_offset(key)
+                    if off >= wm[p] and any(m == member for m, _ in copies):
+                        return True
+                return False
+
+            # SIGKILL a replica the moment it provably holds SERVED,
+            # UNCOMMITTED work (an output past the watermark): the death
+            # then must exercise redelivery AND the journal handoff.
+            victim = None
+            deadline = _time.monotonic() + 240
+            while victim is None:
+                if _time.monotonic() > deadline:
+                    raise TimeoutError(
+                        "no kill opportunity arose\n" + fleet.diagnose()
+                    )
+                done = len(fleet.results()) >= n
+                for inc in fleet.live():
+                    if done:
+                        break
+                    if uncommitted_output_of(inc.member):
+                        victim = fleet.kill_replica(inc.idx)
+                        break
+                if done and victim is None:
+                    raise RuntimeError(
+                        "storm finished before any replica held "
+                        "uncommitted served work — shrink commit_every"
+                    )
+                _time.sleep(0.01)
+
+            # Survivors absorb (instant supervisor fencing on the reaped
+            # corpse; the lease is the fallback), then drain commits all.
+            fleet.wait(
+                lambda f: set(f.results())
+                == {str(i).encode() for i in range(n)},
+                timeout_s=240,
+            )
+            fleet.drain()
+            fleet.wait(
+                lambda f: all(not i.running for i in f.incarnations),
+                timeout_s=120,
+            )
+            fleet.poll_once()
+            zero_lost = fleet.fully_committed()
+
+            res = fleet.results()
+            duplicates = sum(len(v) - 1 for v in res.values())
+            # Every member's uncommitted work re-delivers at the eager
+            # rebalance (the victim's AND the survivors'), so the bound
+            # is fleet-wide.
+            dup_bound = replicas * (commit_every + slots)
+            identical = set(res) == set(ref) and all(
+                np.array_equal(toks, ref[k])
+                for k, copies in res.items() for _m, toks in copies
+            )
+
+            # The zombie-fencing acceptance: a post-mortem commit from
+            # the killed member's stale generation bounces, watermark
+            # unmoved.
+            wm_before = {
+                p: fleet.broker.committed("s17", TopicPartition("t17", p))
+                for p in range(parts)
+            }
+            try:
+                fleet.broker.commit(
+                    "s17", {TopicPartition("t17", 0): 1},
+                    member_id=victim["member"],
+                    generation=victim["generation"],
+                )
+                zombie_rejected = False
+            except CommitFailedError:
+                zombie_rejected = True
+            wm_after = {
+                p: fleet.broker.committed("s17", TopicPartition("t17", p))
+                for p in range(parts)
+            }
+            vic_inc = [
+                i for i in fleet.incarnations
+                if i.member == victim["member"]
+            ][0]
+            worker_m = fleet.worker_metrics()
+            warm_used = sum(
+                m["warm_resumes"] + m["served_from_journal"]
+                for m in worker_m
+            )
+            membership = fleet.broker.membership("s17")
+            elapsed = _time.perf_counter() - t0
+        finally:
+            fleet.close()
+    return {
+        "scenario": "17:process-fleet-kill-storm",
+        "model_scale": label,
+        "replicas": replicas,
+        "records": n,
+        "ready_s": round(ready_s, 2),
+        "elapsed_s": round(elapsed, 2),
+        "victim": victim["member"],
+        "victim_sigkilled": vic_inc.exit_code == -9,
+        "fence_reason": vic_inc.fence_reason,
+        "fence_count": membership["fence_count"],
+        "zero_lost": zero_lost,
+        "identical_to_no_kill": identical,
+        "duplicates": duplicates,
+        "duplicate_bound": dup_bound,
+        "duplicates_within_bound": duplicates <= dup_bound,
+        "journal_handoff_entries": vic_inc.handoff_entries,
+        "warm_resumes_plus_journal_served": warm_used,
+        "zombie_commit_rejected": zombie_rejected,
+        "watermark_unmoved_by_zombie": wm_before == wm_after,
+        "exit_codes": {
+            i.member: (None if i.proc is None else i.proc.returncode)
+            for i in fleet.incarnations
+        },
+    }
+
+
 def scenario_8(size: str = "tiny") -> dict:
     """Streaming CTR: DLRM-style recommender trained from a Kafka event
     stream — label + dense features + hashed categorical ids per record,
@@ -2100,6 +2307,7 @@ SCENARIOS = {
     14: scenario_14,
     15: scenario_15,
     16: scenario_16,
+    17: scenario_17,
 }
 
 
@@ -2148,7 +2356,7 @@ def run_scenario(
         )
     sample_kw = dict(temperature=temperature, top_k=top_k, top_p=top_p)
     spec_kw = dict(spec=spec, spec_k=spec_k, spec_draft_layers=spec_draft_layers)
-    if num in (10, 11, 12, 13, 15, 16):
+    if num in (10, 11, 12, 13, 15, 16, 17):
         return SCENARIOS[num](size, replicas=replicas)
     if model_scale is not None:
         if num not in (5, 7):

@@ -1,5 +1,8 @@
 """Decode journal: per-replica resumable generation state for warm
-failover (see journal.py's module docstring for the full design)."""
+failover (see journal.py's module docstring for the full design).
+``DecodeJournal.scan_dir`` is the cross-process discovery path: a
+survivor of a peer's death (or a freshly spawned replacement) merges
+every journal file in the shared directory into warm-resume hints."""
 
 from torchkafka_tpu.journal.journal import (
     DecodeJournal,

@@ -122,7 +122,7 @@ class DecodeJournal:
     guarantee for speed — benchmarks only, never correctness runs."""
 
     def __init__(self, path: str | os.PathLike, *, cadence: int = 8,
-                 fsync: bool = True) -> None:
+                 fsync: bool = True, lock: bool = True) -> None:
         if cadence < 1:
             raise ValueError(f"cadence must be >= 1 token, got {cadence}")
         self._path = os.fspath(path)
@@ -133,6 +133,63 @@ class DecodeJournal:
         self._dirty = False
         self._closed = False
         self.stats = _Stats()
+        # Single-writer discipline across PROCESSES: a journal file is one
+        # replica incarnation's private state; two live writers would
+        # interleave tmp-renames and hand survivors a chimera. The lock
+        # file carries the owner pid — a dead owner's lock (SIGKILL never
+        # cleans up) or our own is stale and silently stolen.
+        self._lock_held = False
+        if lock:
+            self._acquire_lock()
+
+    def _acquire_lock(self) -> None:
+        from torchkafka_tpu.errors import JournalLockedError
+
+        lock_path = self._path + ".lock"
+        my_pid = os.getpid()
+        for _ in range(2):
+            try:
+                fd = os.open(lock_path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                with os.fdopen(fd, "w") as f:
+                    f.write(str(my_pid))
+                self._lock_held = True
+                return
+            except FileExistsError:
+                try:
+                    with open(lock_path) as f:
+                        owner = int(f.read().strip() or "0")
+                except (OSError, ValueError):
+                    owner = 0
+                stale = owner == 0 or owner == my_pid
+                if not stale:
+                    try:
+                        os.kill(owner, 0)  # signal 0: existence probe only
+                    except ProcessLookupError:
+                        stale = True
+                    except PermissionError:
+                        pass  # alive, different uid: definitely not ours
+                if not stale:
+                    raise JournalLockedError(
+                        f"decode journal {self._path!r} is owned by live "
+                        f"process {owner}; journals are single-writer — "
+                        "give each replica incarnation its own path"
+                    )
+                try:
+                    os.unlink(lock_path)
+                except FileNotFoundError:
+                    pass
+        raise JournalLockedError(
+            f"could not acquire journal lock {lock_path!r} (contended)"
+        )
+
+    def _release_lock(self) -> None:
+        if not self._lock_held:
+            return
+        self._lock_held = False
+        try:
+            os.unlink(self._path + ".lock")
+        except OSError:
+            pass
 
     @property
     def path(self) -> str:
@@ -259,6 +316,7 @@ class DecodeJournal:
             self.flush()
         finally:
             self._closed = True
+            self._release_lock()
 
     # -------------------------------------------------------------- querying
 
@@ -270,6 +328,47 @@ class DecodeJournal:
 
     def __len__(self) -> int:
         return len(self._entries)
+
+    @staticmethod
+    def scan_dir(
+        journal_dir: str | os.PathLike,
+        exclude: tuple[str, ...] = (),
+    ) -> dict[tuple[str, int, int], JournalEntry]:
+        """Cross-process journal discovery: load EVERY journal file in
+        ``journal_dir`` except the caller's own (``exclude`` paths) and
+        merge their live entries — what a survivor (or a freshly spawned
+        replacement) consults after a peer's death, and what a restarting
+        fleet consults for every previous incarnation at once. Entries
+        for the same record across files keep the FRESHEST copy
+        (finished beats in-flight, more emitted tokens beat fewer) — a
+        record that migrated between incarnations leaves a stale shadow
+        in the older file. Deterministic: files visited in sorted order,
+        and hints are CRC-gated at apply time, so a stale or foreign
+        entry can never corrupt a resume. The ``journal_handoff_pre_load``
+        crash point pins the window where a loader dies mid-scan: the
+        files are read-only here, so the next scan sees identical state."""
+        crash_hook("journal_handoff_pre_load")
+        journal_dir = os.fspath(journal_dir)
+        excluded = {os.path.abspath(os.fspath(p)) for p in exclude}
+        merged: dict[tuple[str, int, int], JournalEntry] = {}
+        try:
+            names = sorted(os.listdir(journal_dir))
+        except FileNotFoundError:
+            return {}
+        for name in names:
+            if not name.endswith(".json"):
+                continue  # .tmp (torn writes) and .lock files are not journals
+            path = os.path.join(journal_dir, name)
+            if os.path.abspath(path) in excluded:
+                continue
+            for key, entry in DecodeJournal.load(path).items():
+                old = merged.get(key)
+                if old is None or (
+                    (entry.finished, len(entry.tokens))
+                    > (old.finished, len(old.tokens))
+                ):
+                    merged[key] = entry
+        return merged
 
     @staticmethod
     def load(path: str | os.PathLike) -> dict[tuple[str, int, int], JournalEntry]:

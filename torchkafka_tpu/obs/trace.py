@@ -65,11 +65,20 @@ DROPPED = "dropped"
 # overload state changes land in the trace, ordered against the record
 # lifecycles that caused them — and replay byte-identically.
 BURN_STATE = "burn_state"
+# Membership events (topic "fleet", offset = membership sequence): the
+# fleet's liveness story on the same stream — a replica joining the
+# group, a replica fenced (lease expiry, kill, drain-timeout), and a
+# dead replica's journal handed to survivors — ordered against the
+# record lifecycles they interrupt or resume.
+REPLICA_JOINED = "replica_joined"
+REPLICA_FENCED = "replica_fenced"
+JOURNAL_HANDOFF = "journal_handoff"
 
 STAGES = (
     POLLED, QOS_ADMITTED, DEFERRED, PREFILL_QUEUED, CHUNK_SCHEDULED,
     WARM_RESUMED, SLOT_ACTIVE, TOKENS, FINISHED, JOURNAL_SERVED, COMMITTED,
-    QUARANTINED, DROPPED, BURN_STATE,
+    QUARANTINED, DROPPED, BURN_STATE, REPLICA_JOINED, REPLICA_FENCED,
+    JOURNAL_HANDOFF,
 )
 
 
@@ -257,6 +266,7 @@ class RecordTracer:
         # Optional obs.BurnRateMonitor: receives per-completion goodput
         # classifications (note_commit) and quarantine events.
         self._monitor = None
+        self._membership_seq = 0  # offsets for topic-"fleet" events
         self._jsonl = None
         if self.config.jsonl_path is not None:
             self._jsonl = open(self.config.jsonl_path, "a", encoding="utf-8")
@@ -453,6 +463,47 @@ class RecordTracer:
                         queue_wait_s=life.queue_wait,
                     )
                 del self._open[(topic, partition, offset)]
+
+    def replica_joined(self, member: str, replica=None) -> None:
+        """A replica became a live group member (spawned, respawned, or
+        scaled in). Topic ``fleet``; offset = membership sequence."""
+        with self._lock:
+            seq = self._membership_seq
+            self._membership_seq += 1
+            self._emit(REPLICA_JOINED, "fleet", 0, seq, (
+                ("member", member), ("replica", replica),
+            ))
+
+    def replica_fenced(self, member: str, reason: str = "lease_expired",
+                       lease_age_s: float | None = None,
+                       replica=None) -> None:
+        """A replica was fenced out of the group: its lease expired (a
+        real process death — or a zombie too slow to renew), it was
+        killed, or it overran a drain timeout. Its partitions rebalance
+        to survivors; its stale-generation commits are rejected from
+        here on."""
+        with self._lock:
+            seq = self._membership_seq
+            self._membership_seq += 1
+            attrs = [("member", member), ("reason", reason),
+                     ("replica", replica)]
+            if lease_age_s is not None:
+                attrs.append(("lease_age_s", round(lease_age_s, 4)))
+            self._emit(REPLICA_FENCED, "fleet", 0, seq,
+                       tuple(sorted(attrs)))
+
+    def journal_handoff(self, member: str, entries: int,
+                        replica=None) -> None:
+        """A dead replica's on-disk decode journal was handed to
+        survivors (``entries`` live generations become warm-resume
+        hints)."""
+        with self._lock:
+            seq = self._membership_seq
+            self._membership_seq += 1
+            self._emit(JOURNAL_HANDOFF, "fleet", 0, seq, (
+                ("entries", entries), ("member", member),
+                ("replica", replica),
+            ))
 
     def burn_state(self, seq: int, metric: str, dim: str, label: str,
                    old: str, new: str, fast: float, slow: float) -> None:

@@ -28,6 +28,18 @@ journal_mid_write          death inside the decode journal's tmp write —
                            the torn tmp must be invisible to recovery
 checkpoint_mid_write       death after the checkpoint payload, before the
                            atomic rename — the torn step must be invisible
+heartbeat_pre_send         a replica made decode progress but dies before
+                           the lease renewal that would prove it alive —
+                           the lease expires, survivors absorb its
+                           partitions, its uncommitted work re-delivers
+lease_expired_pre_fence    a supervisor OBSERVED an expired lease but dies
+                           before fencing — the zombie stays a member, yet
+                           its next commit self-fences (commit-time reap),
+                           so the watermark never merges zombie work
+journal_handoff_pre_load   a replica (or recovery incarnation) dies inside
+                           the peer-journal scan, before hints load — the
+                           journals on disk stay intact; the next scan
+                           warm-resumes exactly the same entries
 ========================== =================================================
 
 Sites call ``crash_hook("<name>")``; production cost is one global ``is
@@ -62,6 +74,9 @@ REGISTERED_CRASH_POINTS: tuple[str, ...] = (
     "post_dlq_pre_retire",
     "journal_mid_write",
     "checkpoint_mid_write",
+    "heartbeat_pre_send",
+    "lease_expired_pre_fence",
+    "journal_handoff_pre_load",
 )
 
 ENV_VAR = "TORCHKAFKA_CRASHPOINT"

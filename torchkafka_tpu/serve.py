@@ -2836,6 +2836,34 @@ class StreamingGenerator:
                         "output (restart re-delivers and regenerates)"
                     ) from exc
         snapshot = self._ledger.snapshot()
+        # Commit only partitions we still OWN: an eager rebalance (a
+        # member joined/left — fleet.scale on the process fleet) can take
+        # partitions away with completions still in this ledger. Kafka
+        # clients drop those from the commit set — the broker would
+        # reject the WHOLE commit as "partitions not owned" otherwise,
+        # permanently stalling even the owned partitions' watermark. The
+        # new owner re-serves the departed records (at-least-once;
+        # duplicates bounded by this replica's uncommitted work), so
+        # skipping them here loses nothing. assignment() also syncs the
+        # group first, so the commit below carries the POST-rebalance
+        # generation instead of burning one doomed attempt.
+        try:
+            assigned = set(self._consumer.assignment())
+        except Exception:  # noqa: BLE001 - transport hiccup: commit as-is
+            assigned = None
+        if assigned is not None:
+            stray = [tp for tp in snapshot if tp not in assigned]
+            if stray:
+                _logger.info(
+                    "dropping %d departed partition(s) from commit after "
+                    "rebalance: %s", len(stray), sorted(stray),
+                )
+                snapshot = {
+                    tp: off for tp, off in snapshot.items()
+                    if tp in assigned
+                }
+            if not snapshot:
+                return True  # nothing we own has progress to commit
         # Outputs durable, offsets not yet committed: death here must
         # replay (duplicates on the output topic), never lose.
         crash_hook("pre_commit")

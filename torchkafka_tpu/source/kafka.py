@@ -271,6 +271,14 @@ class KafkaConsumer(ConsumerIterMixin):
     def has_paused(self) -> bool:
         return self._any_paused
 
+    def heartbeat(self) -> None:
+        """Interface parity with ``MemoryConsumer.heartbeat``: kafka-python
+        maintains the group heartbeat on its own background thread (the
+        broker's real session.timeout.ms reaper does the fencing), so the
+        explicit renewal is a no-op here — the call exists so fleet code
+        written against the memory transport runs unchanged on Kafka."""
+        return None
+
     def close(self) -> None:
         if self._closed:
             return

@@ -43,6 +43,7 @@ from typing import Any, Iterable, Mapping, Sequence
 from torchkafka_tpu.errors import (
     CommitFailedError,
     ConsumerClosedError,
+    FencedMemberError,
     NotAssignedError,
     UnknownTopicError,
 )
@@ -62,12 +63,42 @@ class _Group:
         self.members: dict[str, "frozenset[str] | re.Pattern"] = {}
         self.assignment: dict[str, list[TopicPartition]] = {}
         self.committed: dict[TopicPartition, int] = {}
+        # member_id -> lease expiry deadline (broker clock). Populated only
+        # when the broker has a session timeout; renewed by heartbeat().
+        self.leases: dict[str, float] = {}
+        # Members evicted by lease expiry or an explicit fence() — kept so
+        # a zombie's later heartbeat gets FencedMemberError (Kafka's
+        # UNKNOWN_MEMBER_ID) rather than a confusing KeyError.
+        self.fenced: set[str] = set()
+        self.fence_count = 0
 
 
 class InMemoryBroker:
     """Thread-safe partitioned log store with consumer-group semantics."""
 
-    def __init__(self, commit_log_path: str | None = None) -> None:
+    def __init__(
+        self,
+        commit_log_path: str | None = None,
+        *,
+        session_timeout_s: float | None = None,
+        clock=None,
+    ) -> None:
+        """``session_timeout_s``: opt-in heartbeat leases for group
+        members (None, the default, preserves lease-free semantics —
+        membership changes only via join/leave). With a timeout set,
+        ``join`` grants each member a lease that only ``heartbeat``
+        renews; a member whose lease expires is FENCED — evicted with a
+        rebalance — the next time any group-mutating traffic arrives
+        (another member's heartbeat or join, its own commit, or an
+        explicit ``fence``). Fencing on the zombie's own COMMIT is the
+        integrity half: a merely-slow member that missed heartbeats gets
+        its commit rejected (records re-deliver), never merged.
+        ``clock``: the lease clock (default ``time.monotonic``);
+        injectable so lease tests run on a ``ManualClock``."""
+        if session_timeout_s is not None and session_timeout_s <= 0:
+            raise ValueError(
+                f"session_timeout_s must be > 0 or None, got {session_timeout_s}"
+            )
         self._lock = threading.RLock()
         self._data_arrived = threading.Condition(self._lock)
         self._logs: dict[TopicPartition, list[Record]] = {}
@@ -75,6 +106,8 @@ class InMemoryBroker:
         self._groups: dict[str, _Group] = {}
         self._rr: dict[str, int] = {}  # per-topic round-robin produce cursor
         self._commit_log_path = commit_log_path
+        self._session_timeout_s = session_timeout_s
+        self._clock = clock if clock is not None else time.monotonic
 
     # ------------------------------------------------------------- topics
 
@@ -176,6 +209,33 @@ class InMemoryBroker:
     def _group(self, group_id: str) -> _Group:
         return self._groups.setdefault(group_id, _Group())
 
+    def _fence_locked(self, g: _Group, member_id: str) -> bool:
+        """Evict one member (lease expiry or explicit fence) and
+        rebalance. Returns True if the member was actually present.
+        Caller holds the lock."""
+        if member_id not in g.members:
+            return False
+        del g.members[member_id]
+        g.leases.pop(member_id, None)
+        g.fenced.add(member_id)
+        g.fence_count += 1
+        self._rebalance(g)
+        return True
+
+    def _reap_locked(self, g: _Group) -> list[str]:
+        """Fence every member whose lease has expired. Called from the
+        group-MUTATING entry points (join/heartbeat/commit/fence) — read
+        paths (group_state/membership) stay pure so a supervisor can
+        OBSERVE an expired lease before anything acts on it (the
+        ``lease_expired_pre_fence`` window). Caller holds the lock."""
+        if self._session_timeout_s is None or not g.leases:
+            return []
+        now = self._clock()
+        expired = [m for m, deadline in g.leases.items() if deadline <= now]
+        for m in expired:
+            self._fence_locked(g, m)
+        return expired
+
     def join(
         self,
         group_id: str,
@@ -193,9 +253,16 @@ class InMemoryBroker:
         behavior)."""
         with self._lock:
             g = self._group(group_id)
+            self._reap_locked(g)
             g.members[member_id] = (
                 re.compile(pattern) if pattern is not None else topics
             )
+            # A re-join after fencing is a FRESH membership (Kafka's
+            # rejoin-with-new-epoch): the fenced mark clears, the old
+            # generation stays dead.
+            g.fenced.discard(member_id)
+            if self._session_timeout_s is not None:
+                g.leases[member_id] = self._clock() + self._session_timeout_s
             self._rebalance(g)
             return g.generation
 
@@ -209,7 +276,69 @@ class InMemoryBroker:
             g = self._group(group_id)
             if member_id in g.members:
                 del g.members[member_id]
+                g.leases.pop(member_id, None)
                 self._rebalance(g)
+
+    def heartbeat(
+        self, group_id: str, member_id: str, generation: int | None = None,
+    ) -> int:
+        """Renew ``member_id``'s lease; returns the CURRENT group
+        generation so the caller can cheaply detect a rebalance. Any
+        member's heartbeat also reaps peers with expired leases — the
+        self-healing sweep that hands a SIGKILLed member's partitions to
+        survivors without waiting for a supervisor. Raises
+        ``FencedMemberError`` if the member itself was fenced (or never
+        joined): the zombie learns it is dead instead of serving into the
+        void. ``generation`` is advisory (diagnostics); lease renewal is
+        keyed on identity, not generation — a member mid-rebalance-sync
+        is alive, just behind."""
+        with self._lock:
+            g = self._group(group_id)
+            self._reap_locked(g)
+            if member_id not in g.members:
+                raise FencedMemberError(
+                    f"member {member_id!r} is not in group {group_id!r} "
+                    "(lease expired or fenced); re-join to resume"
+                )
+            if self._session_timeout_s is not None:
+                g.leases[member_id] = self._clock() + self._session_timeout_s
+            return g.generation
+
+    def fence(self, group_id: str, member_id: str) -> bool:
+        """Explicitly evict a member (the supervisor's response to an
+        observed lease expiry): rebalance hands its partitions to
+        survivors, and its stale-generation commits are rejected from
+        here on. Idempotent — fencing an already-gone member returns
+        False. Also reaps any other expired leases while it is here."""
+        with self._lock:
+            g = self._group(group_id)
+            fenced = self._fence_locked(g, member_id)
+            self._reap_locked(g)
+            return fenced
+
+    def membership(self, group_id: str) -> dict:
+        """Read-only membership snapshot for supervisors/observability:
+        generation, member ids, per-member lease seconds REMAINING
+        (negative = expired but not yet reaped; None when leases are
+        off), and the cumulative fence count. Deliberately performs no
+        reaping — observing an expired lease must not race the observer's
+        own response to it."""
+        with self._lock:
+            g = self._group(group_id)
+            now = self._clock()
+            return {
+                "generation": g.generation,
+                "members": sorted(g.members),
+                "leases": {
+                    m: (
+                        g.leases[m] - now if m in g.leases else None
+                    )
+                    for m in g.members
+                },
+                "session_timeout_s": self._session_timeout_s,
+                "fenced": sorted(g.fenced),
+                "fence_count": g.fence_count,
+            }
 
     def _rebalance(self, g: _Group) -> None:
         """Range-assign every subscribed partition across members, bump generation.
@@ -261,6 +390,18 @@ class InMemoryBroker:
         with self._lock:
             g = self._group(group_id)
             if member_id is not None:
+                # Lease discipline first: a member whose own lease lapsed
+                # is fenced BY this very commit attempt — the "merely
+                # slow" zombie gets a clean CommitFailedError (records
+                # re-deliver to whoever owns the partitions now), never a
+                # merged watermark.
+                self._reap_locked(g)
+                if member_id not in g.members:
+                    raise CommitFailedError(
+                        f"member {member_id!r} fenced/evicted from group "
+                        f"{group_id!r} (lease expired or rebalanced away); "
+                        "offsets not committed"
+                    )
                 if generation != g.generation:
                     raise CommitFailedError(
                         f"generation {generation} != current {g.generation} "
@@ -518,6 +659,27 @@ class MemoryConsumer(ConsumerIterMixin):
                 self._group_id, offsets,
                 member_id=self._member_id, generation=self._generation,
             )
+
+    def heartbeat(self) -> int | None:
+        """Renew this member's broker-side lease; returns the group's
+        current generation (None in manual-assignment mode, which has no
+        membership to keep alive). Raises ``FencedMemberError`` once the
+        broker has evicted this member — the caller must re-join (a fresh
+        ``MemoryConsumer``) or exit and be respawned; continuing to serve
+        would be zombie work whose commits are all doomed. The process
+        fleet's replica loop calls this every ``heartbeat_interval_s``;
+        the ``heartbeat_pre_send`` crash point pins the window where a
+        replica dies between decode progress and the renewal that would
+        have proven it alive."""
+        if self._manual:
+            return None
+        self._check_open()
+        from torchkafka_tpu.resilience.crashpoint import crash_hook
+
+        crash_hook("heartbeat_pre_send")
+        return self._broker.heartbeat(
+            self._group_id, self._member_id, self._generation
+        )
 
     def committed(self, tp: TopicPartition) -> int | None:
         self._check_open()

@@ -52,6 +52,9 @@ _METHODS = frozenset(
         "commit",
         "committed",
         "wait_for_data",
+        "heartbeat",
+        "fence",
+        "membership",
     }
 )
 
@@ -156,6 +159,16 @@ class BrokerServer:
     def close(self) -> None:
         self._closing = True
         try:
+            # shutdown() BEFORE close(): the accept thread blocked in
+            # accept() holds a kernel reference to the listening socket,
+            # so a bare close() leaves the listener alive (and accepting!)
+            # until that syscall returns — a "closed" server that still
+            # answers is exactly the zombie the fencing tests exist to
+            # rule out. shutdown() wakes the acceptor with an error.
+            try:
+                self._sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass  # already disconnected/never listening
             self._sock.close()
         finally:
             with self._lock:
@@ -184,32 +197,102 @@ class BrokerClient:
     Thread-safe via a per-client request lock (one in-flight RPC per
     client); a raising broker call re-raises the marshalled exception
     (CommitFailedError and friends cross the wire intact).
+
+    Transport faults (connection reset/refused, socket timeout, a frame
+    cut mid-read) surface as the RETRYABLE ``BrokerUnavailableError`` —
+    never a raw ``OSError`` — and mark the socket dead so the next call
+    reconnects. Pass ``retry`` (a ``resilience.RetryPolicy``) and the
+    client retries such faults itself, reconnecting with the policy's
+    jittered backoff: group membership lives broker-side, so a reconnect
+    resumes the same member identity (the lease, if any, still has to be
+    renewed in time — a retry storm longer than the session timeout gets
+    fenced, exactly as it should). Safe because every proxied operation
+    is idempotent or at-least-once-tolerant: polls re-fetch from the
+    consumer position, commits carry absolute offsets, a re-sent produce
+    can at worst duplicate a record the downstream is already required
+    to tolerate.
     """
 
-    def __init__(self, host: str, port: int, timeout_s: float = 30.0) -> None:
-        self._sock = socket.create_connection((host, port), timeout=timeout_s)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    def __init__(
+        self, host: str, port: int, timeout_s: float = 30.0, retry=None,
+    ) -> None:
+        self._host = host
+        self._port = port
+        self._timeout_s = timeout_s
+        self._retry = retry
         self._lock = threading.Lock()
         self._closed = False
+        self._sock: socket.socket | None = None
+        # Eager connect: config errors (wrong port) surface at
+        # construction — through the policy, so a racing server start is
+        # absorbed too.
+        if retry is not None:
+            retry.run(self._ensure_connected)
+        else:
+            self._ensure_connected()
 
-    def _call(self, method: str, *args: Any, **kwargs: Any) -> Any:
+    def _ensure_connected(self) -> None:
         with self._lock:
             if self._closed:
                 raise ConnectionError("broker client is closed")
-            _send(self._sock, (method, args, kwargs))
-            status, value = _recv(self._sock)
+            self._connect_locked()
+
+    def _connect_locked(self) -> None:
+        if self._sock is not None:
+            return
+        from torchkafka_tpu.errors import BrokerUnavailableError
+
+        try:
+            sock = socket.create_connection(
+                (self._host, self._port), timeout=self._timeout_s
+            )
+        except OSError as exc:
+            raise BrokerUnavailableError(
+                f"broker {self._host}:{self._port} unreachable: {exc}"
+            ) from exc
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock = sock
+
+    def _call_once(self, method: str, args: tuple, kwargs: dict) -> Any:
+        from torchkafka_tpu.errors import BrokerUnavailableError
+
+        with self._lock:
+            if self._closed:
+                raise ConnectionError("broker client is closed")
+            self._connect_locked()
+            try:
+                _send(self._sock, (method, args, kwargs))
+                status, value = _recv(self._sock)
+            except (ConnectionError, OSError, EOFError) as exc:
+                # The socket is in an unknown framing state: drop it so
+                # the next attempt reconnects cleanly.
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+                raise BrokerUnavailableError(
+                    f"broker RPC {method!r} failed mid-flight: {exc}"
+                ) from exc
         if status == "err":
             raise value
         return value
+
+    def _call(self, method: str, *args: Any, **kwargs: Any) -> Any:
+        if self._retry is None:
+            return self._call_once(method, args, kwargs)
+        return self._retry.run(lambda: self._call_once(method, args, kwargs))
 
     def close(self) -> None:
         with self._lock:
             if not self._closed:
                 self._closed = True
-                try:
-                    self._sock.close()
-                except OSError:
-                    pass
+                if self._sock is not None:
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = None
 
     def __enter__(self) -> "BrokerClient":
         return self
@@ -254,6 +337,15 @@ class BrokerClient:
 
     def committed(self, group_id, tp):
         return self._call("committed", group_id, tp)
+
+    def heartbeat(self, group_id, member_id, generation=None):
+        return self._call("heartbeat", group_id, member_id, generation)
+
+    def fence(self, group_id, member_id):
+        return self._call("fence", group_id, member_id)
+
+    def membership(self, group_id):
+        return self._call("membership", group_id)
 
     def wait_for_data(self, timeout_s):
         # Cap the server-side block below the socket timeout so a quiet
