@@ -375,6 +375,228 @@ def run_procs_failover(tk, cfg, params, args, prompt_len, max_new) -> None:
               file=sys.stderr)
 
 
+def run_txn(tk, cfg, params, args, prompt_len, max_new) -> None:
+    """The transaction tax, measured twice.
+
+    (a) PAIRED in-process commit-latency micro: the SAME prompts served
+    at-least-once (async sends + flush-then-commit) and exactly-once
+    (one transaction per commit window: produces + offsets + atomic
+    commit), interleaved per slice; commit p50/p99 from
+    ``ServeMetrics.commit_latency`` — the number PERF.md's 0.04–0.07 ms
+    baseline row quotes. Exactness asserted inside every slice: both
+    modes byte-identical, and the exactly-once run's COMMITTED view
+    holds each completion exactly once.
+
+    (b) CROSS-PROCESS SIGKILL failover with transactions: the
+    procs-failover storm re-run with ``exactly_once=True`` — a replica
+    SIGKILLed while its journal proves uncommitted served work, the
+    supervisor's fence aborts its in-flight transaction, the survivor
+    re-serves — and committed-view duplicates are asserted == 0 (the
+    at-least-once slice of this same file measures 16/run)."""
+    import tempfile
+
+    import numpy as np
+
+    from torchkafka_tpu.journal import DecodeJournal
+    from torchkafka_tpu.serve import StreamingGenerator
+    from torchkafka_tpu.source.records import TopicPartition
+
+    n, parts = args.prompts, 4
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(0, cfg.vocab_size, (n, prompt_len),
+                           dtype=np.int32)
+
+    # ---------------------------------------------- (a) commit-tax micro
+    def serve_once(txn: bool):
+        broker = tk.InMemoryBroker()
+        broker.create_topic("in", partitions=parts)
+        broker.create_topic("out", partitions=1)
+        for i in range(n):
+            broker.produce("in", prompts[i].tobytes(), partition=i % parts,
+                           key=str(i).encode())
+        consumer = tk.MemoryConsumer(broker, "in", group_id="b")
+        producer = (
+            tk.TransactionalProducer(broker, "bench-txn")
+            if txn else tk.MemoryProducer(broker)
+        )
+        gen = StreamingGenerator(
+            consumer, params, cfg, slots=4, prompt_len=prompt_len,
+            max_new=max_new, commit_every=8, ticks_per_sync=1,
+            output_producer=producer, output_topic="out",
+            exactly_once=txn,
+        )
+        res = {rec.key: toks for rec, toks in gen.run(idle_timeout_ms=300)}
+        assert len(res) == n
+        commit = gen.metrics.commit_latency.summary()
+        if txn:
+            recs, _ = broker.fetch_stable(TopicPartition("out", 0), 0, 10**6)
+            keys = [r.key for r in recs]
+            assert sorted(keys) == sorted(set(keys)), "committed duplicates"
+            assert len(keys) == n, "committed view incomplete"
+        consumer.close()
+        return res, commit
+
+    ref, _ = serve_once(txn=False)  # jit warm + byte-truth
+    rows = {"at_least_once": [], "exactly_once": []}
+    for s in range(args.slices):
+        for mode, txn in (("at_least_once", False), ("exactly_once", True)):
+            res, commit = serve_once(txn)
+            assert set(res) == set(ref)
+            for k in ref:
+                np.testing.assert_array_equal(res[k], ref[k], err_msg=str(k))
+            rows[mode].append(commit)
+            print(f"slice {s} {mode}: commit p50 {commit['p50_ms']:.4f} ms "
+                  f"p99 {commit['p99_ms']:.4f} ms", file=sys.stderr)
+    micro = {}
+    for mode, commits in rows.items():
+        micro[mode] = {
+            "commit_p50_ms": float(np.median([c["p50_ms"] for c in commits])),
+            "commit_p99_ms": float(np.median([c["p99_ms"] for c in commits])),
+            "commits_per_run": commits[0]["count"],
+        }
+    tax = (
+        micro["exactly_once"]["commit_p99_ms"]
+        / micro["at_least_once"]["commit_p99_ms"]
+        if micro["at_least_once"]["commit_p99_ms"] else None
+    )
+    print("| commit path | p50 ms (median of slices) | p99 ms |")
+    print("|---|---|---|")
+    for mode in ("at_least_once", "exactly_once"):
+        print(f"| {mode.replace('_', '-')} | "
+              f"{micro[mode]['commit_p50_ms']:.4f} | "
+              f"{micro[mode]['commit_p99_ms']:.4f} |")
+
+    # ------------------------------- (b) cross-process SIGKILL, dups == 0
+    all_keys = {str(i).encode() for i in range(n)}
+    ref_proc = _proc_reference(tk, cfg, params, prompts, parts, max_new)
+
+    def killed_txn_run():
+        from torchkafka_tpu.fleet import ProcessFleet
+
+        spec = dict(MODEL_SPEC, max_seq_len=prompt_len + max_new)
+        td_ctx = tempfile.TemporaryDirectory()
+        td = td_ctx.name
+        fleet = ProcessFleet(
+            spec, topic="bench", prompt_len=prompt_len, max_new=max_new,
+            workdir=td, replicas=2, partitions=parts, slots=4,
+            commit_every=8, session_timeout_s=5.0,
+            heartbeat_interval_s=0.25, journal_cadence=1,
+            respawn=False, group="bench", exactly_once=True,
+        )
+        try:
+            fleet.start()
+            fleet.wait_ready(timeout_s=600)
+            for i in range(n):
+                fleet.broker.produce(
+                    "bench", prompts[i].tobytes(),
+                    partition=i % parts, key=str(i).encode(),
+                )
+
+            def has_uncommitted_served(inc) -> bool:
+                try:
+                    entries = DecodeJournal.load(inc.journal_path)
+                except Exception:
+                    return False
+                for (topic, p, off), e in entries.items():
+                    if e.finished and topic == "bench" and off >= (
+                        fleet.broker.committed(
+                            "bench", TopicPartition("bench", p)
+                        ) or 0
+                    ):
+                        return True
+                return False
+
+            victim = None
+            deadline = time.monotonic() + 300
+            while victim is None:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(fleet.diagnose())
+                if len(fleet.results("read_committed")) >= n:
+                    raise RuntimeError("storm drained pre-kill")
+                for inc in fleet.live():
+                    if has_uncommitted_served(inc):
+                        victim = fleet.kill_replica(inc.idx)
+                        break
+                time.sleep(0.01)
+
+            def covered(f):
+                committed = set(f.results("read_committed"))
+                if committed >= all_keys:
+                    return True
+                pending = set()
+                for inc in f.live():
+                    try:
+                        entries = DecodeJournal.load(inc.journal_path)
+                    except Exception:
+                        continue
+                    for (topic, p, off), e in entries.items():
+                        if e.finished and topic == "bench":
+                            pending.add(str(off * parts + p).encode())
+                return committed | pending >= all_keys
+
+            fleet.wait(covered, timeout_s=600)
+            fleet.drain()
+            fleet.wait(
+                lambda f: all(not i.running for i in f.incarnations),
+                timeout_s=300,
+            )
+            fleet.poll_once()
+            assert fleet.fully_committed()
+            committed_res = fleet.results("read_committed")
+            _assert_exact(
+                {k: v for k, v in committed_res.items()}, ref_proc, n
+            )
+            dups = sum(len(v) - 1 for v in committed_res.values())
+            assert dups == 0, f"committed duplicates: {dups}"
+            wm = fleet.worker_metrics()
+            jserved = sum(m["served_from_journal"] for m in wm)
+            restored = sum(m["tokens_restored"] for m in wm)
+        finally:
+            fleet.close()
+            td_ctx.cleanup()
+        return dups, jserved, restored
+
+    slices = []
+    for s in range(args.slices):
+        dups, jserved, restored = killed_txn_run()
+        slices.append({
+            "slice": s, "committed_duplicates": dups,
+            "journal_served": jserved, "tokens_restored": restored,
+        })
+        print(f"slice {s}: committed duplicates {dups} "
+              f"(journal-served {jserved}, restored {restored})",
+              file=sys.stderr)
+    print("| cross-process SIGKILL failover | duplicates (committed view) |")
+    print("|---|---|")
+    print("| at-least-once (this file's procs-failover rows) | 16/run |")
+    print("| exactly-once (asserted, every slice) | 0 |")
+
+    doc = {
+        "mode": "txn",
+        "prompts": n,
+        "max_new": max_new,
+        "commit_tax": micro,
+        "commit_p99_tax_ratio": tax,
+        "failover_slices": slices,
+        "committed_duplicates_asserted": 0,
+        "exactness": (
+            "both modes byte-identical to the reference; exactly-once "
+            "committed view asserted one-copy-per-prompt, every slice"
+        ),
+    }
+    print(json.dumps(doc), file=sys.stderr)
+    if args.json_out:
+        try:
+            with open(args.json_out, encoding="utf-8") as f:
+                existing = json.load(f)
+        except (OSError, ValueError):
+            existing = {}
+        existing["txn"] = doc
+        with open(args.json_out, "w", encoding="utf-8") as f:
+            json.dump(existing, f, indent=1)
+        print(f"appended txn rows to {args.json_out}", file=sys.stderr)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--replicas", default="1,2,4")
@@ -388,8 +610,14 @@ def main() -> None:
                     help="real-process fleet curve, e.g. 1,2,4")
     ap.add_argument("--procs-failover", action="store_true",
                     help="cross-process SIGKILL cold-vs-warm differential")
+    ap.add_argument("--txn", action="store_true",
+                    help="exactly-once transaction tax: paired commit-"
+                    "latency micro (at-least-once vs transactional) + "
+                    "cross-process SIGKILL failover with committed-view "
+                    "duplicates asserted == 0")
     ap.add_argument("--json-out", default=None,
-                    help="--procs-failover: FAILOVER_BENCH.json to append")
+                    help="--procs-failover/--txn: FAILOVER_BENCH.json to "
+                    "append")
     args = ap.parse_args()
     counts = [int(x) for x in args.replicas.split(",")]
 
@@ -412,6 +640,9 @@ def main() -> None:
     )
     params = init_params(jax.random.key(0), cfg)
 
+    if args.txn:
+        run_txn(tk, cfg, params, args, prompt_len, max_new=16)
+        return
     if args.procs:
         run_procs(tk, cfg, params, args, prompt_len, max_new=16)
         return

@@ -14,6 +14,13 @@ Modes:
           incarnation's file), output producer, poison quarantine → DLQ.
           Covers post_poll, pre_commit, mid_tick, post_dlq_pre_retire
           and journal_mid_write.
+  txn   — the serve loop in EXACTLY-ONCE mode: outputs + DLQ + offsets
+          in one broker transaction per commit window, the producer
+          epoch-fenced by transactional id (recovery's
+          init_producer_id aborts whatever the corpse left open).
+          Covers txn_begin_post, txn_produce_mid, txn_pre_commit and
+          txn_post_commit_pre_ack — the at-least-once serve audits
+          become exactly-once ones (committed view: each output ONCE).
   ckpt  — the training-shaped commit→checkpoint pairing: poll a chunk,
           commit its offsets, then StreamCheckpointer.save — resuming
           from the newest complete checkpoint at startup. Covers
@@ -132,6 +139,48 @@ def run_serve(broker, workdir: str) -> None:
         pass
     server.close()
     consumer.close()
+
+
+TXN_ID = "crash-txn"
+
+
+def run_serve_txn(broker, workdir: str) -> None:
+    """One EXACTLY-ONCE serving incarnation: same topics, model and
+    journal as ``run_serve``, but the output path is one transaction per
+    commit window (completions + DLQ copies + source offsets atomic).
+    Constructing the ``TransactionalProducer`` re-initializes
+    ``TXN_ID`` — bumping the epoch and aborting any transaction a
+    previous (killed) incarnation left open: that single call is the
+    whole exactly-once recovery story."""
+    import torchkafka_tpu as tk
+    from torchkafka_tpu.journal import DecodeJournal
+    from torchkafka_tpu.resilience import PoisonQuarantine
+    from torchkafka_tpu.serve import StreamingGenerator
+
+    cfg, params = build_model()
+    jpath = os.path.join(workdir, "journal.json")
+    hints = DecodeJournal.load(jpath)  # before the new journal's 1st flush
+    consumer = tk.MemoryConsumer(broker, PROMPT_TOPIC, group_id=GROUP)
+    producer = tk.TransactionalProducer(broker, TXN_ID)
+    server = StreamingGenerator(
+        consumer, params, cfg, slots=SLOTS, prompt_len=P, max_new=MAX_NEW,
+        commit_every=COMMIT_EVERY, ticks_per_sync=1,
+        max_poll_records=SLOTS,
+        decode_prompt=make_decode_prompt(),
+        output_producer=producer, output_topic=OUT_TOPIC,
+        exactly_once=True,
+        quarantine=PoisonQuarantine(
+            producer, DLQ_TOPIC, budget=1, timeout_s=5.0
+        ),
+        journal=DecodeJournal(jpath, cadence=JOURNAL_CADENCE),
+    )
+    if hints:
+        server.add_resume_hints(hints)
+    for _rec, _toks in server.run(idle_timeout_ms=400):
+        pass
+    server.close()
+    consumer.close()
+    producer.close()
 
 
 FLEET_TOPIC, FLEET_OUT = "ft", "fout"
@@ -268,6 +317,8 @@ def main() -> int:
     try:
         if mode == "serve":
             run_serve(client, workdir)
+        elif mode == "txn":
+            run_serve_txn(client, workdir)
         elif mode == "ckpt":
             run_ckpt(client, workdir)
         elif mode == "fleet":

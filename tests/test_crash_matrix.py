@@ -66,10 +66,23 @@ MATRIX: dict[str, tuple[str, int]] = {
     "heartbeat_pre_send": ("fleet", 12),
     "journal_handoff_pre_load": ("fleet", 2),
     "lease_expired_pre_fence": ("sweep", 1),
+    # Exactly-once transactional serving (serve.py exactly_once=True over
+    # TransactionalProducer). Arrival counts land each death mid-stream:
+    # begin 2 = the second window's (empty) transaction just opened;
+    # produce 3 = the second window holds one output, more coming;
+    # commit 2 = the second window fully staged (records + offsets),
+    # the atomic flip not yet asked for; post-commit 2 = the second
+    # window committed ON the broker, ack never observed.
+    "txn_begin_post": ("txn", 2),
+    "txn_produce_mid": ("txn", 3),
+    "txn_pre_commit": ("txn", 2),
+    "txn_post_commit_pre_ack": ("txn", 2),
 }
 
 # The tier-1 representative subset: one mid-serve death (commit path) and
-# one mid-checkpoint death (torn save). Everything else is chaos+slow.
+# one mid-checkpoint death (torn save). Everything else — the txn points
+# included — is chaos+slow (tier-1 wall-clock is budgeted; scenario 18 in
+# test_harness keeps a tier-1 exactly-once SIGKILL anyway).
 TIER1 = ("pre_commit", "checkpoint_mid_write")
 
 
@@ -255,6 +268,107 @@ def _run_ckpt_case(tmp_path, point: str, at: int):
     )
 
 
+def _committed_outputs(broker, topic, parts=1, raw=False):
+    """Committed-view (read_committed) records of ``topic`` by key —
+    the downstream consumer's truth in exactly-once mode. ``raw=True``
+    keeps byte values (DLQ payloads are not token arrays)."""
+    out: dict[bytes, list] = {}
+    for p in range(parts):
+        recs, _ = broker.fetch_stable(TopicPartition(topic, p), 0, 100000)
+        for rec in recs:
+            out.setdefault(rec.key, []).append(
+                rec.value if raw else np.frombuffer(rec.value, dtype=np.int32)
+            )
+    return out
+
+
+def _run_txn_case(tmp_path, reference, point: str, at: int):
+    """The exactly-once matrix: a real subprocess serving in
+    transactional mode, SIGKILLed at a txn crash point. The at-least-
+    once audits become exactly-once ones: at death AND after recovery,
+    the COMMITTED view of the output topic holds each completion at
+    most / exactly once (duplicates == 0, not bounded), every committed
+    offset is covered by a committed output or committed DLQ copy, and
+    a commit forged from the corpse's stale epoch bounces off the fence
+    with the watermark untouched."""
+    from torchkafka_tpu.errors import ProducerFencedError
+
+    broker = tk.InMemoryBroker()
+    W.prime_topics(broker)
+    workdir = str(tmp_path / point)
+    os.makedirs(workdir, exist_ok=True)
+    with tk.BrokerServer(broker) as server:
+        proc, marker = _spawn("txn", server.port, workdir, point, at)
+        proc.wait(timeout=180)
+    with open(os.path.join(workdir, "child.log"), "rb") as f:
+        log = f.read().decode(errors="replace")
+    assert proc.returncode == -signal.SIGKILL, (
+        f"worker exited {proc.returncode}, not SIGKILL — the armed point "
+        f"{point!r} was never reached?\n{log}"
+    )
+    with open(marker) as f:
+        assert f.read().strip() == f"{point}:{at}"
+    _reap_group(broker, W.GROUP)
+
+    # ---- exactly-once invariants at the moment of death -------------------
+    committed = _committed(broker)
+    outs = _committed_outputs(broker, W.OUT_TOPIC)
+    dlq = _committed_outputs(broker, W.DLQ_TOPIC, raw=True)
+    poison_tp, poison_off = 0, W.N_PROMPTS // W.PARTS
+    for key, copies in outs.items():
+        assert len(copies) == 1, (
+            f"duplicate committed output for {key!r} at death"
+        )
+        np.testing.assert_array_equal(copies[0], reference[key])
+    for p, wm in committed.items():
+        assert wm <= broker.end_offset(TopicPartition(W.PROMPT_TOPIC, p))
+        for off in range(wm):
+            if (p, off) == (poison_tp, poison_off):
+                assert dlq, "poison offset committed with no committed DLQ copy"
+                continue
+            key = str(off * W.PARTS + p).encode()
+            assert key in outs, (
+                f"committed {p}:{off} (prompt {key}) has no committed output"
+            )
+    # The corpse's journal parses (same torn-write contract as serve mode).
+    DecodeJournal.load(os.path.join(workdir, "journal.json"))
+
+    # ---- recovery: same worker logic, in-process --------------------------
+    # Constructing the recovery TransactionalProducer re-inits the
+    # transactional id: epoch bump, corpse's open transaction aborted.
+    W.run_serve_txn(broker, workdir)
+
+    outs = _committed_outputs(broker, W.OUT_TOPIC)
+    assert set(outs) == set(reference), (
+        "lost completions after recovery: "
+        f"{set(reference) ^ set(outs)}"
+    )
+    for key, copies in outs.items():
+        # THE exactly-once assertion: not bounded, zero duplicates.
+        assert len(copies) == 1, (
+            f"{len(copies)} committed copies of {key!r} after recovery"
+        )
+        np.testing.assert_array_equal(copies[0], reference[key], err_msg=str(key))
+    dlq = _committed_outputs(broker, W.DLQ_TOPIC, raw=True)
+    assert list(dlq) == [b"poison"]
+    assert len(dlq[b"poison"]) == 1, "poison dead-lettered more than once"
+    assert b"poison" not in outs
+    final = _committed(broker)
+    for p in range(W.PARTS):
+        assert final[p] == broker.end_offset(
+            TopicPartition(W.PROMPT_TOPIC, p)
+        ), f"partition {p} not fully committed after recovery"
+
+    # ---- the fence: a forged stale-epoch commit bounces -------------------
+    pid, cur_epoch = broker.init_producer_id(W.TXN_ID)
+    wm_before = _committed(broker)
+    with pytest.raises(ProducerFencedError):
+        broker.begin_txn(pid, cur_epoch - 1)
+    with pytest.raises(ProducerFencedError):
+        broker.commit_txn(pid, cur_epoch - 1)
+    assert _committed(broker) == wm_before, "forged commit moved the watermark"
+
+
 @pytest.fixture(scope="module")
 def fleet_reference(tmp_path_factory):
     """The no-kill fleet-mode run: key → completion tokens."""
@@ -424,6 +538,13 @@ def _dispatch_case(tmp_path, request, point: str) -> None:
     mode, at = MATRIX[point]
     if mode == "serve":
         _run_serve_case(
+            tmp_path, request.getfixturevalue("reference"), point, at
+        )
+    elif mode == "txn":
+        # Greedy decode is a pure function of (params, prompt): the
+        # serve-mode no-kill reference defines byte-truth for the
+        # transactional worker too (same model seed, same prompts).
+        _run_txn_case(
             tmp_path, request.getfixturevalue("reference"), point, at
         )
     elif mode == "ckpt":

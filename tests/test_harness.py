@@ -311,6 +311,41 @@ def test_scenario_17_process_fleet_kill_storm():
     assert sorted(codes.values()) == [-9, 0]
 
 
+def test_scenario_18_exactly_once_kill_storm():
+    """The tier-1 exactly-once smoke: the scenario-17 kill storm with
+    transactional output. Two real OS-process replicas serve through
+    epoch-fenced TransactionalProducers (one transaction per commit
+    window: completions + offsets atomic); one is SIGKILLed while its
+    on-disk journal proves it holds served-but-uncommitted work. The
+    acceptance contract is the ISSUE's: after the kill and drain, a
+    read_committed consumer of the output topic observes ZERO
+    duplicates and zero losses — asserted equal, not bounded — every
+    committed completion byte-identical to the no-kill reference, and a
+    commit forged from the victim's stale epoch rejected by the fence
+    with the watermark and the committed view both untouched."""
+    out = run_scenario(18, "tiny")
+    assert out["scenario"] == "18:exactly-once-kill-storm"
+    assert out["replicas"] == 2
+    assert out["victim_sigkilled"] is True  # a real SIGKILL corpse
+    assert out["zero_lost"] is True
+    assert out["identical_to_no_kill"] is True
+    # THE upgrade over scenario 17's bounded duplicates: exactly once.
+    assert out["committed_duplicates"] == 0
+    # Cross-process warm failover still composes: the victim's journal
+    # reached the survivor, and the re-served completions were produced
+    # inside the survivor's transactions (never double-published).
+    assert out["journal_handoff_entries"] > 0
+    assert out["warm_resumes_plus_journal_served"] > 0
+    # Epoch fencing: the victim's transactional id was re-initialized,
+    # so its stale epoch can neither commit nor move anything.
+    assert out["zombie_txn_commit_rejected"] is True
+    assert out["watermark_unmoved_by_zombie"] is True
+    assert out["committed_view_unmoved_by_zombie"] is True
+    codes = out["exit_codes"]
+    assert codes[out["victim"]] == -9
+    assert sorted(codes.values()) == [-9, 0]
+
+
 def test_scenario_13_warm_failover_smoke():
     """The tier-1 warm-failover smoke: a seeded mid-generation replica
     kill through a journaled 2-replica fleet. The survivor consults the

@@ -339,6 +339,13 @@ class TestCrashPoints:
             # inside the cross-process journal scan.
             "heartbeat_pre_send", "lease_expired_pre_fence",
             "journal_handoff_pre_load",
+            # The exactly-once transactional windows (ISSUE 11): a
+            # producer dying with an empty transaction just opened,
+            # mid-way through a window's produces, with everything
+            # staged but the atomic commit not yet asked for, and after
+            # the broker committed but before the ack was observed.
+            "txn_begin_post", "txn_produce_mid",
+            "txn_pre_commit", "txn_post_commit_pre_ack",
         }
 
 

@@ -35,6 +35,8 @@ from torchkafka_tpu.errors import (
     CommitFailedError,
     ConsumerClosedError,
     NotAssignedError,
+    ProducerClosedError,
+    ProducerFencedError,
 )
 from torchkafka_tpu.source.records import TopicPartition
 
@@ -371,3 +373,254 @@ class TestConformance:
         lag = c.lag()
         assert lag[tps[0]] == 1 and lag[tps[1]] == 0
         c.close()
+
+
+# ------------------------------------------------------------- producers
+#
+# The producer half of the conformance story: the closed-producer
+# contract must be identical across transports (the memory double, the
+# same producer over the netbroker socket, and the kafka adapter), and
+# the TRANSACTIONAL surface must behave identically wherever it exists
+# (begin/produce/commit/abort/fence observable the same way via memory,
+# netbroker, and kafka-when-importable-and-reachable).
+
+PRODUCER_TRANSPORTS = ["memory", "netbroker"] + (
+    ["kafka"] if HAVE_KAFKA else []
+)
+
+
+class _ProducerEnv:
+    """One transport-backed producer environment over a fresh topic."""
+
+    supports_transactions = True
+
+    def __init__(self, topic: str):
+        self.topic = topic
+
+    def producer(self):
+        raise NotImplementedError
+
+    def txn_producer(self, txn_id: str):
+        raise NotImplementedError
+
+    def consumer(self, group: str, **kw):
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class _MemoryProducerEnv(_ProducerEnv):
+    def __init__(self, topic):
+        super().__init__(topic)
+        self.broker = tk.InMemoryBroker()
+        self.broker.create_topic(topic, partitions=1)
+
+    def producer(self):
+        return tk.MemoryProducer(self.broker)
+
+    def txn_producer(self, txn_id):
+        return tk.TransactionalProducer(self.broker, txn_id)
+
+    def consumer(self, group, **kw):
+        return tk.MemoryConsumer(self.broker, self.topic, group_id=group, **kw)
+
+
+class _NetbrokerProducerEnv(_ProducerEnv):
+    """The SAME MemoryProducer/TransactionalProducer classes over a
+    BrokerClient socket proxy — the transactional RPCs (and the
+    marshalled ProducerFencedError) are what get exercised."""
+
+    def __init__(self, topic):
+        super().__init__(topic)
+        self.broker = tk.InMemoryBroker()
+        self.broker.create_topic(topic, partitions=1)
+        self.server = tk.BrokerServer(self.broker)
+        self._clients: list = []
+
+    def _client(self):
+        client = tk.BrokerClient(self.server.host, self.server.port)
+        self._clients.append(client)
+        return client
+
+    def producer(self):
+        return tk.MemoryProducer(self._client())
+
+    def txn_producer(self, txn_id):
+        return tk.TransactionalProducer(self._client(), txn_id)
+
+    def consumer(self, group, **kw):
+        return tk.MemoryConsumer(
+            self._client(), self.topic, group_id=group, **kw
+        )
+
+    def close(self):
+        for c in self._clients:
+            c.close()
+        self.server.close()
+
+
+class _KafkaProducerEnv(_ProducerEnv):
+    def __init__(self, topic):
+        super().__init__(topic)
+        from kafka.admin import KafkaAdminClient, NewTopic
+
+        self._admin = KafkaAdminClient(bootstrap_servers=KAFKA_BOOTSTRAP)
+        self._admin.create_topics(
+            [NewTopic(topic, num_partitions=1, replication_factor=1)]
+        )
+        import kafka as _k
+
+        self.supports_transactions = hasattr(
+            _k.KafkaProducer, "init_transactions"
+        )
+
+    def producer(self):
+        return tk.KafkaProducer(bootstrap_servers=KAFKA_BOOTSTRAP)
+
+    def txn_producer(self, txn_id):
+        return tk.KafkaTransactionalProducer(
+            txn_id, bootstrap_servers=KAFKA_BOOTSTRAP
+        )
+
+    def consumer(self, group, **kw):
+        return tk.KafkaConsumer(
+            self.topic, group_id=group, bootstrap_servers=KAFKA_BOOTSTRAP,
+            auto_offset_reset="earliest", **kw,
+        )
+
+    def close(self):
+        self._admin.close()
+
+
+@pytest.fixture(params=PRODUCER_TRANSPORTS)
+def penv(request):
+    if request.param == "kafka" and not KAFKA_BOOTSTRAP:
+        pytest.skip("kafka-python importable but KAFKA_BOOTSTRAP not set")
+    topic = f"pconf-{uuid.uuid4().hex[:12]}"
+    e = {
+        "memory": _MemoryProducerEnv,
+        "netbroker": _NetbrokerProducerEnv,
+        "kafka": _KafkaProducerEnv,
+    }[request.param](topic)
+    e.name = request.param
+    yield e
+    e.close()
+
+
+class TestProducerConformance:
+    def test_closed_producer_contract(self, penv):
+        """Identical across transports: a closed producer refuses send
+        AND flush with ProducerClosedError; close is idempotent; a live
+        producer's handle resolves to real metadata."""
+        p = penv.producer()
+        md = p.send(penv.topic, b"v0", key=b"k").get(10.0)
+        assert (md.topic, md.partition) == (penv.topic, 0)
+        assert md.offset >= 0
+        p.flush(5.0)
+        p.close()
+        p.close()  # idempotent
+        with pytest.raises(ProducerClosedError):
+            p.send(penv.topic, b"v1")
+        with pytest.raises(ProducerClosedError):
+            p.flush()
+
+    def test_closed_txn_producer_contract(self, penv):
+        if not penv.supports_transactions:
+            pytest.skip("client has no transactional API")
+        p = penv.txn_producer(f"txn-{uuid.uuid4().hex[:8]}")
+        p.begin()
+        p.send(penv.topic, b"v0")
+        p.commit()
+        p.close()
+        p.close()  # idempotent
+        with pytest.raises(ProducerClosedError):
+            p.begin()
+        with pytest.raises(ProducerClosedError):
+            p.send(penv.topic, b"v1")
+        with pytest.raises(ProducerClosedError):
+            p.flush()
+
+    def test_txn_commit_visible_abort_invisible(self, penv):
+        """The core visibility rows: uncommitted records are invisible
+        to read_committed consumers and visible to read_uncommitted
+        ones; commit makes them durable for both; an aborted
+        transaction leaves no trace in the committed view."""
+        if not penv.supports_transactions:
+            pytest.skip("client has no transactional API")
+        p = penv.txn_producer(f"txn-{uuid.uuid4().hex[:8]}")
+        p.begin()
+        p.send(penv.topic, b"committed-1")
+        p.send(penv.topic, b"committed-2")
+        rc = penv.consumer("g-rc", isolation_level="read_committed")
+        ru = penv.consumer("g-ru")
+        assert _drain(rc, 1, timeout_ms=500) == []
+        assert [r.value for r in _drain(ru, 2)] == [
+            b"committed-1", b"committed-2",
+        ]
+        p.commit()
+        assert [r.value for r in _drain(rc, 2)] == [
+            b"committed-1", b"committed-2",
+        ]
+        p.begin()
+        p.send(penv.topic, b"aborted")
+        p.abort()
+        p.begin()
+        p.send(penv.topic, b"after")
+        p.commit()
+        # read_committed skips the aborted record entirely.
+        assert [r.value for r in _drain(rc, 1)] == [b"after"]
+        rc.close()
+        ru.close()
+        p.close()
+
+    def test_txn_offsets_commit_atomically(self, penv):
+        if not penv.supports_transactions:
+            pytest.skip("client has no transactional API")
+        if penv.name == "kafka":
+            pytest.skip(
+                "needs a live broker's coordinator; the memory-semantics "
+                "transports prove the protocol"
+            )
+        tp = TopicPartition(penv.topic, 0)
+        p = penv.txn_producer(f"txn-{uuid.uuid4().hex[:8]}")
+        p.begin()
+        p.send(penv.topic, b"out")
+        p.send_offsets("g-atomic", {tp: 3})
+        c = penv.consumer("g-atomic")
+        assert c.committed(tp) is None  # staged, not durable
+        p.commit()
+        assert c.committed(tp) == 3  # atomic with the record
+        c.close()
+        p.close()
+
+    def test_txn_fence_on_reinit(self, penv):
+        """Two producers, one transactional id: the second init fences
+        the first — its in-flight transaction aborts, its later ops
+        raise the terminal ProducerFencedError (marshalled intact over
+        the netbroker socket) — identical on every transport."""
+        if not penv.supports_transactions:
+            pytest.skip("client has no transactional API")
+        if penv.name == "kafka":
+            pytest.skip(
+                "deterministically racing two live transactional "
+                "producers needs coordinated broker timing; the memory-"
+                "semantics transports prove the protocol"
+            )
+        txn_id = f"txn-{uuid.uuid4().hex[:8]}"
+        old = penv.txn_producer(txn_id)
+        old.begin()
+        old.send(penv.topic, b"zombie")
+        new = penv.txn_producer(txn_id)
+        new.begin()
+        new.send(penv.topic, b"fresh")
+        new.commit()
+        with pytest.raises(ProducerFencedError):
+            old.send(penv.topic, b"more")
+        with pytest.raises(ProducerFencedError):
+            old.commit()
+        rc = penv.consumer("g-fence", isolation_level="read_committed")
+        assert [r.value for r in _drain(rc, 1)] == [b"fresh"]
+        rc.close()
+        old.close()
+        new.close()

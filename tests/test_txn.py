@@ -1,0 +1,400 @@
+"""Broker transactions: unit contracts + seeded fuzz vs a brute-force
+reference log.
+
+The unit half pins the ``TransactionalProducer`` lifecycle against the
+in-memory broker (visibility, abort, epoch fencing, offset atomicity,
+idempotent commit retry, state-machine misuse). The fuzz half (style of
+test_fuzz_commit.py) drives randomized interleavings of
+begin/produce/offsets/commit/abort/re-init — two transactional ids,
+stale-epoch forgeries included — directly against the broker RPC surface
+and checks, after EVERY op, that the committed view and the group
+watermark match an independently-maintained brute-force model:
+
+  F1  committed view = records below the LSO whose txn committed (or
+      that were never transactional), in offset order
+  F2  read_uncommitted view = the whole log, always
+  F3  group watermarks move ONLY at commit_txn (atomically with F1)
+  F4  a stale epoch's op raises ProducerFencedError and changes nothing
+  F5  a fresh read_committed consumer drains exactly F1
+"""
+
+import numpy as np
+import pytest
+
+import torchkafka_tpu as tk
+from torchkafka_tpu.errors import (
+    CommitFailedError,
+    ProducerClosedError,
+    ProducerFencedError,
+    TransactionStateError,
+)
+from torchkafka_tpu.source.records import TopicPartition
+
+TP = TopicPartition("t", 0)
+
+
+def _broker(parts=1):
+    b = tk.InMemoryBroker()
+    b.create_topic("t", partitions=parts)
+    return b
+
+
+def _stable_values(broker, tp=TP):
+    recs, _ = broker.fetch_stable(tp, 0, 100000)
+    return [r.value for r in recs]
+
+
+class TestTransactionalProducer:
+    def test_commit_makes_records_and_offsets_visible_atomically(self):
+        b = _broker()
+        p = tk.TransactionalProducer(b, "p")
+        p.begin()
+        p.send("t", b"a")
+        p.send("t", b"b")
+        p.send_offsets("g", {TP: 2})
+        # Staged, not committed: invisible to read_committed, watermark
+        # untouched, but read_uncommitted (legacy) sees the log as-is.
+        assert _stable_values(b) == []
+        assert b.committed("g", TP) is None
+        assert [r.value for r in b.fetch(TP, 0, 10)] == [b"a", b"b"]
+        p.commit()
+        assert _stable_values(b) == [b"a", b"b"]
+        assert b.committed("g", TP) == 2
+        assert not p.in_transaction
+
+    def test_abort_leaves_no_trace(self):
+        b = _broker()
+        p = tk.TransactionalProducer(b, "p")
+        p.begin()
+        p.send("t", b"dead")
+        p.send_offsets("g", {TP: 1})
+        assert p.abort() is True
+        assert _stable_values(b) == []
+        assert b.committed("g", TP) is None
+        # The aborted record holds its offset but never surfaces; later
+        # committed work reads past it.
+        p.begin()
+        p.send("t", b"live")
+        p.commit()
+        assert _stable_values(b) == [b"live"]
+        assert p.abort() is False  # idempotent with nothing open
+
+    def test_reinit_fences_and_aborts_in_flight(self):
+        b = _broker()
+        old = tk.TransactionalProducer(b, "shared")
+        old.begin()
+        old.send("t", b"zombie")
+        new = tk.TransactionalProducer(b, "shared")
+        assert new.epoch == old.epoch + 1
+        # The old epoch's transaction died with the fence.
+        new.begin()
+        new.send("t", b"fresh")
+        new.commit()
+        assert _stable_values(b) == [b"fresh"]
+        # Every op on the stale handle is a zombie's.
+        with pytest.raises(ProducerFencedError):
+            old.send("t", b"more")
+        with pytest.raises(ProducerFencedError):
+            old.commit()
+        with pytest.raises(ProducerFencedError):
+            old.begin()
+        assert _stable_values(b) == [b"fresh"]
+
+    def test_generation_checked_offsets_abort_whole_txn(self):
+        """A rebalance between staging and committing aborts records AND
+        offsets together — the atomicity the exactly-once serve path
+        leans on."""
+        b = _broker()
+        c1 = tk.MemoryConsumer(b, "t", group_id="g")
+        p = tk.TransactionalProducer(b, "p")
+        p.begin()
+        p.send("t", b"out")
+        p.send_offsets(
+            "g", {TP: 1}, member_id=c1.member_id, generation=c1.generation
+        )
+        c2 = tk.MemoryConsumer(b, "t", group_id="g")  # generation bump
+        with pytest.raises(CommitFailedError):
+            p.commit()
+        assert _stable_values(b) == []
+        assert b.committed("g", TP) is None
+        assert not p.in_transaction  # broker aborted it; handle agrees
+        c1.close()
+        c2.close()
+
+    def test_stale_generation_rejected_at_staging_too(self):
+        b = _broker()
+        c1 = tk.MemoryConsumer(b, "t", group_id="g")
+        gen = c1.generation
+        c2 = tk.MemoryConsumer(b, "t", group_id="g")
+        p = tk.TransactionalProducer(b, "p")
+        p.begin()
+        with pytest.raises(CommitFailedError):
+            p.send_offsets("g", {TP: 1}, member_id=c1.member_id,
+                           generation=gen)
+        c1.close()
+        c2.close()
+
+    def test_commit_retry_is_idempotent(self):
+        """A commit whose ack was eaten by the transport retries into
+        success (the broker remembers the epoch's outcome) — but a
+        VOLUNTARY double-commit without a new begin is still a state
+        error once a different outcome intervened."""
+        b = _broker()
+        p = tk.TransactionalProducer(b, "p")
+        p.begin()
+        p.send("t", b"once")
+        p.commit()
+        # The retry path: same epoch, no open txn, last outcome committed.
+        b.commit_txn(p.producer_id, p.epoch)  # no raise
+        assert _stable_values(b) == [b"once"]
+        p.begin()
+        p.abort()
+        with pytest.raises(TransactionStateError):
+            b.commit_txn(p.producer_id, p.epoch)  # last outcome: aborted
+
+    def test_state_machine_misuse(self):
+        b = _broker()
+        p = tk.TransactionalProducer(b, "p")
+        with pytest.raises(TransactionStateError):
+            p.send("t", b"x")
+        with pytest.raises(TransactionStateError):
+            p.send_offsets("g", {TP: 1})
+        with pytest.raises(TransactionStateError):
+            p.commit()
+        p.close()
+        with pytest.raises(ProducerClosedError):
+            p.begin()
+        with pytest.raises(ProducerClosedError):
+            p.flush()
+
+    def test_close_aborts_open_txn(self):
+        b = _broker()
+        p = tk.TransactionalProducer(b, "p")
+        p.begin()
+        p.send("t", b"x")
+        p.close()
+        p.close()  # idempotent
+        # Nothing leaks into the committed view, and the LSO is released
+        # (a later producer's committed work is readable).
+        q = tk.TransactionalProducer(b, "q")
+        q.begin()
+        q.send("t", b"y")
+        q.commit()
+        assert _stable_values(b) == [b"y"]
+
+    def test_lso_blocks_later_committed_records(self):
+        """Ordering guarantee: a committed record never surfaces to
+        read_committed consumers before an EARLIER still-open
+        transaction decides."""
+        b = _broker()
+        a = tk.TransactionalProducer(b, "a")
+        c = tk.TransactionalProducer(b, "c")
+        a.begin()
+        a.send("t", b"gate")  # offset 0, open
+        c.begin()
+        c.send("t", b"behind")  # offset 1
+        c.commit()
+        assert b.last_stable_offset(TP) == 0
+        assert _stable_values(b) == []  # committed, but behind the gate
+        a.abort()
+        assert _stable_values(b) == [b"behind"]
+        assert b.last_stable_offset(TP) == 2
+
+    def test_read_committed_consumer_skips_aborted(self):
+        b = _broker()
+        p = tk.TransactionalProducer(b, "p")
+        p.begin()
+        p.send("t", b"dead")
+        p.abort()
+        b.produce("t", b"plain")
+        c = tk.MemoryConsumer(b, "t", group_id="rc",
+                              isolation_level="read_committed")
+        got = c.poll(max_records=10)
+        assert [r.value for r in got] == [b"plain"]
+        c.commit()
+        # Position advanced OVER the aborted offset: nothing re-delivers.
+        assert b.committed("rc", TP) == 2
+        c.close()
+
+
+# --------------------------------------------------------------- fuzz
+
+
+class _RefModel:
+    """Brute-force reference: a flat log of (value, txn_seq|None), txn
+    statuses, per-group watermarks, and per-id epochs — semantics
+    reimplemented independently of the broker's bookkeeping."""
+
+    def __init__(self):
+        self.log: list[tuple[bytes, int | None]] = []
+        self.status: dict[int, str] = {}
+        self.watermark: dict[str, int] = {}
+        self.epochs: dict[str, int] = {}
+        self.open: dict[str, int | None] = {}  # txn_id -> open seq
+        self.offsets: dict[int, dict[str, int]] = {}  # seq -> group -> off
+        self.outcome: dict[str, tuple[int, str] | None] = {}
+        self._seq = 0
+
+    def init(self, txn_id):
+        if txn_id in self.epochs:
+            self.epochs[txn_id] += 1
+            if self.open.get(txn_id) is not None:
+                self._abort(txn_id)
+        else:
+            self.epochs[txn_id] = 0
+            self.open[txn_id] = None
+            self.outcome[txn_id] = None
+        return self.epochs[txn_id]
+
+    def _abort(self, txn_id):
+        seq = self.open[txn_id]
+        self.status[seq] = "aborted"
+        self.outcome[txn_id] = (self.epochs[txn_id], "aborted")
+        self.open[txn_id] = None
+
+    def begin(self, txn_id):
+        if self.open.get(txn_id) is not None:
+            self._abort(txn_id)
+        self._seq += 1
+        self.status[self._seq] = "open"
+        self.offsets[self._seq] = {}
+        self.open[txn_id] = self._seq
+
+    def produce(self, txn_id, value):
+        self.log.append((value, self.open[txn_id]))
+
+    def plain_produce(self, value):
+        self.log.append((value, None))
+
+    def buffer_offsets(self, txn_id, group, off):
+        self.offsets[self.open[txn_id]][group] = off
+
+    def commit(self, txn_id):
+        seq = self.open[txn_id]
+        self.status[seq] = "committed"
+        self.outcome[txn_id] = (self.epochs[txn_id], "committed")
+        self.open[txn_id] = None
+        for group, off in self.offsets[seq].items():
+            self.watermark[group] = off
+
+    def abort(self, txn_id):
+        if self.open.get(txn_id) is not None:
+            self._abort(txn_id)
+
+    def lso(self):
+        for i, (_v, seq) in enumerate(self.log):
+            if seq is not None and self.status[seq] == "open":
+                return i
+        return len(self.log)
+
+    def committed_view(self):
+        lso = self.lso()
+        return [
+            v for i, (v, seq) in enumerate(self.log)
+            if i < lso and (seq is None or self.status[seq] == "committed")
+        ]
+
+
+def _fuzz_round(seed: int) -> None:
+    rng = np.random.default_rng(seed)
+    b = _broker()
+    model = _RefModel()
+    ids = ["A", "B"]
+    handles: dict[str, tuple[int, int]] = {}  # txn_id -> (pid, epoch)
+    stale: list[tuple[int, int]] = []
+    counter = 0
+
+    def check():
+        assert _stable_values(b) == model.committed_view(), f"seed {seed}"
+        assert [r.value for r in b.fetch(TP, 0, 100000)] == [
+            v for v, _ in model.log
+        ], f"seed {seed}"
+        for g in ("g1", "g2"):
+            assert b.committed(g, TP) == model.watermark.get(g), (
+                f"seed {seed} group {g}"
+            )
+        assert b.last_stable_offset(TP) == model.lso(), f"seed {seed}"
+
+    for _ in range(int(rng.integers(40, 120))):
+        txn_id = ids[int(rng.integers(len(ids)))]
+        op = rng.random()
+        if txn_id not in handles or op < 0.06:
+            if txn_id in handles:
+                stale.append(handles[txn_id])
+            pid, epoch = b.init_producer_id(txn_id)
+            assert epoch == model.init(txn_id)
+            handles[txn_id] = (pid, epoch)
+        elif op < 0.22:
+            pid, epoch = handles[txn_id]
+            b.begin_txn(pid, epoch)
+            model.begin(txn_id)
+        elif op < 0.60:
+            pid, epoch = handles[txn_id]
+            value = f"{txn_id}{counter}".encode()
+            counter += 1
+            if model.open.get(txn_id) is None:
+                with pytest.raises(TransactionStateError):
+                    b.txn_produce(pid, epoch, "t", value)
+            else:
+                b.txn_produce(pid, epoch, "t", value)
+                model.produce(txn_id, value)
+        elif op < 0.70:
+            pid, epoch = handles[txn_id]
+            group = "g1" if rng.random() < 0.5 else "g2"
+            off = int(rng.integers(0, 50))
+            if model.open.get(txn_id) is None:
+                with pytest.raises(TransactionStateError):
+                    b.txn_commit_offsets(pid, epoch, group, {TP: off})
+            else:
+                b.txn_commit_offsets(pid, epoch, group, {TP: off})
+                model.buffer_offsets(txn_id, group, off)
+        elif op < 0.84:
+            pid, epoch = handles[txn_id]
+            if model.open.get(txn_id) is None:
+                if model.outcome[txn_id] == (epoch, "committed"):
+                    b.commit_txn(pid, epoch)  # idempotent retry
+                else:
+                    with pytest.raises(TransactionStateError):
+                        b.commit_txn(pid, epoch)
+            else:
+                b.commit_txn(pid, epoch)
+                model.commit(txn_id)
+        elif op < 0.92:
+            pid, epoch = handles[txn_id]
+            b.abort_txn(pid, epoch)
+            model.abort(txn_id)
+        elif op < 0.96 and stale:
+            # F4: forged ops from a fenced epoch change NOTHING.
+            pid, epoch = stale[int(rng.integers(len(stale)))]
+            forged = rng.random()
+            with pytest.raises(ProducerFencedError):
+                if forged < 0.34:
+                    b.begin_txn(pid, epoch)
+                elif forged < 0.67:
+                    b.txn_produce(pid, epoch, "t", b"forged")
+                else:
+                    b.commit_txn(pid, epoch)
+        else:
+            value = f"plain{counter}".encode()
+            counter += 1
+            b.produce("t", value)
+            model.plain_produce(value)
+        check()
+
+    # F5: a fresh read_committed consumer drains exactly the model's
+    # committed view (and never blocks past the LSO).
+    c = tk.MemoryConsumer(b, "t", group_id=f"drain-{seed}",
+                          isolation_level="read_committed")
+    got = []
+    while True:
+        recs = c.poll(max_records=17)
+        if not recs:
+            break
+        got.extend(r.value for r in recs)
+    assert got == model.committed_view(), f"seed {seed}"
+    c.close()
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_fuzz_txn_interleavings(seed):
+    _fuzz_round(seed)

@@ -29,7 +29,9 @@ from torchkafka_tpu.errors import (
     OutputDeliveryError,
     PoisonRecordError,
     ProducerClosedError,
+    ProducerFencedError,
     TpuKafkaError,
+    TransactionStateError,
 )
 from torchkafka_tpu.journal import DecodeJournal, JournalEntry
 from torchkafka_tpu.obs import (
@@ -58,10 +60,12 @@ from torchkafka_tpu.source import (
     InMemoryBroker,
     KafkaConsumer,
     KafkaProducer,
+    KafkaTransactionalProducer,
     MemoryConsumer,
     MemoryProducer,
     Producer,
     RecordMetadata,
+    TransactionalProducer,
     dead_letter_to_topic,
     seek_to_timestamp,
     Record,
@@ -87,7 +91,7 @@ from torchkafka_tpu.transform import (
     raw_bytes,
 )
 
-__version__ = "0.13.0"
+__version__ = "0.14.0"
 
 __all__ = [
     "BarrierError",
@@ -111,6 +115,7 @@ __all__ = [
     "InMemoryBroker",
     "KafkaConsumer",
     "KafkaProducer",
+    "KafkaTransactionalProducer",
     "KafkaStream",
     "LocalBarrier",
     "ManualClock",
@@ -123,6 +128,7 @@ __all__ = [
     "PoisonRecordError",
     "Producer",
     "ProducerClosedError",
+    "ProducerFencedError",
     "BurnRateMonitor",
     "ChaosSchedule",
     "RecordMetadata",
@@ -141,6 +147,8 @@ __all__ = [
     "StreamCheckpointer",
     "TopicPartition",
     "TpuKafkaError",
+    "TransactionStateError",
+    "TransactionalProducer",
     "batch_sharding",
     "chunk_of",
     "chunked",

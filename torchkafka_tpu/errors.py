@@ -83,6 +83,32 @@ class BrokerUnavailableError(TpuKafkaError):
     retryable = True
 
 
+class ProducerFencedError(TpuKafkaError):
+    """This transactional producer's EPOCH is stale: another producer
+    re-initialized the same ``transactional.id`` (``init_producer_id``
+    bumps the epoch, Kafka's KIP-98 fencing), so every transactional
+    operation this handle attempts is a zombie's. TERMINAL: the broker
+    already aborted the old epoch's in-flight transaction when the new
+    incarnation initialized — nothing produced under the stale epoch can
+    ever reach the committed view, and retrying the identical call cannot
+    help. The only valid responses are to re-initialize (becoming the
+    newest incarnation and fencing the OTHER one) or to exit and let a
+    supervisor respawn. The producer-side twin of ``FencedMemberError``:
+    the lease protocol fences a consumer's commits, the epoch fences a
+    producer's transactions, and the process fleet wires the two to the
+    same replica identity."""
+
+
+class TransactionStateError(TpuKafkaError):
+    """A transactional operation was issued in the wrong state — produce
+    or commit with no open transaction, begin-inside-begin with a
+    different outcome pending, offsets on a producer that never
+    initialized. TERMINAL (caller bug): Kafka's INVALID_TXN_STATE. The
+    transaction protocol is a strict begin → produce*/offsets* →
+    commit-or-abort cycle; anything else indicates the caller lost track
+    of its own state machine."""
+
+
 class FencedMemberError(TpuKafkaError):
     """This group member has been FENCED: its heartbeat lease expired (or
     a supervisor fenced it explicitly) and the broker evicted it from the

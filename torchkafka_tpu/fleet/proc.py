@@ -115,7 +115,11 @@ def build_model(model_spec: dict):
 
 class _TaggingProducer:
     """Wrap a producer so every output record carries a ``member`` header
-    naming this incarnation — the supervisor's attribution handle."""
+    naming this incarnation — the supervisor's attribution handle. Every
+    OTHER attribute (the transactional surface — begin/commit/abort/
+    send_offsets/in_transaction — when the inner producer is a
+    ``TransactionalProducer``) forwards untouched, so serve.py's
+    exactly_once mode drives transactions straight through the tag."""
 
     def __init__(self, inner, member: str) -> None:
         self._inner = inner
@@ -134,6 +138,9 @@ class _TaggingProducer:
 
     def close(self):
         return self._inner.close()
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
 
 
 def _dump_metrics(spec: dict, gen, fleet_metrics, exit_code: int) -> None:
@@ -164,7 +171,7 @@ def run_replica_worker(spec: dict, broker=None, shutdown=None) -> int:
     the process exit code: ``EXIT_CLEAN`` after a drain (idle-exit or
     SIGTERM via ``shutdown``), ``EXIT_FENCED`` when the broker evicted
     this member."""
-    from torchkafka_tpu.errors import FencedMemberError
+    from torchkafka_tpu.errors import FencedMemberError, ProducerFencedError
     from torchkafka_tpu.fleet.metrics import FleetMetrics
     from torchkafka_tpu.fleet.qos import AdmissionQueue, QoSConfig, TenantBuckets
     from torchkafka_tpu.fleet.replica import Replica, SERVING
@@ -216,7 +223,24 @@ def run_replica_worker(spec: dict, broker=None, shutdown=None) -> int:
         if hb_interval is not None and hb_mode == "thread":
             hb = _HeartbeatSender(consumer, float(hb_interval))
             hb.start()
-        producer = _TaggingProducer(MemoryProducer(broker), member)
+        exactly_once = bool(spec.get("exactly_once", False))
+        if exactly_once:
+            from torchkafka_tpu.source.producer import TransactionalProducer
+
+            # The transactional id is keyed by replica INDEX, not
+            # incarnation: a respawned replacement re-initializes the
+            # SAME id, which bumps the epoch — fencing the victim and
+            # aborting whatever transaction its death left open. That
+            # epoch bump IS the exactly-once handoff (the consumer-side
+            # twin is the member-id range slot trick above).
+            txn_id = spec.get(
+                "transactional_id",
+                f"{spec['group']}-r{int(spec.get('replica_index', 0)):03d}",
+            )
+            inner_producer = TransactionalProducer(broker, txn_id)
+        else:
+            inner_producer = MemoryProducer(broker)
+        producer = _TaggingProducer(inner_producer, member)
         journal = DecodeJournal(
             jpath, cadence=int(spec.get("journal_cadence", 4)),
         )
@@ -238,6 +262,7 @@ def run_replica_worker(spec: dict, broker=None, shutdown=None) -> int:
             rng=jax.random.key(int(spec.get("sampling_seed", 0))),
             output_producer=producer,
             output_topic=spec["out_topic"],
+            exactly_once=exactly_once,
             journal=journal,
         )
         # Cross-process warm failover, incarnation-start edition: every
@@ -310,7 +335,7 @@ def run_replica_worker(spec: dict, broker=None, shutdown=None) -> int:
                     rep.start_drain()
                     continue
                 time.sleep(0.002)
-    except FencedMemberError:
+    except (FencedMemberError, ProducerFencedError):
         exit_code = EXIT_FENCED
         # Best-effort journal flush: we are a zombie for the GROUP, but
         # our disk state is still the freshest record of the in-flight

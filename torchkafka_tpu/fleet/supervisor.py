@@ -144,6 +144,7 @@ class ProcessFleet:
         ticks_per_sync: int = 1,
         respawn: bool = True,
         journal: bool = True,
+        exactly_once: bool = False,
         broker=None,
         metrics=None,
         tracer=None,
@@ -163,6 +164,14 @@ class ProcessFleet:
         self.session_timeout_s = session_timeout_s
         self.respawn = respawn
         self._journal_on = journal
+        # Exactly-once output: every worker serves through a
+        # TransactionalProducer whose transactional id is keyed by
+        # replica INDEX (``_txn_id``), so a respawned replacement's
+        # init_producer_id fences its predecessor's epoch — and the
+        # supervisor's own fence path aborts a victim's in-flight
+        # transaction EAGERLY (``_abort_victim_txn``), so the committed
+        # view settles without waiting for a respawn.
+        self.exactly_once = exactly_once
         self.broker = broker if broker is not None else InMemoryBroker(
             session_timeout_s=session_timeout_s
         )
@@ -200,6 +209,7 @@ class ProcessFleet:
             "eos_id": eos_id,
             "heartbeat_interval_s": heartbeat_interval_s,
             "idle_exit_ms": idle_exit_ms,
+            "exactly_once": exactly_once,
         }
         self.incarnations: list[_Incarnation] = []
         self.victims: list[dict] = []  # kill_replica forensics
@@ -349,6 +359,7 @@ class ProcessFleet:
                             else "process_death",
                             None,
                         )
+                    self._abort_victim_txn(inc)
                     self._handoff(inc)
                     self._maybe_respawn(inc)
             elif inc.state != ZOMBIE and inc.member in fenced_members:
@@ -361,6 +372,7 @@ class ProcessFleet:
                 # now — the group must not run short while it stalls.
                 inc.state = ZOMBIE
                 self._note_fence(inc.member, "lease_expired", None)
+                self._abort_victim_txn(inc)
                 self._handoff(inc)
                 self._maybe_respawn(inc)
 
@@ -376,6 +388,31 @@ class ProcessFleet:
             self.tracer.replica_fenced(
                 member, reason=reason, lease_age_s=lease_age_s,
                 replica=inc.idx if inc is not None else None,
+            )
+
+    def _txn_id(self, idx: int) -> str:
+        """The transactional id for replica index ``idx`` — shared by
+        every incarnation of that slot (fleet/proc.py derives the same
+        string), which is exactly what makes a respawn's
+        init_producer_id fence its predecessor."""
+        return f"{self.group}-r{idx:03d}"
+
+    def _abort_victim_txn(self, inc: _Incarnation) -> None:
+        """Fence the victim's producer epoch and abort its in-flight
+        transaction NOW (exactly_once fleets only). Without this, a
+        victim's uncommitted outputs would stay transaction-open —
+        blocking read_committed consumers at the LSO — until a
+        replacement incarnation happens to re-initialize the id; with
+        ``respawn=False`` that is never. Ordered BEFORE any respawn, so
+        the replacement's own init lands a newer epoch on top."""
+        if not self.exactly_once:
+            return
+        try:
+            self.broker.init_producer_id(self._txn_id(inc.idx))
+        except Exception:  # noqa: BLE001 - best effort; the next
+            # incarnation's init is the backstop
+            _logger.exception(
+                "eager transaction fence for %s failed", inc.member
             )
 
     def _by_member(self, member: str) -> _Incarnation | None:
@@ -507,15 +544,23 @@ class ProcessFleet:
 
     # ------------------------------------------------------------ results
 
-    def results(self) -> dict[bytes, list[tuple[str, np.ndarray]]]:
+    def results(
+        self, isolation: str = "read_uncommitted"
+    ) -> dict[bytes, list[tuple[str, np.ndarray]]]:
         """Output-topic completions grouped by prompt key:
         ``key -> [(serving member, tokens), ...]`` in produce order —
-        duplicates visible, attribution explicit."""
+        duplicates visible, attribution explicit.
+        ``isolation="read_committed"``: only records whose transaction
+        committed (the downstream consumer's view in an exactly_once
+        fleet — the view in which duplicates are asserted ZERO)."""
         out: dict[bytes, list[tuple[str, np.ndarray]]] = {}
         for p in range(self.broker.partitions_for(self.out_topic)):
-            for rec in self.broker.fetch(
-                TopicPartition(self.out_topic, p), 0, 1000000
-            ):
+            tp = TopicPartition(self.out_topic, p)
+            if isolation == "read_committed":
+                recs, _ = self.broker.fetch_stable(tp, 0, 1000000)
+            else:
+                recs = self.broker.fetch(tp, 0, 1000000)
+            for rec in recs:
                 member = dict(rec.headers).get("member", b"?").decode()
                 out.setdefault(rec.key, []).append(
                     (member, np.frombuffer(rec.value, dtype=np.int32))

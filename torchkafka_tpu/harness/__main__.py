@@ -58,9 +58,10 @@ def main() -> None:
                     help="scenario 7 with --temperature: nucleus mass in "
                     "(0, 1] — minimal prefix reaching p stays sampleable")
     ap.add_argument("--replicas", type=int, default=2,
-                    help="scenarios 10/11/12/13/15/16 (serving fleet / chaos "
-                    "soak / prefix-cache fleet / warm failover / SLO "
-                    "observability / traffic observatory): replica count")
+                    help="scenarios 10/11/12/13/15/16/17/18 (serving fleet / "
+                    "chaos soak / prefix-cache fleet / warm failover / SLO "
+                    "observability / traffic observatory / process-fleet "
+                    "kill storm / exactly-once kill storm): replica count")
     ap.add_argument("--prefill-chunk", type=int, default=None,
                     help="scenario 14 (chunked-prefill storm): suffix "
                     "tokens the fused tick carries alongside decode "

@@ -1936,6 +1936,239 @@ def scenario_17(size: str = "tiny", replicas: int = 2) -> dict:
     }
 
 
+def scenario_18(size: str = "tiny", replicas: int = 2) -> dict:
+    """Exactly-once under SIGKILL: the scenario-17 kill storm upgraded
+    to transactional output (``ProcessFleet(exactly_once=True)``). Each
+    replica process serves through a ``TransactionalProducer`` whose
+    transactional id is keyed by replica INDEX — one transaction per
+    commit window covering that window's completions AND offsets. One
+    replica is SIGKILLed while it provably holds outputs in an OPEN
+    (uncommitted) transaction; the supervisor fences it, bumping the
+    producer epoch, which ABORTS the in-flight transaction — so a
+    ``read_committed`` consumer of the output topic observes ZERO
+    duplicates and zero losses (asserted equal, not bounded), every
+    committed completion byte-identical to the no-kill reference. A
+    commit forged from the victim's stale epoch raises
+    ``ProducerFencedError`` with the watermark and committed view
+    untouched. The at-least-once duplicates are still VISIBLE in the
+    read_uncommitted view (the aborted copies hold their offsets) —
+    exactly Kafka's shape, reported for contrast."""
+    import tempfile
+    import time as _time
+
+    import torchkafka_tpu as tk
+    from torchkafka_tpu.errors import ProducerFencedError
+    from torchkafka_tpu.fleet import ProcessFleet
+    from torchkafka_tpu.serve import StreamingGenerator
+    from torchkafka_tpu.source.records import TopicPartition
+
+    prompt_len, max_new = (8, 16) if size == "tiny" else (32, 32)
+    n = 10 if size == "tiny" else 48
+    parts, slots, commit_every = 2, 2, 4
+    cfg, params, label = _serving_model(size, None, prompt_len, max_new)
+    model_spec = dict(
+        seed=0, vocab_size=cfg.vocab_size, d_model=cfg.d_model,
+        n_layers=cfg.n_layers, n_heads=cfg.n_heads,
+        n_kv_heads=cfg.n_kv_heads, d_ff=cfg.d_ff,
+        max_seq_len=cfg.max_seq_len,
+    )
+    rng = np.random.default_rng(18)
+    prompts = rng.integers(0, cfg.vocab_size, (n, prompt_len),
+                           dtype=np.int32)
+
+    # In-process no-kill reference (greedy decode is a pure function of
+    # (params, prompt)).
+    rb = tk.InMemoryBroker()
+    rb.create_topic("t18", partitions=parts)
+    for i in range(n):
+        rb.produce("t18", prompts[i].tobytes(), partition=i % parts,
+                   key=str(i).encode())
+    rc = tk.MemoryConsumer(rb, "t18", group_id="ref18")
+    ref_gen = StreamingGenerator(
+        rc, params, cfg, slots=slots, prompt_len=prompt_len,
+        max_new=max_new, commit_every=commit_every, ticks_per_sync=1,
+    )
+    ref = {rec.key: toks for rec, toks in ref_gen.run(idle_timeout_ms=400)}
+    rc.close()
+
+    all_keys = {str(i).encode() for i in range(n)}
+    t0 = _time.perf_counter()
+    with tempfile.TemporaryDirectory() as td:
+        fleet = ProcessFleet(
+            model_spec, topic="t18", prompt_len=prompt_len,
+            max_new=max_new, workdir=td, replicas=replicas,
+            partitions=parts, slots=slots, commit_every=commit_every,
+            session_timeout_s=3.0, heartbeat_interval_s=0.2,
+            journal_cadence=1, respawn=False, group="s18",
+            exactly_once=True,
+        )
+        try:
+            fleet.start()
+            fleet.wait_ready(timeout_s=300)
+            ready_s = _time.perf_counter() - t0
+            for i in range(n):
+                fleet.broker.produce(
+                    "t18", prompts[i].tobytes(), partition=i % parts,
+                    key=str(i).encode(),
+                )
+
+            from torchkafka_tpu.journal import DecodeJournal
+
+            def uncommitted_served_work(inc) -> bool:
+                """True when the incarnation's on-disk journal holds a
+                FINISHED completion whose offset the committed watermark
+                has not passed: served work whose output has NOT reached
+                a committed transaction (in exactly-once mode staged
+                outputs are invisible until their transaction commits,
+                so the journal — pruned at every commit — is the
+                outside-observable evidence). Killing here forces the
+                abort + journal-handoff + re-serve-exactly-once path."""
+                try:
+                    entries = DecodeJournal.load(inc.journal_path)
+                except Exception:
+                    return False
+                for (topic, p, off), e in entries.items():
+                    if not e.finished or topic != "t18":
+                        continue
+                    wm = fleet.broker.committed(
+                        "s18", TopicPartition("t18", p)
+                    ) or 0
+                    if off >= wm:
+                        return True
+                return False
+
+            victim = None
+            deadline = _time.monotonic() + 240
+            while victim is None:
+                if _time.monotonic() > deadline:
+                    raise TimeoutError(
+                        "no kill opportunity arose\n" + fleet.diagnose()
+                    )
+                done = len(fleet.results("read_committed")) >= n
+                for inc in fleet.live():
+                    if done:
+                        break
+                    if uncommitted_served_work(inc):
+                        victim = fleet.kill_replica(inc.idx)
+                        break
+                if done and victim is None:
+                    raise RuntimeError(
+                        "storm finished before any replica held "
+                        "uncommitted served work — shrink commit_every"
+                    )
+                _time.sleep(0.01)
+
+            def covered(f) -> bool:
+                """Every prompt either already in the committed view or
+                FINISHED in a live member's journal (staged in its
+                outbox — the drain flush will commit it). Unlike
+                scenario 17's raw-coverage wait, nothing of the victim's
+                aborted work counts: only work that can still reach the
+                committed view."""
+                committed = set(f.results("read_committed"))
+                if committed >= all_keys:
+                    return True
+                pending = set()
+                for inc in f.live():
+                    try:
+                        entries = DecodeJournal.load(inc.journal_path)
+                    except Exception:
+                        continue
+                    for (topic, p, off), e in entries.items():
+                        if e.finished and topic == "t18":
+                            pending.add(str(off * parts + p).encode())
+                return committed | pending >= all_keys
+
+            fleet.wait(covered, timeout_s=240)
+            fleet.drain()
+            fleet.wait(
+                lambda f: all(not i.running for i in f.incarnations),
+                timeout_s=120,
+            )
+            fleet.poll_once()
+            zero_lost = fleet.fully_committed()
+
+            committed_res = fleet.results("read_committed")
+            uncommitted_res = fleet.results()
+            # THE exactly-once assertion: the committed view holds each
+            # completion EXACTLY once — zero duplicates, not a bound.
+            committed_dups = sum(
+                len(v) - 1 for v in committed_res.values()
+            )
+            raw_dups = sum(len(v) - 1 for v in uncommitted_res.values())
+            aborted_copies = (
+                sum(len(v) for v in uncommitted_res.values())
+                - sum(len(v) for v in committed_res.values())
+            )
+            identical = set(committed_res) == set(ref) and all(
+                np.array_equal(toks, ref[k])
+                for k, copies in committed_res.items()
+                for _m, toks in copies
+            )
+
+            # The epoch-fencing acceptance: a commit forged from the
+            # victim's stale epoch bounces, watermark + committed view
+            # untouched. (The supervisor's fence already bumped the
+            # victim's transactional id to a newer epoch.)
+            txn_id = f"s18-r{victim['idx']:03d}"
+            pid, cur_epoch = fleet.broker.init_producer_id(txn_id)
+            wm_before = {
+                p: fleet.broker.committed("s18", TopicPartition("t18", p))
+                for p in range(parts)
+            }
+            try:
+                fleet.broker.commit_txn(pid, cur_epoch - 1)
+                zombie_rejected = False
+            except ProducerFencedError:
+                zombie_rejected = True
+            wm_after = {
+                p: fleet.broker.committed("s18", TopicPartition("t18", p))
+                for p in range(parts)
+            }
+            committed_after_forgery = fleet.results("read_committed")
+            vic_inc = [
+                i for i in fleet.incarnations
+                if i.member == victim["member"]
+            ][0]
+            worker_m = fleet.worker_metrics()
+            warm_used = sum(
+                m["warm_resumes"] + m["served_from_journal"]
+                for m in worker_m
+            )
+            membership = fleet.broker.membership("s18")
+            elapsed = _time.perf_counter() - t0
+        finally:
+            fleet.close()
+    return {
+        "scenario": "18:exactly-once-kill-storm",
+        "model_scale": label,
+        "replicas": replicas,
+        "records": n,
+        "ready_s": round(ready_s, 2),
+        "elapsed_s": round(elapsed, 2),
+        "victim": victim["member"],
+        "victim_sigkilled": vic_inc.exit_code == -9,
+        "fence_count": membership["fence_count"],
+        "zero_lost": zero_lost,
+        "identical_to_no_kill": identical,
+        "committed_duplicates": committed_dups,
+        "read_uncommitted_duplicates": raw_dups,
+        "aborted_copies_in_log": aborted_copies,
+        "journal_handoff_entries": vic_inc.handoff_entries,
+        "warm_resumes_plus_journal_served": warm_used,
+        "zombie_txn_commit_rejected": zombie_rejected,
+        "watermark_unmoved_by_zombie": wm_before == wm_after,
+        "committed_view_unmoved_by_zombie": (
+            {k: len(v) for k, v in committed_after_forgery.items()}
+            == {k: len(v) for k, v in committed_res.items()}
+        ),
+        "exit_codes": {
+            i.member: (None if i.proc is None else i.proc.returncode)
+            for i in fleet.incarnations
+        },
+    }
+
+
 def scenario_8(size: str = "tiny") -> dict:
     """Streaming CTR: DLRM-style recommender trained from a Kafka event
     stream — label + dense features + hashed categorical ids per record,
@@ -2308,6 +2541,7 @@ SCENARIOS = {
     15: scenario_15,
     16: scenario_16,
     17: scenario_17,
+    18: scenario_18,
 }
 
 
@@ -2356,7 +2590,7 @@ def run_scenario(
         )
     sample_kw = dict(temperature=temperature, top_k=top_k, top_p=top_p)
     spec_kw = dict(spec=spec, spec_k=spec_k, spec_draft_layers=spec_draft_layers)
-    if num in (10, 11, 12, 13, 15, 16, 17):
+    if num in (10, 11, 12, 13, 15, 16, 17, 18):
         return SCENARIOS[num](size, replicas=replicas)
     if model_scale is not None:
         if num not in (5, 7):

@@ -60,6 +60,13 @@ JOURNAL_SERVED = "journal_served"
 COMMITTED = "committed"
 QUARANTINED = "quarantined"
 DROPPED = "dropped"
+# A dead-letter produce FAILED: the record's quarantine copy is NOT
+# durable. Terminal observability for the swallowed-DLQ path (the
+# stream's guard logs and continues by contract; this event + the
+# dlq_delivery_failures counter are what make a broken DLQ visible on
+# the trace stream and /metrics instead of stderr only). Not part of
+# the happy lifecycle: the record stays open/unresolved.
+DLQ_FAILED = "dlq_failed"
 # Not a record stage: a BurnRateMonitor state transition, riding the
 # same event stream (topic "slo", offset = transition sequence) so
 # overload state changes land in the trace, ordered against the record
@@ -77,8 +84,8 @@ JOURNAL_HANDOFF = "journal_handoff"
 STAGES = (
     POLLED, QOS_ADMITTED, DEFERRED, PREFILL_QUEUED, CHUNK_SCHEDULED,
     WARM_RESUMED, SLOT_ACTIVE, TOKENS, FINISHED, JOURNAL_SERVED, COMMITTED,
-    QUARANTINED, DROPPED, BURN_STATE, REPLICA_JOINED, REPLICA_FENCED,
-    JOURNAL_HANDOFF,
+    QUARANTINED, DROPPED, DLQ_FAILED, BURN_STATE, REPLICA_JOINED,
+    REPLICA_FENCED, JOURNAL_HANDOFF,
 )
 
 
@@ -429,6 +436,15 @@ class RecordTracer:
             self._emit(DROPPED, rec.topic, rec.partition, rec.offset,
                        (("replica", replica),))
             self._open.pop((rec.topic, rec.partition, rec.offset), None)
+
+    def dlq_failed(self, rec: Record, replica=None) -> None:
+        """A dead-letter produce for ``rec`` failed — the quarantine copy
+        is NOT durable. The record's lifecycle stays OPEN (it is neither
+        served, dropped, nor durably quarantined), which is exactly what
+        the trace should say about it."""
+        with self._lock:
+            self._emit(DLQ_FAILED, rec.topic, rec.partition, rec.offset,
+                       (("replica", replica),))
 
     def note_commit(self, snapshot: dict) -> None:
         """A successful offset commit: every FINISHED lifecycle whose

@@ -287,6 +287,11 @@ class KafkaStream:
             try:
                 self._dead_letter(record, exc)
             except Exception:  # noqa: BLE001 - a broken DLQ must not kill ingest
+                # Swallowed by contract, but never SILENTLY: the counter
+                # puts a broken DLQ on the /metrics endpoint (the record
+                # really is lost to the DLQ — that must page someone, not
+                # scroll past in stderr).
+                self.metrics.dlq_delivery_failures.add(1)
                 _logger.exception("dead_letter callback raised; record lost to DLQ")
 
     def _apply(self, record):

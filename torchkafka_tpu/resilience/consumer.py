@@ -254,6 +254,22 @@ class ResilientConsumer(ConsumerIterMixin):
     def close(self) -> None:
         self._inner.close()
 
+    # Group metadata (transactional offset commits present it so the
+    # broker fences them generation-checked): forwarded where the inner
+    # transport has it, None where it does not.
+
+    @property
+    def group_id(self):
+        return getattr(self._inner, "group_id", None)
+
+    @property
+    def member_id(self):
+        return getattr(self._inner, "member_id", None)
+
+    @property
+    def generation(self):
+        return getattr(self._inner, "generation", None)
+
     # Iteration via ConsumerIterMixin over SELF.poll so the record-at-a-time
     # loop shape rides the resilient path too (same pattern as ChaosConsumer:
     # delegating to iter(inner) would bypass every retry).

@@ -40,6 +40,23 @@ journal_handoff_pre_load   a replica (or recovery incarnation) dies inside
                            the peer-journal scan, before hints load — the
                            journals on disk stay intact; the next scan
                            warm-resumes exactly the same entries
+txn_begin_post             a transaction is open on the broker, nothing
+                           produced in it — the next incarnation's
+                           init_producer_id fences the epoch and aborts it;
+                           recovery must leave NO trace in the committed view
+txn_produce_mid            some of a commit window's outputs are in the open
+                           transaction, the rest never will be — none may
+                           surface committed; recovery re-serves the whole
+                           window exactly once
+txn_pre_commit             records + offsets staged, commit_txn not yet
+                           issued — the exactly-once twin of pre_commit:
+                           death aborts, recovery's committed view holds
+                           each output ONCE (vs at-least-once's duplicates)
+txn_post_commit_pre_ack    the transaction committed ON the broker but the
+                           producer dies before observing the ack — offsets
+                           moved atomically with the records, so recovery
+                           re-serves NOTHING; the committed view already
+                           holds the single copy
 ========================== =================================================
 
 Sites call ``crash_hook("<name>")``; production cost is one global ``is
@@ -77,6 +94,10 @@ REGISTERED_CRASH_POINTS: tuple[str, ...] = (
     "heartbeat_pre_send",
     "lease_expired_pre_fence",
     "journal_handoff_pre_load",
+    "txn_begin_post",
+    "txn_produce_mid",
+    "txn_pre_commit",
+    "txn_post_commit_pre_ack",
 )
 
 ENV_VAR = "TORCHKAFKA_CRASHPOINT"

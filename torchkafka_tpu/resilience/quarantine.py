@@ -83,10 +83,32 @@ class PoisonQuarantine:
         self._counts: dict[tuple[str, int, int], int] = {}
         self.failures = RateMeter()  # every note_failure call
         self.quarantined = RateMeter()  # records dead-lettered (resolved)
+        self.dlq_failures = RateMeter()  # DLQ produces that FAILED (each
+        # one raised OutputDeliveryError — fail-stop — but the count
+        # survives for the /metrics view of a broken DLQ)
+        # The exact send kwargs of the most recent SUCCESSFUL dead-letter
+        # produce — forensic/observability handle (what exactly went to
+        # the DLQ, provenance headers included).
+        self.last_dead_letter: dict | None = None
 
     @property
     def topic(self) -> str:
         return self._topic
+
+    @property
+    def producer(self):
+        """The DLQ producer (read-only). serve.py's exactly_once mode
+        validates the quarantine shares its transactional producer —
+        the atomicity argument needs one transaction, not two brokers."""
+        return self._producer
+
+    def rebind_producer(self, producer) -> None:
+        """Swap the DLQ delivery path. serve.py's exactly_once mode
+        rebinds the quarantine onto its transactional outbox so the
+        dead-letter copy is produced INSIDE the commit window's
+        transaction — atomic with the offset that retires the poison
+        record — rather than acknowledged ahead of it."""
+        self._producer = producer
 
     def attempts(self, record: Record) -> int:
         """Failures recorded so far for this record (0 if unseen/resolved)."""
@@ -126,23 +148,29 @@ class PoisonQuarantine:
         return True
 
     def _dead_letter(self, record: Record, exc: BaseException, attempts: int) -> None:
+        kwargs = dict(
+            topic=self._topic,
+            value=record.value,
+            key=record.key,
+            headers=(
+                ("dlq.error", str(exc).encode()),
+                ("dlq.topic", record.topic.encode()),
+                ("dlq.partition", str(record.partition).encode()),
+                ("dlq.offset", str(record.offset).encode()),
+                ("dlq.attempts", str(attempts).encode()),
+            ),
+        )
         try:
             self._producer.send(
-                self._topic,
-                record.value,
-                key=record.key,
-                headers=(
-                    ("dlq.error", str(exc).encode()),
-                    ("dlq.topic", record.topic.encode()),
-                    ("dlq.partition", str(record.partition).encode()),
-                    ("dlq.offset", str(record.offset).encode()),
-                    ("dlq.attempts", str(attempts).encode()),
-                ),
+                kwargs["topic"], kwargs["value"], key=kwargs["key"],
+                headers=kwargs["headers"],
             ).get(self._timeout_s)
         except Exception as e:  # noqa: BLE001 - any DLQ failure fails stop
+            self.dlq_failures.add(1)
             raise OutputDeliveryError(
                 f"dead-letter produce to {self._topic!r} failed for "
                 f"{record.topic}@{record.partition}:{record.offset}; "
                 "refusing to resolve the record without a durable "
                 "quarantine copy (crash-before-commit: it re-delivers)"
             ) from e
+        self.last_dead_letter = kwargs

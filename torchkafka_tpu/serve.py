@@ -48,6 +48,7 @@ from torchkafka_tpu.errors import (
     CommitFailedError,
     ConsumerClosedError,
     OutputDeliveryError,
+    ProducerFencedError,
 )
 from torchkafka_tpu.journal import DecodeJournal, JournalEntry, value_crc
 from torchkafka_tpu.kvcache import (
@@ -72,7 +73,7 @@ from torchkafka_tpu.models.generate import (
 )
 from torchkafka_tpu.models.quant import embed_rows, load_weight
 from torchkafka_tpu.models.transformer import TransformerConfig, _rms_norm, _rope
-from torchkafka_tpu.source.records import Record
+from torchkafka_tpu.source.records import Record, TopicPartition
 from torchkafka_tpu.utils import tracing as xprof
 from torchkafka_tpu.utils.metrics import Gauge, LatencyHistogram, RateMeter
 
@@ -231,6 +232,20 @@ class ServeMetrics:
         self.commit_failures = RateMeter()
         self.output_flush_failures = RateMeter()  # output topic not durable
         self.output_send_failures = RateMeter()  # sync send refusals (stall)
+        self.dlq_delivery_failures = RateMeter()  # quarantine DLQ produces
+        # that FAILED (the serve path fail-stops on them, but the count
+        # outlives the crash on /metrics — a broken DLQ must page, not
+        # only kill)
+        # Exactly-once output (exactly_once=True): one transaction per
+        # commit window. All zero in at-least-once mode.
+        self.txn_commits = RateMeter()  # transactions committed (records
+        # + offsets atomic)
+        self.txn_aborts = RateMeter()  # transactions aborted (survivable
+        # commit failure, send fault, or defensive abort)
+        self.txn_held_outputs = Gauge()  # outbox entries a commit could
+        # NOT yet publish: finished out of completion order, their
+        # offsets above the in-order watermark — published by a later
+        # window the moment the watermark passes them
         self.commit_latency = LatencyHistogram()  # full commit path: output
         # flush + durability waits + offset commit (see _commit docstring)
         self.slot_occupancy = Gauge()  # active slots / pool size, last tick
@@ -329,6 +344,12 @@ class ServeMetrics:
             "commit_failures": self.commit_failures.count,
             "output_flush_failures": self.output_flush_failures.count,
             "output_send_failures": self.output_send_failures.count,
+            "dlq_delivery_failures": self.dlq_delivery_failures.count,
+            "txn": {
+                "commits": self.txn_commits.count,
+                "aborts": self.txn_aborts.count,
+                "held_outputs": int(self.txn_held_outputs.value),
+            },
             "commit": self.commit_latency.summary(),
             "slot_occupancy": round(self.slot_occupancy.value, 3),
             "ticks": self.tick_time.count,
@@ -411,6 +432,10 @@ class ServeMetrics:
             ("commit_failures_total", "counter", s["commit_failures"]),
             ("output_flush_failures_total", "counter", s["output_flush_failures"]),
             ("output_send_failures_total", "counter", s["output_send_failures"]),
+            ("dlq_delivery_failures_total", "counter", s["dlq_delivery_failures"]),
+            ("txn_commits_total", "counter", s["txn"]["commits"]),
+            ("txn_aborts_total", "counter", s["txn"]["aborts"]),
+            ("txn_held_outputs", "gauge", s["txn"]["held_outputs"]),
             ("commit_latency_p50_milliseconds", "gauge", s["commit"]["p50_ms"]),
             ("commit_latency_p99_milliseconds", "gauge", s["commit"]["p99_ms"]),
             ("completions_per_second", "gauge", s["completions_per_s"]),
@@ -493,6 +518,45 @@ class _PendingPrefill:
         self.enq_tick = enq_tick
 
 
+class _TxnOutboxProducer:
+    """The quarantine's producer in exactly-once mode: dead-letter
+    produces are STAGED into the server's transactional outbox (keyed by
+    the poison record's identity, parsed from the ``dlq.*`` provenance
+    headers the quarantine always writes) instead of sent immediately —
+    they are produced inside the commit window's transaction, atomic
+    with the offset that retires the record. The returned handle
+    resolves immediately: in transactional mode durability IS the
+    transaction commit, which the commit discipline already gates before
+    any offset becomes durable."""
+
+    def __init__(self, server: "StreamingGenerator") -> None:
+        self._server = server
+
+    def send(self, topic, value, *, key=None, partition=None,
+             timestamp_ms=None, headers=()):
+        from torchkafka_tpu.source.producer import (
+            RecordMetadata,
+            _ResolvedSend,
+        )
+
+        h = {k: v for k, v in headers}
+        ident = (
+            h["dlq.topic"].decode(),
+            int(h["dlq.partition"]),
+            int(h["dlq.offset"]),
+        )
+        self._server._txn_outbox[ident] = dict(
+            topic=topic, value=value, key=key, headers=tuple(headers),
+        )
+        return _ResolvedSend(RecordMetadata(topic, -1, -1))
+
+    def flush(self, timeout_s=None) -> None:
+        pass  # staged sends settle at transaction commit
+
+    def close(self) -> None:
+        pass
+
+
 def _record_tenant(record: Record) -> str:
     """Tenant = the record key (the rule fleet/qos.py and obs/trace.py
     admit and label by), for the per-tenant cache-locality counters."""
@@ -543,6 +607,7 @@ class StreamingGenerator:
         rng: jax.Array | None = None,
         output_producer=None,
         output_topic: str | None = None,
+        exactly_once: bool = False,
         encode_output: Callable[[Record, np.ndarray], bytes] | None = None,
         max_send_failure_streak: int = 64,
         quarantine=None,
@@ -585,6 +650,33 @@ class StreamingGenerator:
         the prompts that produced them commit, so a crash regenerates
         instead of losing completions (at-least-once end to end; the
         output topic may see duplicates, keyed by the prompt's key).
+
+        ``exactly_once``: the TRANSACTIONAL output mode — pass a
+        ``source.producer.TransactionalProducer`` (or any object with
+        its begin/send/send_offsets/commit/abort surface; the kafka
+        adapter's ``KafkaTransactionalProducer`` qualifies) as
+        ``output_producer`` and every commit window becomes ONE broker
+        transaction covering that window's completions AND their source
+        offsets, Kafka-KIP-98-style. Consequences, each the upgrade of
+        an at-least-once behavior above: completions are invisible to
+        ``read_committed`` consumers until the window's offsets commit
+        WITH them (no more duplicates-on-replay — a crash before commit
+        aborts the transaction and the regenerated outputs are the only
+        committed copy); a survivable commit failure (rebalance) aborts
+        the whole window and this server re-produces, inside the NEXT
+        transaction, exactly the window outputs for partitions it still
+        owns (departed partitions' records re-serve on their new owner —
+        the only committed copy, again); the quarantine's DLQ produce
+        rides the same transaction, so poison retirement (DLQ copy +
+        offset) is atomic too; and journal-re-served completions are
+        produced inside the new incarnation's transaction while the dead
+        incarnation's uncommitted transaction was aborted by the epoch
+        fence at ``TransactionalProducer`` construction — never
+        double-published. A ``ProducerFencedError`` anywhere on this
+        path is terminal fail-stop: another incarnation owns this
+        replica's transactional id; serving on would be zombie work.
+        ``read_uncommitted`` consumers (the default everywhere) observe
+        the output topic exactly as before.
 
         ``mesh``: model-sharded serving (``jax.sharding.Mesh``) — params
         are committed to the training ``param_specs`` layouts (tp/fsdp,
@@ -761,6 +853,61 @@ class StreamingGenerator:
             )
         self._output_producer = output_producer
         self._output_topic = output_topic
+        if exactly_once:
+            if output_producer is None:
+                raise ValueError(
+                    "exactly_once requires output_producer/output_topic "
+                    "(the transaction is the output path)"
+                )
+            missing = [
+                m for m in ("begin", "send_offsets", "commit", "abort")
+                if not callable(getattr(output_producer, m, None))
+            ]
+            if missing:
+                raise ValueError(
+                    "exactly_once requires a transactional producer "
+                    "(source.producer.TransactionalProducer surface); "
+                    f"output_producer lacks {missing}"
+                )
+            if quarantine is not None and (
+                getattr(quarantine, "producer", None) is not output_producer
+            ):
+                raise ValueError(
+                    "exactly_once requires the quarantine to share the "
+                    "transactional output producer (its DLQ produce must "
+                    "ride the same transaction as the offset that retires "
+                    "the poison record); build PoisonQuarantine over the "
+                    "same TransactionalProducer instance"
+                )
+        self._txn_mode = exactly_once
+        # The transactional OUTBOX: outputs (and DLQ copies) staged by
+        # record identity, PRODUCED ONLY AT COMMIT TIME and only for
+        # offsets the in-order ledger snapshot covers. Holding sends to
+        # the commit point is what makes "outputs + offsets one atomic
+        # unit" literally true: an out-of-completion-order output whose
+        # offset the watermark cannot yet cover would otherwise commit
+        # in one transaction while its record stays redeliverable —
+        # the redelivered re-serve then double-publishes. Keyed staging
+        # also dedups the eager-rebalance re-serve for free (the second
+        # completion overwrites the identical first). Entries survive
+        # aborted transactions untouched (the retry re-sends them) and
+        # leave only with the committed transaction that covered them.
+        self._txn_outbox: dict[tuple[str, int, int], dict] = {}
+        # High-water of offsets ALREADY covered by this server's
+        # committed transactions. An eager rebalance can hand the server
+        # a second copy of a record it fetched before the generation
+        # bump (old copy queued, new copy redelivered); if the first
+        # copy's window commits before the second copy finishes, the
+        # re-serve re-stages the same identity AFTER its covering commit
+        # — without this watermark the next window would publish it
+        # again. Entries below it are duplicate serves of committed
+        # records and are dropped at the commit point.
+        self._txn_committed_wm: dict = {}
+        if exactly_once and quarantine is not None:
+            # Route the DLQ produce into the outbox: the quarantine copy
+            # commits atomically WITH the offset that retires the poison
+            # record, instead of racing ahead of it.
+            quarantine.rebind_producer(_TxnOutboxProducer(self))
         self._encode_output = encode_output or (
             lambda rec, toks: np.asarray(toks, np.int32).tobytes()
         )
@@ -2267,7 +2414,23 @@ class StreamingGenerator:
                     return rec, self._decode_prompt(rec)
                 except Exception as exc:
                     if self._quarantine is not None:
-                        if not self._quarantine.note_failure(rec, exc):
+                        # In exactly_once mode the quarantine's producer
+                        # was rebound onto the transactional outbox at
+                        # construction: its dead-letter produce stages by
+                        # record identity and commits atomically with
+                        # the offset that retires the poison record (a
+                        # re-quarantine after redelivery overwrites the
+                        # identical entry — one committed DLQ copy).
+                        try:
+                            resolved = self._quarantine.note_failure(rec, exc)
+                        except OutputDeliveryError:
+                            self.metrics.dlq_delivery_failures.add(1)
+                            if self._tracer is not None:
+                                self._tracer.dlq_failed(
+                                    rec, replica=self._trace_replica
+                                )
+                            raise
+                        if not resolved:
                             continue  # budget left: re-attempt in place
                         self.metrics.quarantined.add(1)
                         if self._tracer is not None:
@@ -2486,6 +2649,17 @@ class StreamingGenerator:
             self._journal.flush()
         return filled
 
+    def _txn_abort(self) -> None:
+        """Defensive abort of an in-flight transaction (best effort — a
+        dead broker just leaves it for the next ``begin`` or the next
+        incarnation's epoch fence to abort). The outbox is untouched:
+        its entries re-send inside the next window's transaction."""
+        try:
+            if self._output_producer.abort():
+                self.metrics.txn_aborts.add(1)
+        except Exception:  # noqa: BLE001 - the broker will abort it
+            _logger.debug("defensive transaction abort failed", exc_info=True)
+
     def _retire_completion(
         self, rec: Record, out: np.ndarray,
         completions: list[tuple[Record, np.ndarray]],
@@ -2512,24 +2686,42 @@ class StreamingGenerator:
             # emitted() so the ledger watermark stalls at exactly
             # this record — it re-delivers and regenerates on
             # restart.
-            try:
-                self._pending_outputs.append(
-                    self._output_producer.send(
-                        self._output_topic,
-                        self._encode_output(rec, out),
-                        key=rec.key,
+            if self._txn_mode:
+                # STAGE, don't send: the outbox entry is produced inside
+                # the commit window's transaction — and only once the
+                # in-order watermark covers this record's offset, so its
+                # output and its offset are one atomic broker decision.
+                # Keyed by record identity: an eager-rebalance re-serve
+                # of the same record overwrites the identical entry (one
+                # committed copy, ever). Nothing here can fail, so the
+                # send-failure streak machinery doesn't apply — output
+                # path health surfaces at transaction commit instead.
+                self._txn_outbox[(rec.topic, rec.partition, rec.offset)] = (
+                    dict(
+                        topic=self._output_topic,
+                        value=self._encode_output(rec, out),
+                        key=rec.key, headers=(),
                     )
                 )
-                self._send_failure_streak = 0
-            except Exception:  # noqa: BLE001 - fail closed per record
-                sent_ok = False
-                self.metrics.output_send_failures.add(1)
-                self._send_failure_streak += 1
-                _logger.exception(
-                    "output send failed for %s@%d:%d; leaving "
-                    "it uncommitted to re-deliver",
-                    rec.topic, rec.partition, rec.offset,
-                )
+            else:
+                try:
+                    self._pending_outputs.append(
+                        self._output_producer.send(
+                            self._output_topic,
+                            self._encode_output(rec, out),
+                            key=rec.key,
+                        )
+                    )
+                    self._send_failure_streak = 0
+                except Exception:  # noqa: BLE001 - fail closed per record
+                    sent_ok = False
+                    self.metrics.output_send_failures.add(1)
+                    self._send_failure_streak += 1
+                    _logger.exception(
+                        "output send failed for %s@%d:%d; leaving "
+                        "it uncommitted to re-deliver",
+                        rec.topic, rec.partition, rec.offset,
+                    )
                 if (
                     self._send_failure_streak
                     >= self._max_send_failure_streak
@@ -2719,8 +2911,14 @@ class StreamingGenerator:
         open circuit, broker fault) leaves the cadence counter intact, so
         the completions stay commit-pending and the next cadence point or
         flush retries them — a transient failure at the final flush no
-        longer silently strands the tail uncommitted until restart."""
-        if self._uncommitted and self._commit():
+        longer silently strands the tail uncommitted until restart.
+        In exactly_once mode a non-empty outbox also forces the flush:
+        held out-of-order outputs (e.g. behind a record that resolved
+        as DROPPED, which advances no completion counter) must still
+        reach a committed transaction."""
+        if (
+            self._uncommitted or (self._txn_mode and self._txn_outbox)
+        ) and self._commit():
             self._uncommitted = 0
 
     @property
@@ -2812,8 +3010,13 @@ class StreamingGenerator:
 
         ``commit_latency`` observes the WHOLE commit path — output flush +
         per-handle durability waits + the offset commit — so an
-        output-broker stall shows up in the p99 an operator watches."""
+        output-broker stall shows up in the p99 an operator watches.
+
+        With ``exactly_once`` the whole discipline above collapses into
+        ONE transaction commit — see ``_commit_txn``."""
         t0 = time.perf_counter()
+        if self._txn_mode:
+            return self._commit_txn(t0)
         if self._output_producer is not None:
             try:
                 self._output_producer.flush()
@@ -2882,6 +3085,141 @@ class StreamingGenerator:
             # Journal GC at commit flush: entries below the committed
             # watermark are durable history — pruning here is what bounds
             # the journal file by in-flight work.
+            self._journal.prune(snapshot)
+            self._journal.flush()
+        return True
+
+    def _commit_txn(self, t0: float) -> bool:
+        """The exactly-once commit: ONE short-lived transaction per
+        window — begin, produce every outbox entry the in-order ledger
+        snapshot covers (outputs and DLQ copies alike), stage the
+        snapshot's offsets with the consumer's CURRENT group metadata,
+        commit. Outputs whose offsets the watermark cannot yet cover
+        (completions that finished out of order behind a still-pending
+        record) are HELD for a later window — publishing them early is
+        exactly the committed-output-with-redeliverable-offset hole that
+        turns a rebalance into duplicates. Failure classes:
+
+        - ``CommitFailedError`` (rebalance/fencing): SURVIVABLE — the
+          broker aborted records + offsets atomically; the outbox is
+          untouched, so the next window re-sends whatever this replica
+          still owns (the snapshot filter drops departed partitions,
+          whose records re-serve on their new owner).
+        - ``ProducerFencedError``: TERMINAL — another incarnation owns
+          this transactional id; raise (fail-stop, the process fleet
+          exits EXIT_FENCED).
+        - transport faults: abort defensively and return False — a
+          commit whose ack was eaten is answered idempotently by the
+          broker on retry.
+
+        ``commit_latency`` observes the whole path — the transaction's
+        produces + offset staging + atomic commit — so the measured
+        "transaction tax" is honest against the legacy flush-then-commit
+        p99."""
+        p = self._output_producer
+        snapshot = self._ledger.snapshot()
+        try:
+            assigned = set(self._consumer.assignment())
+        except Exception:  # noqa: BLE001 - transport hiccup: commit as-is
+            assigned = None
+        if assigned is not None:
+            stray = [tp for tp in snapshot if tp not in assigned]
+            if stray:
+                _logger.info(
+                    "dropping %d departed partition(s) from txn commit "
+                    "after rebalance: %s", len(stray), sorted(stray),
+                )
+                snapshot = {
+                    tp: off for tp, off in snapshot.items()
+                    if tp in assigned
+                }
+            # Outbox entries for departed partitions are STALE: their
+            # records either committed under this replica already (never
+            # redeliver) or re-serve on the new owner (the only copy).
+            # If the partition ever comes back, redelivery re-stages
+            # fresh entries; keeping these would double-publish.
+            stale = [
+                ident for ident in self._txn_outbox
+                if TopicPartition(ident[0], ident[1]) not in assigned
+            ]
+            for ident in stale:
+                del self._txn_outbox[ident]
+        dup_serves = [
+            ident for ident in self._txn_outbox
+            if ident[2] < self._txn_committed_wm.get(
+                TopicPartition(ident[0], ident[1]), 0
+            )
+        ]
+        for ident in dup_serves:
+            # A re-serve of a record a previous window already committed
+            # (both copies of an eager-rebalance double delivery ran to
+            # completion): the committed view has its single copy.
+            del self._txn_outbox[ident]
+        if dup_serves:
+            _logger.info(
+                "dropped %d duplicate re-serve(s) already covered by "
+                "committed transactions", len(dup_serves),
+            )
+        sendable = [
+            ident for ident in self._txn_outbox
+            if ident[2] < snapshot.get(TopicPartition(ident[0], ident[1]), 0)
+        ]
+        if not snapshot and not p.in_transaction:
+            return True  # nothing resolved, nothing dangling: no-op
+        try:
+            # begin() also aborts any transaction a lost commit ack left
+            # dangling broker-side, so state drift self-heals here.
+            p.begin()
+            for ident in sendable:
+                kw = self._txn_outbox[ident]
+                p.send(
+                    kw["topic"], kw["value"], key=kw["key"],
+                    headers=kw.get("headers", ()),
+                )
+            if snapshot:
+                p.send_offsets(
+                    getattr(self._consumer, "group_id"), snapshot,
+                    member_id=getattr(self._consumer, "member_id", None),
+                    generation=getattr(self._consumer, "generation", None),
+                )
+            p.commit()
+        except ProducerFencedError:
+            self.metrics.commit_failures.add(1)
+            self.metrics.txn_aborts.add(1)
+            _logger.exception(
+                "transactional producer fenced; failing stop — the "
+                "successor incarnation owns this replica's outputs now"
+            )
+            raise
+        except CommitFailedError:
+            self.metrics.commit_failures.add(1)
+            self.metrics.txn_aborts.add(1)
+            self._txn_abort()  # defensive: send_offsets may refuse pre-commit
+            _logger.exception(
+                "transaction aborted on commit failure; the outbox "
+                "re-sends next window, departed records re-serve on "
+                "their new owner"
+            )
+            return False
+        except Exception:  # noqa: BLE001 - transport fault: retry later
+            self.metrics.commit_failures.add(1)
+            self._txn_abort()
+            _logger.exception(
+                "transaction commit failed in flight; aborted "
+                "defensively — the outbox re-sends next flush"
+            )
+            return False
+        for ident in sendable:
+            del self._txn_outbox[ident]
+        for tp, off in snapshot.items():
+            if off > self._txn_committed_wm.get(tp, 0):
+                self._txn_committed_wm[tp] = off
+        self.metrics.txn_commits.add(1)
+        self.metrics.txn_held_outputs.set(float(len(self._txn_outbox)))
+        self.metrics.commit_latency.observe(time.perf_counter() - t0)
+        if self._tracer is not None:
+            self._tracer.note_commit(snapshot)
+        if self._journal is not None:
             self._journal.prune(snapshot)
             self._journal.flush()
         return True

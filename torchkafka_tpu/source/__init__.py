@@ -7,6 +7,7 @@ from torchkafka_tpu.source.kafka import (
     HAVE_KAFKA_PYTHON,
     KafkaConsumer,
     KafkaProducer,
+    KafkaTransactionalProducer,
 )
 from torchkafka_tpu.source.memory import InMemoryBroker, MemoryConsumer
 from torchkafka_tpu.source.netbroker import BrokerClient, BrokerServer
@@ -14,6 +15,7 @@ from torchkafka_tpu.source.producer import (
     MemoryProducer,
     Producer,
     RecordMetadata,
+    TransactionalProducer,
     dead_letter_to_topic,
 )
 from torchkafka_tpu.source.records import Record, TopicPartition
@@ -28,9 +30,11 @@ __all__ = [
     "InMemoryBroker",
     "KafkaConsumer",
     "KafkaProducer",
+    "KafkaTransactionalProducer",
     "MemoryConsumer",
     "MemoryProducer",
     "Producer",
+    "TransactionalProducer",
     "RecordMetadata",
     "dead_letter_to_topic",
     "seek_to_timestamp",

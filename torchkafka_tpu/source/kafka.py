@@ -107,6 +107,7 @@ class KafkaConsumer(ConsumerIterMixin):
             else [topics] if isinstance(topics, str) else list(topics)
         )
         self._closed = False
+        self._group_id = kafka_kwargs.get("group_id")
         self._any_paused = False  # O(1) hint for ConsumerIterMixin's hot loop
         # Iteration is built on poll() via ConsumerIterMixin, so the
         # iterator-ending timeout and the yielded-position tracking both live
@@ -196,6 +197,21 @@ class KafkaConsumer(ConsumerIterMixin):
     def committed(self, tp: TopicPartition) -> int | None:
         self._check_open()
         return self._consumer.committed(_ktp(tp))
+
+    @property
+    def group_id(self) -> str | None:
+        return self._group_id
+
+    @property
+    def member_id(self) -> str | None:
+        """Group metadata parity with MemoryConsumer: None — on Kafka the
+        transaction coordinator fences transactional offset commits
+        broker-side, so the client presents only the group id."""
+        return None
+
+    @property
+    def generation(self) -> int | None:
+        return None
 
     def position(self, tp: TopicPartition) -> int:
         self._check_open()
@@ -358,4 +374,159 @@ class KafkaProducer:
         if self._closed:
             return
         self._closed = True
+        self._producer.close()
+
+
+def _fenced_error_types():
+    """kafka-python's producer-fencing error classes, where they exist
+    (the transactional API landed in kafka-python 2.1; older releases
+    have neither the methods nor the errors)."""
+    return tuple(
+        t for t in (
+            getattr(_kafka_errors, "ProducerFenced", None),
+            getattr(_kafka_errors, "ProducerFencedError", None),
+            getattr(_kafka_errors, "InvalidProducerEpochError", None),
+        ) if t is not None
+    )
+
+
+class KafkaTransactionalProducer:
+    """``TransactionalProducer``'s surface mapped onto kafka-python's
+    NATIVE transactional API (KafkaProducer(transactional_id=...) +
+    init_transactions/begin_transaction/send_offsets_to_transaction/
+    commit_transaction/abort_transaction) — Kafka's own EOS does the
+    heavy lifting; this adapter only translates types: framework
+    ``TopicPartition`` offsets cross into kafka-python's, and the
+    client's fencing errors surface as the framework's terminal
+    ``ProducerFencedError`` so callers classify identically on every
+    transport. Requires kafka-python >= 2.1 (the release that grew
+    transactions); constructing on an older client raises a clear error
+    rather than failing method-by-method."""
+
+    def __init__(self, transactional_id: str, **kafka_kwargs) -> None:
+        if not HAVE_KAFKA_PYTHON:  # pragma: no cover
+            raise ImportError(
+                "kafka-python is not installed; install it or use "
+                "torchkafka_tpu.source.producer.TransactionalProducer "
+                "over an InMemoryBroker/BrokerClient"
+            )
+        if not hasattr(_kafka.KafkaProducer, "init_transactions"):
+            raise RuntimeError(
+                "this kafka-python has no transactional API "
+                "(init_transactions et al. landed in 2.1); upgrade the "
+                "client to use KafkaTransactionalProducer"
+            )
+        self._closed = False
+        self._in_txn = False
+        self._txn_id = transactional_id
+        self._producer = _kafka.KafkaProducer(
+            transactional_id=transactional_id, **kafka_kwargs
+        )
+        self._translate(self._producer.init_transactions)
+
+    def _translate(self, fn, *args, **kwargs):
+        fenced = _fenced_error_types()
+        try:
+            return fn(*args, **kwargs)
+        except fenced as e:  # pragma: no cover - needs a live broker race
+            raise errors.ProducerFencedError(str(e)) from e
+        except _kafka_errors.CommitFailedError as e:  # pragma: no cover
+            raise errors.CommitFailedError(str(e)) from e
+
+    @property
+    def transactional_id(self) -> str:
+        return self._txn_id
+
+    @property
+    def in_transaction(self) -> bool:
+        return self._in_txn
+
+    def _check_open(self) -> None:
+        if self._closed:
+            raise errors.ProducerClosedError("producer is closed")
+
+    def begin(self) -> None:
+        self._check_open()
+        self._translate(self._producer.begin_transaction)
+        self._in_txn = True
+
+    def send(
+        self,
+        topic: str,
+        value: bytes,
+        *,
+        key: bytes | None = None,
+        partition: int | None = None,
+        timestamp_ms: int | None = None,
+        headers: tuple[tuple[str, bytes], ...] = (),
+    ) -> _KafkaSendHandle:
+        self._check_open()
+        if not self._in_txn:
+            raise errors.TransactionStateError(
+                "send outside a transaction; call begin() first"
+            )
+        fut = self._translate(
+            self._producer.send, topic, value=value, key=key,
+            partition=partition, timestamp_ms=timestamp_ms,
+            headers=list(headers) or None,
+        )
+        return _KafkaSendHandle(fut)
+
+    def send_offsets(
+        self,
+        group_id: str,
+        offsets: Mapping[TopicPartition, int],
+        *,
+        member_id: str | None = None,
+        generation: int | None = None,
+    ) -> None:
+        """``member_id``/``generation`` are accepted for surface parity
+        and ignored: kafka-python's send_offsets_to_transaction carries
+        the group id, and the BROKER's transaction coordinator does the
+        generation fencing (the memory transport checks in-process)."""
+        self._check_open()
+        if not self._in_txn:
+            raise errors.TransactionStateError(
+                "send_offsets outside a transaction; call begin() first"
+            )
+        converted = {
+            _ktp(tp): _offset_and_metadata(off) for tp, off in offsets.items()
+        }
+        self._translate(
+            self._producer.send_offsets_to_transaction, converted, group_id
+        )
+
+    def commit(self) -> None:
+        self._check_open()
+        if not self._in_txn:
+            raise errors.TransactionStateError("no transaction to commit")
+        try:
+            self._translate(self._producer.commit_transaction)
+        finally:
+            self._in_txn = False
+
+    def abort(self) -> bool:
+        self._check_open()
+        if not self._in_txn:
+            return False
+        try:
+            self._translate(self._producer.abort_transaction)
+        finally:
+            self._in_txn = False
+        return True
+
+    def flush(self, timeout_s: float | None = None) -> None:
+        self._check_open()
+        self._producer.flush(timeout=timeout_s)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._in_txn:  # pragma: no cover - teardown best-effort
+            try:
+                self._producer.abort_transaction()
+            except Exception:  # noqa: BLE001
+                pass
+            self._in_txn = False
         self._producer.close()

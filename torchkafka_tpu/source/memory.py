@@ -45,6 +45,8 @@ from torchkafka_tpu.errors import (
     ConsumerClosedError,
     FencedMemberError,
     NotAssignedError,
+    ProducerFencedError,
+    TransactionStateError,
     UnknownTopicError,
 )
 from torchkafka_tpu.source.consumer import ConsumerIterMixin
@@ -71,6 +73,36 @@ class _Group:
         # UNKNOWN_MEMBER_ID) rather than a confusing KeyError.
         self.fenced: set[str] = set()
         self.fence_count = 0
+
+
+class _Txn:
+    """One in-flight transaction: the records appended under it (by log
+    position) and the offset commits buffered to land atomically with
+    them."""
+
+    __slots__ = ("seq", "records", "offsets")
+
+    def __init__(self, seq: int) -> None:
+        self.seq = seq
+        self.records: list[tuple[TopicPartition, int]] = []
+        # group_id -> (offsets, member_id, generation); last write per
+        # group wins (Kafka's sendOffsetsToTransaction semantics).
+        self.offsets: dict[str, tuple[dict, str | None, int | None]] = {}
+
+
+class _TxnProducer:
+    """Broker-side state for one ``transactional.id``: the current
+    producer id + epoch, the open transaction (if any), and the last
+    completed outcome (for idempotent commit retries)."""
+
+    __slots__ = ("txn_id", "pid", "epoch", "open", "last")
+
+    def __init__(self, txn_id: str, pid: int) -> None:
+        self.txn_id = txn_id
+        self.pid = pid
+        self.epoch = 0
+        self.open: _Txn | None = None
+        self.last: tuple[int, str] | None = None  # (epoch, outcome)
 
 
 class InMemoryBroker:
@@ -108,6 +140,19 @@ class InMemoryBroker:
         self._commit_log_path = commit_log_path
         self._session_timeout_s = session_timeout_s
         self._clock = clock if clock is not None else time.monotonic
+        # Transactions (KIP-98 shape): producers keyed by transactional
+        # id; per-partition side table mapping offset -> txn sequence for
+        # TRANSACTIONAL records only (non-transactional records have no
+        # entry and are stable the moment they append); txn sequence ->
+        # lifecycle status. Records append to the real log immediately
+        # (read_uncommitted sees them, like Kafka); the committed view is
+        # computed by ``fetch_stable``.
+        self._txn_producers: dict[str, _TxnProducer] = {}
+        self._txn_by_pid: dict[int, _TxnProducer] = {}
+        self._txn_pid_counter = itertools.count(1000)
+        self._txn_seq_counter = itertools.count(1)
+        self._txn_status: dict[int, str] = {}  # seq -> open|committed|aborted
+        self._rec_txn: dict[TopicPartition, dict[int, int]] = {}
 
     # ------------------------------------------------------------- topics
 
@@ -203,6 +248,234 @@ class InMemoryBroker:
             log = self._logs[tp]
             i = bisect.bisect_left(log, timestamp_ms, key=lambda r: r.timestamp_ms)
             return log[i].offset if i < len(log) else None
+
+    # -------------------------------------------------------- transactions
+
+    def init_producer_id(self, transactional_id: str) -> tuple[int, int]:
+        """Register (or re-register) a transactional producer; returns
+        ``(producer_id, epoch)``. Re-initializing an EXISTING
+        transactional id is the fencing act (KIP-98): the epoch bumps —
+        every operation still carrying the old epoch raises
+        ``ProducerFencedError`` from here on — and any transaction the
+        old epoch left open is ABORTED (its records drop out of the
+        committed view, its buffered offsets are discarded). This is how
+        a SIGKILLed producer's in-flight transaction dies: its successor
+        (same transactional id — the process fleet keys it by replica
+        INDEX, not incarnation) initializes, and the corpse's work
+        vanishes atomically."""
+        if not transactional_id:
+            raise ValueError("transactional_id must be a non-empty string")
+        with self._lock:
+            st = self._txn_producers.get(transactional_id)
+            if st is None:
+                st = _TxnProducer(transactional_id, next(self._txn_pid_counter))
+                self._txn_producers[transactional_id] = st
+                self._txn_by_pid[st.pid] = st
+            else:
+                st.epoch += 1
+                if st.open is not None:
+                    self._abort_txn_locked(st)
+            return st.pid, st.epoch
+
+    def _txn_state(self, producer_id: int, epoch: int) -> _TxnProducer:
+        """Resolve + fence-check. Caller holds the lock."""
+        st = self._txn_by_pid.get(producer_id)
+        if st is None:
+            raise ProducerFencedError(
+                f"unknown producer id {producer_id} (never initialized, or "
+                "forged); init_producer_id first"
+            )
+        if epoch != st.epoch:
+            raise ProducerFencedError(
+                f"producer {st.txn_id!r} epoch {epoch} is "
+                f"{'stale' if epoch < st.epoch else 'from the future'} "
+                f"(current {st.epoch}): another incarnation holds this "
+                "transactional id; this handle is a zombie's"
+            )
+        return st
+
+    def begin_txn(self, producer_id: int, epoch: int) -> None:
+        """Open a transaction. If the SAME epoch already holds one open
+        (a client that lost track after a transport fault mid-cycle),
+        the stale transaction is aborted first and a fresh one opened —
+        self-healing over strictness, since nothing of the old one could
+        ever have committed without this epoch asking for it."""
+        with self._lock:
+            st = self._txn_state(producer_id, epoch)
+            if st.open is not None:
+                self._abort_txn_locked(st)
+            txn = _Txn(next(self._txn_seq_counter))
+            self._txn_status[txn.seq] = "open"
+            st.open = txn
+
+    def txn_produce(
+        self,
+        producer_id: int,
+        epoch: int,
+        topic: str,
+        value: bytes,
+        key: bytes | None = None,
+        partition: int | None = None,
+        timestamp_ms: int | None = None,
+        headers: tuple[tuple[str, bytes], ...] = (),
+    ) -> Record:
+        """Append one record UNDER the open transaction. The record lands
+        in the real log immediately (``read_uncommitted`` consumers see
+        it, as on Kafka) but stays invisible to ``read_committed``
+        consumers until ``commit_txn`` — and vanishes from their view
+        forever on abort."""
+        with self._lock:
+            st = self._txn_state(producer_id, epoch)
+            if st.open is None:
+                raise TransactionStateError(
+                    f"producer {st.txn_id!r} has no open transaction; "
+                    "begin_txn first"
+                )
+            rec = self.produce(
+                topic, value, key=key, partition=partition,
+                timestamp_ms=timestamp_ms, headers=headers,
+            )
+            tp = TopicPartition(rec.topic, rec.partition)
+            self._rec_txn.setdefault(tp, {})[rec.offset] = st.open.seq
+            st.open.records.append((tp, rec.offset))
+            return rec
+
+    def txn_commit_offsets(
+        self,
+        producer_id: int,
+        epoch: int,
+        group_id: str,
+        offsets: Mapping[TopicPartition, int],
+        member_id: str | None = None,
+        generation: int | None = None,
+    ) -> None:
+        """Buffer consumer offsets INTO the open transaction — they
+        become durable atomically with the transaction's records at
+        ``commit_txn`` (Kafka's sendOffsetsToTransaction). Validated
+        eagerly against the group (stale generation / fenced member /
+        unowned partition raises ``CommitFailedError`` NOW, so the
+        caller can abort instead of discovering it at commit) and
+        re-validated atomically at commit time — a rebalance in between
+        aborts the whole transaction, records included. Repeated calls
+        for the same group replace the earlier buffer (last wins)."""
+        with self._lock:
+            st = self._txn_state(producer_id, epoch)
+            if st.open is None:
+                raise TransactionStateError(
+                    f"producer {st.txn_id!r} has no open transaction; "
+                    "begin_txn first"
+                )
+            g = self._group(group_id)
+            self._validate_group_commit_locked(
+                g, group_id, offsets, member_id, generation
+            )
+            st.open.offsets[group_id] = (dict(offsets), member_id, generation)
+
+    def commit_txn(self, producer_id: int, epoch: int) -> None:
+        """Atomically commit the open transaction: its records become
+        visible to ``read_committed`` consumers AND its buffered offsets
+        merge into the group watermark(s) — one outcome, never half.
+        The offset validation re-runs HERE, inside the same lock that
+        flips the records' status: if the group rebalanced since
+        ``txn_commit_offsets`` (the member was fenced, the generation
+        moved), the ENTIRE transaction aborts and ``CommitFailedError``
+        raises — the records never reach the committed view, so the new
+        partition owner's re-serve is the only copy (this is the
+        exactly-once pivot). A retry of an already-committed transaction
+        (transport fault ate the ack) is answered with success."""
+        with self._lock:
+            st = self._txn_state(producer_id, epoch)
+            if st.open is None:
+                if st.last == (epoch, "committed"):
+                    return  # idempotent retry of an un-acked commit
+                raise TransactionStateError(
+                    f"producer {st.txn_id!r} has no open transaction to "
+                    "commit"
+                )
+            txn = st.open
+            try:
+                for gid, (offsets, member_id, generation) in txn.offsets.items():
+                    self._validate_group_commit_locked(
+                        self._group(gid), gid, offsets, member_id, generation
+                    )
+            except CommitFailedError:
+                # Atomicity means failure is total: records out too.
+                self._abort_txn_locked(st)
+                raise
+            self._txn_status[txn.seq] = "committed"
+            st.open = None
+            st.last = (epoch, "committed")
+            for gid, (offsets, member_id, generation) in txn.offsets.items():
+                self._apply_commit_locked(gid, offsets, member_id)
+            # Committed records became readable below the (possibly
+            # advanced) LSO: wake blocked read_committed pollers.
+            self._data_arrived.notify_all()
+
+    def abort_txn(self, producer_id: int, epoch: int) -> bool:
+        """Abort the open transaction: its records drop out of the
+        committed view permanently, its buffered offsets are discarded,
+        and the group watermark is untouched. Idempotent — aborting with
+        nothing open returns False (a recovery path must be free to
+        abort defensively)."""
+        with self._lock:
+            st = self._txn_state(producer_id, epoch)
+            if st.open is None:
+                return False
+            self._abort_txn_locked(st)
+            return True
+
+    def _abort_txn_locked(self, st: _TxnProducer) -> None:
+        self._txn_status[st.open.seq] = "aborted"
+        st.last = (st.epoch, "aborted")
+        st.open = None
+        # Aborted records stop gating the LSO: readers blocked on them
+        # may now advance.
+        self._data_arrived.notify_all()
+
+    def last_stable_offset(self, tp: TopicPartition) -> int:
+        """The partition's LSO: everything below it has a settled
+        transactional fate (committed, aborted, or was never
+        transactional). ``fetch_stable`` never reads at or past it —
+        Kafka's read_committed ordering guarantee (a later record never
+        surfaces before an earlier still-open transaction decides)."""
+        with self._lock:
+            if tp not in self._logs:
+                raise UnknownTopicError(tp)
+            return self._lso_locked(tp)
+
+    def _lso_locked(self, tp: TopicPartition) -> int:
+        lso = len(self._logs[tp])
+        meta = self._rec_txn.get(tp)
+        if meta:
+            for off, seq in meta.items():
+                if off < lso and self._txn_status[seq] == "open":
+                    lso = off
+        return lso
+
+    def fetch_stable(
+        self, tp: TopicPartition, offset: int, max_records: int
+    ) -> tuple[list[Record], int]:
+        """The read_committed fetch: records from ``offset`` with
+        committed-or-non-transactional status, stopping at the LSO;
+        aborted records are skipped (they hold their offsets but never
+        surface). Returns ``(records, next_offset)`` — the consumer must
+        resume from ``next_offset``, which advances over skipped aborted
+        records (unlike plain ``fetch``, the record list alone cannot
+        carry the position)."""
+        with self._lock:
+            if tp not in self._logs:
+                raise UnknownTopicError(tp)
+            log = self._logs[tp]
+            meta = self._rec_txn.get(tp, {})
+            lso = self._lso_locked(tp)
+            out: list[Record] = []
+            pos = max(0, offset)
+            while pos < lso and len(out) < max_records:
+                seq = meta.get(pos)
+                if seq is None or self._txn_status[seq] == "committed":
+                    out.append(log[pos])
+                pos += 1
+            return out, pos
 
     # -------------------------------------------------------------- groups
 
@@ -389,38 +662,52 @@ class InMemoryBroker:
         ``assign()`` mode."""
         with self._lock:
             g = self._group(group_id)
-            if member_id is not None:
-                # Lease discipline first: a member whose own lease lapsed
-                # is fenced BY this very commit attempt — the "merely
-                # slow" zombie gets a clean CommitFailedError (records
-                # re-deliver to whoever owns the partitions now), never a
-                # merged watermark.
-                self._reap_locked(g)
-                if member_id not in g.members:
-                    raise CommitFailedError(
-                        f"member {member_id!r} fenced/evicted from group "
-                        f"{group_id!r} (lease expired or rebalanced away); "
-                        "offsets not committed"
-                    )
-                if generation != g.generation:
-                    raise CommitFailedError(
-                        f"generation {generation} != current {g.generation} "
-                        f"(group rebalanced); offsets not committed"
-                    )
-                owned = set(g.assignment.get(member_id, []))
-                stray = set(offsets) - owned
-                if stray:
-                    raise CommitFailedError(f"partitions not owned: {sorted(stray)}")
-            g.committed.update(offsets)
-            if self._commit_log_path:
-                entry = {
-                    "group": group_id,
-                    "member": member_id,
-                    "offsets": {f"{tp.topic}:{tp.partition}": o for tp, o in offsets.items()},
-                    "ts": time.time(),
-                }
-                with open(self._commit_log_path, "a", encoding="utf-8") as f:
-                    f.write(json.dumps(entry) + "\n")
+            self._validate_group_commit_locked(
+                g, group_id, offsets, member_id, generation
+            )
+            self._apply_commit_locked(group_id, offsets, member_id)
+
+    def _validate_group_commit_locked(
+        self, g: _Group, group_id: str, offsets, member_id, generation,
+    ) -> None:
+        """The generation/ownership discipline, shared by plain commits
+        and transactional offset commits (validated at buffer time AND
+        re-run atomically inside commit_txn). Caller holds the lock."""
+        if member_id is None:
+            return  # standalone (manual-assignment) mode skips the check
+        # Lease discipline first: a member whose own lease lapsed
+        # is fenced BY this very commit attempt — the "merely
+        # slow" zombie gets a clean CommitFailedError (records
+        # re-deliver to whoever owns the partitions now), never a
+        # merged watermark.
+        self._reap_locked(g)
+        if member_id not in g.members:
+            raise CommitFailedError(
+                f"member {member_id!r} fenced/evicted from group "
+                f"{group_id!r} (lease expired or rebalanced away); "
+                "offsets not committed"
+            )
+        if generation != g.generation:
+            raise CommitFailedError(
+                f"generation {generation} != current {g.generation} "
+                f"(group rebalanced); offsets not committed"
+            )
+        owned = set(g.assignment.get(member_id, []))
+        stray = set(offsets) - owned
+        if stray:
+            raise CommitFailedError(f"partitions not owned: {sorted(stray)}")
+
+    def _apply_commit_locked(self, group_id: str, offsets, member_id) -> None:
+        self._group(group_id).committed.update(offsets)
+        if self._commit_log_path:
+            entry = {
+                "group": group_id,
+                "member": member_id,
+                "offsets": {f"{tp.topic}:{tp.partition}": o for tp, o in offsets.items()},
+                "ts": time.time(),
+            }
+            with open(self._commit_log_path, "a", encoding="utf-8") as f:
+                f.write(json.dumps(entry) + "\n")
 
     def committed(self, group_id: str, tp: TopicPartition) -> int | None:
         with self._lock:
@@ -470,9 +757,15 @@ class MemoryConsumer(ConsumerIterMixin):
         member_id: str | None = None,
         consumer_timeout_ms: int | None = None,
         rebalance_listener: Any | None = None,
+        isolation_level: str = "read_uncommitted",
     ) -> None:
         if auto_offset_reset not in ("earliest", "latest"):
             raise ValueError(f"auto_offset_reset must be earliest|latest, got {auto_offset_reset!r}")
+        if isolation_level not in ("read_uncommitted", "read_committed"):
+            raise ValueError(
+                "isolation_level must be read_uncommitted|read_committed, "
+                f"got {isolation_level!r}"
+            )
         if group_id is None:
             # Loud, not a shared "" group: omitting group_id would silently
             # make unrelated consumers rebalance each other and share a
@@ -500,6 +793,12 @@ class MemoryConsumer(ConsumerIterMixin):
             self._topics = frozenset()
         self._group_id = group_id
         self._auto_offset_reset = auto_offset_reset
+        # "read_committed": polls go through ``fetch_stable`` — only
+        # records whose transactional fate is COMMITTED (or that were
+        # never transactional) are delivered, never past the LSO, with
+        # aborted records silently skipped. The default preserves the
+        # pre-transaction behavior byte-for-byte (plain ``fetch``).
+        self._isolation = isolation_level
         self._closed = False
         self._positions: dict[TopicPartition, int] = {}
         self._fetch_rr = 0  # round-robin cursor across assigned partitions
@@ -630,11 +929,21 @@ class MemoryConsumer(ConsumerIterMixin):
                     if tp in self._paused:
                         continue
                     pos = self._resolve_position(tp)
-                    recs = self._broker.fetch(tp, pos, budget)
-                    if recs:
-                        self._positions[tp] = recs[-1].offset + 1
+                    if self._isolation == "read_committed":
+                        # fetch_stable returns the resume position
+                        # explicitly: it can advance over SKIPPED aborted
+                        # records, which the record list cannot express.
+                        recs, nxt = self._broker.fetch_stable(tp, pos, budget)
+                        if nxt != pos:
+                            self._positions[tp] = nxt
                         out.extend(recs)
                         budget -= len(recs)
+                    else:
+                        recs = self._broker.fetch(tp, pos, budget)
+                        if recs:
+                            self._positions[tp] = recs[-1].offset + 1
+                            out.extend(recs)
+                            budget -= len(recs)
             if out or timeout_ms <= 0:
                 return out
             remaining = deadline - time.monotonic()
@@ -684,6 +993,27 @@ class MemoryConsumer(ConsumerIterMixin):
     def committed(self, tp: TopicPartition) -> int | None:
         self._check_open()
         return self._broker.committed(self._group_id, tp)
+
+    @property
+    def group_id(self) -> str:
+        return self._group_id
+
+    @property
+    def member_id(self) -> str | None:
+        """This member's group identity (None in manual-assignment mode,
+        which has no membership). With ``generation`` below, this is the
+        group metadata a transactional producer presents so its offset
+        commit is fenced exactly like a plain commit would be (Kafka's
+        ConsumerGroupMetadata handed to sendOffsetsToTransaction)."""
+        return self._member_id
+
+    @property
+    def generation(self) -> int | None:
+        """The generation this consumer last synced (None in manual
+        mode). Callers building a transactional offset commit should
+        sync first (``assignment()``) so a rebalance is adopted before
+        the commit burns a doomed attempt."""
+        return self._generation
 
     def position(self, tp: TopicPartition) -> int:
         self._check_open()

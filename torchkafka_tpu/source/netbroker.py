@@ -55,6 +55,18 @@ _METHODS = frozenset(
         "heartbeat",
         "fence",
         "membership",
+        # Transactions (KIP-98 surface): epoch fencing crosses the wire
+        # as the marshalled terminal ProducerFencedError; transport
+        # faults stay the retryable BrokerUnavailableError — the same
+        # retryable-vs-terminal split every other RPC rides.
+        "init_producer_id",
+        "begin_txn",
+        "txn_produce",
+        "txn_commit_offsets",
+        "commit_txn",
+        "abort_txn",
+        "fetch_stable",
+        "last_stable_offset",
     }
 )
 
@@ -351,3 +363,35 @@ class BrokerClient:
         # Cap the server-side block below the socket timeout so a quiet
         # broker never looks like a dead one.
         return self._call("wait_for_data", min(timeout_s, 5.0))
+
+    # ---- transactions (KIP-98 surface over the socket) ----
+
+    def init_producer_id(self, transactional_id):
+        return self._call("init_producer_id", transactional_id)
+
+    def begin_txn(self, producer_id, epoch):
+        return self._call("begin_txn", producer_id, epoch)
+
+    def txn_produce(self, producer_id, epoch, topic, value, **kw):
+        return self._call("txn_produce", producer_id, epoch, topic, value, **kw)
+
+    def txn_commit_offsets(
+        self, producer_id, epoch, group_id, offsets,
+        member_id=None, generation=None,
+    ):
+        return self._call(
+            "txn_commit_offsets", producer_id, epoch, group_id, offsets,
+            member_id=member_id, generation=generation,
+        )
+
+    def commit_txn(self, producer_id, epoch):
+        return self._call("commit_txn", producer_id, epoch)
+
+    def abort_txn(self, producer_id, epoch):
+        return self._call("abort_txn", producer_id, epoch)
+
+    def fetch_stable(self, tp, offset, max_records):
+        return self._call("fetch_stable", tp, offset, max_records)
+
+    def last_stable_offset(self, tp):
+        return self._call("last_stable_offset", tp)

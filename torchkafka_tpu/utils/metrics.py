@@ -306,6 +306,9 @@ class StreamMetrics:
         self.dropped = RateMeter()  # records dropped by the processor
         self.processor_errors = RateMeter()  # drops caused by a RAISING processor
         self.quarantined = RateMeter()  # poison records dead-lettered (resolved)
+        self.dlq_delivery_failures = RateMeter()  # DLQ produces that FAILED —
+        # the record is lost to the quarantine topic (the stream's guard
+        # swallows the exception by contract; this counter is the page)
         self.commit_latency = LatencyHistogram()
         self.commit_failures = RateMeter()
         self.ingest_lag_ms = Gauge()  # append-time -> poll-time of newest record
@@ -318,6 +321,7 @@ class StreamMetrics:
             "dropped": self.dropped.count,
             "processor_errors": self.processor_errors.count,
             "quarantined": self.quarantined.count,
+            "dlq_delivery_failures": self.dlq_delivery_failures.count,
             "commit": self.commit_latency.summary(),
             "commit_failures": self.commit_failures.count,
             "ingest_lag_ms": round(self.ingest_lag_ms.value, 3),
@@ -336,6 +340,7 @@ class StreamMetrics:
             ("dropped_records_total", "counter", s["dropped"]),
             ("processor_errors_total", "counter", s["processor_errors"]),
             ("quarantined_records_total", "counter", s["quarantined"]),
+            ("dlq_delivery_failures_total", "counter", s["dlq_delivery_failures"]),
             ("commit_failures_total", "counter", s["commit_failures"]),
             ("commits_total", "counter", s["commit"]["count"]),
             ("records_per_second", "gauge", s["records_per_s"]),
