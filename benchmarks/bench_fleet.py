@@ -35,6 +35,13 @@ OS-process scheduling + socket-RPC overhead — R processes only scale
 when R cores exist (a 1-core container shows ≈flat-to-negative, and
 PERF.md says so).
 
+``--wal``: the durable-broker WAL tax — the same transactional serving
+run over broker durability memory / None / batch / commit (paired,
+interleaved, exactness + exactly-once committed view asserted inside
+every slice) plus a recovery-time vs WAL-size curve with recovered
+state asserted equal to the pre-death broker at every point. Appends
+rows to FAILOVER_BENCH.json via --json-out.
+
 ``--procs-failover``: the CROSS-PROCESS warm-failover differential — a
 real SIGKILL of one worker process mid-storm, journals shared (warm:
 the survivor loads the victim's file across the process boundary) vs
@@ -597,6 +604,178 @@ def run_txn(tk, cfg, params, args, prompt_len, max_new) -> None:
         print(f"appended txn rows to {args.json_out}", file=sys.stderr)
 
 
+def run_wal(tk, cfg, params, args, prompt_len, max_new) -> None:
+    """The WAL tax and the recovery curve, measured paired.
+
+    (a) Commit-latency micro: the SAME transactional serving run over
+    four broker durabilities — pure in-memory (the 0.217 ms baseline
+    row), WAL with ``durability=None`` (unbuffered write, no fsync),
+    ``"batch"`` (fsync on commit-class appends), ``"commit"`` (fsync
+    every append) — interleaved per slice, byte-exactness + exactly-once
+    committed view asserted inside EVERY slice before its numbers count.
+    The in-memory mode doubles as the no-regression guard: wal_dir=None
+    must not move the baseline.
+
+    (b) Recovery-time vs WAL-size: seeded logs of growing record counts
+    recovered cold (``InMemoryBroker(wal_dir=...)``), recovery wall
+    clock from the broker's own ``recovery_info``; recovered state
+    asserted equal to the original (end offsets, committed offsets,
+    committed view) every point."""
+    import tempfile
+
+    import numpy as np
+
+    from torchkafka_tpu.serve import StreamingGenerator
+    from torchkafka_tpu.source.records import TopicPartition
+
+    n, parts = args.prompts, 4
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(0, cfg.vocab_size, (n, prompt_len),
+                           dtype=np.int32)
+
+    MODES = (
+        ("memory", False, None),
+        ("wal_none", True, None),
+        ("wal_batch", True, "batch"),
+        ("wal_commit", True, "commit"),
+    )
+
+    def serve_once(wal: bool, durability):
+        with tempfile.TemporaryDirectory() as td:
+            broker = tk.InMemoryBroker(
+                wal_dir=td if wal else None, wal_durability=durability,
+            )
+            broker.create_topic("in", partitions=parts)
+            broker.create_topic("out", partitions=1)
+            for i in range(n):
+                broker.produce("in", prompts[i].tobytes(),
+                               partition=i % parts, key=str(i).encode())
+            consumer = tk.MemoryConsumer(broker, "in", group_id="b")
+            producer = tk.TransactionalProducer(broker, "bench-wal")
+            gen = StreamingGenerator(
+                consumer, params, cfg, slots=4, prompt_len=prompt_len,
+                max_new=max_new, commit_every=8, ticks_per_sync=1,
+                output_producer=producer, output_topic="out",
+                exactly_once=True,
+            )
+            res = {rec.key: toks
+                   for rec, toks in gen.run(idle_timeout_ms=300)}
+            assert len(res) == n
+            commit = gen.metrics.commit_latency.summary()
+            # Exactness inside the bench: committed view exactly-once.
+            recs, _ = broker.fetch_stable(TopicPartition("out", 0), 0, 10**6)
+            keys = [r.key for r in recs]
+            assert sorted(keys) == sorted(set(keys)), "committed duplicates"
+            assert len(keys) == n, "committed view incomplete"
+            wal_stats = (
+                {"bytes": broker.wal.stats.bytes_written,
+                 "fsyncs": broker.wal.stats.fsyncs}
+                if broker.wal is not None else None
+            )
+            consumer.close()
+            broker.close()
+        return res, commit, wal_stats
+
+    ref, _, _ = serve_once(False, None)  # jit warm + byte-truth
+    rows: dict[str, list] = {m: [] for m, _w, _d in MODES}
+    stats_by_mode: dict[str, dict | None] = {}
+    for s in range(args.slices):
+        for mode, wal, durability in MODES:
+            res, commit, wal_stats = serve_once(wal, durability)
+            assert set(res) == set(ref)
+            for k in ref:
+                np.testing.assert_array_equal(res[k], ref[k], err_msg=str(k))
+            rows[mode].append(commit)
+            stats_by_mode[mode] = wal_stats
+            print(f"slice {s} {mode}: commit p50 {commit['p50_ms']:.4f} ms "
+                  f"p99 {commit['p99_ms']:.4f} ms", file=sys.stderr)
+    micro = {}
+    for mode, commits in rows.items():
+        micro[mode] = {
+            "commit_p50_ms": float(np.median([c["p50_ms"] for c in commits])),
+            "commit_p99_ms": float(np.median([c["p99_ms"] for c in commits])),
+            "commits_per_run": commits[0]["count"],
+            "wal": stats_by_mode[mode],
+        }
+    base = micro["memory"]["commit_p99_ms"]
+    print("| durability | commit p50 ms | p99 ms | vs in-memory p99 |")
+    print("|---|---|---|---|")
+    for mode, _w, _d in MODES:
+        m = micro[mode]
+        ratio = m["commit_p99_ms"] / base if base else float("nan")
+        print(f"| {mode} | {m['commit_p50_ms']:.4f} | "
+              f"{m['commit_p99_ms']:.4f} | {ratio:.2f}x |")
+
+    # ------------------------------------- (b) recovery-time vs WAL size
+    curve = []
+    for n_records in (256, 1024, 4096):
+        with tempfile.TemporaryDirectory() as td:
+            b = tk.InMemoryBroker(wal_dir=td, wal_durability=None)
+            b.create_topic("t", partitions=parts)
+            payload_rng = np.random.default_rng(n_records)
+            for i in range(n_records):
+                b.produce(
+                    "t",
+                    payload_rng.integers(0, 256, 64, np.uint8).tobytes(),
+                    partition=i % parts, key=str(i).encode(),
+                )
+            gen_id = b.join("g", "m0", frozenset({"t"}))
+            b.commit("g", {TopicPartition("t", p): n_records // parts
+                           for p in range(parts)},
+                     member_id="m0", generation=gen_id)
+            wal_bytes = b.wal.total_bytes()
+            b.close()
+            t0 = time.perf_counter()
+            r = tk.InMemoryBroker(wal_dir=td)
+            cold_ms = (time.perf_counter() - t0) * 1e3
+            # Exactness inside the bench: recovered state == original.
+            for p in range(parts):
+                tp = TopicPartition("t", p)
+                assert r.end_offset(tp) == b.end_offset(tp)
+                assert [x.value for x in r.fetch(tp, 0, 10**6)] \
+                    == [x.value for x in b.fetch(tp, 0, 10**6)]
+                assert r.committed("g", tp) == n_records // parts
+            row = {
+                "records": n_records,
+                "wal_bytes": wal_bytes,
+                "recovery_ms": r.recovery_info["recovery_ms"],
+                "construction_ms": round(cold_ms, 3),
+            }
+            r.close()
+        curve.append(row)
+        print(f"recovery: {n_records} records, {wal_bytes} B WAL -> "
+              f"{row['recovery_ms']} ms replay", file=sys.stderr)
+    print("| WAL records | bytes | recovery ms |")
+    print("|---|---|---|")
+    for row in curve:
+        print(f"| {row['records']} | {row['wal_bytes']:,} | "
+              f"{row['recovery_ms']:.2f} |")
+
+    doc = {
+        "mode": "wal",
+        "prompts": n,
+        "max_new": max_new,
+        "commit_tax": micro,
+        "recovery_curve": curve,
+        "exactness": (
+            "all four durabilities byte-identical to the reference with "
+            "an exactly-once committed view, every slice; recovery curve "
+            "points asserted state-equal to the pre-death broker"
+        ),
+    }
+    print(json.dumps(doc), file=sys.stderr)
+    if args.json_out:
+        try:
+            with open(args.json_out, encoding="utf-8") as f:
+                existing = json.load(f)
+        except (OSError, ValueError):
+            existing = {}
+        existing["wal"] = doc
+        with open(args.json_out, "w", encoding="utf-8") as f:
+            json.dump(existing, f, indent=1)
+        print(f"appended wal rows to {args.json_out}", file=sys.stderr)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--replicas", default="1,2,4")
@@ -615,9 +794,14 @@ def main() -> None:
                     "latency micro (at-least-once vs transactional) + "
                     "cross-process SIGKILL failover with committed-view "
                     "duplicates asserted == 0")
+    ap.add_argument("--wal", action="store_true",
+                    help="durable-broker WAL tax: paired transactional "
+                    "commit-latency micro across durability "
+                    "memory/None/batch/commit + recovery-time vs "
+                    "WAL-size curve, exactness asserted every slice")
     ap.add_argument("--json-out", default=None,
-                    help="--procs-failover/--txn: FAILOVER_BENCH.json to "
-                    "append")
+                    help="--procs-failover/--txn/--wal: "
+                    "FAILOVER_BENCH.json to append")
     args = ap.parse_args()
     counts = [int(x) for x in args.replicas.split(",")]
 
@@ -640,6 +824,9 @@ def main() -> None:
     )
     params = init_params(jax.random.key(0), cfg)
 
+    if args.wal:
+        run_wal(tk, cfg, params, args, prompt_len, max_new=16)
+        return
     if args.txn:
         run_txn(tk, cfg, params, args, prompt_len, max_new=16)
         return

@@ -34,6 +34,17 @@ Modes:
           joined and never heartbeated: observes the expired lease via
           membership(), then fences. Covers lease_expired_pre_fence
           (the kill lands between observation and the fence).
+  broker — the BROKER is the victim: this process hosts a WAL-backed
+          ``InMemoryBroker`` behind a ``BrokerServer`` (port published
+          via an atomic port file) while the PARENT drives a
+          consume-transform-produce transactional workload against it;
+          the armed point fires inside the broker's own WAL/commit code
+          (``wal_append_mid``, ``wal_pre_fsync``,
+          ``txn_marker_pre_append``, ``txn_marker_post_append_pre_ack``)
+          or inside its startup replay over a pre-built WAL
+          (``recovery_mid_replay`` — the child dies before the port file
+          ever appears). The parent audits by RECOVERING the corpse's
+          wal dir in-process and asserting the exactly-once invariants.
 
 Importable from test_crash_matrix.py: the mode functions double as the
 parent's no-kill reference and recovery runners (identical logic, same
@@ -268,6 +279,102 @@ def run_sweep(broker) -> None:
     sweep_expired(broker, SWEEP_GROUP)
 
 
+BW_TOPIC, BW_OUT = "bt", "bout"
+BW_GROUP = "bg"
+BW_TXN_ID = "btxn"
+BW_PARTS = 2
+BW_PROMPTS = 12
+BW_BATCH = 3
+
+
+def bw_transform(value: bytes) -> bytes:
+    """The broker matrix's deterministic 'serving' stand-in: the matrix
+    audits BROKER durability, so the transform just has to be a pure
+    function of the input (no model, no jax — a broker child stays
+    light)."""
+    return value[::-1] + b"!"
+
+
+def prime_bw_topics(broker) -> None:
+    broker.create_topic(BW_TOPIC, partitions=BW_PARTS)
+    broker.create_topic(BW_OUT, partitions=1)
+    for i in range(BW_PROMPTS):
+        broker.produce(
+            BW_TOPIC, f"prompt-{i:02d}".encode(), partition=i % BW_PARTS,
+            key=str(i).encode(),
+        )
+
+
+def drive_bw_txn(broker, member: str = "drv") -> bool:
+    """Consume-transform-produce with ONE transaction per batch (outputs
+    + source offsets atomic — the serve.py exactly_once shape, distilled
+    to its transport essentials). Returns True when every prompt is
+    committed end-to-end, False when the broker died mid-drive (every
+    transactional guarantee is then the recovered broker's to keep)."""
+    import torchkafka_tpu as tk
+    from torchkafka_tpu.errors import BrokerUnavailableError
+
+    consumer = producer = None
+    try:
+        consumer = tk.MemoryConsumer(
+            broker, BW_TOPIC, group_id=BW_GROUP, member_id=member,
+        )
+        producer = tk.TransactionalProducer(broker, BW_TXN_ID)
+        idle = 0
+        while True:
+            records = consumer.poll(max_records=BW_BATCH, timeout_ms=100)
+            if not records:
+                idle += 1
+                if idle > 3:
+                    return True
+                continue
+            idle = 0
+            producer.begin()
+            offsets: dict = {}
+            for r in records:
+                producer.send(BW_OUT, bw_transform(r.value), key=r.key)
+                tp = tk.TopicPartition(r.topic, r.partition)
+                offsets[tp] = max(offsets.get(tp, 0), r.offset + 1)
+            producer.send_offsets(
+                BW_GROUP, offsets,
+                member_id=consumer.member_id,
+                generation=consumer.generation,
+            )
+            producer.commit()
+    except (BrokerUnavailableError, ConnectionError):
+        return False
+    finally:
+        for closer in (consumer, producer):
+            if closer is not None:
+                try:
+                    closer.close()
+                except Exception:  # noqa: BLE001 - broker may be dead
+                    pass
+
+
+def run_broker_host(workdir: str) -> None:
+    """The broker-victim child: construct a WAL-backed broker (this is
+    where ``recovery_mid_replay`` fires when a previous life left a
+    log), serve it, publish the bound port atomically, then wait to be
+    killed — the serving-side crash points fire inside the RPC handler
+    threads as the parent's workload drives them."""
+    import time as _time
+
+    from torchkafka_tpu.source.memory import InMemoryBroker
+    from torchkafka_tpu.source.netbroker import BrokerServer
+
+    broker = InMemoryBroker(
+        wal_dir=os.path.join(workdir, "wal"), wal_durability="commit",
+    )
+    server = BrokerServer(broker)
+    tmp = os.path.join(workdir, "port.tmp")
+    with open(tmp, "w") as f:
+        f.write(str(server.port))
+    os.replace(tmp, os.path.join(workdir, "port"))
+    while True:
+        _time.sleep(0.05)
+
+
 def run_ckpt(broker, workdir: str) -> None:
     """One training-shaped incarnation: resume from the newest complete
     checkpoint, then chunks of poll → commit → save. The commit-then-
@@ -305,6 +412,15 @@ def main() -> int:
     mode, host, port, workdir = (
         sys.argv[1], sys.argv[2], int(sys.argv[3]), sys.argv[4]
     )
+    if mode == "broker":
+        # The broker child is jax-free (it serves, it does not decode):
+        # arm and host directly — run_broker_host never returns (SIGKILL
+        # is this mode's only exit).
+        from torchkafka_tpu.resilience.crashpoint import arm_from_env
+
+        arm_from_env()
+        run_broker_host(workdir)
+        return 0
     import jax
 
     jax.config.update("jax_platforms", "cpu")

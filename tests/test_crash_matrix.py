@@ -33,6 +33,7 @@ import os
 import signal
 import subprocess
 import sys
+import time
 
 import numpy as np
 import pytest
@@ -77,12 +78,29 @@ MATRIX: dict[str, tuple[str, int]] = {
     "txn_produce_mid": ("txn", 3),
     "txn_pre_commit": ("txn", 2),
     "txn_post_commit_pre_ack": ("txn", 2),
+    # Broker-side durability windows (source/wal.py + source/memory.py):
+    # the CHILD is the broker here, SIGKILLed inside its own WAL/commit
+    # code while the parent drives transactional traffic. Arrival counts
+    # land mid-stream against the deterministic append schedule: prime =
+    # 14 appends (2 topics + 12 produces), join 15, init_pid 16, then 5
+    # per 3-record batch (begin + 3 produces + commit marker) — 24 dies
+    # writing batch 2's second produce (batch 1 committed), 26 dies ON
+    # batch 2's commit-marker append; the marker points count commit_txn
+    # arrivals, so 2 = batch 2's atomic flip.
+    "wal_append_mid": ("broker", 24),
+    "wal_pre_fsync": ("broker", 26),
+    "txn_marker_pre_append": ("broker", 2),
+    "txn_marker_post_append_pre_ack": ("broker", 2),
+    # Dies inside the startup REPLAY over a WAL a previous broker life
+    # left behind (event 10 is mid-prime): recovery must be re-runnable.
+    "recovery_mid_replay": ("broker", 10),
 }
 
 # The tier-1 representative subset: one mid-serve death (commit path) and
-# one mid-checkpoint death (torn save). Everything else — the txn points
-# included — is chaos+slow (tier-1 wall-clock is budgeted; scenario 18 in
-# test_harness keeps a tier-1 exactly-once SIGKILL anyway).
+# one mid-checkpoint death (torn save). Everything else — the txn and
+# broker-side points included — is chaos+slow (tier-1 wall-clock is
+# budgeted; scenarios 18/19 in test_harness keep a tier-1 exactly-once
+# SIGKILL and a tier-1 broker crash-recovery anyway).
 TIER1 = ("pre_commit", "checkpoint_mid_write")
 
 
@@ -501,6 +519,160 @@ def _run_sweep_case(tmp_path, point: str, at: int):
         assert broker.committed(W.SWEEP_GROUP, tp) == broker.end_offset(tp)
 
 
+def _bw_committed_outputs(broker):
+    """read_committed view of the broker-matrix output topic, by key."""
+    out: dict[bytes, list[bytes]] = {}
+    recs, _ = broker.fetch_stable(TopicPartition(W.BW_OUT, 0), 0, 100000)
+    for rec in recs:
+        out.setdefault(rec.key, []).append(rec.value)
+    return out
+
+
+def _bw_audit(broker, *, complete: bool) -> None:
+    """The exactly-once invariants over a recovered broker: every
+    committed output at most (``complete``: exactly) one copy per key
+    and byte-correct, every committed source offset covered by a
+    committed output, no unsettled transaction gating the LSO."""
+    outs = _bw_committed_outputs(broker)
+    expected = {
+        str(i).encode(): W.bw_transform(f"prompt-{i:02d}".encode())
+        for i in range(W.BW_PROMPTS)
+    }
+    for key, copies in outs.items():
+        assert len(copies) == 1, (
+            f"{len(copies)} committed copies of {key!r}"
+        )
+        assert copies[0] == expected[key], key
+    for p in range(W.BW_PARTS):
+        tp = TopicPartition(W.BW_TOPIC, p)
+        wm = broker.committed(W.BW_GROUP, tp) or 0
+        end = broker.end_offset(tp)
+        assert wm <= end
+        for off in range(wm):
+            key = str(off * W.BW_PARTS + p).encode()
+            assert key in outs, (
+                f"committed {p}:{off} (prompt {key}) has no committed "
+                "output — the offset/output atom split"
+            )
+        if complete:
+            assert wm == end, f"partition {p} not fully committed"
+    if complete:
+        assert set(outs) == set(expected), (
+            "lost prompts: ", set(expected) - set(outs),
+        )
+    # Every transaction settled at recovery: nothing gates the LSO.
+    for topic, parts in ((W.BW_TOPIC, W.BW_PARTS), (W.BW_OUT, 1)):
+        for p in range(parts):
+            tp = TopicPartition(topic, p)
+            assert broker.last_stable_offset(tp) == broker.end_offset(tp)
+
+
+def _run_broker_case(tmp_path, point: str, at: int):
+    """The broker is the corpse: a real subprocess hosting a WAL-backed
+    ``InMemoryBroker`` is SIGKILLed inside its own durability code while
+    the parent drives a transactional consume-transform-produce workload
+    against it (or, for ``recovery_mid_replay``, inside its startup
+    replay over a WAL a previous life built). The parent audits by
+    RECOVERING the wal dir in-process: exactly-once invariants at death,
+    a full re-drive to completion, and recovery idempotence."""
+    from torchkafka_tpu.errors import BrokerUnavailableError
+
+    workdir = str(tmp_path / point)
+    os.makedirs(workdir, exist_ok=True)
+    wal_dir = os.path.join(workdir, "wal")
+
+    if point == "recovery_mid_replay":
+        # A previous broker life builds the WAL in-process: a full
+        # committed drive plus a DANGLING open transaction, then an
+        # unclean end (no close — the log tail is whatever durability
+        # left). The armed child then dies replaying event `at`.
+        prior = tk.InMemoryBroker(wal_dir=wal_dir, wal_durability="commit")
+        W.prime_bw_topics(prior)
+        assert W.drive_bw_txn(prior) is True
+        pid, epoch = prior.init_producer_id(W.BW_TXN_ID)
+        prior.begin_txn(pid, epoch)
+        prior.txn_produce(pid, epoch, W.BW_OUT, b"dangling", partition=0)
+        del prior  # crash: never closed, never flushed
+        proc, marker = _spawn("broker", 0, workdir, point, at)
+        proc.wait(timeout=120)
+        assert not os.path.exists(os.path.join(workdir, "port")), (
+            "the recovering broker served before finishing replay"
+        )
+        drove = False
+    else:
+        proc, marker = _spawn("broker", 0, workdir, point, at)
+        port_path = os.path.join(workdir, "port")
+        deadline = time.monotonic() + 60
+        while not os.path.exists(port_path):
+            if proc.poll() is not None:
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError("broker child never published a port")
+            time.sleep(0.01)
+        assert proc.poll() is None, "broker died before serving"
+        with open(port_path) as f:
+            port = int(f.read())
+        client = tk.BrokerClient("localhost", port, timeout_s=10)
+        drove = False
+        try:
+            W.prime_bw_topics(client)
+            drove = W.drive_bw_txn(client)
+        except BrokerUnavailableError:
+            pass
+        finally:
+            client.close()
+        proc.wait(timeout=120)
+        assert drove is False, (
+            f"workload completed without the broker dying — arrival "
+            f"count {at} for {point!r} is past the schedule"
+        )
+    with open(os.path.join(workdir, "child.log"), "rb") as f:
+        log = f.read().decode(errors="replace")
+    assert proc.returncode == -signal.SIGKILL, (
+        f"broker exited {proc.returncode}, not SIGKILL — point {point!r} "
+        f"never reached?\n{log}"
+    )
+    with open(marker) as f:
+        assert f.read().strip() == f"{point}:{at}"
+
+    # ---- invariants at the moment of death (recover the corpse's WAL) ----
+    recovered = tk.InMemoryBroker(wal_dir=wal_dir, wal_durability="commit")
+    info = recovered.recovery_info
+    assert info is not None and info["replayed_events"] > 0
+    if point == "wal_append_mid":
+        # The armed kill fired INSIDE a frame body: the torn tail must
+        # have been detected and truncated, never replayed.
+        assert info["truncated_bytes"] > 0, info
+    _bw_audit(recovered, complete=False)
+
+    # ---- recovery: re-drive the same workload to completion -------------
+    _reap_group(recovered, W.BW_GROUP)
+    if point == "recovery_mid_replay":
+        # The prior life fully committed its drive: the re-drive just
+        # confirms nothing re-delivers and the dangling txn left no
+        # committed trace.
+        assert b"dangling" not in [
+            r.value
+            for r in recovered.fetch_stable(
+                TopicPartition(W.BW_OUT, 0), 0, 100000
+            )[0]
+        ]
+    assert W.drive_bw_txn(recovered, member="drv-recovery") is True
+    _bw_audit(recovered, complete=True)
+    recovered.close()
+
+    # ---- recovery is idempotent: a second recovery reproduces the state --
+    again = tk.InMemoryBroker(wal_dir=wal_dir, wal_durability="commit")
+    assert again.recovery_info["truncated_bytes"] == 0  # repaired already
+    _bw_audit(again, complete=True)
+    for p in range(W.BW_PARTS):
+        tp = TopicPartition(W.BW_TOPIC, p)
+        assert again.end_offset(tp) == recovered.end_offset(tp)
+        assert again.committed(W.BW_GROUP, tp) == \
+            recovered.committed(W.BW_GROUP, tp)
+    again.close()
+
+
 FULL_POINTS = [p for p in MATRIX if p not in TIER1]
 
 
@@ -555,5 +727,7 @@ def _dispatch_case(tmp_path, request, point: str) -> None:
         )
     elif mode == "sweep":
         _run_sweep_case(tmp_path, point, at)
+    elif mode == "broker":
+        _run_broker_case(tmp_path, point, at)
     else:  # pragma: no cover - matrix typo guard
         raise ValueError(f"unknown matrix mode {mode!r}")

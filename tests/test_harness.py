@@ -346,6 +346,34 @@ def test_scenario_18_exactly_once_kill_storm():
     assert sorted(codes.values()) == [-9, 0]
 
 
+def test_scenario_19_broker_crash_recovery():
+    """The tier-1 durable-broker smoke: a 2-process exactly-once fleet
+    over a WAL-backed broker; the broker dies UNCLEANLY mid-storm (with
+    journal-proven uncommitted served work in flight) and is recovered
+    from the write-ahead log on the same port while the workers ride the
+    outage on the reconnect stack. The acceptance contract is the
+    ISSUE's: zero lost records, committed-view duplicates exactly zero,
+    byte-identical completions, and every worker's circuit breaker
+    provably opened during the outage then closed after recovery — no
+    process in the system is special anymore."""
+    out = run_scenario(19, "tiny")
+    assert out["scenario"] == "19:broker-crash-recovery-storm"
+    assert out["replicas"] == 2
+    assert out["broker_restarts"] == 1
+    # The WAL really carried the state across the death.
+    assert out["recovery"]["replayed_records"] > 0
+    assert out["recovery"]["replayed_events"] > out["recovery"]["replayed_records"]
+    assert out["zero_lost"] is True
+    assert out["identical_to_no_restart"] is True
+    assert out["committed_duplicates"] == 0
+    # The workers rode the outage: nobody was fenced or respawned, and
+    # every breaker opened during the outage then closed on recovery.
+    assert out["workers_survived_unfenced"] is True
+    assert all(v >= 1 for v in out["breaker_opens"].values())
+    assert all(v >= 1 for v in out["breaker_closes"].values())
+    assert sorted(out["exit_codes"].values()) == [0, 0]
+
+
 def test_scenario_13_warm_failover_smoke():
     """The tier-1 warm-failover smoke: a seeded mid-generation replica
     kill through a journaled 2-replica fleet. The survivor consults the

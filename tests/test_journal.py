@@ -346,6 +346,13 @@ class TestCrashPoints:
             # the broker committed but before the ack was observed.
             "txn_begin_post", "txn_produce_mid",
             "txn_pre_commit", "txn_post_commit_pre_ack",
+            # The durable-broker windows (ISSUE 12): the BROKER dying
+            # mid-WAL-frame (the torn tail), with a frame written but
+            # unfsynced, before/after appending a transaction's commit
+            # marker, and mid-way through its own recovery replay.
+            "wal_append_mid", "wal_pre_fsync",
+            "txn_marker_pre_append", "txn_marker_post_append_pre_ack",
+            "recovery_mid_replay",
         }
 
 

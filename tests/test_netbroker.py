@@ -324,3 +324,195 @@ class TestReconnect:
         with pytest.raises(BrokerUnavailableError):
             c.wait_for_data(0.01)
         c.close()
+
+
+class TestSamePortRestart:
+    """The broker-restart satellite: a BrokerServer that dies and is
+    rebound on the SAME port (ProcessFleet.restart_broker's transport
+    half) must look like any other outage to clients — a client blocked
+    in a poll when the listener dies surfaces the retryable
+    BrokerUnavailableError (never a hang, never a terminal error), and a
+    retry-policy client reconnects to the reborn server and resumes."""
+
+    def test_blocked_poll_reconnects_to_reborn_server(self):
+        broker = tk.InMemoryBroker()
+        broker.create_topic("t")
+        s1 = tk.BrokerServer(broker)
+        port = s1.port
+        client = tk.BrokerClient(
+            s1.host, port,
+            retry=RetryPolicy(max_attempts=20, base_delay_s=0.02,
+                              max_delay_s=0.2, deadline_s=20.0),
+        )
+        consumer = tk.MemoryConsumer(client, "t", group_id="g")
+        results: list = []
+        errors: list = []
+
+        def blocked_poll():
+            try:
+                results.append(consumer.poll(max_records=10,
+                                             timeout_ms=10000))
+            except Exception as exc:  # noqa: BLE001 - asserted below
+                errors.append(exc)
+
+        import threading
+        import time
+
+        t = threading.Thread(target=blocked_poll)
+        t.start()
+        time.sleep(0.3)  # the poll is parked in wait_for_data
+        s1.close()  # the listener dies mid-poll, connections reset
+        time.sleep(0.1)  # a real restart is not instantaneous
+        s2 = tk.BrokerServer(broker, port=port)  # reborn, same port
+        broker.produce("t", b"after-restart")
+        t.join(timeout=15)
+        assert not t.is_alive(), "poll hung across the restart"
+        assert not errors, errors
+        assert [r.value for r in results[0]] == [b"after-restart"]
+        # Membership survived (the broker object lived; with a WAL even
+        # its death does — test_procfleet covers that half).
+        assert consumer.assignment()
+        consumer.close()
+        client.close()
+        s2.close()
+
+    def test_blocked_poll_without_retry_raises_retryable(self):
+        """No policy: the blocked poll must FAIL FAST with the retryable
+        classification — not hang, not raise a terminal error."""
+        broker = tk.InMemoryBroker()
+        broker.create_topic("t")
+        s = tk.BrokerServer(broker)
+        client = tk.BrokerClient(s.host, s.port)
+        consumer = tk.MemoryConsumer(client, "t", group_id="g")
+        import threading
+        import time
+
+        caught: list = []
+
+        def blocked_poll():
+            try:
+                consumer.poll(max_records=10, timeout_ms=10000)
+                caught.append(None)
+            except Exception as exc:  # noqa: BLE001 - asserted below
+                caught.append(exc)
+
+        t = threading.Thread(target=blocked_poll)
+        t.start()
+        time.sleep(0.2)
+        s.close()
+        t.join(timeout=10)
+        assert not t.is_alive(), "poll hung on the dead listener"
+        assert isinstance(caught[0], BrokerUnavailableError)
+        assert caught[0].retryable is True
+        client.close()
+
+    def test_commit_lands_after_restart(self):
+        """An offset commit issued against the reborn listener merges
+        into the same group state the old listener served."""
+        broker = tk.InMemoryBroker()
+        broker.create_topic("t")
+        s1 = tk.BrokerServer(broker)
+        port = s1.port
+        pol = RetryPolicy(max_attempts=20, base_delay_s=0.02,
+                          deadline_s=20.0)
+        c = tk.BrokerClient(s1.host, port, retry=pol)
+        c.commit("g", {TopicPartition("t", 0): 3})
+        s1.close()
+        s2 = tk.BrokerServer(broker, port=port)
+        c.commit("g", {TopicPartition("t", 0): 5})
+        assert c.committed("g", TopicPartition("t", 0)) == 5
+        c.close()
+        s2.close()
+
+
+class TestChaosTransport:
+    """Wire-fault injection at the socket layer (WireFaults +
+    ChaosTransport): broker outages injectable without killing anything.
+    Zero-rate transparency is additionally enforced across the WHOLE
+    consumer contract by test_transport_conformance's chaosnet env."""
+
+    def test_zero_rates_pass_through(self, server):
+        c = tk.BrokerClient(server.host, server.port,
+                            faults=tk.WireFaults(seed=0))
+        c.create_topic("t", partitions=2)
+        rec = c.produce("t", b"v", key=b"k")
+        assert c.fetch(TopicPartition("t", rec.partition), 0, 10)[0].value \
+            == b"v"
+        c.close()
+
+    def test_op_counted_request_reset_never_executes(self, server):
+        """A request cut mid-frame (seeded partial write) provably never
+        executes broker-side: the produce that failed did NOT land, and
+        the next call reconnects."""
+        server.broker.create_topic("t")
+        f = tk.WireFaults(seed=3, reset_at_ops=(1,))
+        c = tk.BrokerClient(server.host, server.port, faults=f)
+        c.produce("t", b"first")  # op 0
+        with pytest.raises(BrokerUnavailableError):
+            c.produce("t", b"torn")  # op 1: cut mid-request
+        assert f.faults_injected == 1
+        # The torn request never executed; the reconnected client sees
+        # exactly one record.
+        assert c.end_offset(TopicPartition("t", 0)) == 1
+        c.produce("t", b"third")
+        assert [r.value for r in c.fetch(TopicPartition("t", 0), 0, 10)] \
+            == [b"first", b"third"]
+        c.close()
+
+    def test_lost_ack_is_at_least_once_under_retry(self, server):
+        """A reply reset (request executed, ack lost) retried by the
+        policy re-executes the idempotent-or-tolerated op: the produce
+        lands at least once and the client keeps working."""
+        server.broker.create_topic("t")
+        f = tk.WireFaults(seed=4, recv_reset_at_ops=(1,))
+        c = tk.BrokerClient(
+            server.host, server.port,
+            retry=RetryPolicy(max_attempts=5, base_delay_s=0.01),
+            faults=f,
+        )
+        c.produce("t", b"a")  # op 0
+        c.produce("t", b"b")  # op 1: executed, ack lost, retried
+        values = [r.value for r in c.fetch(TopicPartition("t", 0), 0, 10)]
+        assert values.count(b"a") == 1
+        assert 1 <= values.count(b"b") <= 2  # at-least-once, honestly
+        assert f.faults_injected == 1
+        c.close()
+
+    def test_seeded_schedule_is_deterministic(self, server):
+        """Two clients with identical plans and identical call sequences
+        inject identical fault schedules — the chaos is replayable."""
+        server.broker.create_topic("d")
+
+        def run(seed):
+            f = tk.WireFaults(seed=seed, reset_rate=0.3)
+            c = tk.BrokerClient(server.host, server.port, faults=f)
+            outcomes = []
+            for i in range(20):
+                try:
+                    c.produce("d", b"x")
+                    outcomes.append("ok")
+                except BrokerUnavailableError:
+                    outcomes.append("fault")
+            c.close()
+            return outcomes, f.faults_injected
+
+        a, na = run(11)
+        b, nb = run(11)
+        assert a == b and na == nb
+        assert "fault" in a and "ok" in a
+
+    def test_stall_injects_latency_not_failure(self, server):
+        import time
+
+        server.broker.create_topic("t")
+        f = tk.WireFaults(seed=5, stall_at_ops=(0,), stall_s=0.1)
+        c = tk.BrokerClient(server.host, server.port, faults=f)
+        t0 = time.perf_counter()
+        c.produce("t", b"v")
+        assert time.perf_counter() - t0 >= 0.1
+        assert c.end_offset(TopicPartition("t", 0)) == 1
+        c.close()
+
+    def test_rate_validation(self):
+        with pytest.raises(ValueError, match="reset_rate"):
+            tk.WireFaults(reset_rate=1.5)

@@ -694,6 +694,28 @@ def _resilience_metrics():
     return m.render_prometheus()
 
 
+def _broker_metrics(tmp_path_factory=None):
+    """A durable broker's WAL/recovery exposition, populated by a REAL
+    write-and-recover cycle (not hand-set counters): appends + fsyncs
+    from traffic, then a second construction replays the log and fills
+    the recovery_* families."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        b = tk.InMemoryBroker(wal_dir=td, wal_durability="commit")
+        b.create_topic("t")
+        b.produce("t", b"v1")
+        pid, epoch = b.init_producer_id("x")
+        b.begin_txn(pid, epoch)
+        b.txn_produce(pid, epoch, "t", b"open")
+        b.wal.close()  # release the fd; the un-flushed state IS the crash
+        r = tk.InMemoryBroker(wal_dir=td, wal_durability="commit")
+        r.produce("t", b"post")  # appends on the recovered broker too
+        text = r.metrics.render_prometheus()
+        r.close()
+    return text
+
+
 def _slo_tracer():
     mc = ManualClock()
     tr = RecordTracer(ObsConfig(clock=mc.now))
@@ -713,8 +735,9 @@ def _slo_tracer():
 @pytest.mark.parametrize("render", [
     _stream_metrics, _serve_metrics, _fleet_metrics, _resilience_metrics,
     _slo_tracer, _burn_monitor, _windowed_slo_tracer, _traced_fleet_metrics,
+    _broker_metrics,
 ], ids=["stream", "serve", "fleet", "resilience", "slo", "burn",
-        "windowed-slo", "traced-fleet"])
+        "windowed-slo", "traced-fleet", "broker"])
 def test_exposition_conformance(render):
     """The one grammar every exposition must satisfy — so the shared
     endpoint can't drift per class, and hostile tenant keys (quotes,
@@ -809,6 +832,7 @@ def test_combined_exposition_has_no_duplicate_metric_families():
         _stream_metrics(), _serve_metrics(), _fleet_metrics(),
         _resilience_metrics(), _slo_tracer(), _burn_monitor(),
         _windowed_slo_tracer(), _traced_fleet_metrics(),
+        _broker_metrics(),
     ))
     names = re.findall(r"^# TYPE (\S+)", text, re.M)
     assert len(names) == len(set(names))

@@ -141,6 +141,100 @@ class TestSupervisorUnits:
         assert not os.path.exists(str(path) + ".lock")
 
 
+class TestBrokerRestartUnits:
+    """The supervisor's broker-restart path without worker processes:
+    the durable-broker drill's mechanics in isolation (the end-to-end
+    storm is harness scenario 19 / tests/test_harness.py)."""
+
+    def _fleet(self, tmp_path, **kw):
+        return ProcessFleet(
+            MODEL, topic="t", prompt_len=P, max_new=MAX_NEW,
+            workdir=tmp_path, replicas=1, partitions=PARTS,
+            respawn=False, group="g", **kw,
+        )
+
+    def test_restart_without_wal_refuses(self, tmp_path):
+        fleet = self._fleet(tmp_path)
+        try:
+            with pytest.raises(ValueError, match="wal_dir"):
+                fleet.restart_broker()
+        finally:
+            fleet.close()
+
+    def test_crash_restart_recovers_state_on_same_port(self, tmp_path):
+        from torchkafka_tpu.obs import ObsConfig, RecordTracer
+        from torchkafka_tpu.obs.trace import BROKER_RESTARTED
+
+        tracer = RecordTracer(ObsConfig())
+        fleet = self._fleet(
+            tmp_path, wal_dir=tmp_path / "wal", wal_durability="commit",
+            tracer=tracer,
+        )
+        try:
+            prompts = _prompts(4)
+            _produce(fleet.broker, "t", prompts)
+            gen = fleet.broker.join("g", "m0", frozenset({"t"}))
+            fleet.broker.commit(
+                "g", {TopicPartition("t", 0): 1},
+                member_id="m0", generation=gen,
+            )
+            pid, epoch = fleet.broker.init_producer_id("x")
+            fleet.broker.begin_txn(pid, epoch)
+            fleet.broker.txn_produce(pid, epoch, "t", b"open", partition=0)
+            port = fleet.server.port
+            old_broker = fleet.broker
+            info = fleet.restart_broker(crash=True)
+            # Same port, fresh broker object, recovered state.
+            assert fleet.server.port == port
+            assert fleet.broker is not old_broker
+            assert info["replayed_records"] == 5
+            assert info["aborted_txns"] == 1
+            for p in range(PARTS):
+                tp = TopicPartition("t", p)
+                assert fleet.broker.end_offset(tp) \
+                    == old_broker.end_offset(tp)
+            assert fleet.broker.committed(
+                "g", TopicPartition("t", 0)
+            ) == 1
+            assert fleet.broker.membership("g")["members"] == ["m0"]
+            # The dangling transaction aborted: LSO == end, and the old
+            # epoch is fenced while the sequence continues.
+            tp0 = TopicPartition("t", 0)
+            assert fleet.broker.last_stable_offset(tp0) \
+                == fleet.broker.end_offset(tp0)
+            assert fleet.broker.init_producer_id("x") == (pid, epoch + 1)
+            # Supervision narrated it: counter + typed trace event.
+            assert fleet.metrics.broker_restarts.count == 1
+            stages = [e.stage for e in tracer.events]
+            assert stages.count(BROKER_RESTARTED) == 1
+            ev = dict(
+                [e for e in tracer.events
+                 if e.stage == BROKER_RESTARTED][0].attrs
+            )
+            assert ev["replayed_records"] == 5
+            assert ev["aborted_txns"] == 1
+            # A client connects to the reborn listener and reads the
+            # recovered log.
+            with tk.BrokerClient(fleet.server.host, port) as c:
+                assert len(c.fetch(tp0, 0, 100)) \
+                    == fleet.broker.end_offset(tp0)
+        finally:
+            fleet.close()
+
+    def test_clean_restart_flushes_tail(self, tmp_path):
+        """crash=False closes the WAL first — the clean-shutdown path."""
+        fleet = self._fleet(
+            tmp_path, wal_dir=tmp_path / "wal", wal_durability=None,
+        )
+        try:
+            _produce(fleet.broker, "t", _prompts(2))
+            fleet.restart_broker(crash=False)
+            assert fleet.broker.end_offset(TopicPartition("t", 0)) == 1
+            assert fleet.metrics.broker_restarts.count == 1
+        finally:
+            fleet.close()
+
+
 def _drain_and_settle(fleet, timeout_s=120):
     fleet.drain()
     fleet.wait(lambda f: all(not i.running for i in f.incarnations),

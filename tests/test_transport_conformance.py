@@ -12,6 +12,12 @@ Transports:
 - ``chaos``: ``ChaosConsumer(MemoryConsumer, ...)`` with every fault rate
   at zero — the injector must be contract-TRANSPARENT when idle, or every
   chaos test conflates wrapper bugs with injected faults.
+- ``chaosnet``: the netbroker transport with a zero-rate ``WireFaults``
+  plan on every client socket (``ChaosTransport``) — the WIRE-level
+  injector held to the same transparency bar as the API-level one.
+- ``walbroker``: the memory transport over a WAL-backed durable broker
+  (``InMemoryBroker(wal_dir=...)``) — durability logging must be
+  invisible to the consumer contract.
 - ``resilient``: ``ResilientConsumer(MemoryConsumer)`` with no faults
   firing — same transparency requirement for the resilience layer's
   no-fault hot path (retry loops, breaker bookkeeping, forwarding).
@@ -48,9 +54,8 @@ except ImportError:
     HAVE_KAFKA = False
 KAFKA_BOOTSTRAP = os.environ.get("KAFKA_BOOTSTRAP")
 
-TRANSPORTS = ["memory", "netbroker", "chaos", "resilient"] + (
-    ["kafka"] if HAVE_KAFKA else []
-)
+TRANSPORTS = ["memory", "netbroker", "chaos", "chaosnet", "resilient",
+              "walbroker"] + (["kafka"] if HAVE_KAFKA else [])
 
 
 class _Env:
@@ -123,6 +128,48 @@ class _ChaosEnv(_MemoryEnv):
         return tk.ChaosConsumer(super().consumer(group, **kw), seed=0)
 
 
+class _ChaosNetEnv(_NetbrokerEnv):
+    """The netbroker transport with every client socket wrapped in a
+    zero-rate ChaosTransport: the wire-level fault injector must be
+    contract-transparent when idle, exactly like the API-level one."""
+
+    def consumer(self, group, **kw):
+        client = tk.BrokerClient(
+            self.server.host, self.server.port,
+            faults=tk.WireFaults(seed=0),
+        )
+        self._clients.append(client)
+        return tk.MemoryConsumer(client, self.topic, group_id=group, **kw)
+
+
+class _WalBrokerEnv(_Env):
+    """The memory transport over a DURABLE broker: write-ahead logging
+    must change nothing a consumer can observe."""
+
+    def __init__(self, topic, partitions):
+        super().__init__(topic, partitions)
+        import tempfile
+
+        self._td = tempfile.TemporaryDirectory()
+        self.broker = tk.InMemoryBroker(
+            wal_dir=self._td.name, wal_durability="batch"
+        )
+        self.broker.create_topic(topic, partitions=partitions)
+
+    def produce(self, value, partition, key=None):
+        self.broker.produce(self.topic, value, partition=partition, key=key)
+
+    def consumer(self, group, **kw):
+        return tk.MemoryConsumer(self.broker, self.topic, group_id=group, **kw)
+
+    def committed_by_broker(self, group, p):
+        return self.broker.committed(group, TopicPartition(self.topic, p))
+
+    def close(self):
+        self.broker.close()
+        self._td.cleanup()
+
+
 class _ResilientEnv(_MemoryEnv):
     """ResilientConsumer over a healthy transport: the wrapper must be
     invisible — retries never fire, the breaker stays closed, terminal
@@ -182,7 +229,9 @@ def env(request):
         "memory": _MemoryEnv,
         "netbroker": _NetbrokerEnv,
         "chaos": _ChaosEnv,
+        "chaosnet": _ChaosNetEnv,
         "resilient": _ResilientEnv,
+        "walbroker": _WalBrokerEnv,
         "kafka": _KafkaEnv,
     }[request.param](topic, partitions=2)
     e.name = request.param

@@ -54,6 +54,7 @@ from torchkafka_tpu.resilience import (
 from torchkafka_tpu.source import (
     ChaosConsumer,
     ChaosProducer,
+    ChaosTransport,
     Consumer,
     BrokerClient,
     BrokerServer,
@@ -70,6 +71,8 @@ from torchkafka_tpu.source import (
     seek_to_timestamp,
     Record,
     TopicPartition,
+    WireFaults,
+    WriteAheadLog,
     partitions_for_process,
 )
 from torchkafka_tpu.workload import (
@@ -91,7 +94,7 @@ from torchkafka_tpu.transform import (
     raw_bytes,
 )
 
-__version__ = "0.14.0"
+__version__ = "0.15.0"
 
 __all__ = [
     "BarrierError",
@@ -104,6 +107,7 @@ __all__ = [
     "CommitToken",
     "ChaosConsumer",
     "ChaosProducer",
+    "ChaosTransport",
     "Consumer",
     "ConsumerClosedError",
     "DecodeJournal",
@@ -149,6 +153,8 @@ __all__ = [
     "TpuKafkaError",
     "TransactionStateError",
     "TransactionalProducer",
+    "WireFaults",
+    "WriteAheadLog",
     "batch_sharding",
     "chunk_of",
     "chunked",

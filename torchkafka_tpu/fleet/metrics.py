@@ -43,6 +43,8 @@ class FleetMetrics:
         self.replica_fences = RateMeter()  # members evicted involuntarily:
         # lease expiry (real process death or a zombie too slow to renew),
         # kill, or drain-timeout escalation
+        self.broker_restarts = RateMeter()  # broker deaths recovered from
+        # the write-ahead log (ProcessFleet.restart_broker)
         self._member_lease_age: dict[str, Gauge] = {}  # seconds since the
         # member's last successful lease renewal (age = session timeout
         # minus observed remaining; 0 right after a heartbeat)
@@ -173,6 +175,7 @@ class FleetMetrics:
         membership = {
             "joins": self.replica_joins.count,
             "fences": self.replica_fences.count,
+            "broker_restarts": self.broker_restarts.count,
             "lease_age_s": {
                 m: round(g.value, 3)
                 for m, g in sorted(self._member_lease_age.items())
@@ -256,6 +259,8 @@ class FleetMetrics:
             ("replica_drains_total", "counter", s["drains"]),
             ("replica_joins_total", "counter", s["membership"]["joins"]),
             ("replica_fences_total", "counter", s["membership"]["fences"]),
+            ("broker_restarts_total", "counter",
+             s["membership"]["broker_restarts"]),
             ("member_lease_age_seconds", "gauge", [
                 (format_labels(member=m), age)
                 for m, age in s["membership"]["lease_age_s"].items()

@@ -65,10 +65,14 @@ class _HeartbeatSender(threading.Thread):
         self._interval_s = interval_s
         self._stop = threading.Event()
         self.fenced = False
+        self.outages = 0  # renewals that found the broker unreachable
         self.error: BaseException | None = None
 
     def run(self) -> None:
-        from torchkafka_tpu.errors import FencedMemberError
+        from torchkafka_tpu.errors import (
+            BrokerUnavailableError,
+            FencedMemberError,
+        )
 
         while not self._stop.is_set():
             try:
@@ -76,9 +80,19 @@ class _HeartbeatSender(threading.Thread):
             except FencedMemberError:
                 self.fenced = True
                 return
+            except BrokerUnavailableError:
+                # Outage outlived the client's retry budget: keep trying
+                # — a WAL-recovered broker restores this member with a
+                # fresh lease, so the next renewal that lands simply
+                # resumes the session. If the broker instead comes back
+                # without us (or never), the outcome is FencedMemberError
+                # or shutdown, both handled above/outside.
+                self.outages += 1
+                self._stop.wait(self._interval_s)
+                continue
             except Exception as exc:  # noqa: BLE001 - flagged, loop decides
-                # Retries exhausted (long outage) or a teardown race: the
-                # serving loop surfaces it at its next safe point.
+                # A teardown race or a genuine bug: the serving loop
+                # surfaces it at its next safe point.
                 self.error = exc
                 return
             self._stop.wait(self._interval_s)
@@ -143,7 +157,9 @@ class _TaggingProducer:
         return getattr(self._inner, name)
 
 
-def _dump_metrics(spec: dict, gen, fleet_metrics, exit_code: int) -> None:
+def _dump_metrics(
+    spec: dict, gen, fleet_metrics, exit_code: int, breaker=None, hb=None,
+) -> None:
     path = spec.get("metrics_path")
     if not path:
         return
@@ -157,6 +173,10 @@ def _dump_metrics(spec: dict, gen, fleet_metrics, exit_code: int) -> None:
         "served_from_journal": m.journal_served.count,
         "resume_rejected": m.resume_rejected.count,
         "completions": fleet_metrics.completions.count,
+        "commit_failures": m.commit_failures.count,
+        "circuit_opens": breaker.opens if breaker is not None else 0,
+        "circuit_closes": breaker.closes if breaker is not None else 0,
+        "heartbeat_outages": hb.outages if hb is not None else 0,
     }
     tmp = path + ".tmp"
     with open(tmp, "w", encoding="utf-8") as f:
@@ -171,7 +191,11 @@ def run_replica_worker(spec: dict, broker=None, shutdown=None) -> int:
     the process exit code: ``EXIT_CLEAN`` after a drain (idle-exit or
     SIGTERM via ``shutdown``), ``EXIT_FENCED`` when the broker evicted
     this member."""
-    from torchkafka_tpu.errors import FencedMemberError, ProducerFencedError
+    from torchkafka_tpu.errors import (
+        BrokerUnavailableError,
+        FencedMemberError,
+        ProducerFencedError,
+    )
     from torchkafka_tpu.fleet.metrics import FleetMetrics
     from torchkafka_tpu.fleet.qos import AdmissionQueue, QoSConfig, TenantBuckets
     from torchkafka_tpu.fleet.replica import Replica, SERVING
@@ -203,6 +227,7 @@ def run_replica_worker(spec: dict, broker=None, shutdown=None) -> int:
     gen = None
     journal = None
     hb = None
+    breaker = None
     metrics = FleetMetrics()
     exit_code = EXIT_CLEAN
     try:
@@ -214,6 +239,34 @@ def run_replica_worker(spec: dict, broker=None, shutdown=None) -> int:
         consumer = MemoryConsumer(
             broker, spec["topic"], group_id=spec["group"], member_id=member,
         )
+        if spec.get("resilient"):
+            # Broker-outage riding, made observable: poll/commit run
+            # behind a RetryPolicy + CircuitBreaker (resilience/), so a
+            # broker-process death mid-storm degrades to empty polls and
+            # fast-failed (survivable) commits while the circuit is open,
+            # then closes when the WAL-recovered broker answers again —
+            # the open-then-close transition counters land in the metrics
+            # dump for the supervisor's audit.
+            from torchkafka_tpu.resilience import (
+                CircuitBreaker,
+                ResilientConsumer,
+                RetryPolicy,
+            )
+            from torchkafka_tpu.utils.metrics import ResilienceMetrics
+
+            breaker = CircuitBreaker(
+                failure_threshold=int(spec.get("breaker_threshold", 3)),
+                reset_timeout_s=float(spec.get("breaker_cooldown_s", 0.25)),
+            )
+            consumer = ResilientConsumer(
+                consumer,
+                policy=RetryPolicy(
+                    max_attempts=2, base_delay_s=0.02, max_delay_s=0.2,
+                    deadline_s=2.0,
+                ),
+                breaker=breaker,
+                metrics=ResilienceMetrics(),
+            )
         hb_interval = spec.get("heartbeat_interval_s", 0.25)
         # "thread" (default, Kafka's own split: session liveness on a
         # background sender, so warmup/tick stalls are SLOW, not dead) or
@@ -306,20 +359,42 @@ def run_replica_worker(spec: dict, broker=None, shutdown=None) -> int:
                 )
             if hb is not None and hb.error is not None:
                 raise hb.error
-            if hb is None and hb_interval is not None:
-                consumer.heartbeat()  # loop mode: one renewal per pump
-            assigned = frozenset(consumer.assignment())
-            if assigned != last_assign:
-                if assigned - last_assign:
-                    # Gained partitions: a peer died or the fleet
-                    # rescaled. Its journal, read FROM DISK across the
-                    # process boundary, is the warm-failover handoff.
-                    fresh = DecodeJournal.scan_dir(jdir, exclude=(jpath,))
-                    if fresh:
-                        gen.add_resume_hints(fresh)
-                last_assign = assigned
-            completions = rep.pump()
-            rep.maybe_flush()
+            if breaker is not None and not breaker.allow():
+                # Circuit open: the broker outage is declared. Stop
+                # hammering a dead socket; in-flight decode state, the
+                # journal, and the outbox keep. The cooldown's half-open
+                # probe (the next allowed pump) decides recovery.
+                time.sleep(0.02)
+                continue
+            try:
+                if hb is None and hb_interval is not None:
+                    consumer.heartbeat()  # loop mode: one renewal per pump
+                assigned = frozenset(consumer.assignment())
+                if assigned != last_assign:
+                    if assigned - last_assign:
+                        # Gained partitions: a peer died or the fleet
+                        # rescaled. Its journal, read FROM DISK across the
+                        # process boundary, is the warm-failover handoff.
+                        fresh = DecodeJournal.scan_dir(jdir, exclude=(jpath,))
+                        if fresh:
+                            gen.add_resume_hints(fresh)
+                    last_assign = assigned
+                completions = rep.pump()
+                rep.maybe_flush()
+            except BrokerUnavailableError:
+                # The broker is DOWN past the client's retry budget (a
+                # broker-process death; the supervisor is restarting it
+                # from the WAL). Ride the outage: commits stay pending
+                # and the next pump retries — a recovered broker restores
+                # this member's lease and generation, so serving resumes
+                # with zero lost records. The breaker counts the outage
+                # evidence (open-then-close lands in the metrics dump).
+                if breaker is not None:
+                    breaker.record_failure()
+                time.sleep(0.02)
+                continue
+            if breaker is not None:
+                breaker.record_success()
             if rep.drain_idle:
                 rep.finish_drain()
                 return EXIT_CLEAN
@@ -351,7 +426,8 @@ def run_replica_worker(spec: dict, broker=None, shutdown=None) -> int:
         if hb is not None:
             hb.stop()
         if gen is not None:
-            _dump_metrics(spec, gen, metrics, exit_code)
+            _dump_metrics(spec, gen, metrics, exit_code, breaker=breaker,
+                          hb=hb)
         if journal is not None:
             try:
                 journal.close()  # flush + release the single-writer lock
@@ -373,6 +449,12 @@ def main(argv: list[str]) -> int:
     spec_path = argv[1]
     with open(spec_path, encoding="utf-8") as f:
         spec = json.load(f)
+    # SIGUSR1 → all-thread stack dump on stderr (the worker log): the
+    # supervisor-side diagnosis tool for a wedged-but-alive replica.
+    import faulthandler
+    import signal as _signal
+
+    faulthandler.register(_signal.SIGUSR1)
     import jax
 
     jax.config.update("jax_platforms", "cpu")

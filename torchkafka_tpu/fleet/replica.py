@@ -71,6 +71,7 @@ class Replica:
         self._clock = clock
         self.state = SERVING
         self._since_commit = 0
+        self._retry_flush = False  # a survivably-failed flush awaits retry
         self._assigned: frozenset = frozenset()
 
     # ----------------------------------------------------------- lifecycle
@@ -160,11 +161,18 @@ class Replica:
     def maybe_flush(self, force: bool = False) -> None:
         """Cadence commit — called by the fleet AFTER it registered the
         completions the last pump returned, so every commit provably
-        follows the completions it covers."""
-        if force or self._since_commit >= self._commit_every:
-            if self._since_commit:
-                self.gen.flush_commits()
+        follows the completions it covers. A flush that FAILS survivably
+        (rebalance, broker outage) is retried on every subsequent call
+        until it lands: commit-follows-completion counts completions,
+        but a replica whose last completions coincided with an outage
+        would otherwise idle forever with its tail uncommitted (and, in
+        exactly_once mode, its outputs invisible) — found by the
+        broker crash-restart drill."""
+        if force or self._retry_flush or self._since_commit >= self._commit_every:
+            if self._since_commit or self._retry_flush:
+                ok = self.gen.flush_commits()
                 self._since_commit = 0
+                self._retry_flush = ok is False
 
     # ------------------------------------------------------------ internal
 

@@ -145,6 +145,11 @@ class ProcessFleet:
         respawn: bool = True,
         journal: bool = True,
         exactly_once: bool = False,
+        wal_dir: str | os.PathLike | None = None,
+        wal_durability: str | None = "batch",
+        resilient: bool = False,
+        reconnect_attempts: int = 6,
+        reconnect_deadline_s: float = 15.0,
         broker=None,
         metrics=None,
         tracer=None,
@@ -172,8 +177,16 @@ class ProcessFleet:
         # transaction EAGERLY (``_abort_victim_txn``), so the committed
         # view settles without waiting for a respawn.
         self.exactly_once = exactly_once
+        # Broker durability: with ``wal_dir`` set, the hosted broker
+        # writes a segmented write-ahead log (source/wal.py) and
+        # ``restart_broker`` can crash-and-recover it on the SAME port —
+        # workers ride the outage on their reconnect stacks and resume
+        # against identical topics/offsets/generations/producer epochs.
+        self.wal_dir = None if wal_dir is None else os.fspath(wal_dir)
+        self.wal_durability = wal_durability
         self.broker = broker if broker is not None else InMemoryBroker(
-            session_timeout_s=session_timeout_s
+            session_timeout_s=session_timeout_s,
+            wal_dir=self.wal_dir, wal_durability=wal_durability,
         )
         for t, p in ((topic, partitions), (out_topic, 1),
                      (ready_topic, 1)):
@@ -210,6 +223,9 @@ class ProcessFleet:
             "heartbeat_interval_s": heartbeat_interval_s,
             "idle_exit_ms": idle_exit_ms,
             "exactly_once": exactly_once,
+            "resilient": resilient,
+            "reconnect_attempts": reconnect_attempts,
+            "reconnect_deadline_s": reconnect_deadline_s,
         }
         self.incarnations: list[_Incarnation] = []
         self.victims: list[dict] = []  # kill_replica forensics
@@ -473,6 +489,61 @@ class ProcessFleet:
         self.victims.append(forensics)
         return forensics
 
+    def restart_broker(self, crash: bool = True, down_s: float = 0.0) -> dict:
+        """Kill and recover the hosted broker — the broker-death drill.
+
+        ``crash=True`` (default) is an unclean death: the listener and
+        every live connection drop mid-RPC (exactly what a SIGKILLed
+        broker process looks like from a client socket) and the
+        in-memory state object is ABANDONED un-flushed — the only
+        surviving truth is whatever the write-ahead log already holds
+        per its durability discipline. ``down_s`` holds the port closed
+        before recovery so outage-riding (retry storms, circuit
+        breakers opening) is actually exercised. Then a fresh
+        ``InMemoryBroker(wal_dir=...)`` RECOVERS — records, offsets,
+        generations, producer epochs, memberships with fresh leases;
+        open transactions aborted — and rebinds a ``BrokerServer`` on
+        the SAME port, so every worker's reconnect lands without
+        re-configuration. Requires the fleet to have been built with
+        ``wal_dir`` (a volatile broker cannot be restarted into
+        anything but amnesia). Returns the recovery summary."""
+        if self.wal_dir is None:
+            raise ValueError(
+                "restart_broker requires ProcessFleet(wal_dir=...): "
+                "without a WAL there is no state to recover"
+            )
+        from torchkafka_tpu.source.memory import InMemoryBroker
+        from torchkafka_tpu.source.netbroker import BrokerServer
+
+        host, port = self.server.host, self.server.port
+        self.server.close()  # connections reset: clients see the outage
+        if not crash:
+            self.broker.close()  # clean shutdown flushes the WAL tail
+        # crash=True: the old broker object is simply dropped — no
+        # flush, no close; its unfsynced tail is the page cache's
+        # problem, exactly as process death leaves it.
+        if down_s > 0:
+            time.sleep(down_s)
+        t0 = time.perf_counter()
+        self.broker = InMemoryBroker(
+            session_timeout_s=self.session_timeout_s,
+            wal_dir=self.wal_dir, wal_durability=self.wal_durability,
+        )
+        self.server = BrokerServer(self.broker, host=host, port=port)
+        elapsed_ms = (time.perf_counter() - t0) * 1e3
+        self.metrics.broker_restarts.add(1)
+        info = dict(self.broker.recovery_info or {})
+        info["restart_ms"] = round(elapsed_ms, 3)
+        if self.tracer is not None:
+            self.tracer.broker_restarted(
+                replayed_records=info.get("replayed_records", 0),
+                aborted_txns=info.get("aborted_txns", 0),
+                recovery_ms=info.get("recovery_ms", 0.0),
+            )
+        _logger.info("broker restarted on %s:%s from WAL: %s",
+                     host, port, info)
+        return info
+
     def scale(self, n: int) -> None:
         """Elastic membership mid-serve. Scale-UP spawns fresh members
         (the rebalance hands them partitions — and their startup journal
@@ -609,6 +680,7 @@ class ProcessFleet:
                 inc.proc.kill()
                 inc.proc.wait()
         self.server.close()
+        self.broker.close()  # flush + close the WAL, when one exists
 
     def __enter__(self) -> "ProcessFleet":
         return self

@@ -58,7 +58,7 @@ def main() -> None:
                     help="scenario 7 with --temperature: nucleus mass in "
                     "(0, 1] — minimal prefix reaching p stays sampleable")
     ap.add_argument("--replicas", type=int, default=2,
-                    help="scenarios 10/11/12/13/15/16/17/18 (serving fleet / "
+                    help="scenarios 10-13/15-19 (serving fleet / "
                     "chaos soak / prefix-cache fleet / warm failover / SLO "
                     "observability / traffic observatory / process-fleet "
                     "kill storm / exactly-once kill storm): replica count")

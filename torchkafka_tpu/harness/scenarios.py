@@ -2169,6 +2169,198 @@ def scenario_18(size: str = "tiny", replicas: int = 2) -> dict:
     }
 
 
+def scenario_19(size: str = "tiny", replicas: int = 2) -> dict:
+    """Broker death mid-storm: the last unfenced process joins the fault
+    model. A 2-process ``exactly_once`` fleet serves over a DURABLE
+    broker (``ProcessFleet(wal_dir=...)`` — every produce/commit/
+    membership/transaction event write-ahead logged, source/wal.py);
+    once a worker's journal proves served-but-uncommitted work exists,
+    the broker is killed UNCLEANLY (``restart_broker(crash=True)``: the
+    listener and every connection drop mid-RPC, the in-memory state is
+    abandoned un-flushed) and held down long enough that the workers'
+    circuit breakers OPEN. The supervisor then recovers a fresh broker
+    from the WAL on the SAME port: records, offsets, generations,
+    producer epochs, and memberships (fresh leases) come back; open
+    transactions abort. Workers ride the outage on the reconnect stack
+    (RetryPolicy → BrokerUnavailableError → CircuitBreaker) and resume
+    — no fencing, no respawn. Audited: zero lost records, committed-view
+    duplicates EXACTLY zero, every committed completion byte-identical
+    to a no-restart reference, and every worker's breaker opened during
+    the outage then closed after recovery (the open-then-close
+    transition counters in the worker metrics dumps)."""
+    import tempfile
+    import time as _time
+
+    import torchkafka_tpu as tk
+    from torchkafka_tpu.fleet import ProcessFleet
+    from torchkafka_tpu.journal import DecodeJournal
+    from torchkafka_tpu.serve import StreamingGenerator
+    from torchkafka_tpu.source.records import TopicPartition
+
+    prompt_len, max_new = (8, 16) if size == "tiny" else (32, 32)
+    n = 12 if size == "tiny" else 48
+    parts, slots, commit_every = 4, 2, 4
+    down_s = 2.5
+    cfg, params, label = _serving_model(size, None, prompt_len, max_new)
+    model_spec = dict(
+        seed=0, vocab_size=cfg.vocab_size, d_model=cfg.d_model,
+        n_layers=cfg.n_layers, n_heads=cfg.n_heads,
+        n_kv_heads=cfg.n_kv_heads, d_ff=cfg.d_ff,
+        max_seq_len=cfg.max_seq_len,
+    )
+    rng = np.random.default_rng(19)
+    prompts = rng.integers(0, cfg.vocab_size, (n, prompt_len),
+                           dtype=np.int32)
+    all_keys = {str(i).encode() for i in range(n)}
+
+    # In-process no-restart reference (greedy decode is a pure function
+    # of (params, prompt)).
+    rb = tk.InMemoryBroker()
+    rb.create_topic("t19", partitions=parts)
+    for i in range(n):
+        rb.produce("t19", prompts[i].tobytes(), partition=i % parts,
+                   key=str(i).encode())
+    rc = tk.MemoryConsumer(rb, "t19", group_id="ref19")
+    ref_gen = StreamingGenerator(
+        rc, params, cfg, slots=slots, prompt_len=prompt_len,
+        max_new=max_new, commit_every=commit_every, ticks_per_sync=1,
+    )
+    ref = {rec.key: toks for rec, toks in ref_gen.run(idle_timeout_ms=400)}
+    rc.close()
+
+    t0 = _time.perf_counter()
+    with tempfile.TemporaryDirectory() as td:
+        import os as _os
+
+        fleet = ProcessFleet(
+            model_spec, topic="t19", prompt_len=prompt_len,
+            max_new=max_new, workdir=td, replicas=replicas,
+            partitions=parts, slots=slots, commit_every=commit_every,
+            session_timeout_s=8.0, heartbeat_interval_s=0.2,
+            journal_cadence=1, respawn=False, group="s19",
+            exactly_once=True,
+            wal_dir=_os.path.join(td, "wal"), wal_durability="batch",
+            # Short client retries so the outage is FELT (and ridden)
+            # by the resilience stack instead of silently absorbed
+            # inside the transport: the breakers must provably open.
+            resilient=True, reconnect_attempts=2,
+            reconnect_deadline_s=0.4,
+        )
+        try:
+            fleet.start()
+            fleet.wait_ready(timeout_s=300)
+            ready_s = _time.perf_counter() - t0
+            for i in range(n):
+                fleet.broker.produce(
+                    "t19", prompts[i].tobytes(), partition=i % parts,
+                    key=str(i).encode(),
+                )
+
+            def uncommitted_served_work(inc) -> bool:
+                """Scenario 18's kill criterion, re-aimed at the broker:
+                a FINISHED journal entry past the committed watermark
+                proves in-flight transactional work exists for the crash
+                to strand."""
+                try:
+                    entries = DecodeJournal.load(inc.journal_path)
+                except Exception:  # noqa: BLE001 - mid-write race
+                    return False
+                for (topic, p, off), e in entries.items():
+                    if not e.finished or topic != "t19":
+                        continue
+                    wm = fleet.broker.committed(
+                        "s19", TopicPartition("t19", p)
+                    ) or 0
+                    if off >= wm:
+                        return True
+                return False
+
+            deadline = _time.monotonic() + 240
+            while not any(
+                uncommitted_served_work(i) for i in fleet.live()
+            ):
+                if _time.monotonic() > deadline:
+                    raise TimeoutError(
+                        "no crash opportunity arose\n" + fleet.diagnose()
+                    )
+                if len(fleet.results("read_committed")) >= n:
+                    raise RuntimeError(
+                        "storm finished before any worker held "
+                        "uncommitted served work — shrink commit_every"
+                    )
+                _time.sleep(0.01)
+
+            recovery = fleet.restart_broker(crash=True, down_s=down_s)
+
+            def covered(f) -> bool:
+                committed = set(f.results("read_committed"))
+                if committed >= all_keys:
+                    return True
+                pending = set()
+                for inc in f.live():
+                    try:
+                        entries = DecodeJournal.load(inc.journal_path)
+                    except Exception:  # noqa: BLE001 - mid-write race
+                        continue
+                    for (topic, p, off), e in entries.items():
+                        if e.finished and topic == "t19":
+                            pending.add(str(off * parts + p).encode())
+                return committed | pending >= all_keys
+
+            fleet.wait(covered, timeout_s=240)
+            fleet.drain()
+            fleet.wait(
+                lambda f: all(not i.running for i in f.incarnations),
+                timeout_s=120,
+            )
+            fleet.poll_once()
+            zero_lost = fleet.fully_committed()
+
+            committed_res = fleet.results("read_committed")
+            committed_dups = sum(
+                len(v) - 1 for v in committed_res.values()
+            )
+            identical = set(committed_res) == set(ref) and all(
+                np.array_equal(toks, ref[k])
+                for k, copies in committed_res.items()
+                for _m, toks in copies
+            )
+            worker_m = fleet.worker_metrics()
+            elapsed = _time.perf_counter() - t0
+        finally:
+            fleet.close()
+    return {
+        "scenario": "19:broker-crash-recovery-storm",
+        "model_scale": label,
+        "replicas": replicas,
+        "records": n,
+        "ready_s": round(ready_s, 2),
+        "elapsed_s": round(elapsed, 2),
+        "broker_down_s": down_s,
+        "broker_restarts": fleet.metrics.broker_restarts.count,
+        "recovery": recovery,
+        "zero_lost": zero_lost,
+        "identical_to_no_restart": identical,
+        "committed_duplicates": committed_dups,
+        "workers_survived_unfenced": all(
+            m["exit"] == 0 for m in worker_m
+        ) and len(worker_m) == replicas,
+        "breaker_opens": {
+            m["member"]: m["circuit_opens"] for m in worker_m
+        },
+        "breaker_closes": {
+            m["member"]: m["circuit_closes"] for m in worker_m
+        },
+        "heartbeat_outages": sum(
+            m["heartbeat_outages"] for m in worker_m
+        ),
+        "exit_codes": {
+            i.member: (None if i.proc is None else i.proc.returncode)
+            for i in fleet.incarnations
+        },
+    }
+
+
 def scenario_8(size: str = "tiny") -> dict:
     """Streaming CTR: DLRM-style recommender trained from a Kafka event
     stream — label + dense features + hashed categorical ids per record,
@@ -2542,6 +2734,7 @@ SCENARIOS = {
     16: scenario_16,
     17: scenario_17,
     18: scenario_18,
+    19: scenario_19,
 }
 
 
@@ -2590,7 +2783,7 @@ def run_scenario(
         )
     sample_kw = dict(temperature=temperature, top_k=top_k, top_p=top_p)
     spec_kw = dict(spec=spec, spec_k=spec_k, spec_draft_layers=spec_draft_layers)
-    if num in (10, 11, 12, 13, 15, 16, 17, 18):
+    if num in (10, 11, 12, 13, 15, 16, 17, 18, 19):
         return SCENARIOS[num](size, replicas=replicas)
     if model_scale is not None:
         if num not in (5, 7):

@@ -80,6 +80,11 @@ BURN_STATE = "burn_state"
 REPLICA_JOINED = "replica_joined"
 REPLICA_FENCED = "replica_fenced"
 JOURNAL_HANDOFF = "journal_handoff"
+# The broker itself died and was crash-recovered from its write-ahead
+# log (ProcessFleet.restart_broker): the one event that interrupts EVERY
+# record lifecycle at once, so it rides the same "fleet" stream ordered
+# against them.
+BROKER_RESTARTED = "broker_restarted"
 
 STAGES = (
     POLLED, QOS_ADMITTED, DEFERRED, PREFILL_QUEUED, CHUNK_SCHEDULED,
@@ -519,6 +524,23 @@ class RecordTracer:
             self._emit(JOURNAL_HANDOFF, "fleet", 0, seq, (
                 ("entries", entries), ("member", member),
                 ("replica", replica),
+            ))
+
+    def broker_restarted(self, replayed_records: int = 0,
+                         aborted_txns: int = 0,
+                         recovery_ms: float = 0.0) -> None:
+        """The hosted broker was crash-recovered from its WAL: how much
+        state the log salvaged (records replayed, dangling transactions
+        aborted) and how long the replay took. Topic ``fleet``; offset =
+        membership sequence — ordered against the joins/fences the
+        outage may have triggered."""
+        with self._lock:
+            seq = self._membership_seq
+            self._membership_seq += 1
+            self._emit(BROKER_RESTARTED, "fleet", 0, seq, (
+                ("aborted_txns", aborted_txns),
+                ("recovery_ms", round(recovery_ms, 3)),
+                ("replayed_records", replayed_records),
             ))
 
     def burn_state(self, seq: int, metric: str, dim: str, label: str,

@@ -254,6 +254,13 @@ class ResilientConsumer(ConsumerIterMixin):
     def close(self) -> None:
         self._inner.close()
 
+    def heartbeat(self):
+        """Forward the lease renewal verbatim (transport retry lives in
+        the inner client; a FencedMemberError must surface untouched —
+        retrying a fenced member's heartbeat is a zombie's hope)."""
+        fn = getattr(self._inner, "heartbeat", None)
+        return None if fn is None else fn()
+
     # Group metadata (transactional offset commits present it so the
     # broker fences them generation-checked): forwarded where the inner
     # transport has it, None where it does not.

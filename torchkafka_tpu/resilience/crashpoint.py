@@ -57,6 +57,28 @@ txn_post_commit_pre_ack    the transaction committed ON the broker but the
                            moved atomically with the records, so recovery
                            re-serves NOTHING; the committed view already
                            holds the single copy
+wal_append_mid             the BROKER dies between the two halves of a WAL
+                           frame's body — the torn tail. The event was never
+                           acknowledged; recovery must CRC-detect the frame,
+                           truncate it away, and never replay it
+wal_pre_fsync              a WAL frame is fully written but not yet fsynced —
+                           process death keeps it (page cache), machine death
+                           may not; either outcome must satisfy the same
+                           invariants (the event was unacknowledged)
+txn_marker_pre_append      a transaction's offsets validated, the commit
+                           marker NOT yet in the WAL — broker death here
+                           means recovery finds a begun-but-unsettled
+                           transaction and ABORTS it; nothing surfaces
+                           committed
+txn_marker_post_append_pre_ack  the commit marker is durably in the WAL but
+                           the broker dies before flipping memory state /
+                           acking — recovery REPLAYS the marker (records +
+                           offsets commit atomically) and the producer's
+                           retry is answered idempotently
+recovery_mid_replay        the recovering broker dies mid-way through its
+                           own WAL replay — replay is read-only until it
+                           completes, so a second recovery must reproduce
+                           the identical state
 ========================== =================================================
 
 Sites call ``crash_hook("<name>")``; production cost is one global ``is
@@ -98,6 +120,11 @@ REGISTERED_CRASH_POINTS: tuple[str, ...] = (
     "txn_produce_mid",
     "txn_pre_commit",
     "txn_post_commit_pre_ack",
+    "wal_append_mid",
+    "wal_pre_fsync",
+    "txn_marker_pre_append",
+    "txn_marker_post_append_pre_ack",
+    "recovery_mid_replay",
 )
 
 ENV_VAR = "TORCHKAFKA_CRASHPOINT"

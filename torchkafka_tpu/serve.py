@@ -45,6 +45,7 @@ from jax import lax
 
 from torchkafka_tpu.commit.ledger import OffsetLedger
 from torchkafka_tpu.errors import (
+    BrokerUnavailableError,
     CommitFailedError,
     ConsumerClosedError,
     OutputDeliveryError,
@@ -2904,22 +2905,31 @@ class StreamingGenerator:
             self._uncommitted = 0
         return completions
 
-    def flush_commits(self) -> None:
+    def flush_commits(self) -> bool:
         """Commit anything emitted since the last commit (cadence-pending
         completions). The external-admission caller's end-of-window flush;
         run() calls it on exit. A SURVIVABLE commit failure (rebalance,
-        open circuit, broker fault) leaves the cadence counter intact, so
-        the completions stay commit-pending and the next cadence point or
-        flush retries them — a transient failure at the final flush no
-        longer silently strands the tail uncommitted until restart.
-        In exactly_once mode a non-empty outbox also forces the flush:
-        held out-of-order outputs (e.g. behind a record that resolved
-        as DROPPED, which advances no completion counter) must still
-        reach a committed transaction."""
-        if (
-            self._uncommitted or (self._txn_mode and self._txn_outbox)
-        ) and self._commit():
-            self._uncommitted = 0
+        open circuit, broker fault or outage) leaves the cadence counter
+        intact, so the completions stay commit-pending and the next
+        cadence point or flush retries them — a transient failure at the
+        final flush no longer silently strands the tail uncommitted until
+        restart. In exactly_once mode a non-empty outbox also forces the
+        flush: held out-of-order outputs (e.g. behind a record that
+        resolved as DROPPED, which advances no completion counter) must
+        still reach a committed transaction.
+
+        Returns False exactly when a survivable failure left work
+        pending — the caller's cue to RETRY at its next safe point even
+        if no new completions arrive (a fleet replica that went idle
+        with a failed flush would otherwise never commit its tail: the
+        broker-outage wedge the durable-broker restart drill exposed).
+        True means the flush succeeded or nothing needed flushing."""
+        if self._uncommitted or (self._txn_mode and self._txn_outbox):
+            if self._commit():
+                self._uncommitted = 0
+                return True
+            return False
+        return True
 
     @property
     def pending_commit(self) -> int:
@@ -3077,6 +3087,19 @@ class StreamingGenerator:
         except CommitFailedError:
             self.metrics.commit_failures.add(1)
             _logger.exception("offset commit failed; prompts will re-deliver")
+            return False
+        except BrokerUnavailableError:
+            # A broker outage that outlived the client's own retry budget
+            # (e.g. a broker-process death mid-restart): survivable — the
+            # ledger snapshot stays pending, the cadence counter stays
+            # intact, and the next flush retries against the recovered
+            # broker. Riding the outage here is what lets a WAL-restarted
+            # broker pick the fleet back up with zero lost records.
+            self.metrics.commit_failures.add(1)
+            _logger.warning(
+                "broker unavailable at commit; offsets stay pending and "
+                "retry at the next flush"
+            )
             return False
         if self._tracer is not None:
             # Durably committed: close every covered record's e2e span.

@@ -10,7 +10,13 @@ from torchkafka_tpu.source.kafka import (
     KafkaTransactionalProducer,
 )
 from torchkafka_tpu.source.memory import InMemoryBroker, MemoryConsumer
-from torchkafka_tpu.source.netbroker import BrokerClient, BrokerServer
+from torchkafka_tpu.source.netbroker import (
+    BrokerClient,
+    BrokerServer,
+    ChaosTransport,
+    WireFaults,
+)
+from torchkafka_tpu.source.wal import WriteAheadLog
 from torchkafka_tpu.source.producer import (
     MemoryProducer,
     Producer,
@@ -25,6 +31,7 @@ __all__ = [
     "BrokerServer",
     "ChaosConsumer",
     "ChaosProducer",
+    "ChaosTransport",
     "Consumer",
     "HAVE_KAFKA_PYTHON",
     "InMemoryBroker",
@@ -40,6 +47,8 @@ __all__ = [
     "seek_to_timestamp",
     "Record",
     "TopicPartition",
+    "WireFaults",
+    "WriteAheadLog",
     "local_batch_size",
     "partitions_for_process",
 ]

@@ -51,6 +51,7 @@ from torchkafka_tpu.errors import (
 )
 from torchkafka_tpu.source.consumer import ConsumerIterMixin
 from torchkafka_tpu.source.records import Record, TopicPartition
+from torchkafka_tpu.source import wal as _wal
 
 _member_counter = itertools.count()
 
@@ -58,7 +59,8 @@ _member_counter = itertools.count()
 class _Group:
     """One consumer group: membership, generation, assignment, offsets."""
 
-    def __init__(self) -> None:
+    def __init__(self, gid: str = "") -> None:
+        self.gid = gid
         self.generation = 0
         # member_id -> subscription: a frozenset of topic names, or a
         # compiled regex (pattern subscription) resolved at rebalance time
@@ -114,6 +116,9 @@ class InMemoryBroker:
         *,
         session_timeout_s: float | None = None,
         clock=None,
+        wal_dir: str | None = None,
+        wal_durability: str | None = "batch",
+        wal_segment_bytes: int = 4 * 1024 * 1024,
     ) -> None:
         """``session_timeout_s``: opt-in heartbeat leases for group
         members (None, the default, preserves lease-free semantics —
@@ -126,11 +131,33 @@ class InMemoryBroker:
         integrity half: a merely-slow member that missed heartbeats gets
         its commit rejected (records re-deliver), never merged.
         ``clock``: the lease clock (default ``time.monotonic``);
-        injectable so lease tests run on a ``ManualClock``."""
+        injectable so lease tests run on a ``ManualClock``.
+
+        ``wal_dir``: opt-in DURABILITY (None, the default, keeps the
+        broker fully in-memory — nothing on disk, nothing recovered).
+        With a directory set, every state change that the broker ever
+        acknowledges — produced records, committed offset snapshots,
+        group membership/generation mutations, transaction begin/commit/
+        abort markers, producer-id inits — is appended to a segmented
+        CRC-framed write-ahead log (source/wal.py) BEFORE the ack, and
+        construction over a non-empty ``wal_dir`` RECOVERS: the log
+        replays into identical topics/records/offsets/generations, a
+        transaction with a begin but no commit marker is ABORTED (its
+        producer's next ``init_producer_id`` already expects that — the
+        epoch fence from the process fleet), the LSO recomputes so
+        ``read_committed`` consumers never see a half-recovered
+        transaction, and restored group members get FRESH leases (a
+        member that is really dead just expires one session timeout
+        later, exactly like any other silent peer). ``wal_durability``:
+        the fsync discipline (``"commit"``/``"batch"``/None — see
+        source/wal.py; process death never loses acknowledged events
+        under any of them, only machine death reaches the knob)."""
         if session_timeout_s is not None and session_timeout_s <= 0:
             raise ValueError(
                 f"session_timeout_s must be > 0 or None, got {session_timeout_s}"
             )
+        from torchkafka_tpu.utils.metrics import BrokerMetrics
+
         self._lock = threading.RLock()
         self._data_arrived = threading.Condition(self._lock)
         self._logs: dict[TopicPartition, list[Record]] = {}
@@ -153,6 +180,209 @@ class InMemoryBroker:
         self._txn_seq_counter = itertools.count(1)
         self._txn_status: dict[int, str] = {}  # seq -> open|committed|aborted
         self._rec_txn: dict[TopicPartition, dict[int, int]] = {}
+        self.metrics = BrokerMetrics()
+        self.recovery_info: dict | None = None
+        self._wal: _wal.WriteAheadLog | None = None
+        if wal_dir is not None:
+            self._recover_from_wal(
+                wal_dir, wal_durability, wal_segment_bytes
+            )
+
+    # ------------------------------------------------------ WAL + recovery
+
+    @property
+    def wal(self) -> "_wal.WriteAheadLog | None":
+        return self._wal
+
+    def _wal_append(self, kind: str, event: dict) -> None:
+        # The closed-WAL guard covers teardown stragglers (a drain-path
+        # mutation landing after close()): in-memory semantics proceed,
+        # durability is over — the broker is already being discarded.
+        if self._wal is not None and not self._wal.closed:
+            self._wal.append(kind, event)
+
+    def close(self) -> None:
+        """Flush + close the write-ahead log (clean shutdown; a crash
+        skips this by definition and recovery covers it). No-op for the
+        pure in-memory broker."""
+        if self._wal is not None and not self._wal.closed:
+            self._wal.close()
+
+    def _recover_from_wal(
+        self, wal_dir: str, durability: str | None, segment_bytes: int
+    ) -> None:
+        """Rebuild broker state from the log: replay the clean frame
+        prefix (a torn tail is truncated, never replayed), settle every
+        transaction the log left unsettled (begin without commit/abort →
+        ABORT — its records drop out of the committed view and its
+        buffered offsets vanish), advance the id counters past everything
+        replayed, grant restored members fresh leases, then open the log
+        for append and write the recovery abort markers so the on-disk
+        log states what recovery decided."""
+        from torchkafka_tpu.resilience.crashpoint import crash_hook
+
+        t0 = time.perf_counter()
+        events, truncated = _wal.replay(wal_dir, repair=True)
+        replayed_records = 0
+        for kind, event in events:
+            # Recovery is read-only until the replay completes: a death
+            # here leaves the log byte-identical, so the next recovery
+            # reproduces the identical state (the crash matrix kills a
+            # recovering broker exactly here to prove it).
+            crash_hook("recovery_mid_replay")
+            self._apply_wal_event(kind, event)
+            if kind == "produce":
+                replayed_records += 1
+        aborted: list[tuple[str, int, int]] = []
+        for st in self._txn_producers.values():
+            if st.open is not None:
+                self._txn_status[st.open.seq] = "aborted"
+                st.last = (st.epoch, "aborted")
+                aborted.append((st.txn_id, st.epoch, st.open.seq))
+                st.open = None
+        if self._txn_by_pid:
+            self._txn_pid_counter = itertools.count(
+                max(self._txn_by_pid) + 1
+            )
+        if self._txn_status:
+            self._txn_seq_counter = itertools.count(
+                max(self._txn_status) + 1
+            )
+        if self._session_timeout_s is not None:
+            # Restored members get fresh leases dated from recovery: a
+            # live worker's reconnecting heartbeat renews in time, a dead
+            # one silently expires one session timeout later — the normal
+            # fencing path, no special casing. This is what lets a
+            # process fleet ride a broker restart without re-joining.
+            now = self._clock()
+            for g in self._groups.values():
+                for m in g.members:
+                    g.leases[m] = now + self._session_timeout_s
+        else:
+            # A lease-less broker has NO liveness protocol that could
+            # ever reap a dead member: restored memberships would be
+            # immortal ghosts squatting on their partitions. Kafka's own
+            # coordinator failover makes members REJOIN; mirror that —
+            # drop memberships (committed offsets keep, they are the
+            # durable resume state) with one final rebalance per group,
+            # so a pre-crash zombie's stale-generation commit still
+            # bounces off the moved generation.
+            for g in self._groups.values():
+                if g.members:
+                    g.members.clear()
+                    g.leases.clear()
+                    self._rebalance(g)
+        recovery_ms = (time.perf_counter() - t0) * 1e3
+        self._wal = _wal.WriteAheadLog(
+            wal_dir, durability=durability, segment_bytes=segment_bytes,
+            metrics=self.metrics,
+        )
+        for txn_id, epoch, seq in aborted:
+            self._wal_append("txn_abort", {
+                "txn_id": txn_id, "epoch": epoch, "seq": seq,
+                "recovery": True,
+            })
+        m = self.metrics
+        m.recoveries.add(1)
+        m.recovery_replayed_events.add(len(events))
+        m.recovery_replayed_records.add(replayed_records)
+        m.recovery_aborted_txns.add(len(aborted))
+        m.recovery_truncated_bytes.add(truncated)
+        m.recovery_ms.set(recovery_ms)
+        self.recovery_info = {
+            "replayed_events": len(events),
+            "replayed_records": replayed_records,
+            "aborted_txns": len(aborted),
+            "truncated_bytes": truncated,
+            "recovery_ms": round(recovery_ms, 3),
+        }
+
+    def _apply_wal_event(self, kind: str, d: dict) -> None:
+        """One replayed event → the same state mutation the original
+        call made, minus re-logging and lease bookkeeping (leases are
+        wall-clock state; recovery re-grants them wholesale). Raw-state
+        application keeps replay byte-exact: record timestamps, offsets,
+        the round-robin produce cursor, and group generations all come
+        out identical to the pre-crash broker."""
+        if kind == "topic":
+            topic, parts = d["topic"], d["partitions"]
+            self._topics[topic] = parts
+            for p in range(parts):
+                self._logs[TopicPartition(topic, p)] = []
+            for g in self._groups.values():
+                if any(
+                    isinstance(sub, re.Pattern) and sub.match(topic)
+                    for sub in g.members.values()
+                ):
+                    self._rebalance(g)
+        elif kind == "produce":
+            tp = TopicPartition(d["topic"], d["partition"])
+            log = self._logs[tp]
+            rec = Record(
+                topic=d["topic"], partition=d["partition"],
+                offset=len(log), value=d["value"], key=d["key"],
+                timestamp_ms=d["ts"], headers=tuple(d["headers"]),
+            )
+            log.append(rec)
+            if d.get("rr"):
+                self._rr[d["topic"]] = d["partition"] + 1
+            if d.get("seq") is not None:
+                self._rec_txn.setdefault(tp, {})[rec.offset] = d["seq"]
+        elif kind == "group":
+            g = self._group(d["group"])
+            member = d["member"]
+            if d["op"] == "join":
+                g.members[member] = (
+                    re.compile(d["pattern"])
+                    if d.get("pattern") is not None
+                    else frozenset(d["topics"])
+                )
+                g.fenced.discard(member)
+                self._rebalance(g)
+            elif d["op"] == "leave":
+                if member in g.members:
+                    del g.members[member]
+                    self._rebalance(g)
+            elif d["op"] == "fence":
+                if member in g.members:
+                    del g.members[member]
+                    g.fenced.add(member)
+                    g.fence_count += 1
+                    self._rebalance(g)
+        elif kind == "commit":
+            self._group(d["group"]).committed.update(d["offsets"])
+        elif kind == "init_pid":
+            st = self._txn_producers.get(d["txn_id"])
+            if st is None:
+                st = _TxnProducer(d["txn_id"], d["pid"])
+                self._txn_producers[d["txn_id"]] = st
+                self._txn_by_pid[st.pid] = st
+            st.epoch = d["epoch"]
+        elif kind == "txn_begin":
+            st = self._txn_producers[d["txn_id"]]
+            txn = _Txn(d["seq"])
+            self._txn_status[txn.seq] = "open"
+            st.open = txn
+        elif kind == "txn_commit":
+            self._txn_status[d["seq"]] = "committed"
+            st = self._txn_producers.get(d["txn_id"])
+            if st is not None:
+                if st.open is not None and st.open.seq == d["seq"]:
+                    st.open = None
+                st.last = (d["epoch"], "committed")
+            for gid, offsets in d["offsets"].items():
+                self._group(gid).committed.update(offsets)
+        elif kind == "txn_abort":
+            self._txn_status[d["seq"]] = "aborted"
+            st = self._txn_producers.get(d["txn_id"])
+            if st is not None:
+                if st.open is not None and st.open.seq == d["seq"]:
+                    st.open = None
+                st.last = (d["epoch"], "aborted")
+        else:  # pragma: no cover - forward-compat guard
+            logging.getLogger(__name__).warning(
+                "ignoring unknown WAL event kind %r", kind
+            )
 
     # ------------------------------------------------------------- topics
 
@@ -160,6 +390,9 @@ class InMemoryBroker:
         with self._lock:
             if topic in self._topics:
                 raise ValueError(f"topic {topic!r} already exists")
+            self._wal_append("topic", {
+                "topic": topic, "partitions": partitions,
+            })
             self._topics[topic] = partitions
             for p in range(partitions):
                 self._logs[TopicPartition(topic, p)] = []
@@ -186,11 +419,15 @@ class InMemoryBroker:
         partition: int | None = None,
         timestamp_ms: int | None = None,
         headers: tuple[tuple[str, bytes], ...] = (),
+        _txn_seq: int | None = None,
     ) -> Record:
         """Append one record; partition chosen by explicit arg, key hash, or
-        round-robin (Kafka's default partitioner behavior)."""
+        round-robin (Kafka's default partitioner behavior). ``_txn_seq``
+        is internal (``txn_produce``): the owning transaction's sequence,
+        journaled WITH the record so recovery restores the association."""
         with self._lock:
             n = self.partitions_for(topic)
+            was_rr = partition is None and key is None
             if partition is None:
                 if key is not None:
                     partition = zlib.crc32(key) % n
@@ -216,7 +453,17 @@ class InMemoryBroker:
                 timestamp_ms=ts,
                 headers=tuple(headers),
             )
+            # Write-ahead: the record is durable before the append is
+            # acknowledged (an unlogged append dies with the process and
+            # was never acked — the producer's retry is the recovery).
+            self._wal_append("produce", {
+                "topic": topic, "partition": partition, "value": value,
+                "key": key, "ts": ts, "headers": tuple(headers),
+                "rr": was_rr, "seq": _txn_seq,
+            })
             log.append(rec)
+            if _txn_seq is not None:
+                self._rec_txn.setdefault(tp, {})[rec.offset] = _txn_seq
             self._data_arrived.notify_all()
             return rec
 
@@ -275,6 +522,13 @@ class InMemoryBroker:
                 st.epoch += 1
                 if st.open is not None:
                     self._abort_txn_locked(st)
+            # Durable BEFORE the ack: the epoch fence must survive broker
+            # death, or a recovered broker would let a SIGKILLed zombie's
+            # stale epoch write again.
+            self._wal_append("init_pid", {
+                "txn_id": transactional_id, "pid": st.pid,
+                "epoch": st.epoch,
+            })
             return st.pid, st.epoch
 
     def _txn_state(self, producer_id: int, epoch: int) -> _TxnProducer:
@@ -305,6 +559,9 @@ class InMemoryBroker:
             if st.open is not None:
                 self._abort_txn_locked(st)
             txn = _Txn(next(self._txn_seq_counter))
+            self._wal_append("txn_begin", {
+                "txn_id": st.txn_id, "epoch": epoch, "seq": txn.seq,
+            })
             self._txn_status[txn.seq] = "open"
             st.open = txn
 
@@ -334,10 +591,11 @@ class InMemoryBroker:
             rec = self.produce(
                 topic, value, key=key, partition=partition,
                 timestamp_ms=timestamp_ms, headers=headers,
+                _txn_seq=st.open.seq,
             )
-            tp = TopicPartition(rec.topic, rec.partition)
-            self._rec_txn.setdefault(tp, {})[rec.offset] = st.open.seq
-            st.open.records.append((tp, rec.offset))
+            st.open.records.append(
+                (TopicPartition(rec.topic, rec.partition), rec.offset)
+            )
             return rec
 
     def txn_commit_offsets(
@@ -402,11 +660,31 @@ class InMemoryBroker:
                 # Atomicity means failure is total: records out too.
                 self._abort_txn_locked(st)
                 raise
+            from torchkafka_tpu.resilience.crashpoint import crash_hook
+
+            # The WAL marker IS the commit decision (KIP-98's transaction
+            # marker): offsets validated, marker not yet durable — broker
+            # death here recovers to an ABORTED transaction (begin with
+            # no commit marker), nothing surfaces committed.
+            crash_hook("txn_marker_pre_append")
+            self._wal_append("txn_commit", {
+                "txn_id": st.txn_id, "epoch": epoch, "seq": txn.seq,
+                "offsets": {
+                    gid: dict(offsets)
+                    for gid, (offsets, _m, _g) in txn.offsets.items()
+                },
+            })
+            # Marker durable, memory state not yet flipped / ack not yet
+            # sent: broker death here recovers to a COMMITTED transaction
+            # (records + offsets atomic), and the producer's retry of
+            # commit_txn is answered idempotently via the restored
+            # ``last`` outcome.
+            crash_hook("txn_marker_post_append_pre_ack")
             self._txn_status[txn.seq] = "committed"
             st.open = None
             st.last = (epoch, "committed")
             for gid, (offsets, member_id, generation) in txn.offsets.items():
-                self._apply_commit_locked(gid, offsets, member_id)
+                self._apply_commit_locked(gid, offsets, member_id, log=False)
             # Committed records became readable below the (possibly
             # advanced) LSO: wake blocked read_committed pollers.
             self._data_arrived.notify_all()
@@ -425,6 +703,9 @@ class InMemoryBroker:
             return True
 
     def _abort_txn_locked(self, st: _TxnProducer) -> None:
+        self._wal_append("txn_abort", {
+            "txn_id": st.txn_id, "epoch": st.epoch, "seq": st.open.seq,
+        })
         self._txn_status[st.open.seq] = "aborted"
         st.last = (st.epoch, "aborted")
         st.open = None
@@ -480,7 +761,7 @@ class InMemoryBroker:
     # -------------------------------------------------------------- groups
 
     def _group(self, group_id: str) -> _Group:
-        return self._groups.setdefault(group_id, _Group())
+        return self._groups.setdefault(group_id, _Group(group_id))
 
     def _fence_locked(self, g: _Group, member_id: str) -> bool:
         """Evict one member (lease expiry or explicit fence) and
@@ -488,6 +769,9 @@ class InMemoryBroker:
         Caller holds the lock."""
         if member_id not in g.members:
             return False
+        self._wal_append("group", {
+            "op": "fence", "group": g.gid, "member": member_id,
+        })
         del g.members[member_id]
         g.leases.pop(member_id, None)
         g.fenced.add(member_id)
@@ -527,6 +811,10 @@ class InMemoryBroker:
         with self._lock:
             g = self._group(group_id)
             self._reap_locked(g)
+            self._wal_append("group", {
+                "op": "join", "group": group_id, "member": member_id,
+                "topics": sorted(topics), "pattern": pattern,
+            })
             g.members[member_id] = (
                 re.compile(pattern) if pattern is not None else topics
             )
@@ -548,6 +836,9 @@ class InMemoryBroker:
         with self._lock:
             g = self._group(group_id)
             if member_id in g.members:
+                self._wal_append("group", {
+                    "op": "leave", "group": group_id, "member": member_id,
+                })
                 del g.members[member_id]
                 g.leases.pop(member_id, None)
                 self._rebalance(g)
@@ -697,7 +988,17 @@ class InMemoryBroker:
         if stray:
             raise CommitFailedError(f"partitions not owned: {sorted(stray)}")
 
-    def _apply_commit_locked(self, group_id: str, offsets, member_id) -> None:
+    def _apply_commit_locked(
+        self, group_id: str, offsets, member_id, log: bool = True,
+    ) -> None:
+        """``log=False``: the caller (``commit_txn``) already made the
+        durability decision with its transaction marker — the offsets
+        ride THAT frame, not a second one."""
+        if log:
+            self._wal_append("commit", {
+                "group": group_id, "offsets": dict(offsets),
+                "member": member_id,
+            })
         self._group(group_id).committed.update(offsets)
         if self._commit_log_path:
             entry = {
@@ -911,6 +1212,8 @@ class MemoryConsumer(ConsumerIterMixin):
 
     def poll(self, max_records: int = 500, timeout_ms: int = 0) -> list[Record]:
         self._check_open()
+        from torchkafka_tpu.errors import BrokerUnavailableError
+
         deadline = time.monotonic() + timeout_ms / 1000.0
         while True:
             self._sync_group()
@@ -928,22 +1231,39 @@ class MemoryConsumer(ConsumerIterMixin):
                         break
                     if tp in self._paused:
                         continue
-                    pos = self._resolve_position(tp)
-                    if self._isolation == "read_committed":
-                        # fetch_stable returns the resume position
-                        # explicitly: it can advance over SKIPPED aborted
-                        # records, which the record list cannot express.
-                        recs, nxt = self._broker.fetch_stable(tp, pos, budget)
-                        if nxt != pos:
-                            self._positions[tp] = nxt
-                        out.extend(recs)
-                        budget -= len(recs)
-                    else:
-                        recs = self._broker.fetch(tp, pos, budget)
-                        if recs:
-                            self._positions[tp] = recs[-1].offset + 1
+                    try:
+                        pos = self._resolve_position(tp)
+                        if self._isolation == "read_committed":
+                            # fetch_stable returns the resume position
+                            # explicitly: it can advance over SKIPPED
+                            # aborted records, which the record list
+                            # cannot express.
+                            recs, nxt = self._broker.fetch_stable(
+                                tp, pos, budget
+                            )
+                            if nxt != pos:
+                                self._positions[tp] = nxt
                             out.extend(recs)
                             budget -= len(recs)
+                        else:
+                            recs = self._broker.fetch(tp, pos, budget)
+                            if recs:
+                                self._positions[tp] = recs[-1].offset + 1
+                                out.extend(recs)
+                                budget -= len(recs)
+                    except BrokerUnavailableError:
+                        # Poll atomicity under transport faults: positions
+                        # have already advanced for the records in ``out``
+                        # — raising now would DROP them (the caller never
+                        # sees records a retried poll will never re-fetch:
+                        # silent per-consumer loss, found by the broker
+                        # crash-restart drill). Return the partial poll;
+                        # the failed partition's fetch retries next poll
+                        # from its unmoved position. An empty partial
+                        # carries nothing, so the fault surfaces.
+                        if out:
+                            return out
+                        raise
             if out or timeout_ms <= 0:
                 return out
             remaining = deadline - time.monotonic()

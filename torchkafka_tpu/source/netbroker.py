@@ -26,9 +26,11 @@ source/kafka.py.
 from __future__ import annotations
 
 import pickle
+import random
 import socket
 import struct
 import threading
+import time
 from typing import Any
 
 from torchkafka_tpu.source.memory import InMemoryBroker
@@ -95,6 +97,150 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
             return None
         buf += chunk
     return buf
+
+
+class WireFaults:
+    """Seeded socket-layer fault plan for :class:`ChaosTransport`.
+
+    The transport-level complement to ``ChaosConsumer`` (source/chaos.py):
+    where that injects faults at the *consumer API* boundary, this
+    injects them at the *wire* — a request frame cut off mid-write, a
+    connection reset while the reply is in flight, an op-counted stall —
+    so broker outages are reproducible at the socket layer without
+    killing any process. One instance carries the RNG and the op counter
+    ACROSS reconnects (a reconnecting client keeps consuming the same
+    schedule), so a seeded run replays identically.
+
+    All rates default to 0.0 and all op sets to empty: a zero-fault plan
+    is a pure pass-through, asserted contract-transparent by the
+    transport-conformance suite.
+
+    - ``reset_rate`` / ``reset_at_ops``: the request's ``sendall`` is cut
+      short — a seeded PARTIAL prefix of the frame is written (the torn
+      bytes the server must discard), then the connection resets. The
+      RPC provably never executed.
+    - ``recv_reset_rate`` / ``recv_reset_at_ops``: the request was sent
+      (and likely executed broker-side) but the reply is lost mid-read —
+      the lost-ack hazard; only idempotent/at-least-once-tolerant
+      operations survive retries of this, which is exactly the
+      transport's documented contract.
+    - ``stall_rate`` / ``stall_at_ops`` (+ ``stall_s``): latency
+      injection before the request goes out.
+
+    An *op* is one RPC request (one ``sendall``); ``*_at_ops`` sets fire
+    deterministically at those op indices (0-based), composing with the
+    seeded rates."""
+
+    def __init__(
+        self,
+        seed: int = 0,
+        *,
+        reset_rate: float = 0.0,
+        recv_reset_rate: float = 0.0,
+        stall_rate: float = 0.0,
+        stall_s: float = 0.005,
+        reset_at_ops: tuple[int, ...] = (),
+        recv_reset_at_ops: tuple[int, ...] = (),
+        stall_at_ops: tuple[int, ...] = (),
+    ) -> None:
+        for name, rate in (("reset_rate", reset_rate),
+                           ("recv_reset_rate", recv_reset_rate),
+                           ("stall_rate", stall_rate)):
+            if not 0.0 <= rate <= 1.0:
+                raise ValueError(f"{name} must be in [0, 1], got {rate}")
+        self._rng = random.Random(seed)
+        self.reset_rate = reset_rate
+        self.recv_reset_rate = recv_reset_rate
+        self.stall_rate = stall_rate
+        self.stall_s = stall_s
+        self.reset_at_ops = frozenset(reset_at_ops)
+        self.recv_reset_at_ops = frozenset(recv_reset_at_ops)
+        self.stall_at_ops = frozenset(stall_at_ops)
+        self.ops = 0  # RPC requests seen, across reconnects
+        self.faults_injected = 0
+
+    def next_op(self) -> int:
+        op = self.ops
+        self.ops += 1
+        return op
+
+    def send_cut(self, op: int, nbytes: int) -> int | None:
+        """None = write goes through; else the seeded prefix length to
+        write before resetting."""
+        if op in self.reset_at_ops or (
+            self.reset_rate and self._rng.random() < self.reset_rate
+        ):
+            self.faults_injected += 1
+            return self._rng.randrange(nbytes) if nbytes else 0
+        return None
+
+    def recv_reset(self, op: int) -> bool:
+        if op in self.recv_reset_at_ops or (
+            self.recv_reset_rate
+            and self._rng.random() < self.recv_reset_rate
+        ):
+            self.faults_injected += 1
+            return True
+        return False
+
+    def stall(self, op: int) -> bool:
+        return op in self.stall_at_ops or (
+            self.stall_rate and self._rng.random() < self.stall_rate
+        )
+
+
+class ChaosTransport:
+    """A connected socket wrapped with a :class:`WireFaults` plan.
+
+    Implements exactly the surface ``BrokerClient``'s framing uses
+    (``sendall``/``recv``/``close``), forwarding to the real socket and
+    consulting the plan per RPC. Injected failures surface as
+    ``ConnectionResetError`` — indistinguishable from a real peer reset,
+    so the client's translation to the retryable
+    ``BrokerUnavailableError`` (and a ``RetryPolicy``'s reconnects) get
+    exercised by the genuine code path, not a simulation of it."""
+
+    def __init__(self, sock: socket.socket, faults: WireFaults) -> None:
+        self._sock = sock
+        self._faults = faults
+        # The reply-loss decision is drawn ONCE per RPC (at request
+        # time), not per recv chunk — chunk counts are data-dependent
+        # and would desynchronize the seeded schedule.
+        self._pending_recv_reset = False
+
+    def sendall(self, data: bytes) -> None:
+        f = self._faults
+        op = f.next_op()
+        cut = f.send_cut(op, len(data))
+        if cut is not None:
+            try:
+                self._sock.sendall(data[:cut])
+            finally:
+                self.close()
+            raise ConnectionResetError(
+                f"chaos: connection reset after {cut}/{len(data)} bytes "
+                f"of request (op {op})"
+            )
+        if f.stall(op):
+            time.sleep(f.stall_s)
+        self._pending_recv_reset = f.recv_reset(op)
+        self._sock.sendall(data)
+
+    def recv(self, n: int) -> bytes:
+        if self._pending_recv_reset:
+            self._pending_recv_reset = False
+            self.close()
+            raise ConnectionResetError(
+                "chaos: connection reset mid-reply (request may have "
+                "executed broker-side — the lost-ack hazard)"
+            )
+        return self._sock.recv(n)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
 
 
 class BrokerServer:
@@ -227,11 +373,16 @@ class BrokerClient:
 
     def __init__(
         self, host: str, port: int, timeout_s: float = 30.0, retry=None,
+        faults: WireFaults | None = None,
     ) -> None:
         self._host = host
         self._port = port
         self._timeout_s = timeout_s
         self._retry = retry
+        # Wire-fault injection (ChaosTransport): every connection this
+        # client opens — including reconnects — is wrapped with the SAME
+        # plan, so the seeded schedule spans the client's whole life.
+        self._faults = faults
         self._lock = threading.Lock()
         self._closed = False
         self._sock: socket.socket | None = None
@@ -263,7 +414,10 @@ class BrokerClient:
                 f"broker {self._host}:{self._port} unreachable: {exc}"
             ) from exc
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._sock = sock
+        self._sock = (
+            ChaosTransport(sock, self._faults)
+            if self._faults is not None else sock
+        )
 
     def _call_once(self, method: str, args: tuple, kwargs: dict) -> Any:
         from torchkafka_tpu.errors import BrokerUnavailableError

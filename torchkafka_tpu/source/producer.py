@@ -249,6 +249,15 @@ class TransactionalProducer:
         except CommitFailedError:
             self._in_txn = False  # broker aborted it atomically
             raise
+        except TransactionStateError:
+            # The broker has no open transaction for this epoch and no
+            # committed ``last`` outcome to answer idempotently: a broker
+            # that died and RECOVERED mid-cycle aborted it (begin with no
+            # commit marker). Terminal for the transaction, survivable
+            # for the caller — same contract as CommitFailedError: this
+            # handle's state heals so a fresh begin() re-sends the work.
+            self._in_txn = False
+            raise
         self._in_txn = False
         # Committed ON the broker, the ack not yet observed by the
         # caller: death here must NOT re-publish at recovery — the

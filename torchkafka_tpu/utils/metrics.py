@@ -350,3 +350,55 @@ class StreamMetrics:
             ]),
             ("ingest_lag_ms", "gauge", s["ingest_lag_ms"]),
         ])
+
+
+class BrokerMetrics:
+    """The metric set a durable ``InMemoryBroker`` maintains: WAL write
+    cost (appends, bytes, fsyncs) and recovery outcome (events/records
+    replayed, dangling transactions aborted, torn-tail bytes truncated,
+    wall-clock to recover) — the operator's answer to "what did that
+    broker restart cost and what did it salvage". Rendered on the same
+    shared exposition grammar as every other metrics class so the fleet
+    endpoint serves it from the same scrape."""
+
+    def __init__(self) -> None:
+        self.wal_appends = RateMeter()
+        self.wal_bytes_written = RateMeter()
+        self.wal_fsyncs = RateMeter()
+        self.recoveries = RateMeter()
+        self.recovery_replayed_events = RateMeter()
+        self.recovery_replayed_records = RateMeter()
+        self.recovery_aborted_txns = RateMeter()
+        self.recovery_truncated_bytes = RateMeter()
+        self.recovery_ms = Gauge()  # last recovery's replay wall-clock
+
+    def summary(self) -> dict:
+        return {
+            "wal_appends": self.wal_appends.count,
+            "wal_bytes_written": self.wal_bytes_written.count,
+            "wal_fsyncs": self.wal_fsyncs.count,
+            "recoveries": self.recoveries.count,
+            "recovery_replayed_events": self.recovery_replayed_events.count,
+            "recovery_replayed_records": self.recovery_replayed_records.count,
+            "recovery_aborted_txns": self.recovery_aborted_txns.count,
+            "recovery_truncated_bytes": self.recovery_truncated_bytes.count,
+            "recovery_ms": round(self.recovery_ms.value, 3),
+        }
+
+    def render_prometheus(self, prefix: str = "torchkafka_broker") -> str:
+        s = self.summary()
+        return render_exposition(prefix, [
+            ("wal_appends_total", "counter", s["wal_appends"]),
+            ("wal_bytes_written_total", "counter", s["wal_bytes_written"]),
+            ("wal_fsyncs_total", "counter", s["wal_fsyncs"]),
+            ("recoveries_total", "counter", s["recoveries"]),
+            ("recovery_replayed_events_total", "counter",
+             s["recovery_replayed_events"]),
+            ("recovery_replayed_records_total", "counter",
+             s["recovery_replayed_records"]),
+            ("recovery_aborted_txns_total", "counter",
+             s["recovery_aborted_txns"]),
+            ("recovery_truncated_bytes_total", "counter",
+             s["recovery_truncated_bytes"]),
+            ("recovery_ms", "gauge", s["recovery_ms"]),
+        ])
