@@ -64,7 +64,8 @@ def build_broker(tk, np, n: int, prompt_len: int, overlap: float, seed: int):
 
 
 def run_once(tk, np, jax, cfg, params, broker, slots: int, n: int,
-             prompt_len: int, max_new: int, pages: dict | None):
+             prompt_len: int, max_new: int, pages: dict | None,
+             mesh=None, kv_dtype=None, kv_kernel="auto"):
     from torchkafka_tpu.serve import StreamingGenerator
 
     class PeakTracking(StreamingGenerator):
@@ -87,7 +88,8 @@ def run_once(tk, np, jax, cfg, params, broker, slots: int, n: int,
     consumer = tk.MemoryConsumer(broker, "bench", group_id="b")
     server = PeakTracking(
         consumer, params, cfg, slots=slots, prompt_len=prompt_len,
-        max_new=max_new, commit_every=8, kv_pages=pages,
+        max_new=max_new, commit_every=8, kv_pages=pages, mesh=mesh,
+        kv_dtype=kv_dtype, kv_kernel=kv_kernel,
     )
     server.warmup()
     out = {}
@@ -202,6 +204,99 @@ def sweep(tk, np, jax, cfg, params, *, label, n, slots, prompt_len,
     return results
 
 
+def mesh_sweep(tk, np, jax, cfg, params, *, n, slots, prompt_len, max_new,
+               overlap, mesh_specs, slices):
+    """PR-13 paired MESH slices: for each host-device mesh, the sharded
+    PAGED server (and the sharded paged+int8+kernel one) against its
+    single-device reference over identical broker content, exactness
+    asserted per slice. CPU host-device meshes measure the COMPOSITION
+    honestly — cross-"device" collectives on one box are pure overhead,
+    so the wall ratio is a lower bound that only a real TPU slice can
+    convert into the sharded 8B-at-4096 headline (PERF.md's open
+    rows)."""
+    from torchkafka_tpu.parallel import make_mesh
+
+    results = []
+    for spec in mesh_specs:
+        axes = {
+            part.split(":")[0]: int(part.split(":")[1])
+            for part in spec.split(",")
+        }
+        ndev = 1
+        for v in axes.values():
+            ndev *= v
+        mesh = make_mesh(axes, devices=jax.devices()[:ndev])
+        pages = {"block_size": BLOCK, "num_blocks": 4 * slots *
+                 -(-(prompt_len + max_new) // BLOCK)}
+        # Pairings: the plain paged slice measures the COMPOSED server
+        # against the dense single-device reference (the tests'
+        # exactness contract); the int8+kernel slice pairs the sharded
+        # server against the SAME backend on one device — the Pallas
+        # read is exact vs the XLA gather only up to f32 reduction
+        # order, so kernel-vs-gather is not a bitwise pairing at bench
+        # scale, while kernel-vs-kernel isolates exactly the mesh
+        # delta.
+        for mode, base_pages, base_kw, mesh_kw in (
+            ("paged", None, {}, dict(kv_pages=pages)),
+            ("paged_int8_kernel", pages,
+             dict(kv_dtype="int8", kv_kernel=True),
+             dict(kv_dtype="int8", kv_kernel=True, kv_pages=pages)),
+        ):
+            ratios, cell = [], None
+            for s in range(slices):
+                base = run_once(
+                    tk, np, jax, cfg, params,
+                    build_broker(tk, np, n, prompt_len, overlap, seed=s),
+                    slots, n, prompt_len, max_new, base_pages, **base_kw,
+                )
+                sharded = run_once(
+                    tk, np, jax, cfg, params,
+                    build_broker(tk, np, n, prompt_len, overlap, seed=s),
+                    slots, n, prompt_len, max_new,
+                    mesh_kw.get("kv_pages"), mesh=mesh,
+                    kv_dtype=mesh_kw.get("kv_dtype"),
+                    kv_kernel=mesh_kw.get("kv_kernel", "auto"),
+                )
+                assert set(base["out"]) == set(sharded["out"])
+                for k in base["out"]:
+                    np.testing.assert_array_equal(
+                        base["out"][k], sharded["out"][k],
+                        err_msg=f"mesh {spec} mode {mode} slice {s} "
+                                f"prompt {k}",
+                    )
+                assert base["committed"] == sharded["committed"], (
+                    "commit ledgers diverged"
+                )
+                ratios.append(sharded["elapsed_s"] / base["elapsed_s"])
+                cell = (base, sharded)
+            base, sharded = cell
+            rec = {
+                "slice": "mesh",
+                "mesh": spec,
+                "mode": mode,
+                "overlap": overlap,
+                "prompt_len": prompt_len,
+                "max_new": max_new,
+                "hit_rate": sharded["cache"]["hit_rate"],
+                "prefix_tokens_saved": sharded["cache"][
+                    "prefix_tokens_saved"],
+                "sharded_over_single_wall": round(
+                    float(np.median(ratios)), 2
+                ),
+                "single_tok_s": round(base["tok_s"], 1),
+                "sharded_tok_s": round(sharded["tok_s"], 1),
+                "token_exact_and_ledger_identical": True,  # asserted above
+            }
+            results.append(rec)
+            print(
+                f"| mesh {spec} | {mode} | "
+                f"{(sharded['cache']['hit_rate'] or 0):.2f} | "
+                f"{rec['sharded_tok_s']} vs {rec['single_tok_s']} tok/s | "
+                f"{rec['sharded_over_single_wall']:.2f}x wall |"
+            )
+    return results
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--prompts", type=int, default=48)
@@ -217,6 +312,13 @@ def main() -> None:
                     "max_new 8, overlap 0.9 — the system-prompt storm "
                     "regime the chunked tick exists to flip positive)")
     ap.add_argument("--slices", type=int, default=2)
+    ap.add_argument("--mesh", default=None,
+                    help="semicolon list of host-device mesh specs for the "
+                    "PR-13 sharded-paged slices (e.g. "
+                    "'data:2;tp:2;data:2,tp:2'): each runs the sharded "
+                    "paged server — and the paged+int8+Pallas-kernel one — "
+                    "paired against its single-device reference, exactness "
+                    "asserted in-bench")
     ap.add_argument("--json", default=None, help="also write the JSON here")
     args = ap.parse_args()
     overlaps = [float(x) for x in args.overlaps.split(",")]
@@ -278,6 +380,18 @@ def main() -> None:
             prompt_len=prompt_len, max_new=max_new, overlaps=ovl,
             chunks=chunks, slices=args.slices, dense_blocks=dense_blocks,
             block_bytes=block_bytes,
+        )
+    if args.mesh:
+        cfg, params = model_for(32, args.max_new)
+        print(
+            "| mesh | mode | hit rate | sharded vs single tok/s | "
+            "wall ratio |"
+        )
+        print("|---|---|---|---|---|")
+        results += mesh_sweep(
+            tk, np, jax, cfg, params, n=n, slots=slots, prompt_len=32,
+            max_new=args.max_new, overlap=0.5,
+            mesh_specs=args.mesh.split(";"), slices=args.slices,
         )
     payload = {
         "bench": "kvcache",

@@ -374,6 +374,35 @@ def test_scenario_19_broker_crash_recovery():
     assert sorted(out["exit_codes"].values()) == [0, 0]
 
 
+def test_scenario_20_sharded_paged_fleet():
+    """The tier-1 sharded-paged smoke (PR 13): a 2-replica fleet whose
+    generators compose paged block tables + int8 payloads + the kernel
+    probe + a {data, tp} host-device mesh. Coverage and commits exact,
+    the radix cache non-degenerate while sharded, and the resolved
+    backend observable in the report."""
+    out = run_scenario(20, "tiny")
+    assert out["scenario"] == "20:sharded-paged-int8-fleet"
+    assert out["replicas"] == 2
+    assert out["mesh"] == {"data": 2, "tp": 2}
+    assert out["coverage_complete"] is True
+    assert out["committed_complete"] is True
+    assert out["records"] >= 24
+    # The composed backend actually served: paged + int8 under the mesh,
+    # with the kernel's auto decision surfaced (disabled off-TPU, with
+    # the reason on record rather than silent).
+    kb = out["kv_backend"]
+    assert kb["layout"] == "paged" and kb["kv_dtype"] == "int8"
+    assert kb["data"] == 2 and kb["tp"] == 2
+    assert kb["kernel_engaged"] in (0, 1)
+    if not kb["kernel_engaged"]:
+        assert kb["kernel_disabled"]
+    # Radix reuse did real work while sharded.
+    assert out["cache"]["hits"] > 0
+    assert out["cache"]["hit_rate"] > 0.5
+    assert out["prefill_savings_pct"] > 20
+    assert out["commit_failures"] == 0 and out["dropped"] == 0
+
+
 def test_scenario_13_warm_failover_smoke():
     """The tier-1 warm-failover smoke: a seeded mid-generation replica
     kill through a journaled 2-replica fleet. The survivor consults the

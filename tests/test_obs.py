@@ -608,6 +608,15 @@ def _serve_metrics():
     m.output_capped.add(1)
     m.tenant_prefix_hits(EVIL_TENANT).add(2)
     m.tenant_prefix_misses(EVIL_TENANT).add(1)
+    # PR-13 family: the resolved KV backend + kernel engagement pair
+    # (reason strings become label values — the escape path matters).
+    from torchkafka_tpu.kvcache import KVBackend
+
+    m.note_backend(KVBackend(
+        layout="paged", int8=True, kernel=False,
+        kernel_disabled_reason='auto: backend="cpu" is not tpu',
+        chunked=True, data=2, tp=2,
+    ))
     return m.render_prometheus()
 
 

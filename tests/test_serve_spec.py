@@ -261,15 +261,26 @@ class TestSpecValidation:
                 draft_cfg=other, **kw,
             )
 
-    def test_rejects_mesh(self, model):
+    @pytest.mark.slow
+    def test_mesh_spec_token_exact(self, model):
+        """PR 13: spec serving COMPOSES with the mesh now (both models'
+        params commit to serving layouts; GSPMD shards the verify from
+        the layouts alone) — token-exact and commit-identical vs the
+        single-device spec server. Slow: the paged+mesh spec
+        differential in tests/test_kvcache.py is the matrix; this pins
+        the DENSE spec mesh path."""
         from torchkafka_tpu.parallel import make_mesh
 
         cfg, params = model
-        with pytest.raises(ValueError, match="single-device"):
-            SpecStreamingGenerator(
-                object(), params, cfg, slots=2, prompt_len=P,
-                max_new=MAX_NEW, mesh=make_mesh({"data": 8}),
-            )
+        base, cb, _s, _b = _serve(SpecStreamingGenerator, cfg, params, 8)
+        mesh = make_mesh({"data": 2}, devices=jax.devices()[:2])
+        got, cm, _s2, _b2 = _serve(
+            SpecStreamingGenerator, cfg, params, 8, mesh=mesh
+        )
+        assert set(got) == set(base)
+        for k in base:
+            np.testing.assert_array_equal(got[k], base[k], err_msg=str(k))
+        assert cm == cb
 
     def test_stats_empty_before_serving(self, model):
         cfg, params = model

@@ -34,6 +34,7 @@ from torchkafka_tpu.errors import (
     TransactionStateError,
 )
 from torchkafka_tpu.journal import DecodeJournal, JournalEntry
+from torchkafka_tpu.kvcache import KVBackend, PagedKVConfig, resolve_kv_backend
 from torchkafka_tpu.obs import (
     BurnRateMonitor,
     MetricsExporter,
@@ -94,7 +95,7 @@ from torchkafka_tpu.transform import (
     raw_bytes,
 )
 
-__version__ = "0.15.0"
+__version__ = "0.16.0"
 
 __all__ = [
     "BarrierError",
@@ -114,6 +115,9 @@ __all__ = [
     "FencedMemberError",
     "JournalEntry",
     "JournalLockedError",
+    "KVBackend",
+    "PagedKVConfig",
+    "resolve_kv_backend",
     "BrokerClient",
     "BrokerServer",
     "InMemoryBroker",

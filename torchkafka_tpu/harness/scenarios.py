@@ -15,6 +15,14 @@ each returning a metrics dict.
 | 10 | serving fleet: QoS admission + graceful drain | none |
 | 11 | chaos soak: broker outage + poison prompt → recovery + DLQ | none |
 | 12 | prefix-cache fleet: per-tenant system prompts, paged KV reuse | none |
+| 13 | warm failover: seeded replica kill + journal resume | none |
+| 14 | chunked-prefill prompt storm (bounded decode latency) | none |
+| 15 | traced fleet: per-tenant SLOs + Prometheus endpoint | none |
+| 16 | Zipf burst storm: windowed SLOs + burn-rate shedding | none |
+| 17 | real-process fleet: SIGKILL mid-storm, zombie fencing | none |
+| 18 | exactly-once output: transactional SIGKILL storm | none |
+| 19 | durable broker: uncleanly killed + WAL-recovered mid-storm | none |
+| 20 | sharded paged serving: paged+int8+kernel-probe on a {data,tp} mesh | none |
 
 Every scenario runs the full transactional loop (poll → transform → batch →
 device → step → barrier → commit) and reports ``records_per_s`` plus commit
@@ -2361,6 +2369,116 @@ def scenario_19(size: str = "tiny", replicas: int = 2) -> dict:
     }
 
 
+def scenario_20(size: str = "tiny", replicas: int = 2) -> dict:
+    """Sharded paged serving smoke (PR 13, ROADMAP item 1): a 2-replica
+    in-process fleet whose generators compose the four KV-backend axes
+    at once — PAGED block tables + radix prefix reuse, INT8 payloads,
+    the Pallas read under its ``auto`` probe, and a {data, tp}
+    host-device MESH (kv heads + weights over tp; the paged per-slot
+    state rides replicated, serve.py ``pin_paged``). Three keyed
+    tenants with fixed system prompts (the scenario-12 shape) so the
+    radix tree does real work while sharded. The tier-1 guard for the
+    composed path: coverage + commit exactness and a non-degenerate
+    cache hit rate (token-exactness vs single-device serving is
+    tests/test_kvcache.py's sharded differential; the wall-clock story
+    is benchmarks/bench_kvcache.py --mesh)."""
+    import time as _time
+
+    import jax
+
+    import torchkafka_tpu as tk
+    from torchkafka_tpu.fleet import ServingFleet
+    from torchkafka_tpu.source.records import TopicPartition
+
+    prompt_len, max_new = (16, 8) if size == "tiny" else (64, 32)
+    n = 24 if size == "tiny" else 128
+    block = 4 if size == "tiny" else 16
+    sys_len = 3 * block
+    parts = 4
+    cfg, params, label = _serving_model(size, None, prompt_len, max_new)
+    n_dev = len(jax.devices())
+    tp = 2 if n_dev >= 2 and cfg.n_kv_heads % 2 == 0 else 1
+    data = 2 if n_dev >= 2 * tp else 1
+    mesh = tk.make_mesh(
+        {"data": data, "tp": tp}, devices=jax.devices()[: data * tp]
+    )
+    broker = tk.InMemoryBroker()
+    broker.create_topic("t20", partitions=parts)
+    rng = np.random.default_rng(0)
+    tenants = ("alpha", "beta", "gamma")
+    system = {
+        t: rng.integers(0, cfg.vocab_size, sys_len, dtype=np.int32)
+        for t in tenants
+    }
+    produced = []
+    for i in range(n):
+        t = tenants[i % len(tenants)]
+        prompt = np.concatenate([
+            system[t],
+            rng.integers(0, cfg.vocab_size, prompt_len - sys_len,
+                         dtype=np.int32),
+        ])
+        rec = broker.produce("t20", prompt.tobytes(), key=t.encode())
+        produced.append((rec.partition, rec.offset))
+    # 2 slots/replica: the auto chunk width follows slots × prompt_len,
+    # and the fused program's compile time follows the chunk width —
+    # the tier-1 smoke budget lever (coverage is unchanged; admissions
+    # just wave through in more quanta).
+    slots = 2 if size == "tiny" else 4
+    pages = {
+        "block_size": block,
+        "num_blocks": slots * -(-(prompt_len + max_new) // block) + 16,
+    }
+    fleet = ServingFleet(
+        lambda rid: tk.MemoryConsumer(broker, "t20", group_id="s20"),
+        params, cfg, replicas=replicas, prompt_len=prompt_len,
+        max_new=max_new, slots=slots, commit_every=4,
+        gen_kwargs={
+            "kv_pages": pages, "kv_dtype": "int8", "kv_kernel": "auto",
+            "mesh": mesh,
+        },
+    )
+    fleet.warmup()
+    t0 = _time.perf_counter()
+    served = fleet.serve_all(idle_timeout_ms=2000)
+    elapsed = _time.perf_counter() - t0
+    keys = {(r.partition, r.offset) for _rid, r, _t in served}
+    committed_complete = all(
+        broker.committed("s20", TopicPartition("t20", rec_p))
+        == broker.end_offset(TopicPartition("t20", rec_p))
+        for rec_p in {p for p, _ in produced}
+    )
+    s = fleet.metrics.summary(fleet.replicas)
+    cache = s["prefix_cache"]
+    gens = [rep.gen for rep in fleet.replicas]
+    kv_backend = gens[0].metrics.summary()["kv_backend"]
+    fleet.close()
+    return {
+        "scenario": "20:sharded-paged-int8-fleet",
+        "model_scale": label,
+        "replicas": replicas,
+        "mesh": {"data": data, "tp": tp},
+        "kv_backend": kv_backend,
+        "records": len(served),
+        "elapsed_s": round(elapsed, 3),
+        "records_per_s": round(len(served) / elapsed, 1) if elapsed else None,
+        "coverage_complete": keys == set(produced),
+        "committed_complete": committed_complete,
+        "tenants": len(tenants),
+        "system_prompt_tokens": sys_len,
+        "cache": cache,
+        "prefill_tokens": cache["prefill_tokens"],
+        "prefill_tokens_dense": n * prompt_len,
+        "prefill_savings_pct": round(
+            100 * (1 - cache["prefill_tokens"] / (n * prompt_len)), 1
+        ),
+        "commit_failures": sum(
+            g.metrics.commit_failures.count for g in gens
+        ),
+        "dropped": sum(g.metrics.dropped.count for g in gens),
+    }
+
+
 def scenario_8(size: str = "tiny") -> dict:
     """Streaming CTR: DLRM-style recommender trained from a Kafka event
     stream — label + dense features + hashed categorical ids per record,
@@ -2735,6 +2853,7 @@ SCENARIOS = {
     17: scenario_17,
     18: scenario_18,
     19: scenario_19,
+    20: scenario_20,
 }
 
 
@@ -2783,7 +2902,7 @@ def run_scenario(
         )
     sample_kw = dict(temperature=temperature, top_k=top_k, top_p=top_p)
     spec_kw = dict(spec=spec, spec_k=spec_k, spec_draft_layers=spec_draft_layers)
-    if num in (10, 11, 12, 13, 15, 16, 17, 18, 19):
+    if num in (10, 11, 12, 13, 15, 16, 17, 18, 19, 20):
         return SCENARIOS[num](size, replicas=replicas)
     if model_scale is not None:
         if num not in (5, 7):

@@ -6,9 +6,17 @@ servers): ``BlockAllocator`` — refcounted free-list blocks over a
 for idle-slot writes; ``RadixCache`` — prompt-prefix tree mapping whole
 block runs, LRU-evicting unreferenced leaves (eviction is advisory: a
 miss just re-prefills, token-exactness never depends on the cache);
-``PagedKVConfig`` — the ``StreamingGenerator(kv_pages=...)`` knob.
+``PagedKVConfig`` — the ``StreamingGenerator(kv_pages=...)`` knob;
+``resolve_kv_backend`` — the single capability probe deciding how the
+four cache axes (dense/paged × compute/int8 × gather/kernel ×
+single-device/mesh) compose for one server (kvcache/backend.py).
 """
 
+from torchkafka_tpu.kvcache.backend import (
+    KV_KERNEL_AUTO_MIN_POOL,
+    KVBackend,
+    resolve_kv_backend,
+)
 from torchkafka_tpu.kvcache.blocks import (
     SINK_BLOCK,
     BlockAllocator,
@@ -18,7 +26,10 @@ from torchkafka_tpu.kvcache.radix import RadixCache
 
 __all__ = [
     "BlockAllocator",
+    "KVBackend",
+    "KV_KERNEL_AUTO_MIN_POOL",
     "PagedKVConfig",
     "RadixCache",
     "SINK_BLOCK",
+    "resolve_kv_backend",
 ]

@@ -1,0 +1,259 @@
+"""The single KV-backend resolver: one decision object for the serving pool.
+
+The serving cache has four orthogonal axes — dense slot pool vs paged
+block tables (``kv_pages``), compute-dtype vs int8 payloads
+(``kv_dtype``), XLA gathered read vs the Pallas fill-bounded kernels
+(``kv_kernel``), and single-device vs mesh-sharded pools (``mesh``) —
+and until PR 13 the four composed by EXCLUSION: ``kv_pages`` rejected
+any mesh outright and ``kv_kernel`` hard-disabled whenever a mesh was
+set, so the two flagship optimizations could never serve together and
+sharded serving had zero paged/kernel rows anywhere (ROADMAP item 1,
+VERDICT r5 weak #1).
+
+``resolve_kv_backend`` replaces those blanket branches with a
+CAPABILITY PROBE: it validates only what is genuinely unsupported
+(raising a precise, regression-tested error per exclusion) and returns
+a ``KVBackend`` describing the composed configuration — which pool
+layout, which payload dtype, whether the Pallas read engages and, when
+it does not, the machine-readable reason (surfaced on
+``ServeMetrics`` so the ``kv_kernel="auto"`` threshold decision is
+observable instead of silent).
+
+Genuine exclusions (each raises):
+
+- ``kv_pages`` + MoE: the paged suffix prefill routes experts densely
+  (decode's rule) while the dense prefill uses the training dispatch —
+  serving both would break the cache-on/off exactness contract.
+- legacy per-record paged admission (``prefill_chunk=0``) + int8: the
+  PR-4 baseline is compute-dtype only (unchanged).
+- legacy per-record paged admission + mesh: the per-record suffix
+  prefill is a ``[1, S]`` dispatch whose singleton batch cannot shard
+  over ``data``; the chunked tick (``prefill_chunk`` None or >= 1) is
+  the sharded spelling.
+- ``kv_kernel=True`` that cannot be honored (tiling shapes, block
+  size, or a mesh the slots/heads don't divide): require-or-raise, so
+  a benchmark never misattributes the XLA read's numbers to the
+  kernel.
+
+Everything else composes. Under a mesh the pools shard exactly like
+the dense slot pool — kv heads over ``tp``, per-slot state over
+``data`` — with the paged BLOCK pools replicated over ``data`` (blocks
+are shared storage addressed by every slot's table; the per-slot
+tables themselves are replicated operands) and the Pallas reads
+wrapped in ``shard_map`` (``ops.kvattn.*_sharded`` — the
+``flash_attention_sharded`` precedent: batch/head-parallel attention
+needs no collectives, so each (data, tp) shard runs the kernel over
+its own slots and heads).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+__all__ = ["KVBackend", "resolve_kv_backend"]
+
+# Pool length at/above which kv_kernel="auto" engages the Pallas reads:
+# the kernels' advantage grows with pool bytes while their fixed
+# in-tick cost does not — measured win at 1024/2048, measured loss at
+# 192 (serve.py's full matrix; PERF.md).
+KV_KERNEL_AUTO_MIN_POOL = 1024
+
+
+@dataclasses.dataclass(frozen=True)
+class KVBackend:
+    """The resolved serving-cache configuration — what actually serves.
+
+    ``layout``: "dense" (per-slot pool) or "paged" (block pool + per-
+    slot tables). ``int8``: quantized payloads + group-wise scales.
+    ``kernel``: the Pallas fill-bounded read engages on decode ticks.
+    ``kernel_disabled_reason``: why it does NOT engage (None when it
+    does, or when int8 was never requested — there is no kernel
+    without an int8 pool). ``data``/``tp``: mesh axis extents (1 =
+    unsharded axis; both 1 = single device)."""
+
+    layout: str
+    int8: bool
+    kernel: bool
+    kernel_disabled_reason: str | None
+    chunked: bool
+    data: int
+    tp: int
+
+    @property
+    def paged(self) -> bool:
+        return self.layout == "paged"
+
+    @property
+    def sharded(self) -> bool:
+        return self.data > 1 or self.tp > 1
+
+    def describe(self) -> dict:
+        """The ``ServeMetrics`` ``kv_backend`` info payload."""
+        return {
+            "layout": self.layout,
+            "kv_dtype": "int8" if self.int8 else "compute",
+            "kernel": self.kernel,
+            "kernel_disabled_reason": self.kernel_disabled_reason,
+            "chunked": self.chunked,
+            "data": self.data,
+            "tp": self.tp,
+        }
+
+
+def _kernel_probe_dense(cfg, max_len: int, on_tpu: bool) -> str | None:
+    """None = honorable; else the reason the dynamic-length kernel
+    cannot run on this dense pool."""
+    from torchkafka_tpu.ops.kvattn import dynlen_block, kernel_applicable
+
+    if not kernel_applicable(cfg.head_dim, max_len):
+        return (
+            f"tiling: head_dim={cfg.head_dim} % 128 or "
+            f"pool_len={max_len} % 8"
+        )
+    if dynlen_block(max_len) < (256 if on_tpu else 8):
+        return (
+            f"tiling: pool_len={max_len} has no >= 256 DMA block "
+            f"(dynlen_block={dynlen_block(max_len)})"
+        )
+    return None
+
+
+def _kernel_probe_paged(cfg, block_size: int, on_tpu: bool) -> str | None:
+    """None = honorable; else why the block-table kernel cannot run."""
+    from torchkafka_tpu.ops.kvattn import paged_kernel_applicable
+
+    # Tiling gates COMPILED Mosaic only; off-TPU the kernel runs in
+    # Pallas interpret mode (the tests' differential path), which
+    # accepts any shape.
+    if on_tpu and not (
+        paged_kernel_applicable(cfg.head_dim, block_size)
+        and block_size >= 256
+    ):
+        return (
+            f"tiling: head_dim={cfg.head_dim} % 128, block_size="
+            f"{block_size} % 8, and block_size >= 256 required on TPU"
+        )
+    return None
+
+
+def _mesh_kernel_reason(cfg, mesh, slots: int) -> str | None:
+    """None = the shard_map wrapping works on this mesh; else why not.
+
+    The sharded kernels run per (data, tp) shard over local slots and
+    local kv heads, so both must split evenly (``check_serving_mesh``
+    enforces the same divisibilities for the XLA path — this re-states
+    them as a kernel capability so ``auto`` degrades with a reason
+    instead of a deep shape error)."""
+    data = mesh.shape.get("data", 1)
+    tp = mesh.shape.get("tp", 1)
+    if data > 1 and slots % data:
+        return f"mesh: slots={slots} % data={data}"
+    if tp > 1 and (cfg.n_kv_heads % tp or cfg.n_heads % tp):
+        return (
+            f"mesh: n_kv_heads={cfg.n_kv_heads}/n_heads={cfg.n_heads} "
+            f"% tp={tp}"
+        )
+    return None
+
+
+def resolve_kv_backend(
+    cfg,
+    *,
+    mesh=None,
+    kv_dtype: str | None = None,
+    kv_kernel: bool | str = "auto",
+    kv_pages=None,
+    max_len: int,
+    slots: int,
+    backend: str | None = None,
+) -> KVBackend:
+    """Validate one KV-backend combination and decide kernel engagement.
+
+    Raises ``ValueError`` for the genuine exclusions (module
+    docstring); otherwise returns the composed ``KVBackend``.
+    ``backend``: the jax platform string ("tpu"/"cpu"/...) — off-TPU
+    the kernels run in interpret mode, so ``auto`` never engages them
+    there while ``True`` still honors the request for the tests'
+    differential path."""
+    if kv_dtype not in (None, "int8"):
+        raise ValueError(f"kv_dtype must be None or 'int8', got {kv_dtype!r}")
+    # Identity checks, not ``in (True, False, 'auto')``: bool-int
+    # equality would accept 1/0 and then treat them inconsistently
+    # downstream (``kv_kernel is True`` guards would not fire for 1).
+    if not (kv_kernel is True or kv_kernel is False or kv_kernel == "auto"):
+        raise ValueError(
+            f"kv_kernel must be True, False or 'auto', got {kv_kernel!r}"
+        )
+    int8 = kv_dtype == "int8"
+    if kv_kernel is True and not int8:
+        raise ValueError("kv_kernel requires kv_dtype='int8'")
+    paged = kv_pages is not None
+    chunked = paged and kv_pages.prefill_chunk != 0
+    if paged:
+        if kv_pages.prefill_chunk == 0 and int8:
+            raise ValueError(
+                "legacy per-record paged admission (prefill_chunk=0) "
+                "is the PR-4 compute-dtype baseline; the int8 paged "
+                "pool requires the chunked tick (prefill_chunk None "
+                "or >= 1)"
+            )
+        if kv_pages.prefill_chunk == 0 and mesh is not None:
+            raise ValueError(
+                "legacy per-record paged admission (prefill_chunk=0) "
+                "cannot serve under a mesh: its per-record suffix "
+                "prefill is a [1, S] dispatch whose singleton batch "
+                "has no data shard — use the chunked tick "
+                "(prefill_chunk None or >= 1) or mesh=None"
+            )
+        if cfg.is_moe:
+            raise ValueError(
+                "kv_pages does not serve MoE configs: the paged suffix "
+                "prefill routes experts densely (decode's rule) while "
+                "the dense prefill uses the training dispatch, which "
+                "would break the cache-on/off exactness contract"
+            )
+    on_tpu = backend == "tpu"
+    data = mesh.shape.get("data", 1) if mesh is not None else 1
+    tp = mesh.shape.get("tp", 1) if mesh is not None else 1
+
+    kernel = False
+    reason: str | None = None
+    if int8 and kv_kernel:
+        if paged:
+            reason = _kernel_probe_paged(cfg, kv_pages.block_size, on_tpu)
+        else:
+            reason = _kernel_probe_dense(cfg, max_len, on_tpu)
+        if reason is None and mesh is not None:
+            reason = _mesh_kernel_reason(cfg, mesh, slots)
+        if kv_kernel is True:
+            if reason is not None:
+                raise ValueError(
+                    f"kv_kernel=True cannot be honored here ({reason}); "
+                    "the explicit request never falls back silently — a "
+                    "benchmark must not misattribute the XLA read's "
+                    "numbers to the kernel"
+                )
+            kernel = True
+        else:  # "auto": engage only in the measured-win regime
+            if reason is None:
+                if not on_tpu:
+                    reason = f"auto: backend={backend!r} is not tpu"
+                elif max_len < KV_KERNEL_AUTO_MIN_POOL:
+                    reason = (
+                        f"auto: pool_len={max_len} < "
+                        f"{KV_KERNEL_AUTO_MIN_POOL}"
+                    )
+                else:
+                    kernel = True
+    elif kv_kernel and not int8:
+        # auto without int8: there is no kernel for compute-dtype pools.
+        reason = "auto: kv_dtype is not 'int8'"
+    return KVBackend(
+        layout="paged" if paged else "dense",
+        int8=int8,
+        kernel=kernel,
+        kernel_disabled_reason=None if kernel else reason,
+        chunked=chunked,
+        data=data,
+        tp=tp,
+    )

@@ -204,6 +204,41 @@ def slot_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
     return shardings_for_mesh(mesh, P("data", *([None] * (ndim - 1))))
 
 
+def kv_kmajor_sharding(mesh: Mesh) -> NamedSharding:
+    """K-MAJOR dense int8 pool [L, B, K, M, Dh] (the Pallas dynamic-
+    length kernel's layout): slots over data, kv heads over tp — the
+    same axes as ``kv_sharding``, transposed with the layout."""
+    return shardings_for_mesh(mesh, P(None, "data", "tp", None, None))
+
+
+def kv_kmajor_scale_sharding(mesh: Mesh) -> NamedSharding:
+    """K-major int8 scale tensors [L, B, K, M]."""
+    return shardings_for_mesh(mesh, P(None, "data", "tp", None))
+
+
+def paged_pool_sharding(mesh: Mesh) -> NamedSharding:
+    """Paged block pool [L, NB, bs, K, Dh]: kv heads over tp, blocks
+    REPLICATED over data — blocks are shared storage (any slot's table
+    may reference any block, and radix prefix blocks are read by slots
+    on every data shard), so the slot axis that shards over data in the
+    dense pool has no analog here; each data shard holds the full pool
+    for its K/tp heads and XLA all-gathers the per-shard scatter
+    updates to keep the replicas coherent."""
+    return shardings_for_mesh(mesh, P(None, None, None, "tp", None))
+
+
+def paged_pool_kmajor_sharding(mesh: Mesh) -> NamedSharding:
+    """K-major-per-block int8 paged payloads [L, NB, K, bs, Dh]: kv
+    heads over tp, blocks replicated over data (see
+    ``paged_pool_sharding``)."""
+    return shardings_for_mesh(mesh, P(None, None, "tp", None, None))
+
+
+def paged_scale_kmajor_sharding(mesh: Mesh) -> NamedSharding:
+    """K-major-per-block int8 paged scales [L, NB, K, bs]."""
+    return shardings_for_mesh(mesh, P(None, None, "tp", None))
+
+
 def _constrain_cache(cache: KVCache, mesh: Mesh | None) -> KVCache:
     if mesh is None:
         return cache

@@ -629,6 +629,111 @@ def int8_decode_attention_dynlen(
     return out.reshape(b, 1, h, dh)
 
 
+def _serving_shard_specs(mesh):
+    """(batch_axes, tp, manual) for the sharded decode-kernel wrappers:
+    slots over ``data``, kv/q heads over ``tp`` — exactly the dense slot
+    pool's ``kv_sharding`` axes, so the wrapped kernel reads the pool in
+    the layout serving already stores it in. ``fsdp``/``ep``/``pp`` axes
+    stay out of the manual region (weight-only axes; the kernel's
+    operands are replicated across them)."""
+    batch_axes = tuple(a for a in ("data",) if a in mesh.shape)
+    tp = "tp" if "tp" in mesh.shape else None
+    manual = frozenset(batch_axes) | (frozenset({tp}) if tp else frozenset())
+    return (batch_axes if batch_axes else None), tp, manual
+
+
+def int8_decode_attention_dynlen_sharded(
+    q: jax.Array,
+    ck_q: jax.Array,
+    ck_s: jax.Array,
+    cv_q: jax.Array,
+    cv_s: jax.Array,
+    pos: jax.Array,
+    mesh,
+    *,
+    block: int | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """``int8_decode_attention_dynlen`` under a serving mesh.
+
+    A Pallas call is opaque to GSPMD (the ``flash_attention_sharded``
+    lesson), but the decode read is (slot, head)-parallel with no
+    collectives — each shard attends its own slots' watermarked pool
+    over its own kv heads — so ``shard_map`` splits it exactly like the
+    XLA read's layouts: q/pos/caches batch over ``data``, kv heads over
+    ``tp``. Requirements (the capability probe gates on these): B
+    divisible by data, H and K by tp."""
+    from torchkafka_tpu.ops._compat import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    bspec, tp, manual = _serving_shard_specs(mesh)
+    qspec = P(bspec, None, tp, None)   # [B, 1, H, Dh]
+    cspec = P(bspec, tp, None, None)   # [B, K, M, Dh] K-major payloads
+    sspec = P(bspec, tp, None)         # [B, K, M] scales
+    fn = shard_map(
+        functools.partial(
+            int8_decode_attention_dynlen, block=block, interpret=interpret
+        ),
+        mesh=mesh,
+        in_specs=(qspec, cspec, sspec, cspec, sspec, P(bspec)),
+        out_specs=qspec,
+        axis_names=manual,
+        check_vma=False,
+    )
+    return fn(q, ck_q, ck_s, cv_q, cv_s, pos)
+
+
+def int8_paged_decode_attention_sharded(
+    q: jax.Array,
+    pool_kq: jax.Array,
+    pool_ks: jax.Array,
+    pool_vq: jax.Array,
+    pool_vs: jax.Array,
+    table: jax.Array,
+    pos: jax.Array,
+    mesh,
+    *,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """``int8_paged_decode_attention`` under a serving mesh.
+
+    Sharded over ``tp`` ONLY — kv/q heads split per shard, the block
+    pools per-block over tp (``generate.paged_pool_kmajor_sharding``'s
+    per-layer slice), and slots/tables/watermarks REPLICATED across
+    every other axis. That matches the paged serving program's
+    invariant (serve.py ``pin_paged``): the data axis stays out of the
+    paged path entirely — block pools are shared storage with no slot
+    axis to split, and re-introducing data sharding at this kernel's
+    boundary re-triggers the jax-0.4.x partitioned-concat miscompile
+    the rest of the program avoids. Each tp shard DMAs only live
+    blocks for its own heads; no collectives."""
+    from torchkafka_tpu.ops._compat import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    _bspec, tp, _manual = _serving_shard_specs(mesh)
+    manual = frozenset({tp}) if tp else frozenset()
+    if not manual:
+        # No tp axis: nothing to split — the plain kernel call inside
+        # the (data-replicated) paged program is already correct.
+        return int8_paged_decode_attention(
+            q, pool_kq, pool_ks, pool_vq, pool_vs, table, pos,
+            interpret=interpret,
+        )
+    qspec = P(None, None, tp, None)    # [B, 1, H, Dh]
+    pspec = P(None, tp, None, None)    # [NB, K, bs, Dh] payload pools
+    sspec = P(None, tp, None)          # [NB, K, bs] scale pools
+    fn = shard_map(
+        functools.partial(int8_paged_decode_attention, interpret=interpret),
+        mesh=mesh,
+        in_specs=(qspec, pspec, sspec, pspec, sspec, P(None, None),
+                  P(None)),
+        out_specs=qspec,
+        axis_names=manual,
+        check_vma=False,
+    )
+    return fn(q, pool_kq, pool_ks, pool_vq, pool_vs, table, pos)
+
+
 # ------------------------------------------------------------------ v4
 # Block-table read: the v3 watermark-DMA structure extended to read
 # THROUGH per-slot block tables (the int8 PAGED pool). Both the pool

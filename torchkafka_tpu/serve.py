@@ -55,8 +55,10 @@ from torchkafka_tpu.journal import DecodeJournal, JournalEntry, value_crc
 from torchkafka_tpu.kvcache import (
     SINK_BLOCK,
     BlockAllocator,
+    KVBackend,
     PagedKVConfig,
     RadixCache,
+    resolve_kv_backend,
 )
 from torchkafka_tpu.resilience.crashpoint import crash_hook
 from torchkafka_tpu.models.generate import (
@@ -65,8 +67,13 @@ from torchkafka_tpu.models.generate import (
     _project_qkv,
     check_sampling_params,
     check_serving_mesh,
+    kv_kmajor_scale_sharding,
+    kv_kmajor_sharding,
     kv_scale_sharding,
     kv_sharding,
+    paged_pool_kmajor_sharding,
+    paged_pool_sharding,
+    paged_scale_kmajor_sharding,
     prefill,
     sample_logits,
     serving_shardings,
@@ -84,11 +91,10 @@ _logger = logging.getLogger(__name__)
 # every serving roofline in the repo (serve.decode_roofline, scenario 5).
 V5E_PEAK_HBM_GBS = 819.0
 
-# Pool length at/above which kv_kernel="auto" engages the Pallas K-major
-# read: the kernel's advantage grows with pool length (more contiguous
-# bytes per head tile) while its fixed in-tick cost does not — measured
-# win at 1024/2048, measured loss at 192 (full matrix in _build).
-_KV_KERNEL_AUTO_MIN_POOL = 1024
+# The kv_kernel="auto" engagement threshold and every other which-
+# backend decision live in ONE place now: kvcache/backend.py
+# ``resolve_kv_backend`` — the capability probe _build/_build_paged
+# consume (and ServeMetrics surfaces as kv_backend info).
 
 
 def decode_tick_bytes(params, cfg: TransformerConfig, batch: int,
@@ -153,6 +159,7 @@ def _quant_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
 
 def _slot_layer_step_q(
     x, layer, ck_q, ck_s, cv_q, cv_s, pos_b, cfg, use_kernel=False,
+    mesh=None,
 ):
     """int8-KV variant of ``_slot_layer_step``: the pool stores int8
     payloads + per-(position, head) f32 absmax scales over Dh —
@@ -203,11 +210,24 @@ def _slot_layer_step_q(
         # pool-shaped. Net tick win at long pools (the regime "auto"
         # selects; measured matrix in _build/PERF.md); fills < ~90%
         # (the continuous-batching norm) widen it. Caller gates on
-        # single-device + tiling shapes (a Pallas call is opaque to
-        # GSPMD, the flash_attention_sharded lesson).
-        from torchkafka_tpu.ops.kvattn import int8_decode_attention_dynlen
+        # tiling shapes (a Pallas call is opaque to GSPMD, the
+        # flash_attention_sharded lesson — under a mesh the read runs
+        # per (data, tp) shard inside shard_map, each shard over its
+        # own slots and kv heads; the capability probe gated the
+        # divisibilities).
+        from torchkafka_tpu.ops.kvattn import (
+            int8_decode_attention_dynlen,
+            int8_decode_attention_dynlen_sharded,
+        )
 
-        attn = int8_decode_attention_dynlen(q, ck_q, ck_s, cv_q, cv_s, pos_b)
+        if mesh is not None:
+            attn = int8_decode_attention_dynlen_sharded(
+                q, ck_q, ck_s, cv_q, cv_s, pos_b, mesh
+            )
+        else:
+            attn = int8_decode_attention_dynlen(
+                q, ck_q, ck_s, cv_q, cv_s, pos_b
+            )
         x = _attn_tail(x, attn, layer, cfg)
     else:
         valid = jnp.arange(pool_len)[None, :] <= pos_b[:, None]  # [B, M]
@@ -299,6 +319,26 @@ class ServeMetrics:
         # traffic bench reads. Empty on the dense path.
         self._tenant_prefix_hits: dict[str, RateMeter] = {}
         self._tenant_prefix_misses: dict[str, RateMeter] = {}
+        # The resolved KV backend (kvcache.resolve_kv_backend): which
+        # pool layout/dtype actually serves, whether the Pallas read
+        # engaged, and — when it did not — the machine-readable reason,
+        # so the kv_kernel="auto" threshold decision is observable on
+        # /metrics instead of silent.
+        self.kernel_engaged = Gauge()
+        self._kernel_disabled: dict[str, RateMeter] = {}
+        self._kv_backend: dict = {}
+
+    def note_backend(self, backend: "KVBackend") -> None:
+        """Record the resolved backend (called once per build; a paged
+        pool that falls back to dense re-notes the dense resolution)."""
+        self._kv_backend = backend.describe()
+        self.kernel_engaged.set(1.0 if backend.kernel else 0.0)
+        reason = backend.kernel_disabled_reason
+        if reason is not None:
+            self._kernel_disabled.setdefault(reason, RateMeter()).add(1)
+
+    def kernel_disabled_summary(self) -> dict:
+        return {r: m.count for r, m in sorted(self._kernel_disabled.items())}
 
     def tenant_prefix_hits(self, tenant: str) -> RateMeter:
         return self._tenant_prefix_hits.setdefault(tenant, RateMeter())
@@ -361,6 +401,11 @@ class ServeMetrics:
             "tenant_cache": self.tenant_cache_summary(),
             "chunked_prefill": self.chunk_summary(),
             "journal": self.journal_summary(),
+            "kv_backend": {
+                **self._kv_backend,
+                "kernel_engaged": int(self.kernel_engaged.value),
+                "kernel_disabled": self.kernel_disabled_summary(),
+            },
         }
 
     def chunk_summary(self) -> dict:
@@ -412,7 +457,24 @@ class ServeMetrics:
         pc = s["prefix_cache"]
         jn = s["journal"]
         cp = s["chunked_prefill"]
+        kb = s["kv_backend"]
         return render_exposition(prefix, [
+            # The resolved KV backend as an info-style gauge (value 1,
+            # identity in the labels) plus the kernel engagement pair —
+            # the "which pool actually serves, and why not the kernel"
+            # observables.
+            ("kv_backend_info", "gauge", [
+                (format_labels(
+                    layout=str(kb.get("layout", "dense")),
+                    kv_dtype=str(kb.get("kv_dtype", "compute")),
+                    sharding=f"data={kb.get('data', 1)},tp={kb.get('tp', 1)}",
+                ), 1),
+            ]),
+            ("kv_kernel_engaged", "gauge", kb["kernel_engaged"]),
+            ("kv_kernel_disabled_total", "counter", [
+                (format_labels(reason=r), v)
+                for r, v in kb["kernel_disabled"].items()
+            ] or 0),
             ("chunk_ticks_total", "counter", cp["chunk_ticks"]),
             ("admission_stall_ticks_total", "counter", cp["stall_ticks"]),
             ("admission_queue_tokens", "gauge", cp["queue_tokens"]),
@@ -707,12 +769,18 @@ class StreamingGenerator:
         at half fill, 1.57× at mixed fills, 0.94× at exactly-full — and
         continuous batching lives at partial fills. In-tick integration
         still costs ~flat ms at short pools, so ``"auto"`` (default)
-        engages the kernel only at int8 pools ≥ 1024 tokens (no mesh —
-        a Pallas call is opaque to GSPMD — TPU backend, tiling shapes,
-        pool tiling at a ≥ 256 block); else the XLA read. ``True``:
-        REQUIRE the kernel at any pool length; raises if mesh/shapes
-        can't honor it (so a benchmark never misattributes the XLA
-        read's numbers to the kernel); off-TPU it runs in Pallas
+        engages the kernel only at int8 pools ≥ 1024 tokens (TPU
+        backend, tiling shapes, pool tiling at a ≥ 256 block); else the
+        XLA read. Composes with ``mesh``: a Pallas call is opaque to
+        GSPMD, so the sharded read runs per (data, tp) shard inside
+        ``shard_map`` (``ops.kvattn.int8_decode_attention_dynlen_
+        sharded``, the ``flash_attention_sharded`` precedent — slots
+        over data, kv heads over tp, no collectives), gated by the
+        capability probe on the same divisibilities the XLA layouts
+        need. ``True``: REQUIRE the kernel at any pool length; raises
+        if shapes/mesh can't honor it (so a benchmark never
+        misattributes the XLA read's numbers to the kernel; the reason
+        is in the error and on ``metrics``); off-TPU it runs in Pallas
         interpret mode — correct but slow, for tests. ``False``: always
         the XLA read. In kernel mode the pool is stored K-major
         ([L, B, K, M, Dh]) so every head's tile is a contiguous slice —
@@ -745,9 +813,19 @@ class StreamingGenerator:
         just re-prefills). Pool pressure defers admissions (FIFO
         re-offer once blocks free); a pool too small for even one slot
         falls back to dense cache-off serving with a warning
-        (``metrics.cache_fallbacks``). Single-device, and not MoE (the
-        paged prefill routes experts densely — decode's rule — which
-        would break exactness vs the training-dispatch dense prefill).
+        (``metrics.cache_fallbacks``). Composes with ``mesh`` in
+        chunked mode: the block pools shard kv heads over tp and
+        replicate over data (shared storage — any slot's table may
+        reference any block), per-slot state shards over data, tables
+        ride replicated, and the whole admission/radix/chunk machinery
+        is mesh-blind host code — token-exact vs single-device serving
+        (differential-tested across {data}, {tp}, {data, tp} meshes).
+        Not MoE (the paged prefill routes experts densely — decode's
+        rule — which would break exactness vs the training-dispatch
+        dense prefill), and the LEGACY per-record admission
+        (``prefill_chunk=0``) stays single-device (its [1, S] suffix
+        prefill has no data shard; both validated with precise
+        errors by ``kvcache.resolve_kv_backend``).
 
         Admission is CHUNKED by default (``prefill_chunk`` on the
         config): instead of one suffix-prefill dispatch per record (the
@@ -794,8 +872,10 @@ class StreamingGenerator:
         re-decoded tokens are bounded by the journal cadence; a
         journaled FINISHED completion re-serves with zero re-decode.
         Warm resume of partial generations needs the compute-dtype pool
-        on one device (``kv_dtype=None``, ``mesh=None``); hints are
-        ignored (cold replay, still correct) otherwise.
+        (``kv_dtype=None``) and a resume-capable prefill: one device,
+        a data-free mesh, or the paged CHUNKED path under any mesh
+        (``_resume_supported``); hints are ignored (cold replay, still
+        correct) otherwise.
 
         ``tracer``: an ``obs.RecordTracer`` — per-record lifecycle span
         events (polled → admitted → first token → per-token ticks →
@@ -914,40 +994,22 @@ class StreamingGenerator:
         )
         if max_send_failure_streak < 1:
             raise ValueError("max_send_failure_streak must be >= 1")
-        if kv_dtype not in (None, "int8"):
-            raise ValueError(f"kv_dtype must be None or 'int8', got {kv_dtype!r}")
-        # Identity checks, not ``in (True, False, 'auto')``: bool-int
-        # equality would accept 1/0 here and then treat them inconsistently
-        # downstream (``kv_kernel is True`` guards would not fire for 1).
-        if not (kv_kernel is True or kv_kernel is False or kv_kernel == "auto"):
-            raise ValueError(
-                f"kv_kernel must be True, False or 'auto', got {kv_kernel!r}"
-            )
-        if kv_kernel is True and kv_dtype != "int8":
-            raise ValueError("kv_kernel requires kv_dtype='int8'")
-        if kv_pages is not None:
-            if isinstance(kv_pages, dict):
-                kv_pages = PagedKVConfig(**kv_pages)
-            if kv_pages.prefill_chunk == 0 and kv_dtype is not None:
-                raise ValueError(
-                    "legacy per-record paged admission (prefill_chunk=0) "
-                    "is the PR-4 compute-dtype baseline; the int8 paged "
-                    "pool requires the chunked tick (prefill_chunk None "
-                    "or >= 1)"
-                )
-            if mesh is not None:
-                raise ValueError(
-                    "kv_pages is single-device for now: the block-table "
-                    "gather/scatter has no sharded spelling here yet — "
-                    "serve with mesh=None"
-                )
-            if cfg.is_moe:
-                raise ValueError(
-                    "kv_pages does not serve MoE configs: the paged suffix "
-                    "prefill routes experts densely (decode's rule) while "
-                    "the dense prefill uses the training dispatch, which "
-                    "would break the cache-on/off exactness contract"
-                )
+        if kv_pages is not None and isinstance(kv_pages, dict):
+            kv_pages = PagedKVConfig(**kv_pages)
+        # ONE capability probe for the whole (pages × dtype × kernel ×
+        # mesh) space: validates the genuine exclusions eagerly (bad
+        # dtype/kernel values, MoE + pages, legacy per-record admission
+        # under int8 or a mesh, un-honorable kv_kernel=True) and raises
+        # precise errors. The composed axes — sharded paged pools,
+        # sharded kernels — are SUPPORTED now; _build/_build_paged
+        # re-resolve against the final pool length for the engagement
+        # decision and surface it on ``metrics`` (kv_backend info +
+        # kernel_engaged/kernel_disabled).
+        resolve_kv_backend(
+            cfg, mesh=mesh, kv_dtype=kv_dtype, kv_kernel=kv_kernel,
+            kv_pages=kv_pages, max_len=prompt_len + max_new, slots=slots,
+            backend=jax.default_backend(),
+        )
         self._kv_pages = kv_pages
         self._paged_deferred: list[Record] = []
         # Chunked-prefill host state (paged mode; see _paged_setup).
@@ -1002,7 +1064,13 @@ class StreamingGenerator:
         # every tick's sampling — deliberately outside the donated state
         # tuple so state-poking tests/tools see the same tuple shapes.
         self._slot_keys = jnp.zeros((slots, self._key_width), jnp.uint32)
+        # Set by _build/_build_paged (the spec subclass's too): the
+        # resolved KVBackend this server actually serves with — a paged
+        # pool too small for one slot re-resolves as dense here.
+        self._kv_backend: KVBackend | None = None
         self._build()
+        if self._kv_backend is not None:
+            self.metrics.note_backend(self._kv_backend)
 
     def _build(self) -> None:
         if self._kv_pages is not None and self._paged_setup():
@@ -1021,53 +1089,21 @@ class StreamingGenerator:
         # integration cost (K-major layout handling + the fusion break
         # around a Pallas call) — while long pools WIN and the win
         # grows with pool bytes (v2 K-major read: M=2048 33.95→27.24
-        # ms, +25% tok/s). "auto" therefore engages the kernel only in
-        # the measured-win regime: long pools (M >=
-        # _KV_KERNEL_AUTO_MIN_POOL) on the TPU backend. The shipped
-        # kernel is v3 (dynamic-length): same K-major read, plus per-
-        # slot watermark-bounded DMA — 1.92×/1.57× the XLA read at
-        # half/mixed fills, 0.94× at exactly-full (paired micro).
-        # Requires single-device (a Pallas call is opaque to GSPMD) and
-        # tiling shapes either way.
-        if kv_int8 and self._kv_kernel_opt:
-            from torchkafka_tpu.ops.kvattn import (
-                dynlen_block, kernel_applicable,
-            )
-
-            on_tpu = jax.default_backend() == "tpu"
-            honorable = (
-                mesh is None
-                and kernel_applicable(cfg.head_dim, M)
-                # The dynamic-length kernel's scratch is block-sized, not
-                # pool-sized (no VMEM upper bound on M), but a pool that
-                # only tiles at tiny blocks would drown in per-block
-                # recurrence overhead — require a >= 256 block for
-                # compiled (TPU) use. Off-TPU runs are the interpret-mode
-                # correctness path (tests), where any tiling block is
-                # acceptable.
-                and dynlen_block(M) >= (256 if on_tpu else 8)
-            )
-            if self._kv_kernel_opt == "auto":
-                kv_kernel = (
-                    honorable
-                    and jax.default_backend() == "tpu"
-                    and M >= _KV_KERNEL_AUTO_MIN_POOL
-                )
-            else:  # explicit True: never fall back silently — a benchmark
-                # must not misattribute the XLA read's numbers to the kernel.
-                if not honorable:
-                    raise ValueError(
-                        "kv_kernel=True cannot be honored here: it needs a "
-                        "single device (Pallas is opaque to GSPMD; "
-                        f"mesh={'set' if mesh is not None else 'None'}), "
-                        f"tiling shapes (head_dim={cfg.head_dim} % 128, "
-                        f"pool_len={M} % 8), and a pool length tiling "
-                        "at a >= 256 block on TPU (ops.kvattn."
-                        f"dynlen_block({M}) = {dynlen_block(M)})"
-                    )
-                kv_kernel = True
-        else:
-            kv_kernel = False
+        # ms, +25% tok/s). The engagement decision (incl. the "auto"
+        # >= 1024-pool threshold and the per-mesh divisibilities the
+        # shard_map wrapping needs) is the capability probe's —
+        # kvcache.resolve_kv_backend — so dense and paged builds, and
+        # the metrics that surface the decision, share one rule. Under
+        # a mesh the kernel runs per (data, tp) shard inside shard_map
+        # (ops.kvattn.int8_decode_attention_dynlen_sharded, the
+        # flash_attention_sharded precedent); kv_kernel=True raised at
+        # construction if the combination cannot be honored.
+        self._kv_backend = resolve_kv_backend(
+            cfg, mesh=mesh, kv_dtype="int8" if kv_int8 else None,
+            kv_kernel=self._kv_kernel_opt, kv_pages=None, max_len=M,
+            slots=B, backend=jax.default_backend(),
+        )
+        kv_kernel = self._kv_backend.kernel
         self._kv_kernel = kv_kernel
 
         def pin_state(caches, last_tok, pos, gen):
@@ -1075,11 +1111,17 @@ class StreamingGenerator:
             the donate-and-rebind round trip keeps kv heads on tp and
             slots on data, instead of whatever GSPMD first guesses. int8
             pools carry 4D scale tensors [L, B, M, K] between the 5D
-            payloads — same axes minus head_dim."""
+            payloads — same axes minus head_dim; kernel mode stores both
+            K-MAJOR ([L, B, K, M, ·]), same axes transposed with the
+            layout."""
             if mesh is None:
                 return caches, last_tok, pos, gen
-            kv = kv_sharding(mesh)
-            kvs = kv_scale_sharding(mesh)
+            if kv_kernel:
+                kv = kv_kmajor_sharding(mesh)
+                kvs = kv_kmajor_scale_sharding(mesh)
+            else:
+                kv = kv_sharding(mesh)
+                kvs = kv_scale_sharding(mesh)
             row = slot_sharding(mesh)
             return (
                 tuple(
@@ -1161,7 +1203,7 @@ class StreamingGenerator:
                         layer, ckq, cks, cvq, cvs = inputs
                         x, ckq, cks, cvq, cvs = _slot_layer_step_q(
                             x, layer, ckq, cks, cvq, cvs, pos, cfg,
-                            use_kernel=kv_kernel,
+                            use_kernel=kv_kernel, mesh=mesh,
                         )
                         return x, (ckq, cks, cvq, cvs)
                 else:
@@ -1291,8 +1333,12 @@ class StreamingGenerator:
         if mesh is not None:
             # Place the initial pool in its serving layout so the first
             # dispatch doesn't start from replicated buffers.
-            kv = kv_sharding(mesh)
-            kvs = kv_scale_sharding(mesh)
+            if kv_kernel:
+                kv = kv_kmajor_sharding(mesh)
+                kvs = kv_kmajor_scale_sharding(mesh)
+            else:
+                kv = kv_sharding(mesh)
+                kvs = kv_scale_sharding(mesh)
             row = slot_sharding(mesh)
             self._caches = tuple(
                 jax.device_put(c, kv if c.ndim == 5 else kvs)
@@ -1356,7 +1402,7 @@ class StreamingGenerator:
             block_table_attention,
             block_table_attention_q8,
             int8_paged_decode_attention,
-            paged_kernel_applicable,
+            int8_paged_decode_attention_sharded,
             paged_scatter_kmajor,
         )
         from torchkafka_tpu.models.quant import quant_kv_groups
@@ -1368,6 +1414,7 @@ class StreamingGenerator:
         nblk = self._blocks_per_slot
         nl, kh, dh = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
         temp = self._temperature
+        mesh = self._mesh
         kv_int8 = self._kv_int8
         self._paged_table_idx = 4 if kv_int8 else 2
 
@@ -1375,41 +1422,78 @@ class StreamingGenerator:
         # DMA kernel reading through per-slot block tables, int8 pools
         # only. Decode-only ticks read through it; chunk-carrying ticks
         # use the XLA gather (the multi-query chunk needs the gathered
-        # view, and a storm tick is prefill-dominated anyway). Same
-        # engagement discipline as the dense kernel: "auto" only in the
-        # measured-win regime (TPU, long pools), True = require-or-raise
-        # so a benchmark never misattributes the gather's numbers.
-        if kv_int8 and self._kv_kernel_opt:
-            on_tpu = jax.default_backend() == "tpu"
-            # Tiling shapes gate COMPILED Mosaic only; off-TPU the kernel
-            # runs in Pallas interpret mode (correct but slow — the
-            # tests' differential path), which accepts any shape.
-            honorable = not on_tpu or (
-                paged_kernel_applicable(dh, bs) and bs >= 256
-            )
-            if self._kv_kernel_opt == "auto":
-                kv_kernel = (
-                    honorable and on_tpu
-                    and self._max_len >= _KV_KERNEL_AUTO_MIN_POOL
-                )
-            else:
-                if not honorable:
-                    raise ValueError(
-                        "kv_kernel=True cannot be honored on this paged "
-                        f"pool: it needs tiling shapes (head_dim={dh} % "
-                        f"128, block_size={bs} % 8) and a block size "
-                        ">= 256 (per-block DMA overhead drowns tiny "
-                        "blocks)"
-                    )
-                kv_kernel = True
-        else:
-            kv_kernel = False
+        # view, and a storm tick is prefill-dominated anyway). The
+        # engagement decision is the shared capability probe's ("auto"
+        # only in the measured-win regime — TPU, long pools; True =
+        # require-or-raise, validated at construction); under a mesh
+        # the read runs per (data, tp) shard inside shard_map
+        # (int8_paged_decode_attention_sharded) with the block pools
+        # replicated over data and sharded per-block over tp.
+        self._kv_backend = resolve_kv_backend(
+            cfg, mesh=mesh, kv_dtype="int8" if kv_int8 else None,
+            kv_kernel=self._kv_kernel_opt, kv_pages=self._kv_pages,
+            max_len=self._max_len, slots=B, backend=jax.default_backend(),
+        )
+        kv_kernel = self._kv_backend.kernel
         self._kv_kernel = kv_kernel
 
         pick_rows = functools.partial(
             _pick_slots, temperature=temp, top_k=self._top_k,
             top_p=self._top_p,
         )
+
+        def pull_replicated(x):
+            """Constrain a per-slot operand to REPLICATED before the
+            chunk tick's concatenation with the (replicated) chunk
+            rows — belt to pin_paged's braces (see its docstring for
+            why the paged path must keep the data axis out of the
+            program on jax 0.4.x)."""
+            if mesh is None:
+                return x
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            return lax.with_sharding_constraint(
+                x, NamedSharding(mesh, P())
+            )
+
+        def pin_paged(pools, last_tok, pos, gen):
+            """The paged pin_state: under a mesh, block pools carry kv
+            heads over tp and stay REPLICATED over data (shared storage
+            — any slot's table may reference any block, so there is no
+            slot axis to split), and the per-slot vectors ride
+            REPLICATED too. The latter is load-bearing, not a missing
+            optimization: on jax 0.4.x, a paged program whose [B]
+            state is sharded over data under a multi-axis mesh
+            MISCOMPILES at the chunk tick's sharded-with-replicated
+            concatenation — wrong VALUES (~O(1) garbage in every chunk
+            row's pool write on {data,tp}/{data,fsdp} meshes; exact on
+            single-axis meshes; reproduced standalone). Keeping the
+            data axis out of the paged program entirely is the
+            invariant that is provably exact; tp still shards the kv
+            heads and every weight matrix — the actual HBM win — and
+            data-parallel serving remains the FLEET's axis (one
+            replica per device group). The dense pool keeps its
+            slots-over-data layout. Identity on one device."""
+            if mesh is None:
+                return pools, last_tok, pos, gen
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            if kv_int8:
+                pp = paged_pool_kmajor_sharding(mesh)
+                ps = paged_scale_kmajor_sharding(mesh)
+            else:
+                pp = paged_pool_sharding(mesh)
+                ps = None  # compute-dtype pools are all 5D payloads
+            rep = NamedSharding(mesh, P())
+            return (
+                tuple(
+                    lax.with_sharding_constraint(c, pp if c.ndim == 5 else ps)
+                    for c in pools
+                ),
+                lax.with_sharding_constraint(last_tok, rep),
+                lax.with_sharding_constraint(pos, rep),
+                lax.with_sharding_constraint(gen, rep),
+            )
 
         def layer_pass(params, x, positions, tables, pools, *,
                        decode_kernel=False, pos_b=None):
@@ -1436,9 +1520,14 @@ class StreamingGenerator:
                         pks = paged_scatter_kmajor(pks, tables, positions, ks)
                         pvq = paged_scatter_kmajor(pvq, tables, positions, vq)
                         pvs = paged_scatter_kmajor(pvs, tables, positions, vs)
-                        attn = int8_paged_decode_attention(
-                            q, pkq, pks, pvq, pvs, tables, pos_b
-                        )
+                        if mesh is not None:
+                            attn = int8_paged_decode_attention_sharded(
+                                q, pkq, pks, pvq, pvs, tables, pos_b, mesh
+                            )
+                        else:
+                            attn = int8_paged_decode_attention(
+                                q, pkq, pks, pvq, pvs, tables, pos_b
+                            )
                         x = _attn_tail(x, attn, layer, cfg)
                     else:
                         x, pkq, pks, pvq, pvs = block_table_attention_q8(
@@ -1571,6 +1660,7 @@ class StreamingGenerator:
             pool. The table passes through the donated state
             unchanged."""
             pools, table = caches[:ti], caches[ti]
+            pools, last_tok, pos, gen = pin_paged(pools, last_tok, pos, gen)
             done0 = jnp.zeros((B,), bool)
             n0 = jnp.zeros((B,), jnp.int32)
 
@@ -1624,13 +1714,16 @@ class StreamingGenerator:
             activation therefore costs ZERO extra dispatches; only the
             rare journal warm-resume restores state host-side."""
             pools, table = caches[:ti], caches[ti]
+            pools, last_tok, pos, gen = pin_paged(pools, last_tok, pos, gen)
             done0 = jnp.zeros((B,), bool)
             n0 = jnp.zeros((B,), jnp.int32)
             act = active_in
-            toks_all = jnp.concatenate([last_tok, ctok])
+            toks_all = jnp.concatenate([pull_replicated(last_tok), ctok])
             x = embed_rows(params["embed"], toks_all, cfg.dtype)[:, None, :]
-            tables_all = jnp.concatenate([table, ctable], axis=0)
-            pos_all = jnp.concatenate([pos, cpos])
+            tables_all = jnp.concatenate(
+                [pull_replicated(table), ctable], axis=0
+            )
+            pos_all = jnp.concatenate([pull_replicated(pos), cpos])
             x, pools = layer_pass(
                 params, x, pos_all[:, None], tables_all, tuple(pools)
             )
@@ -1699,6 +1792,30 @@ class StreamingGenerator:
         self._last_tok = jnp.zeros((B,), jnp.int32)
         self._pos = jnp.zeros((B,), jnp.int32)
         self._gen = jnp.zeros((B, self._max_new), jnp.int32)
+        if mesh is not None:
+            # Place the initial pools/state in their serving layouts so
+            # the first dispatch doesn't start from single-device
+            # buffers. Per-slot state is REPLICATED — the paged program
+            # must keep the data axis out entirely (pin_paged's
+            # docstring; sharding it miscompiles on jax 0.4.x) — and
+            # the table stays a replicated host snapshot (rebuilt by
+            # every admission/retirement).
+            from jax.sharding import NamedSharding, PartitionSpec as PSpec
+
+            if kv_int8:
+                pp = paged_pool_kmajor_sharding(mesh)
+                ps = paged_scale_kmajor_sharding(mesh)
+            else:
+                pp = paged_pool_sharding(mesh)
+                ps = None
+            self._caches = tuple(
+                jax.device_put(c, pp if c.ndim == 5 else ps)
+                for c in self._caches[:self._paged_table_idx]
+            ) + self._caches[self._paged_table_idx:]
+            rep = NamedSharding(mesh, PSpec())
+            self._last_tok = jax.device_put(self._last_tok, rep)
+            self._pos = jax.device_put(self._pos, rep)
+            self._gen = jax.device_put(self._gen, rep)
 
     def _paged_prefill_call(self, caches, table_row, toks, *,
                             total_len: int | None = None):
@@ -2494,16 +2611,36 @@ class StreamingGenerator:
             and 1 <= g <= self._max_new
             and (hint.finished or g < self._max_new)
             # Partial-generation resume prefills through this server's
-            # cache; int8 pools (exactness already traded away) and mesh
-            # serving (a [1, S] prefill can't shard over data) fall back
-            # to cold replay. Finished hints need no prefill at all.
-            and (hint.finished or (not self._kv_int8 and self._mesh is None))
+            # cache — possible exactly when the pool keeps the
+            # exactness contract and the prefill has a spelling here
+            # (_resume_supported). Finished hints need no prefill at
+            # all.
+            and (hint.finished or self._resume_supported())
         )
         if not ok:
             if g >= 1:  # a bare admit-time entry is not a rejection
                 self.metrics.resume_rejected.add(1)
             return None
         return hint
+
+    def _resume_supported(self) -> bool:
+        """Can a PARTIAL journal hint warm-resume on this backend?
+
+        int8 pools never (exactness was traded away — the one contract
+        warm resume exists to keep). Compute-dtype pools: always on one
+        device; under a mesh, the paged CHUNKED path resumes fine (the
+        prompt + emitted tokens ride the chunk queue and state restores
+        host-side), and the dense path resumes when the mesh carries no
+        data axis (its [1, S] resume prefill has no batch to shard —
+        tp/fsdp-only meshes are unaffected). Everything else falls back
+        to cold replay, which is still correct."""
+        if self._kv_int8:
+            return False
+        if self._mesh is None:
+            return True
+        if self._kv_pages is not None and self._chunked:
+            return True
+        return self._mesh.shape.get("data", 1) == 1
 
     def _journal_record(self, rec, key_data, tokens, finished) -> None:
         self._journal.record(

@@ -45,9 +45,13 @@ speculation — including under injected commit failures (chaos-tested:
 speculation never changes which offsets commit).
 
 Greedy-only (temperature=0): the exactness contract is what makes the
-draft a pure speed knob. Single-device, compute-dtype KV (no mesh /
-int8-pool / Pallas-kernel composition yet — each is validated out with a
-clear error rather than silently misbehaving).
+draft a pure speed knob. Compute-dtype KV only (int8 pools and the
+int8-only Pallas read are validated out with a clear error — both give
+up or bypass the exactness contract speculation is built on), but the
+MESH composes: both models' params commit to their serving layouts,
+the verify/draft multi-query math is plain XLA, and GSPMD shards it
+from the layouts alone — token-exact vs single-device spec serving
+(differential-tested), dense and paged pools alike.
 
 Measured acceptance is a first-class output: the state tuple carries
 device-side (rounds, proposed, accepted) counters and ``spec_stats()``
@@ -105,12 +109,6 @@ class SpecStreamingGenerator(StreamingGenerator):
                 "speculation needs the rejection-sampling rule — not "
                 "implemented)"
             )
-        if kwargs.get("mesh") is not None:
-            raise ValueError(
-                "speculative serving is single-device for now: the verify "
-                "step's per-row multi-query writes have no sharded "
-                "spelling here yet — serve with mesh=None"
-            )
         if kwargs.get("kv_dtype") is not None:
             raise ValueError(
                 "speculative serving keeps the compute-dtype slot pool: "
@@ -144,6 +142,25 @@ class SpecStreamingGenerator(StreamingGenerator):
                 f"draft and target must share a vocab: "
                 f"{draft_cfg.vocab_size} != {cfg.vocab_size}"
             )
+        if kwargs.get("mesh") is not None:
+            # Model-sharded spec serving: the DRAFT commits to the same
+            # serving layouts as the target (the base __init__ places
+            # the target tree); the verify/draft multi-query math is
+            # plain XLA, so GSPMD shards it from the layouts alone —
+            # exactly the dense server's design rule. Both models must
+            # satisfy the mesh divisibilities.
+            from torchkafka_tpu.models.generate import (
+                check_serving_mesh,
+                serving_shardings,
+            )
+
+            mesh = kwargs["mesh"]
+            check_serving_mesh(
+                draft_cfg, mesh, batch=kwargs.get("slots", 8)
+            )
+            draft_params = jax.device_put(
+                draft_params, serving_shardings(draft_cfg, mesh, draft_params)
+            )
         self._k = int(k)
         self._draft_params = draft_params
         self._draft_cfg = draft_cfg
@@ -162,6 +179,17 @@ class SpecStreamingGenerator(StreamingGenerator):
         # for those never-attended stale tails.)
         self._max_len = M = P + max_new + k
         self._kv_kernel = False  # the base flag; never engaged here
+        # The resolved backend for metrics (spec pools are compute-dtype
+        # by validation, so the kernel never engages; pages and mesh
+        # compose — the probe validates the same exclusions as the base).
+        from torchkafka_tpu.kvcache import resolve_kv_backend
+
+        self._kv_backend = resolve_kv_backend(
+            cfg, mesh=self._mesh, kv_dtype=None,
+            kv_kernel=self._kv_kernel_opt, kv_pages=self._kv_pages,
+            max_len=M, slots=B, backend=jax.default_backend(),
+        )
+        mesh = self._mesh
         if self._kv_pages is not None and self._paged_setup():
             # Paged pools for BOTH models under ONE block table (same
             # block ids address target and draft tensors), so a radix
@@ -177,8 +205,8 @@ class SpecStreamingGenerator(StreamingGenerator):
             servers' completions start from the same token."""
             tparams, dparams = params_pair
             t_k, t_v, d_k, d_v, acc, prop, rounds = state
-            t_logits, t_fresh = prefill(tparams, cfg, prompts, M)
-            _d_logits, d_fresh = prefill(dparams, dcfg, prompts, M)
+            t_logits, t_fresh = prefill(tparams, cfg, prompts, M, mesh)
+            _d_logits, d_fresh = prefill(dparams, dcfg, prompts, M, mesh)
             sel = admit_mask[None, :, None, None, None]
             t_k = jnp.where(sel, t_fresh.k, t_k)
             t_v = jnp.where(sel, t_fresh.v, t_v)
@@ -306,8 +334,8 @@ class SpecStreamingGenerator(StreamingGenerator):
             writes."""
             tparams, dparams = params_pair
             t_k, t_v, d_k, d_v, acc, prop, rounds = state
-            _tl, t_fresh = prefill(tparams, cfg, seq, M)
-            _dl, d_fresh = prefill(dparams, dcfg, seq, M)
+            _tl, t_fresh = prefill(tparams, cfg, seq, M, mesh)
+            _dl, d_fresh = prefill(dparams, dcfg, seq, M, mesh)
             t_k = lax.dynamic_update_slice(
                 t_k, t_fresh.k.astype(t_k.dtype), (0, slot, 0, 0, 0)
             )
