@@ -1,0 +1,406 @@
+"""Tiered radix cache + disaggregated prefill paired bench.
+
+Two questions, each answered with paired runs over IDENTICAL broker
+content (the repo's pairing discipline — absolute numbers on a
+contended CPU box drift; paired counts and ratios are the signal), with
+token + commit-ledger exactness asserted inside every slice:
+
+1. TIER — a Zipf tenant population at tenant counts where the HBM-only
+   radix tree THRASHES (far more distinct tenant prefixes than pool
+   blocks: every prefix is evicted before its next hit — the
+   TRAFFIC_BENCH hit-by-rank cliff at production scale). Per tenant
+   count: prefix hit rate, prompt tokens actually prefilled, TTFT
+   p50/p99 (RecordTracer-derived), HBM-only vs host-RAM-tiered — the
+   tier's claim is hits and prefill tokens, i.e. the effective cache
+   capacity becomes host memory instead of pool blocks.
+
+2. DISAGG — a 4x prompt storm (records >> fleet slots) served
+   monolithic (decode replicas run their own chunked prefills) vs
+   DISAGGREGATED (a prefill-role worker fills KV and publishes
+   handoffs; the decode server adopts and never runs a prompt pass).
+   Per mode: decode inter-token latency p50/p99 (the number prompt
+   storms are supposed to stop touching), TTFT, decode-side prefill
+   tokens (0 when disaggregated), wall. CPU caveat as everywhere: one
+   box timeshares both roles, so disagg wall is not a speedup claim —
+   the signal is decode ITL and the decode-side prefill-token count.
+
+Usage: python benchmarks/bench_tiered.py [--tenants 4,16,48]
+       [--prompts 96] [--storm-prompts 32] [--json PATH]
+Prints one markdown row per slice plus a JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+VOCAB = 512
+P, MAX_NEW, BS = 16, 8, 4
+
+
+def _model(jnp, jax):
+    from torchkafka_tpu.models.transformer import (
+        TransformerConfig,
+        init_params,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=VOCAB, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=P + MAX_NEW, dtype=jnp.float32,
+    )
+    return cfg, init_params(jax.random.key(0), cfg)
+
+
+def _zipf_stream(np, n_tenants: int, n_prompts: int, seed: int):
+    """Zipf(1.1)-weighted tenant draws; each tenant owns one fixed
+    P-token prompt (the system-prompt shape the radix tree shares)."""
+    from torchkafka_tpu.workload.generator import zipf_weights
+
+    rng = np.random.default_rng(seed)
+    tenant_prompts = rng.integers(0, VOCAB, (n_tenants, P), dtype=np.int32)
+    w = zipf_weights(n_tenants, 1.1)
+    picks = rng.choice(n_tenants, size=n_prompts, p=w)
+    return tenant_prompts, picks
+
+
+def _fill(tk, np, tenant_prompts, picks):
+    broker = tk.InMemoryBroker()
+    broker.create_topic("bench", partitions=2)
+    for i, t in enumerate(picks):
+        broker.produce(
+            "bench", tenant_prompts[t].tobytes(), partition=i % 2,
+            key=f"t{t}".encode(),
+        )
+    return broker
+
+
+def _serve_tier(tk, np, cfg, params, broker, n, *, num_blocks, kv_tier):
+    from torchkafka_tpu.obs import RecordTracer
+    from torchkafka_tpu.serve import StreamingGenerator
+
+    tr = RecordTracer(capacity=1 << 16, token_events=False)
+    consumer = tk.MemoryConsumer(broker, "bench", group_id="b")
+    server = StreamingGenerator(
+        consumer, params, cfg, slots=4, prompt_len=P, max_new=MAX_NEW,
+        commit_every=8, kv_pages={"block_size": BS, "num_blocks": num_blocks},
+        kv_tier=kv_tier, tracer=tr,
+    )
+    server.warmup()
+    out = {}
+    t0 = time.perf_counter()
+    for rec, toks in server.run(max_records=n):
+        out[(rec.partition, rec.offset)] = np.asarray(toks)
+    elapsed = time.perf_counter() - t0
+    committed = {
+        p: broker.committed("b", tk.TopicPartition("bench", p))
+        for p in range(2)
+    }
+    consumer.close()
+    cache = server.metrics.cache_summary()
+    ttft = tr.slo.summary()["ttft"]["all"]
+    return {
+        "out": out, "committed": committed, "elapsed_s": elapsed,
+        "hit_rate": cache["hit_rate"], "prefill_tokens":
+        cache["prefill_tokens"], "tier": cache["tier"],
+        "ttft_p50_ms": ttft["p50_ms"], "ttft_p99_ms": ttft["p99_ms"],
+    }
+
+
+def tier_sweep(tk, np, cfg, params, tenant_counts, n_prompts, num_blocks):
+    rows = []
+    for n_tenants in tenant_counts:
+        tenant_prompts, picks = _zipf_stream(np, n_tenants, n_prompts, 13)
+        hbm = _serve_tier(
+            tk, np, cfg, params, _fill(tk, np, tenant_prompts, picks),
+            n_prompts, num_blocks=num_blocks, kv_tier=None,
+        )
+        tier = _serve_tier(
+            tk, np, cfg, params, _fill(tk, np, tenant_prompts, picks),
+            n_prompts, num_blocks=num_blocks,
+            kv_tier={"capacity_bytes": 64 << 20},
+        )
+        # Exactness asserted INSIDE the bench: tokens + ledger identical.
+        assert set(hbm["out"]) == set(tier["out"])
+        for k in hbm["out"]:
+            assert np.array_equal(hbm["out"][k], tier["out"][k]), k
+        assert hbm["committed"] == tier["committed"]
+        row = {
+            "tenants": n_tenants,
+            "prompts": n_prompts,
+            "pool_blocks": num_blocks - 1,
+            "hbm_only": {
+                k: hbm[k] for k in (
+                    "hit_rate", "prefill_tokens", "ttft_p50_ms",
+                    "ttft_p99_ms", "elapsed_s",
+                )
+            },
+            "tiered": {
+                k: tier[k] for k in (
+                    "hit_rate", "prefill_tokens", "ttft_p50_ms",
+                    "ttft_p99_ms", "elapsed_s",
+                )
+            },
+            "tier_traffic": tier["tier"],
+            "prefill_tokens_saved_vs_hbm": (
+                hbm["prefill_tokens"] - tier["prefill_tokens"]
+            ),
+            "exact": True,
+        }
+        rows.append(row)
+        print(
+            f"| tier | tenants={n_tenants:3d} | "
+            f"hit {hbm['hit_rate'] or 0:.2f}->{tier['hit_rate'] or 0:.2f} | "
+            f"prefill {hbm['prefill_tokens']}->{tier['prefill_tokens']} | "
+            f"ttft p99 {hbm['ttft_p99_ms']:.1f}->{tier['ttft_p99_ms']:.1f} "
+            f"ms | demote/promote {tier['tier']['demotions']}/"
+            f"{tier['tier']['promotions']} | exact |"
+        )
+    return rows
+
+
+# The storm slices use LONG prompts (a prompt storm is prefill-heavy by
+# definition): chunk auto width = slots x prompt_len rows riding each
+# admission tick, which is exactly the decode-latency pressure
+# disaggregation exists to remove.
+STORM_P, STORM_MAX_NEW = 48, 16
+
+
+def _storm_model(jnp, jax):
+    from torchkafka_tpu.models.transformer import (
+        TransformerConfig,
+        init_params,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=VOCAB, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=STORM_P + STORM_MAX_NEW, dtype=jnp.float32,
+    )
+    return cfg, init_params(jax.random.key(0), cfg)
+
+
+def _storm_prompts(np, n, seed=29):
+    rng = np.random.default_rng(seed)
+    prompts = rng.integers(0, VOCAB, (n, STORM_P), dtype=np.int32)
+    prompts[:, :6] = np.arange(6)
+    return prompts
+
+
+def _storm_budget(rec):
+    """Deterministic per-record output budget (keyed by record id):
+    STAGGERED completions, so admissions refill slots WHILE other slots
+    decode — the regime where monolithic chunk ticks ride live decode
+    (ITL pressure) and disaggregated adoption does not."""
+    i = int(rec.key.decode())
+    return 4 + (i * 7) % (STORM_MAX_NEW - 4)
+
+
+def _mono_storm(tk, np, cfg, params, prompts):
+    from torchkafka_tpu.obs import RecordTracer
+    from torchkafka_tpu.serve import StreamingGenerator
+
+    n = prompts.shape[0]
+    broker = tk.InMemoryBroker()
+    broker.create_topic("p", partitions=2)
+    for i in range(n):
+        broker.produce("p", prompts[i].tobytes(), partition=i % 2,
+                       key=str(i).encode())
+    tr = RecordTracer(capacity=1 << 16)
+    c = tk.MemoryConsumer(broker, "p", group_id="g")
+    gen = StreamingGenerator(
+        c, params, cfg, slots=4, prompt_len=STORM_P, max_new=STORM_MAX_NEW,
+        commit_every=8, kv_pages={"block_size": BS, "num_blocks": 128},
+        tracer=tr, max_new_of=_storm_budget,
+    )
+    gen.warmup()
+    out = {}
+    t0 = time.perf_counter()
+    for rec, toks in gen.run(max_records=n):
+        out[(rec.partition, rec.offset)] = np.asarray(toks)
+    elapsed = time.perf_counter() - t0
+    committed = {
+        p: broker.committed("g", tk.TopicPartition("p", p)) for p in range(2)
+    }
+    c.close()
+    return out, committed, elapsed, tr, gen
+
+
+def _disagg_storm(tk, np, cfg, params, prompts):
+    from torchkafka_tpu.fleet.prefill import (
+        PrefillRouter,
+        PrefillWorker,
+        drain_handoffs,
+    )
+    from torchkafka_tpu.obs import RecordTracer
+    from torchkafka_tpu.serve import StreamingGenerator
+    from torchkafka_tpu.source.producer import MemoryProducer
+
+    import threading
+
+    n = prompts.shape[0]
+    broker = tk.InMemoryBroker()
+    broker.create_topic("p", partitions=2)
+    broker.create_topic("ho", partitions=1)
+    for i in range(n):
+        broker.produce("p", prompts[i].tobytes(), partition=i % 2,
+                       key=str(i).encode())
+    pages = {"block_size": BS, "num_blocks": 128}
+    pc = tk.MemoryConsumer(broker, "p", group_id="pf")
+    pgen = StreamingGenerator(
+        pc, params, cfg, slots=4, prompt_len=STORM_P,
+        max_new=STORM_MAX_NEW, commit_every=8, kv_pages=dict(pages),
+        prefill_role=True,
+    )
+    pgen.warmup()
+    worker = PrefillWorker(pgen, pc, MemoryProducer(broker), "ho")
+    tr = RecordTracer(capacity=1 << 16)
+    dc = tk.MemoryConsumer(broker, "p", group_id="g")
+    dgen = StreamingGenerator(
+        dc, params, cfg, slots=4, prompt_len=STORM_P,
+        max_new=STORM_MAX_NEW, commit_every=8, kv_pages=dict(pages),
+        tracer=tr, max_new_of=_storm_budget,
+    )
+    dgen.warmup()
+    ho_c = tk.MemoryConsumer(broker, "ho", group_id="ho-d")
+    router = PrefillRouter(dgen, patience=10**6)
+    out = {}
+    pending = []
+
+    # The prefill worker runs on its OWN thread — the in-process stand-in
+    # for its own process (scenario 21 is the real-process version). The
+    # decode loop below never executes a prompt pass; its ITL is pure
+    # decode-tick cadence.
+    stop = threading.Event()
+
+    def prefill_loop():
+        idle = 0
+        while not stop.is_set() and idle < 200:
+            published = worker.pump()
+            idle = 0 if (published or not worker.idle()) else idle + 1
+
+    pt = threading.Thread(target=prefill_loop, daemon=True)
+    t0 = time.perf_counter()
+    pt.start()
+    for _ in range(200000):
+        drain_handoffs(ho_c, dgen)
+        free = dgen.free_slots() - dgen.pending_admissions
+        if free > len(pending):
+            recs = dc.poll(max_records=free - len(pending), timeout_ms=0)
+            if recs:
+                dgen.note_fetched(recs)
+                pending.extend(recs)
+        take = []
+        while pending and len(take) < free:
+            if router.should_hold(pending[0]):
+                break
+            take.append(pending.pop(0))
+        if take or (dgen.pending_admissions and dgen.free_slots()):
+            dgen.admit_records(take)
+        ticked = False
+        for rec, toks in dgen.step():
+            ticked = True
+            out[(rec.partition, rec.offset)] = np.asarray(toks)
+        if len(out) == n:
+            break
+        if not ticked and not dgen.has_active():
+            time.sleep(0.0005)  # waiting on the transfer plane, not busy
+    elapsed = time.perf_counter() - t0
+    stop.set()
+    pt.join(timeout=30)
+    dgen.flush_commits()
+    committed = {
+        p: broker.committed("g", tk.TopicPartition("p", p)) for p in range(2)
+    }
+    for cl in (pc, dc, ho_c):
+        cl.close()
+    return out, committed, elapsed, tr, dgen, pgen
+
+
+def disagg_storm(tk, np, jnp, jax, n):
+    cfg, params = _storm_model(jnp, jax)
+    prompts = _storm_prompts(np, n)
+    m_out, m_comm, m_wall, m_tr, m_gen = _mono_storm(
+        tk, np, cfg, params, prompts
+    )
+    d_out, d_comm, d_wall, d_tr, d_gen, p_gen = _disagg_storm(
+        tk, np, cfg, params, prompts
+    )
+    # Exactness asserted INSIDE the bench.
+    assert set(m_out) == set(d_out)
+    for k in m_out:
+        assert np.array_equal(m_out[k], d_out[k]), k
+    assert m_comm == d_comm
+
+    def slo(tr):
+        s = tr.slo.summary()
+        return {
+            "itl_p50_ms": s["itl"]["all"]["p50_ms"],
+            "itl_p99_ms": s["itl"]["all"]["p99_ms"],
+            "ttft_p50_ms": s["ttft"]["all"]["p50_ms"],
+            "ttft_p99_ms": s["ttft"]["all"]["p99_ms"],
+        }
+
+    row = {
+        "storm_prompts": n,
+        "decode_slots": 4,
+        "oversubscription": round(n / 4, 1),
+        "monolithic": {
+            **slo(m_tr), "wall_s": round(m_wall, 3),
+            "decode_prefill_tokens": m_gen.metrics.prefill_tokens.count,
+        },
+        "disaggregated": {
+            **slo(d_tr), "wall_s": round(d_wall, 3),
+            "decode_prefill_tokens": d_gen.metrics.prefill_tokens.count,
+            "adopted_slots": d_gen.metrics.adopted_slots.count,
+            "handoffs_published": p_gen.metrics.handoffs_published.count,
+        },
+        "exact": True,
+    }
+    m, d = row["monolithic"], row["disaggregated"]
+    print(
+        f"| disagg | {n} prompts / 4 slots | decode prefill tokens "
+        f"{m['decode_prefill_tokens']}->{d['decode_prefill_tokens']} | "
+        f"itl p99 {m['itl_p99_ms']:.2f}->{d['itl_p99_ms']:.2f} ms | "
+        f"adopted {d['adopted_slots']}/{n} | exact |"
+    )
+    return row
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tenants", default="4,16,48")
+    ap.add_argument("--prompts", type=int, default=96)
+    ap.add_argument("--pool-blocks", type=int, default=17)
+    ap.add_argument("--storm-prompts", type=int, default=32)
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np  # noqa: F401
+
+    from torchkafka_tpu.utils.devices import force_cpu_devices
+
+    force_cpu_devices(1)
+    import torchkafka_tpu as tk
+
+    globals()["np"] = np
+    cfg, params = _model(jnp, jax)
+    doc = {
+        "tiered": tier_sweep(
+            tk, np, cfg, params,
+            [int(t) for t in args.tenants.split(",")],
+            args.prompts, args.pool_blocks,
+        ),
+        "disagg": disagg_storm(tk, np, jnp, jax, args.storm_prompts),
+    }
+    line = json.dumps(doc)
+    print(line)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

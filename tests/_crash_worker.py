@@ -375,6 +375,119 @@ def run_broker_host(workdir: str) -> None:
         _time.sleep(0.05)
 
 
+DG_TOPIC, DG_HANDOFF, DG_OUT, DG_DLQ = "dgt", "dgho", "dgout", "dgdlq"
+DG_GROUP = "dgg"
+DG_PREFILL_GROUP = "dgg-prefill"
+DG_TXN_ID = "dgtxn"
+DG_PARTS = 2
+DG_PROMPTS = 8
+DG_PAGES = {"block_size": 4, "num_blocks": 40}
+
+
+def prime_dg_topics(broker):
+    """Prompt/handoff/output topics for the disaggregated-prefill matrix
+    (no poison: the quarantine path has its own serve-mode coverage)."""
+    import numpy as np
+
+    broker.create_topic(DG_TOPIC, partitions=DG_PARTS)
+    broker.create_topic(DG_HANDOFF, partitions=1)
+    broker.create_topic(DG_OUT, partitions=1)
+    rng = np.random.default_rng(23)
+    prompts = rng.integers(0, VOCAB, (DG_PROMPTS, P), dtype=np.int32)
+    prompts[:, :4] = np.arange(4)  # shared prefix: the radix/tier shape
+    for i in range(DG_PROMPTS):
+        broker.produce(
+            DG_TOPIC, prompts[i].tobytes(), partition=i % DG_PARTS,
+            key=str(i).encode(),
+        )
+    return prompts
+
+
+def run_dg_prefill(broker, workdir: str) -> None:
+    """One prefill-worker incarnation: consume the prompt topic in the
+    PREFILL group, fill paged KV, publish handoffs, commit the prefill
+    group's offsets only after each publish — the
+    ``prefill_handoff_pre_publish`` window sits between harvest and
+    produce."""
+    import torchkafka_tpu as tk
+    from torchkafka_tpu.fleet.prefill import PrefillWorker
+    from torchkafka_tpu.serve import StreamingGenerator
+
+    cfg, params = build_model()
+    consumer = tk.MemoryConsumer(
+        broker, DG_TOPIC, group_id=DG_PREFILL_GROUP,
+    )
+    gen = StreamingGenerator(
+        consumer, params, cfg, slots=SLOTS, prompt_len=P, max_new=MAX_NEW,
+        commit_every=2**31 - 1, ticks_per_sync=1, max_poll_records=SLOTS,
+        kv_pages=dict(DG_PAGES), prefill_role=True,
+    )
+    worker = PrefillWorker(
+        gen, consumer, tk.MemoryProducer(broker), DG_HANDOFF,
+        commit_every=2,
+    )
+    idle = 0
+    while idle < 40:
+        published = worker.pump()
+        idle = 0 if (published or not worker.idle()) else idle + 1
+    worker.close()
+    consumer.close()
+
+
+def run_dg_decode(broker, workdir: str, *, patience: int = 8) -> None:
+    """One EXACTLY-ONCE decode incarnation with handoff adoption: tail
+    the handoff topic, route admission through a PrefillRouter (bounded
+    patience → local-prefill fallback), serve transactionally. The
+    ``decode_adopt_pre_activate`` window sits between an adopted
+    payload's upload and the slot's activation."""
+    import torchkafka_tpu as tk
+    from torchkafka_tpu.fleet.prefill import PrefillRouter, drain_handoffs
+    from torchkafka_tpu.serve import StreamingGenerator
+
+    cfg, params = build_model()
+    consumer = tk.MemoryConsumer(broker, DG_TOPIC, group_id=DG_GROUP)
+    producer = tk.TransactionalProducer(broker, DG_TXN_ID)
+    gen = StreamingGenerator(
+        consumer, params, cfg, slots=SLOTS, prompt_len=P, max_new=MAX_NEW,
+        commit_every=COMMIT_EVERY, ticks_per_sync=1, max_poll_records=SLOTS,
+        output_producer=producer, output_topic=DG_OUT, exactly_once=True,
+        kv_pages=dict(DG_PAGES),
+    )
+    ho = tk.MemoryConsumer(
+        broker, DG_HANDOFF, group_id=f"{DG_GROUP}-ho-{os.getpid()}",
+    )
+    router = PrefillRouter(gen, patience=patience)
+    pending: list = []
+    idle = 0
+    while idle < 60:
+        drain_handoffs(ho, gen)
+        progressed = False
+        free = gen.free_slots() - gen.pending_admissions
+        if free > len(pending):
+            records = consumer.poll(max_records=SLOTS, timeout_ms=0)
+            if records:
+                gen.note_fetched(records)
+                pending.extend(records)
+        take: list = []
+        while pending and len(take) < free:
+            if router.should_hold(pending[0]):
+                break
+            take.append(pending.pop(0))
+        if take or (gen.pending_admissions and gen.free_slots()):
+            gen.admit_records(take)
+            progressed = progressed or bool(take)
+        for _rec, _toks in gen.step():
+            progressed = True
+        if gen.has_active() or pending or progressed:
+            idle = 0
+        else:
+            idle += 1
+    gen.close()
+    ho.close()
+    consumer.close()
+    producer.close()
+
+
 def run_ckpt(broker, workdir: str) -> None:
     """One training-shaped incarnation: resume from the newest complete
     checkpoint, then chunks of poll → commit → save. The commit-then-
@@ -441,6 +554,10 @@ def main() -> int:
             run_fleet(client, workdir)
         elif mode == "sweep":
             run_sweep(client)
+        elif mode == "dgpre":
+            run_dg_prefill(client, workdir)
+        elif mode == "dgdec":
+            run_dg_decode(client, workdir)
         else:
             raise ValueError(f"unknown mode {mode!r}")
     finally:

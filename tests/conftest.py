@@ -44,3 +44,38 @@ def broker():
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+def pytest_collection_modifyitems(config, items):
+    """Budget-aware ordering: the tier-1 wall-clock budget (ROADMAP's
+    870 s `timeout`) is nearly saturated by the long-standing suites, so
+    the NEWEST differential suites (PR 14: tiered cache + disaggregated
+    prefill) and the newest harness scenario are scheduled LAST — a
+    budget overrun on a slow box truncates the newest coverage first,
+    never the seed regression surface. Within the tail, cheap host-only
+    property tests run before jit-compiling differentials so the most
+    coverage survives whatever slack the box leaves. The full suites run
+    unconditionally outside the tier-1 timeout (plain `pytest tests/`,
+    `-m chaos`, CI without `-m 'not slow'`)."""
+    tail_modules = ("test_tier.py", "test_disagg.py")
+    tail_tests = ("test_scenario_21_disaggregated_prefill_kill_storm",)
+
+    def tail_rank(item):
+        path = str(getattr(item, "fspath", ""))
+        if item.name in tail_tests:
+            return 3
+        if path.endswith(tail_modules):
+            # Host-only property/plumbing tests first (sub-second),
+            # jit-heavy serving differentials after.
+            cheap = (
+                "TestHostTier" in item.nodeid
+                or "TestTieredRadixProperty" in item.nodeid
+                or "test_wire_roundtrip" in item.nodeid
+                or "test_admission_queue_routes" in item.nodeid
+                or "test_prefill_role_validation" in item.nodeid
+                or "test_config_validation" in item.nodeid
+            )
+            return 1 if cheap else 2
+        return 0
+
+    items.sort(key=tail_rank)

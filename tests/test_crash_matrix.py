@@ -94,6 +94,13 @@ MATRIX: dict[str, tuple[str, int]] = {
     # Dies inside the startup REPLAY over a WAL a previous broker life
     # left behind (event 10 is mid-prime): recovery must be re-runnable.
     "recovery_mid_replay": ("broker", 10),
+    # Disaggregated prefill (fleet/prefill.py + serve.py adoption): a
+    # prefill worker dying between harvest and publish (arrival 2 = the
+    # second handoff's publish window, the first already on the transfer
+    # plane), and an exactly-once decode replica dying between an
+    # adopted payload's upload and the slot's activation.
+    "prefill_handoff_pre_publish": ("dgpre", 2),
+    "decode_adopt_pre_activate": ("dgdec", 2),
 }
 
 # The tier-1 representative subset: one mid-serve death (commit path) and
@@ -673,6 +680,156 @@ def _run_broker_case(tmp_path, point: str, at: int):
     again.close()
 
 
+@pytest.fixture(scope="module")
+def dg_reference(tmp_path_factory):
+    """The no-kill disaggregated reference: one prefill pass fills the
+    handoff topic, one exactly-once decode pass adopts and serves —
+    key → completion tokens in the committed view. (Greedy decode is a
+    pure function of (params, prompt), and adoption is bitwise the
+    local prefill, so this also defines byte-truth for every kill
+    case.)"""
+    broker = tk.InMemoryBroker()
+    W.prime_dg_topics(broker)
+    wd = str(tmp_path_factory.mktemp("dg-ref"))
+    W.run_dg_prefill(broker, wd)
+    W.run_dg_decode(broker, wd)
+    outs = _committed_outputs(broker, W.DG_OUT)
+    assert set(outs) == {str(i).encode() for i in range(W.DG_PROMPTS)}
+    assert all(len(v) == 1 for v in outs.values())
+    return {k: v[0] for k, v in outs.items()}
+
+
+def _dg_committed(broker):
+    return {
+        p: broker.committed(W.DG_GROUP, TopicPartition(W.DG_TOPIC, p)) or 0
+        for p in range(W.DG_PARTS)
+    }
+
+
+def _dg_audit_death(broker, reference) -> None:
+    """Exactly-once invariants at the moment of death: the committed
+    view holds each completion at most once and byte-correct, and every
+    committed decode-group offset is covered by a committed output."""
+    outs = _committed_outputs(broker, W.DG_OUT)
+    for key, copies in outs.items():
+        assert len(copies) == 1, f"duplicate committed output for {key!r}"
+        np.testing.assert_array_equal(copies[0], reference[key])
+    for p, wm in _dg_committed(broker).items():
+        assert wm <= broker.end_offset(TopicPartition(W.DG_TOPIC, p))
+        for off in range(wm):
+            key = str(off * W.DG_PARTS + p).encode()
+            assert key in outs, (
+                f"committed {p}:{off} (prompt {key}) has no committed output"
+            )
+
+
+def _dg_audit_complete(broker, reference) -> None:
+    outs = _committed_outputs(broker, W.DG_OUT)
+    assert set(outs) == set(reference), (
+        f"lost completions: {set(reference) ^ set(outs)}"
+    )
+    for key, copies in outs.items():
+        # THE exactly-once assertion: dups == 0, not bounded.
+        assert len(copies) == 1, (
+            f"{len(copies)} committed copies of {key!r} after recovery"
+        )
+        np.testing.assert_array_equal(copies[0], reference[key], err_msg=str(key))
+    for p in range(W.DG_PARTS):
+        tp = TopicPartition(W.DG_TOPIC, p)
+        assert (broker.committed(W.DG_GROUP, tp) or 0) == \
+            broker.end_offset(tp), f"partition {p} not fully committed"
+
+
+def _run_dgpre_case(tmp_path, dg_reference, point: str, at: int):
+    """A PREFILL worker SIGKILLed between harvesting a prompt's filled
+    KV and publishing its handoff: the handoff never reaches the
+    transfer plane, the prefill group's offset for it stays uncommitted
+    (at-least-once on the handoff plane), and the decode path — which
+    never depends on a handoff existing — still serves everything
+    exactly once after a fresh prefill incarnation re-serves the gap."""
+    broker = tk.InMemoryBroker()
+    W.prime_dg_topics(broker)
+    workdir = str(tmp_path / point)
+    os.makedirs(workdir, exist_ok=True)
+    with tk.BrokerServer(broker) as server:
+        proc, marker = _spawn("dgpre", server.port, workdir, point, at)
+        proc.wait(timeout=180)
+    with open(os.path.join(workdir, "child.log"), "rb") as f:
+        log = f.read().decode(errors="replace")
+    assert proc.returncode == -signal.SIGKILL, (
+        f"worker exited {proc.returncode}, not SIGKILL — point {point!r} "
+        f"never reached?\n{log}"
+    )
+    with open(marker) as f:
+        assert f.read().strip() == f"{point}:{at}"
+    _reap_group(broker, W.DG_PREFILL_GROUP)
+
+    # ---- invariants at the moment of death ------------------------------
+    # Arrival `at` fired before the at-th publish: at-1 handoffs made it.
+    published = broker.fetch(TopicPartition(W.DG_HANDOFF, 0), 0, 1000)
+    assert len(published) == at - 1
+    # The prefill group never committed past its published work: every
+    # unpublished prompt re-delivers to the next incarnation.
+    for p in range(W.DG_PARTS):
+        tp = TopicPartition(W.DG_TOPIC, p)
+        wm = broker.committed(W.DG_PREFILL_GROUP, tp) or 0
+        handed = {
+            (r.key) for r in published
+        }
+        for off in range(wm):
+            key = str(off * W.DG_PARTS + p).encode()
+            assert key in handed, (
+                f"prefill group committed {p}:{off} ({key}) with no "
+                "published handoff — the mid-transfer loss window"
+            )
+    # The decode group is untouched (nothing served yet).
+    assert sum(_dg_committed(broker).values()) == 0
+
+    # ---- recovery: fresh prefill incarnation + decode to completion -----
+    W.run_dg_prefill(broker, workdir)
+    handed = broker.fetch(TopicPartition(W.DG_HANDOFF, 0), 0, 1000)
+    assert len({r.key for r in handed}) == W.DG_PROMPTS, (
+        "recovery did not re-serve the unpublished handoffs"
+    )
+    W.run_dg_decode(broker, workdir)
+    _dg_audit_complete(broker, dg_reference)
+
+
+def _run_dgdec_case(tmp_path, dg_reference, point: str, at: int):
+    """An exactly-once DECODE replica SIGKILLed between uploading an
+    adopted handoff's KV payload and activating the slot: the record was
+    never emitted to any ledger snapshot, so it re-delivers and
+    re-adopts — committed duplicates stay zero, byte-identical."""
+    broker = tk.InMemoryBroker()
+    W.prime_dg_topics(broker)
+    workdir = str(tmp_path / point)
+    os.makedirs(workdir, exist_ok=True)
+    # The transfer plane is pre-filled by an in-process prefill pass, so
+    # the child's death lands in ADOPTION, not local prefill.
+    W.run_dg_prefill(broker, workdir)
+    with tk.BrokerServer(broker) as server:
+        proc, marker = _spawn("dgdec", server.port, workdir, point, at)
+        proc.wait(timeout=180)
+    with open(os.path.join(workdir, "child.log"), "rb") as f:
+        log = f.read().decode(errors="replace")
+    assert proc.returncode == -signal.SIGKILL, (
+        f"worker exited {proc.returncode}, not SIGKILL — point {point!r} "
+        f"never reached?\n{log}"
+    )
+    with open(marker) as f:
+        assert f.read().strip() == f"{point}:{at}"
+    _reap_group(broker, W.DG_GROUP)
+
+    # ---- exactly-once invariants at the moment of death -----------------
+    _dg_audit_death(broker, dg_reference)
+
+    # ---- recovery: same decode logic, in-process ------------------------
+    # Constructing the recovery TransactionalProducer re-inits DG_TXN_ID:
+    # epoch bump, the corpse's open transaction aborted.
+    W.run_dg_decode(broker, workdir)
+    _dg_audit_complete(broker, dg_reference)
+
+
 FULL_POINTS = [p for p in MATRIX if p not in TIER1]
 
 
@@ -729,5 +886,13 @@ def _dispatch_case(tmp_path, request, point: str) -> None:
         _run_sweep_case(tmp_path, point, at)
     elif mode == "broker":
         _run_broker_case(tmp_path, point, at)
+    elif mode == "dgpre":
+        _run_dgpre_case(
+            tmp_path, request.getfixturevalue("dg_reference"), point, at
+        )
+    elif mode == "dgdec":
+        _run_dgdec_case(
+            tmp_path, request.getfixturevalue("dg_reference"), point, at
+        )
     else:  # pragma: no cover - matrix typo guard
         raise ValueError(f"unknown matrix mode {mode!r}")

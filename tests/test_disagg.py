@@ -1,0 +1,389 @@
+"""Disaggregated prefill (serve.py prefill_role/adoption +
+fleet/prefill.py transfer plane + qos.py routing hook).
+
+The contract: a prefill worker + decode server pair over one broker is
+TOKEN-EXACT and COMMIT-LEDGER-BYTE-IDENTICAL vs the monolithic paged
+server, across greedy, seeded sampling, int8 pools, host meshes, and a
+seeded mid-storm prefill-worker kill (routing patience expires → local-
+prefill fallback, replayed byte-identically). The decode server never
+runs a prompt pass when adopting: its prefill-token counter stays 0.
+
+The process-level version (real OS processes, SIGKILL) lives in
+harness scenario 21 and the crash matrix; this file pins the
+differential at deterministic in-process granularity.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import torchkafka_tpu as tk
+from torchkafka_tpu.fleet.prefill import (
+    PrefillRouter,
+    PrefillWorker,
+    decode_handoff,
+    drain_handoffs,
+    encode_handoff,
+)
+from torchkafka_tpu.fleet.qos import AdmissionQueue, QoSConfig, TenantBuckets
+from torchkafka_tpu.fleet.metrics import FleetMetrics
+from torchkafka_tpu.models.transformer import TransformerConfig, init_params
+from torchkafka_tpu.serve import PrefillHandoff, StreamingGenerator
+from torchkafka_tpu.source.producer import MemoryProducer
+
+P, MAX_NEW, VOCAB, BS = 8, 8, 64, 4
+PAGES = {"block_size": BS, "num_blocks": 40}
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = TransformerConfig(
+        vocab_size=VOCAB, d_model=32, n_layers=2, n_heads=2, n_kv_heads=1,
+        d_ff=64, max_seq_len=P + MAX_NEW, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def mesh_model():
+    cfg = TransformerConfig(
+        vocab_size=VOCAB, d_model=32, n_layers=2, n_heads=2, n_kv_heads=2,
+        d_ff=64, max_seq_len=P + MAX_NEW, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+def _mesh(axes):
+    from torchkafka_tpu.parallel import make_mesh
+
+    n = int(np.prod(list(axes.values())))
+    return make_mesh(axes, devices=jax.devices()[:n])
+
+
+def _prompts(n=10, shared=5, seed=7):
+    rng = np.random.default_rng(seed)
+    prompts = rng.integers(0, VOCAB, (n, P), dtype=np.int32)
+    if shared:
+        prompts[:, :shared] = np.arange(shared, dtype=np.int32)
+    return prompts
+
+
+def _fill(broker, prompts):
+    broker.create_topic("p", partitions=2)
+    for i in range(prompts.shape[0]):
+        broker.produce("p", prompts[i].tobytes(), partition=i % 2,
+                       key=str(i).encode())
+
+
+def _mono(cfg, params, prompts, **kw):
+    """The monolithic paged reference (same group id as the decode side
+    of the disaggregated run, so ledgers compare byte-for-byte)."""
+    broker = tk.InMemoryBroker()
+    _fill(broker, prompts)
+    consumer = tk.MemoryConsumer(broker, "p", group_id="g")
+    server = StreamingGenerator(
+        consumer, params, cfg, slots=4, prompt_len=P, max_new=MAX_NEW,
+        commit_every=4, kv_pages=PAGES, **kw,
+    )
+    out = {}
+    for rec, toks in server.run(max_records=prompts.shape[0]):
+        out[(rec.partition, rec.offset)] = np.asarray(toks)
+    committed = {
+        pt: broker.committed("g", tk.TopicPartition("p", pt)) for pt in (0, 1)
+    }
+    consumer.close()
+    return out, committed, server
+
+
+def _disagg(cfg, params, prompts, *, kill_prefill_after=None, patience=40,
+            mesh=None, **kw):
+    """One deterministic disaggregated run: a prefill worker (own group)
+    and a decode server (group 'g') pumped in lockstep over one broker.
+    ``kill_prefill_after=N`` abandons the prefill worker after its Nth
+    pump — unpublished handoffs vanish with it, the router's patience
+    expires, and held records fall back to local prefills."""
+    broker = tk.InMemoryBroker()
+    _fill(broker, prompts)
+    n = prompts.shape[0]
+    common = dict(
+        slots=4, prompt_len=P, max_new=MAX_NEW, kv_pages=PAGES,
+        **({"mesh": mesh} if mesh is not None else {}), **kw,
+    )
+    pc = tk.MemoryConsumer(broker, "p", group_id="pf")
+    pgen = StreamingGenerator(
+        pc, params, cfg, commit_every=4, prefill_role=True, **common,
+    )
+    worker = PrefillWorker(pgen, pc, MemoryProducer(broker), "ho")
+    broker.create_topic("ho", partitions=1)
+
+    dc = tk.MemoryConsumer(broker, "p", group_id="g")
+    dgen = StreamingGenerator(dc, params, cfg, commit_every=4, **common)
+    ho_c = tk.MemoryConsumer(broker, "ho", group_id="ho-d0")
+    router = PrefillRouter(dgen, patience=patience)
+
+    out = {}
+    pending: list = []
+    prefill_alive = True
+    for it in range(6000):
+        if prefill_alive:
+            if kill_prefill_after is not None and it >= kill_prefill_after:
+                prefill_alive = False  # the seeded mid-storm death
+            else:
+                worker.pump()
+        drain_handoffs(ho_c, dgen)
+        free = dgen.free_slots() - dgen.pending_admissions
+        if free > len(pending):
+            recs = dc.poll(max_records=free - len(pending), timeout_ms=0)
+            if recs:
+                dgen.note_fetched(recs)
+                pending.extend(recs)
+        take: list = []
+        while pending and len(take) < free:
+            if router.should_hold(pending[0]):
+                break
+            take.append(pending.pop(0))
+        if take or (dgen.pending_admissions and dgen.free_slots()):
+            dgen.admit_records(take)
+        for rec, toks in dgen.step():
+            out[(rec.partition, rec.offset)] = np.asarray(toks)
+        if len(out) == n:
+            break
+    assert len(out) == n, f"served {len(out)}/{n}"
+    dgen.flush_commits()
+    committed = {
+        pt: broker.committed("g", tk.TopicPartition("p", pt)) for pt in (0, 1)
+    }
+    pc.close()
+    dc.close()
+    ho_c.close()
+    return out, committed, dgen, pgen
+
+
+def _assert_identical(a, ca, b, cb):
+    assert set(a) == set(b)
+    for k in a:
+        np.testing.assert_array_equal(b[k], a[k], err_msg=str(k))
+    assert ca == cb
+
+
+class TestDisaggDifferential:
+    def test_greedy_token_exact_no_decode_prefill(self, model):
+        cfg, params = model
+        prompts = _prompts()
+        base, cb, _ = _mono(cfg, params, prompts)
+        got, cg, dgen, pgen = _disagg(cfg, params, prompts)
+        _assert_identical(base, cb, got, cg)
+        # THE disaggregation property: every slot adopted, the decode
+        # server prefilled ZERO prompt tokens.
+        assert dgen.metrics.adopted_slots.count == prompts.shape[0]
+        assert dgen.metrics.prefill_tokens.count == 0
+        assert pgen.metrics.handoffs_published.count == prompts.shape[0]
+        # The prefill worker's radix shares prefixes across prompts just
+        # like a monolithic server's would.
+        assert pgen.metrics.prefix_hits.count > 0
+
+    @pytest.mark.slow
+    def test_seeded_sampling_exact(self, model):
+        cfg, params = model
+        prompts = _prompts(seed=11)
+        kw = dict(temperature=0.9, top_k=16, top_p=0.95,
+                  rng=jax.random.key(3))
+        base, cb, _ = _mono(cfg, params, prompts, **kw)
+        got, cg, dgen, _ = _disagg(cfg, params, prompts, **kw)
+        _assert_identical(base, cb, got, cg)
+        assert dgen.metrics.adopted_slots.count == prompts.shape[0]
+
+    @pytest.mark.slow
+    def test_int8_paged_exact(self, model):
+        """int8 handoffs (4-pool payload+scale payloads) adopt exact vs
+        the int8 monolithic paged server."""
+        cfg, params = model
+        prompts = _prompts(seed=13)
+        base, cb, _ = _mono(cfg, params, prompts, kv_dtype="int8")
+        got, cg, dgen, _ = _disagg(cfg, params, prompts, kv_dtype="int8")
+        _assert_identical(base, cb, got, cg)
+        assert dgen.metrics.adopted_slots.count == prompts.shape[0]
+        assert dgen.metrics.prefill_tokens.count == 0
+
+    def test_prefill_kill_falls_back_and_replays_identically(self, model):
+        """The seeded mid-storm prefill-worker death: unpublished
+        handoffs vanish, routing patience expires, held records fall
+        back to LOCAL prefills — still byte-identical vs monolithic
+        (fallback is the always-correct path), and the whole killed run
+        replays byte-identically (same kill point, same routing
+        decisions, same outputs, same ledger)."""
+        cfg, params = model
+        prompts = _prompts(seed=17)
+        base, cb, _ = _mono(cfg, params, prompts)
+        got1, c1, d1, p1 = _disagg(
+            cfg, params, prompts, kill_prefill_after=1, patience=6,
+        )
+        _assert_identical(base, cb, got1, c1)
+        # The death actually bit: some adopted, some fell back local.
+        assert 0 < d1.metrics.adopted_slots.count < prompts.shape[0]
+        assert d1.metrics.prefill_tokens.count > 0
+        got2, c2, d2, _ = _disagg(
+            cfg, params, prompts, kill_prefill_after=1, patience=6,
+        )
+        _assert_identical(got1, c1, got2, c2)
+        assert (
+            d2.metrics.adopted_slots.count == d1.metrics.adopted_slots.count
+        )
+        assert (
+            d2.metrics.prefill_routed.count == d1.metrics.prefill_routed.count
+        )
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize(
+        "axes", [{"tp": 2}, {"data": 2, "tp": 2}], ids=["tp2", "data2xtp2"]
+    )
+    def test_mesh_disagg_exact(self, mesh_model, axes):
+        """Disaggregation composes with mesh-sharded paged pools: the
+        handoff payload is the (gathered) sharded pool's bytes, adoption
+        scatters them back under the same shardings."""
+        cfg, params = mesh_model
+        prompts = _prompts(8)
+        base, cb, _ = _mono(cfg, params, prompts)
+        got, cg, dgen, _ = _disagg(
+            cfg, params, prompts, mesh=_mesh(axes),
+        )
+        _assert_identical(base, cb, got, cg)
+        assert dgen.metrics.adopted_slots.count == prompts.shape[0]
+
+    @pytest.mark.slow
+    def test_mesh_disagg_smoke(self, mesh_model):
+        """Tier-1 mesh acceptance smoke ({tp:2}; full matrix is slow)."""
+        cfg, params = mesh_model
+        prompts = _prompts(6)
+        base, cb, _ = _mono(cfg, params, prompts)
+        got, cg, dgen, _ = _disagg(cfg, params, prompts,
+                                   mesh=_mesh({"tp": 2}))
+        _assert_identical(base, cb, got, cg)
+        assert dgen.metrics.adopted_slots.count == prompts.shape[0]
+
+
+class TestHandoffPlumbing:
+    def test_wire_roundtrip(self, model):
+        rng = np.random.default_rng(0)
+        hand = PrefillHandoff(
+            topic="p", partition=1, offset=42, crc=12345,
+            key_data=(1, 2, 3, 4), temperature=0.7, top_k=8, top_p=0.9,
+            token0=17, prompt_blocks=2,
+            pools=(
+                rng.random((2, 2, BS, 1, 4), dtype=np.float32),
+                rng.integers(-128, 127, (2, 2, 1, BS, 4), dtype=np.int8),
+            ),
+        )
+        back = decode_handoff(encode_handoff(hand))
+        assert back.key == hand.key and back.token0 == 17
+        assert back.crc == hand.crc and back.key_data == hand.key_data
+        assert (back.temperature, back.top_k, back.top_p) == (0.7, 8, 0.9)
+        for a, b in zip(hand.pools, back.pools):
+            assert a.dtype == b.dtype
+            np.testing.assert_array_equal(a, b)
+
+    def test_stale_handoff_rejected_falls_back(self, model):
+        """A handoff whose CRC does not match the record's payload (topic
+        recreated, corrupted plane) is DISCARDED — the record prefills
+        locally, exact."""
+        cfg, params = model
+        prompts = _prompts(4, seed=23)
+        broker = tk.InMemoryBroker()
+        _fill(broker, prompts)
+        dc = tk.MemoryConsumer(broker, "p", group_id="g")
+        dgen = StreamingGenerator(
+            dc, params, cfg, slots=4, prompt_len=P, max_new=MAX_NEW,
+            commit_every=4, kv_pages=PAGES,
+        )
+        nb_p = (P - 1) // BS + 1
+        bad = {
+            ("p", i % 2, i // 2): PrefillHandoff(
+                "p", i % 2, i // 2, crc=0xDEAD, key_data=(0, 0),
+                temperature=0.0, top_k=None, top_p=None, token0=1,
+                prompt_blocks=nb_p,
+                pools=tuple(
+                    np.zeros((cfg.n_layers, nb_p) + p.shape[2:],
+                             np.dtype(p.dtype))
+                    for p in dgen._caches[:dgen._paged_table_idx]
+                ),
+            )
+            for i in range(4)
+        }
+        dgen.add_prefill_handoffs(bad)
+        out = {}
+        for rec, toks in dgen.run(max_records=4):
+            out[(rec.partition, rec.offset)] = np.asarray(toks)
+        base, _, _ = _mono(cfg, params, prompts)
+        for k in base:
+            np.testing.assert_array_equal(out[k], base[k], err_msg=str(k))
+        assert dgen.metrics.adopted_slots.count == 0
+        assert dgen.metrics.resume_rejected.count == 4
+        dc.close()
+
+    def test_admission_queue_routes_head_of_line(self, model):
+        """The qos hook: a held tenant's FIFO head blocks its queue (per-
+        partition FIFO preserved); release admits in order; other
+        tenants flow meanwhile."""
+        from torchkafka_tpu.source.records import Record
+
+        held = {"a"}
+        qos = QoSConfig()
+        metrics = FleetMetrics()
+        queue = AdmissionQueue(
+            qos, TenantBuckets(qos), metrics,
+            prefill_router=lambda rec: rec.key == b"a" and bool(held),
+        )
+
+        def rec(off, key):
+            return Record(topic="p", partition=0, offset=off, key=key,
+                          value=b"x", timestamp_ms=0, headers=())
+
+        for off, key in enumerate([b"a", b"a", b"b"]):
+            queue.push(rec(off, key))
+        picks = queue.select(3)
+        assert [r.key for r in picks] == [b"b"]  # tenant a held whole
+        held.clear()
+        picks = queue.select(3)
+        assert [(r.key, r.offset) for r in picks] == [(b"a", 0), (b"a", 1)]
+
+    def test_prefill_role_validation(self, model):
+        cfg, params = model
+        broker = tk.InMemoryBroker()
+        broker.create_topic("p", partitions=1)
+        c = tk.MemoryConsumer(broker, "p", group_id="g")
+        with pytest.raises(ValueError, match="prefill_role"):
+            StreamingGenerator(
+                c, params, cfg, slots=2, prompt_len=P, max_new=MAX_NEW,
+                prefill_role=True,
+            )
+        with pytest.raises(ValueError, match="kv_tier requires kv_pages"):
+            StreamingGenerator(
+                c, params, cfg, slots=2, prompt_len=P, max_new=MAX_NEW,
+                kv_tier={"capacity_bytes": 1},
+            )
+        c.close()
+
+    def test_disagg_metrics_on_fleet_exposition(self, model):
+        """The fleet-level aggregation renders the new families on the
+        conformance-shaped exposition."""
+        cfg, params = model
+        prompts = _prompts(6, seed=29)
+        _, _, dgen, _ = _disagg(cfg, params, prompts)
+
+        class _R:  # the FleetMetrics.summary(replicas=) duck shape
+            def __init__(self, gen):
+                self.gen = gen
+
+        m = FleetMetrics()
+        text = m.render_prometheus(replicas=[_R(dgen)])
+        for family in (
+            "adopted_slots_total", "prefill_routed_total",
+            "prefill_handoffs_published_total", "radix_demotions_total",
+            "tier_occupancy_bytes",
+        ):
+            assert f"torchkafka_fleet_{family}" in text, family
+        assert m.summary([_R(dgen)])["disagg"]["adopted_slots"] == 6

@@ -427,3 +427,32 @@ def test_scenario_13_warm_failover_smoke():
     assert out["tokens_restored"] > 0
     assert out["served_from_journal"] > 0
     assert out["resume_rejected"] == 0
+
+
+def test_scenario_21_disaggregated_prefill_kill_storm():
+    """The tier-1 disaggregation smoke: 1 REAL prefill-worker process +
+    2 real decode replicas over the socket broker; the prefill worker is
+    SIGKILLed mid-storm after provably publishing handoffs. Asserts the
+    acceptance contract: zero lost records, every completion (duplicates
+    included) byte-identical to the monolithic paged reference, slots
+    provably ADOPTED before the kill (decode ran no prompt pass for
+    them), routing held records for the transfer plane, local-prefill
+    fallback carried the rest after the death, and the prefill group's
+    watermark never covered an unpublished handoff (the mid-transfer
+    at-least-once window)."""
+    out = run_scenario(21, "tiny")
+    assert out["scenario"] == "21:disaggregated-prefill-kill-storm"
+    assert out["decode_replicas"] == 2 and out["prefill_workers"] == 1
+    assert out["zero_lost"] is True
+    assert out["identical_to_monolithic"] is True
+    assert out["handoffs_published_at_kill"] >= 1
+    # Disaggregation provably engaged before the death...
+    assert out["adopted_slots"] >= 1
+    assert out["prefill_routed"] >= out["adopted_slots"]
+    # ...and the fallback provably carried the storm after it.
+    assert out["decode_fallback_prefill_tokens"] > 0
+    assert out["prefill_watermark_never_past_published"] is True
+    # Decode ticks never stalled waiting on the transfer plane (the
+    # routing hold keeps records QUEUED, not slots idle-blocked).
+    assert out["decode_step_p99_ms"] is not None
+    assert out["decode_step_p99_ms"] < 1000.0

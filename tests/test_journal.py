@@ -353,6 +353,11 @@ class TestCrashPoints:
             "wal_append_mid", "wal_pre_fsync",
             "txn_marker_pre_append", "txn_marker_post_append_pre_ack",
             "recovery_mid_replay",
+            # The disaggregated-prefill windows (ISSUE 14): a prefill
+            # worker dying between filling a prompt's KV and publishing
+            # the handoff, and a decode replica dying between uploading
+            # an adopted payload and activating the slot.
+            "prefill_handoff_pre_publish", "decode_adopt_pre_activate",
         }
 
 

@@ -617,7 +617,23 @@ def _serve_metrics():
         kernel_disabled_reason='auto: backend="cpu" is not tpu',
         chunked=True, data=2, tp=2,
     ))
-    return m.render_prometheus()
+    # ISSUE-14 families: tiered radix cache traffic + disaggregated
+    # prefill routing/adoption counters.
+    m.radix_demotions.add(4)
+    m.radix_promotions.add(3)
+    m.tier_hits.add(3)
+    m.tier_occupancy_bytes.set(8192)
+    m.prefill_routed.add(2)
+    m.adopted_slots.add(2)
+    m.handoffs_published.add(1)
+    text = m.render_prometheus()
+    for family in (
+        "radix_demotions_total", "radix_promotions_total",
+        "tier_hits_total", "tier_occupancy_bytes", "prefill_routed_total",
+        "adopted_slots_total", "prefill_handoffs_published_total",
+    ):
+        assert f"torchkafka_serve_{family}" in text, family
+    return text
 
 
 def _fleet_metrics():

@@ -34,7 +34,13 @@ from torchkafka_tpu.errors import (
     TransactionStateError,
 )
 from torchkafka_tpu.journal import DecodeJournal, JournalEntry
-from torchkafka_tpu.kvcache import KVBackend, PagedKVConfig, resolve_kv_backend
+from torchkafka_tpu.kvcache import (
+    HostTier,
+    KVBackend,
+    PagedKVConfig,
+    TierConfig,
+    resolve_kv_backend,
+)
 from torchkafka_tpu.obs import (
     BurnRateMonitor,
     MetricsExporter,
@@ -95,7 +101,7 @@ from torchkafka_tpu.transform import (
     raw_bytes,
 )
 
-__version__ = "0.16.0"
+__version__ = "0.17.0"
 
 __all__ = [
     "BarrierError",
@@ -115,8 +121,10 @@ __all__ = [
     "FencedMemberError",
     "JournalEntry",
     "JournalLockedError",
+    "HostTier",
     "KVBackend",
     "PagedKVConfig",
+    "TierConfig",
     "resolve_kv_backend",
     "BrokerClient",
     "BrokerServer",

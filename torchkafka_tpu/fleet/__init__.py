@@ -7,6 +7,12 @@ heartbeat leases, zombie fencing, and cross-process warm failover.
 
 from torchkafka_tpu.fleet.fleet import ReplicaChaos, ServingFleet
 from torchkafka_tpu.fleet.metrics import FleetMetrics
+from torchkafka_tpu.fleet.prefill import (
+    PrefillRouter,
+    PrefillWorker,
+    decode_handoff,
+    encode_handoff,
+)
 from torchkafka_tpu.fleet.supervisor import ProcessFleet, sweep_expired
 from torchkafka_tpu.fleet.qos import (
     BATCH,
@@ -25,8 +31,12 @@ __all__ = [
     "BATCH",
     "FleetMetrics",
     "INTERACTIVE",
+    "PrefillRouter",
+    "PrefillWorker",
     "ProcessFleet",
     "QoSConfig",
+    "decode_handoff",
+    "encode_handoff",
     "Replica",
     "ReplicaChaos",
     "ServingFleet",

@@ -130,6 +130,21 @@ class FleetMetrics:
             "deferrals": sum(m.admission_deferrals.count for m in gens),
             "fallbacks": sum(m.cache_fallbacks.count for m in gens),
             "pool_occupancy": round(sum(occ) / len(occ), 3) if occ else 0.0,
+            "tier": {
+                "demotions": sum(m.radix_demotions.count for m in gens),
+                "promotions": sum(m.radix_promotions.count for m in gens),
+                "hits": sum(m.tier_hits.count for m in gens),
+                "occupancy_bytes": int(sum(
+                    m.tier_occupancy_bytes.value for m in gens
+                )),
+            },
+        }
+        disagg = {
+            "prefill_routed": sum(m.prefill_routed.count for m in gens),
+            "adopted_slots": sum(m.adopted_slots.count for m in gens),
+            "handoffs_published": sum(
+                m.handoffs_published.count for m in gens
+            ),
         }
         chunk_ticks = sum(m.chunk_ticks.count for m in gens)
         chunk_prefill_tokens = sum(m.prefill_tokens.count for m in gens)
@@ -193,6 +208,7 @@ class FleetMetrics:
             ),
             "serving": serving,
             "prefix_cache": cache,
+            "disagg": disagg,
             "chunked_prefill": chunked,
             "journal": journal,
             "completions": self.completions.count,
@@ -319,6 +335,15 @@ class FleetMetrics:
             ("admission_deferrals_total", "counter", pc["deferrals"]),
             ("prefix_cache_hit_rate", "gauge", pc["hit_rate"] or 0.0),
             ("kvcache_pool_occupancy", "gauge", pc["pool_occupancy"]),
+            ("radix_demotions_total", "counter", pc["tier"]["demotions"]),
+            ("radix_promotions_total", "counter", pc["tier"]["promotions"]),
+            ("tier_hits_total", "counter", pc["tier"]["hits"]),
+            ("tier_occupancy_bytes", "gauge", pc["tier"]["occupancy_bytes"]),
+            ("prefill_routed_total", "counter",
+             s["disagg"]["prefill_routed"]),
+            ("adopted_slots_total", "counter", s["disagg"]["adopted_slots"]),
+            ("prefill_handoffs_published_total", "counter",
+             s["disagg"]["handoffs_published"]),
         ]
         if self._slo is not None:
             series.extend(self._slo.series())

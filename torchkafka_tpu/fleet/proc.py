@@ -174,6 +174,14 @@ def _dump_metrics(
         "resume_rejected": m.resume_rejected.count,
         "completions": fleet_metrics.completions.count,
         "commit_failures": m.commit_failures.count,
+        # Disaggregated decode: slots admitted by handoff adoption (no
+        # prompt pass here) vs locally prefilled tokens, plus the tick
+        # p50/p99 the "decode ITL never stalls" audit reads.
+        "adopted_slots": m.adopted_slots.count,
+        "prefill_routed": m.prefill_routed.count,
+        "prefill_tokens": m.prefill_tokens.count,
+        "step_p50_ms": m.tick_time.summary()["p50_ms"],
+        "step_p99_ms": m.tick_time.summary()["p99_ms"],
         "circuit_opens": breaker.opens if breaker is not None else 0,
         "circuit_closes": breaker.closes if breaker is not None else 0,
         "heartbeat_outages": hb.outages if hb is not None else 0,
@@ -228,6 +236,8 @@ def run_replica_worker(spec: dict, broker=None, shutdown=None) -> int:
     journal = None
     hb = None
     breaker = None
+    ho_consumer = None
+    router = None
     metrics = FleetMetrics()
     exit_code = EXIT_CLEAN
     try:
@@ -316,8 +326,30 @@ def run_replica_worker(spec: dict, broker=None, shutdown=None) -> int:
             output_producer=producer,
             output_topic=spec["out_topic"],
             exactly_once=exactly_once,
+            kv_pages=spec.get("kv_pages"),
+            kv_tier=spec.get("kv_tier"),
             journal=journal,
         )
+        # Disaggregated decode: tail the handoff topic (broadcast — one
+        # private group per replica) into the generator's shelf, and
+        # route admission through the PrefillRouter so records wait
+        # (bounded) for their prefill worker's filled KV instead of
+        # prefilling locally.
+        handoff_topic = spec.get("handoff_topic")
+        if handoff_topic:
+            from torchkafka_tpu.fleet.prefill import (
+                PrefillRouter,
+                drain_handoffs,
+            )
+
+            ho_consumer = MemoryConsumer(
+                broker, handoff_topic,
+                group_id=f"{spec['group']}-ho-{member}",
+                member_id=member,
+            )
+            router = PrefillRouter(
+                gen, patience=int(spec.get("route_patience", 256)),
+            )
         # Cross-process warm failover, incarnation-start edition: every
         # journal a previous incarnation (own or peer) left in the shared
         # dir becomes a resume hint — CRC-gated at apply, so stale or
@@ -333,7 +365,12 @@ def run_replica_worker(spec: dict, broker=None, shutdown=None) -> int:
                 spec["ready_topic"], member.encode()
             )
         qos = QoSConfig()
-        queue = AdmissionQueue(qos, TenantBuckets(qos), metrics)
+        queue = AdmissionQueue(
+            qos, TenantBuckets(qos), metrics,
+            prefill_router=(
+                router.should_hold if router is not None else None
+            ),
+        )
         rep = Replica(
             int(spec.get("replica_index", 0)), gen, consumer, queue, qos,
             metrics,
@@ -369,6 +406,8 @@ def run_replica_worker(spec: dict, broker=None, shutdown=None) -> int:
             try:
                 if hb is None and hb_interval is not None:
                     consumer.heartbeat()  # loop mode: one renewal per pump
+                if ho_consumer is not None:
+                    drain_handoffs(ho_consumer, gen)
                 assigned = frozenset(consumer.assignment())
                 if assigned != last_assign:
                     if assigned - last_assign:
@@ -433,6 +472,11 @@ def run_replica_worker(spec: dict, broker=None, shutdown=None) -> int:
                 journal.close()  # flush + release the single-writer lock
             except Exception:  # noqa: BLE001 - teardown best-effort
                 pass
+        if ho_consumer is not None:
+            try:
+                ho_consumer.close()
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
         if consumer is not None:
             try:
                 consumer.close()
@@ -463,6 +507,10 @@ def main(argv: list[str]) -> int:
 
     arm_from_env()
     with ShutdownSignal() as stop:
+        if spec.get("role") == "prefill":
+            from torchkafka_tpu.fleet.prefill import run_prefill_worker
+
+            return run_prefill_worker(spec, shutdown=stop)
         return run_replica_worker(spec, shutdown=stop)
 
 

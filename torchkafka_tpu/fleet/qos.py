@@ -163,6 +163,7 @@ class AdmissionQueue:
         replica: int | None = None,
         overload: Callable[[str, str], bool] | None = None,
         on_overload_defer: Callable[[str, int], None] | None = None,
+        prefill_router: Callable[[Record], bool] | None = None,
     ) -> None:
         self._cfg = cfg
         self._buckets = buckets
@@ -181,6 +182,16 @@ class AdmissionQueue:
         # reports each deferral decision for goodput accounting.
         self._overload = overload
         self._on_overload_defer = on_overload_defer
+        # Disaggregated-prefill routing (fleet/prefill.py PrefillRouter
+        # .should_hold): the shedding hook re-aimed as a ROUTING
+        # decision — ``prefill_router(record) -> True`` keeps the
+        # tenant's head-of-line record QUEUED this sweep because its
+        # filled-KV handoff is still in flight from a prefill worker;
+        # the router releases it on handoff arrival (adoption) or when
+        # its patience expires (local-prefill fallback). Hold, never
+        # drop: the watermark stalls below held records exactly like
+        # throttles and burn deferrals.
+        self._prefill_router = prefill_router
         # lane -> tenant -> deque[(record, enqueue_time)]
         self._q: dict[str, dict[str, deque]] = {INTERACTIVE: {}, BATCH: {}}
         self._rr: dict[str, int] = {INTERACTIVE: 0, BATCH: 0}
@@ -258,6 +269,13 @@ class AdmissionQueue:
                         self._metrics.tenant_deferred(tenant).add(1)
                         if self._on_overload_defer is not None:
                             self._on_overload_defer(tenant, 1)
+                        continue
+                    if self._prefill_router is not None and \
+                            self._prefill_router(q[0][0]):
+                        # Handoff still in flight: the tenant's FIFO
+                        # head waits for its prefill worker (admitting
+                        # records BEHIND it would break per-partition
+                        # FIFO, so the whole tenant queue holds).
                         continue
                     if not self._buckets.try_acquire(tenant):
                         # Out of tokens: the record stays queued (and the

@@ -95,6 +95,7 @@ class _Incarnation:
     journal_path: str
     log_path: str
     metrics_path: str
+    role: str = "decode"
     state: str = LIVE
     seen_in_group: bool = False
     exit_code: int | None = None
@@ -145,6 +146,11 @@ class ProcessFleet:
         respawn: bool = True,
         journal: bool = True,
         exactly_once: bool = False,
+        prefill_replicas: int = 0,
+        handoff_topic: str = "fleet-handoff",
+        kv_pages: dict | None = None,
+        kv_tier: dict | None = None,
+        route_patience: int = 256,
         wal_dir: str | os.PathLike | None = None,
         wal_durability: str | None = "batch",
         resilient: bool = False,
@@ -184,12 +190,24 @@ class ProcessFleet:
         # against identical topics/offsets/generations/producer epochs.
         self.wal_dir = None if wal_dir is None else os.fspath(wal_dir)
         self.wal_durability = wal_durability
+        # Disaggregated prefill (fleet/prefill.py): ``prefill_replicas``
+        # dedicated workers in their own consumer group fill paged KV
+        # and publish handoffs on ``handoff_topic``; decode replicas
+        # route admission through the handoff shelf (bounded patience →
+        # local-prefill fallback). Requires ``kv_pages``.
+        self.prefill_replicas = prefill_replicas
+        self.handoff_topic = handoff_topic if prefill_replicas else None
+        if prefill_replicas and kv_pages is None:
+            raise ValueError(
+                "prefill_replicas requires kv_pages (the handoff carries "
+                "paged KV blocks)"
+            )
         self.broker = broker if broker is not None else InMemoryBroker(
             session_timeout_s=session_timeout_s,
             wal_dir=self.wal_dir, wal_durability=wal_durability,
         )
         for t, p in ((topic, partitions), (out_topic, 1),
-                     (ready_topic, 1)):
+                     (ready_topic, 1), (self.handoff_topic, 1)):
             if t is None or p is None:
                 continue
             try:
@@ -226,24 +244,32 @@ class ProcessFleet:
             "resilient": resilient,
             "reconnect_attempts": reconnect_attempts,
             "reconnect_deadline_s": reconnect_deadline_s,
+            "kv_pages": kv_pages,
+            "kv_tier": kv_tier,
+            "handoff_topic": self.handoff_topic,
+            "route_patience": route_patience,
         }
         self.incarnations: list[_Incarnation] = []
         self.victims: list[dict] = []  # kill_replica forensics
 
     # ------------------------------------------------------------ spawning
 
-    def _spawn(self, idx: int) -> _Incarnation:
+    def _spawn(self, idx: int, role: str = "decode") -> _Incarnation:
         # Member ids sort by replica INDEX first (r0i* < r1i* < ...), and
         # the broker range-assigns over sorted member ids — so a
         # respawned incarnation slots into its predecessor's position and
         # inherits the same partition range. That bias is what makes the
         # victim's journal (and its radix prefix locality) land where the
-        # redelivered prompts do.
-        member = f"r{idx:03d}i{self._seq:03d}"  # zero-padded: lexicographic
+        # redelivered prompts do. Prefill workers ("q" prefix) live in
+        # their OWN consumer group, so the prefix only has to be
+        # distinct, not ordered against decode members.
+        prefix = "r" if role == "decode" else "q"
+        member = f"{prefix}{idx:03d}i{self._seq:03d}"  # zero-padded
         self._seq += 1                          # order == numeric order
         spec = dict(self._spec_base)
         spec["member_id"] = member
         spec["replica_index"] = idx
+        spec["role"] = role
         spec["metrics_path"] = os.path.join(
             self.workdir, f"{member}.metrics.json"
         )
@@ -277,6 +303,7 @@ class ProcessFleet:
             journal_path=os.path.join(spec["journal_dir"], f"{member}.json"),
             log_path=log_path,
             metrics_path=spec["metrics_path"],
+            role=role,
         )
         self.incarnations.append(inc)
         self.metrics.replica_joins.add(1)
@@ -287,6 +314,8 @@ class ProcessFleet:
     def start(self) -> "ProcessFleet":
         for idx in range(self._target):
             self._spawn(idx)
+        for idx in range(self.prefill_replicas):
+            self._spawn(idx, role="prefill")
         return self
 
     def wait_ready(self, timeout_s: float = 120.0) -> None:
@@ -326,33 +355,53 @@ class ProcessFleet:
 
     # ---------------------------------------------------------- liveness
 
-    def live(self) -> list[_Incarnation]:
-        return [i for i in self.incarnations if i.state in (LIVE, DRAINING)]
+    def live(self, role: str = "decode") -> list[_Incarnation]:
+        return [
+            i for i in self.incarnations
+            if i.state in (LIVE, DRAINING) and i.role == role
+        ]
+
+    def _group_of(self, inc: _Incarnation) -> str:
+        return (
+            self.group if inc.role == "decode"
+            else f"{self.group}-prefill"
+        )
 
     def poll_once(self) -> None:
-        """One supervision round: sweep expired leases (fencing), update
-        lease-age gauges, reap exited children, observe broker-side
-        fencings of still-running processes (stalled zombies), trigger
-        journal-handoff accounting, and respawn toward the target."""
-        info = self.broker.membership(self.group)
-        timeout = info["session_timeout_s"]
-        for member, remaining in info["leases"].items():
-            if remaining is not None and timeout is not None:
-                self.metrics.member_lease_age(member).set(
-                    max(0.0, timeout - remaining)
-                )
-        swept = sweep_expired(
-            self.broker, self.group,
-            on_fence=lambda member, age: self._note_fence(
-                member, "lease_expired", age
-            ),
-        )
-        if swept:
-            info = self.broker.membership(self.group)
-        fenced_members = set(info["fenced"])
+        """One supervision round: sweep expired leases (fencing) in the
+        decode AND prefill groups, update lease-age gauges, reap exited
+        children, observe broker-side fencings of still-running
+        processes (stalled zombies), trigger journal-handoff accounting,
+        and respawn toward the per-role targets."""
+        groups = [self.group]
+        if self.prefill_replicas:
+            groups.append(f"{self.group}-prefill")
+        infos: dict[str, dict] = {}
+        for group in groups:
+            info = self.broker.membership(group)
+            timeout = info["session_timeout_s"]
+            for member, remaining in info["leases"].items():
+                if remaining is not None and timeout is not None:
+                    self.metrics.member_lease_age(member).set(
+                        max(0.0, timeout - remaining)
+                    )
+            swept = sweep_expired(
+                self.broker, group,
+                on_fence=lambda member, age: self._note_fence(
+                    member, "lease_expired", age
+                ),
+            )
+            if swept:
+                info = self.broker.membership(group)
+            infos[group] = info
         for inc in self.incarnations:
             if inc.state not in (LIVE, DRAINING, ZOMBIE):
                 continue
+            info = infos.get(self._group_of(inc))
+            if info is None:
+                info = self.broker.membership(self._group_of(inc))
+                infos[self._group_of(inc)] = info
+            fenced_members = set(info["fenced"])
             if inc.member in info["members"]:
                 inc.seen_in_group = True
             if inc.proc is not None and inc.proc.poll() is not None:
@@ -367,7 +416,7 @@ class ProcessFleet:
                     was = inc.state
                     inc.state = DEAD
                     if inc.member not in fenced_members:
-                        self.broker.fence(self.group, inc.member)
+                        self.broker.fence(self._group_of(inc), inc.member)
                     if was != ZOMBIE and inc.fence_reason is None:
                         self._note_fence(
                             inc.member,
@@ -421,7 +470,7 @@ class ProcessFleet:
         replacement incarnation happens to re-initialize the id; with
         ``respawn=False`` that is never. Ordered BEFORE any respawn, so
         the replacement's own init lands a newer epoch on top."""
-        if not self.exactly_once:
+        if not self.exactly_once or inc.role != "decode":
             return
         try:
             self.broker.init_producer_id(self._txn_id(inc.idx))
@@ -443,6 +492,8 @@ class ProcessFleet:
         they rescan the shared journal dir when the rebalance changes
         their assignment; the supervisor only narrates what disk state
         the death left for them."""
+        if inc.role != "decode":
+            return  # prefill workers hold no decode journal
         entries = len(DecodeJournal.load(inc.journal_path))
         inc.handoff_entries = entries
         if entries:
@@ -455,13 +506,16 @@ class ProcessFleet:
     def _maybe_respawn(self, dead: _Incarnation) -> None:
         if not self.respawn:
             return
-        alive = len(self.live())
-        if alive < self._target:
+        alive = len(self.live(dead.role))
+        target = (
+            self._target if dead.role == "decode" else self.prefill_replicas
+        )
+        if alive < target:
             _logger.info(
-                "respawning replica %d (member %s %s)",
-                dead.idx, dead.member, dead.state,
+                "respawning %s replica %d (member %s %s)",
+                dead.role, dead.idx, dead.member, dead.state,
             )
-            self._spawn(dead.idx)
+            self._spawn(dead.idx, role=dead.role)
 
     # ----------------------------------------------------------- control
 
@@ -475,6 +529,7 @@ class ProcessFleet:
         victims = [
             i for i in self.incarnations
             if i.idx == idx and i.state in (LIVE, DRAINING) and i.running
+            and i.role == "decode"
         ]
         if not victims:
             raise ValueError(f"no live process for replica {idx}")
@@ -485,6 +540,32 @@ class ProcessFleet:
         forensics = {
             "member": inc.member, "idx": idx, "generation": generation,
             "journal_path": inc.journal_path,
+        }
+        self.victims.append(forensics)
+        return forensics
+
+    def kill_prefill(self, idx: int = 0) -> dict:
+        """SIGKILL the newest live prefill-worker incarnation of index
+        ``idx`` — the mid-storm disaggregation drill: unpublished
+        handoffs vanish with the process, decode replicas' routing
+        patience expires and they fall back to local prefills, and (with
+        ``respawn=True``) a fresh prefill incarnation re-serves the
+        prefill group's uncommitted prompts. Zero decode-path loss by
+        construction: the decode group's ledger never depended on a
+        handoff existing."""
+        victims = [
+            i for i in self.incarnations
+            if i.idx == idx and i.state in (LIVE, DRAINING) and i.running
+            and i.role == "prefill"
+        ]
+        if not victims:
+            raise ValueError(f"no live process for prefill worker {idx}")
+        inc = victims[-1]
+        inc.proc.send_signal(signal.SIGKILL)
+        inc.proc.wait()
+        forensics = {
+            "member": inc.member, "idx": idx, "role": "prefill",
+            "log_path": inc.log_path,
         }
         self.victims.append(forensics)
         return forensics
@@ -575,12 +656,14 @@ class ProcessFleet:
         self._target = n
 
     def drain(self) -> None:
-        """SIGTERM every live worker: fleet-wide cooperative drain."""
-        for inc in self.live():
+        """SIGTERM every live worker (prefill included): fleet-wide
+        cooperative drain."""
+        for inc in self.live() + self.live("prefill"):
             if inc.running:
                 inc.proc.send_signal(signal.SIGTERM)
             inc.state = DRAINING
         self._target = 0
+        self.prefill_replicas = 0
 
     def wait(
         self,

@@ -2479,6 +2479,162 @@ def scenario_20(size: str = "tiny", replicas: int = 2) -> dict:
     }
 
 
+def scenario_21(size: str = "tiny", replicas: int = 2) -> dict:
+    """Disaggregated serving under prefill-worker death (fleet/prefill):
+    1 PREFILL worker + R decode replicas as REAL OS processes over the
+    socket broker — the prefill worker consumes the prompt topic in its
+    own group, fills paged KV, and publishes handoffs; decode replicas
+    route admission through the handoff shelf and ADOPT (no prompt pass
+    on the decode path). Mid-storm the prefill worker is SIGKILLed:
+    unpublished handoffs vanish, the decode replicas' routing patience
+    expires and they fall back to local prefills — the optimization
+    degrades, correctness does not. Audited: zero lost records, every
+    completion byte-identical to an in-process monolithic paged
+    reference, adoptions provably happened before the kill, decode tick
+    time never stalled (p99 reported from worker metric dumps), and the
+    prefill group's offsets never covered an unpublished handoff."""
+    import tempfile
+    import time as _time
+
+    import torchkafka_tpu as tk
+    from torchkafka_tpu.fleet import ProcessFleet
+    from torchkafka_tpu.serve import StreamingGenerator
+    from torchkafka_tpu.source.records import TopicPartition
+
+    prompt_len, max_new = (8, 16) if size == "tiny" else (32, 32)
+    n = 24 if size == "tiny" else 64  # 4x oversubscription of 2x2 slots
+    parts, slots, commit_every = 4, 2, 4
+    pages = {"block_size": 4, "num_blocks": 64}
+    cfg, params, label = _serving_model(size, None, prompt_len, max_new)
+    model_spec = dict(
+        seed=0, vocab_size=cfg.vocab_size, d_model=cfg.d_model,
+        n_layers=cfg.n_layers, n_heads=cfg.n_heads,
+        n_kv_heads=cfg.n_kv_heads, d_ff=cfg.d_ff,
+        max_seq_len=cfg.max_seq_len,
+    )
+    rng = np.random.default_rng(21)
+    prompts = rng.integers(0, cfg.vocab_size, (n, prompt_len),
+                           dtype=np.int32)
+    prompts[:, :4] = np.arange(4)  # shared system prefix (radix shape)
+
+    # In-process monolithic paged reference: byte-truth for the fleet.
+    rb = tk.InMemoryBroker()
+    rb.create_topic("t21", partitions=parts)
+    for i in range(n):
+        rb.produce("t21", prompts[i].tobytes(), partition=i % parts,
+                   key=str(i).encode())
+    rc = tk.MemoryConsumer(rb, "t21", group_id="ref21")
+    ref_gen = StreamingGenerator(
+        rc, params, cfg, slots=slots, prompt_len=prompt_len,
+        max_new=max_new, commit_every=commit_every, ticks_per_sync=1,
+        kv_pages=dict(pages),
+    )
+    ref = {rec.key: toks for rec, toks in ref_gen.run(idle_timeout_ms=400)}
+    rc.close()
+
+    t0 = _time.perf_counter()
+    with tempfile.TemporaryDirectory() as td:
+        fleet = ProcessFleet(
+            model_spec, topic="t21", prompt_len=prompt_len,
+            max_new=max_new, workdir=td, replicas=replicas,
+            partitions=parts, slots=slots, commit_every=commit_every,
+            session_timeout_s=5.0, heartbeat_interval_s=0.2,
+            journal_cadence=2, respawn=False, group="s21",
+            kv_pages=pages, prefill_replicas=1, route_patience=1500,
+        )
+        try:
+            fleet.start()
+            fleet.wait_ready(timeout_s=300)
+            ready_s = _time.perf_counter() - t0
+            for i in range(n):
+                fleet.broker.produce(
+                    "t21", prompts[i].tobytes(), partition=i % parts,
+                    key=str(i).encode(),
+                )
+            ho_tp = TopicPartition(fleet.handoff_topic, 0)
+
+            # SIGKILL the prefill worker MID-storm: after some handoffs
+            # are provably on the transfer plane, before all are.
+            deadline = _time.monotonic() + 240
+            while True:
+                published = fleet.broker.end_offset(ho_tp)
+                if published >= 6:
+                    break
+                if _time.monotonic() > deadline:
+                    raise TimeoutError(
+                        "no handoffs ever published\n" + fleet.diagnose()
+                    )
+                _time.sleep(0.005)
+            victim = fleet.kill_prefill(0)
+            published_at_kill = fleet.broker.end_offset(ho_tp)
+
+            fleet.wait(
+                lambda f: set(f.results())
+                == {str(i).encode() for i in range(n)},
+                timeout_s=240,
+            )
+            fleet.drain()
+            fleet.wait(
+                lambda f: all(not i.running for i in f.incarnations),
+                timeout_s=120,
+            )
+            fleet.poll_once()
+            zero_lost = fleet.fully_committed()
+
+            res = fleet.results()
+            duplicates = sum(len(v) - 1 for v in res.values())
+            identical = set(res) == set(ref) and all(
+                np.array_equal(toks, ref[k])
+                for k, copies in res.items() for _m, toks in copies
+            )
+            # The prefill group never committed past its published
+            # handoffs (the mid-transfer at-least-once contract).
+            published_keys = {
+                r.key for r in fleet.broker.fetch(ho_tp, 0, 100000)
+            }
+            prefill_wm_ok = True
+            for p in range(parts):
+                tp = TopicPartition("t21", p)
+                wm = fleet.broker.committed("s21-prefill", tp) or 0
+                for off in range(wm):
+                    if str(off * parts + p).encode() not in published_keys:
+                        prefill_wm_ok = False
+            decode_m = [
+                m for m in fleet.worker_metrics()
+                if m.get("role") != "prefill"
+            ]
+            adopted = sum(m.get("adopted_slots", 0) for m in decode_m)
+            routed = sum(m.get("prefill_routed", 0) for m in decode_m)
+            fallback_tokens = sum(
+                m.get("prefill_tokens", 0) for m in decode_m
+            )
+            step_p99 = max(
+                (m.get("step_p99_ms") or 0.0) for m in decode_m
+            ) if decode_m else None
+            elapsed = _time.perf_counter() - t0
+        finally:
+            fleet.close()
+    return {
+        "scenario": "21:disaggregated-prefill-kill-storm",
+        "model_scale": label,
+        "decode_replicas": replicas,
+        "prefill_workers": 1,
+        "records": n,
+        "ready_s": round(ready_s, 2),
+        "elapsed_s": round(elapsed, 2),
+        "victim": victim["member"],
+        "handoffs_published_at_kill": int(published_at_kill),
+        "zero_lost": zero_lost,
+        "identical_to_monolithic": identical,
+        "duplicates": duplicates,
+        "adopted_slots": adopted,
+        "prefill_routed": routed,
+        "decode_fallback_prefill_tokens": fallback_tokens,
+        "decode_step_p99_ms": step_p99,
+        "prefill_watermark_never_past_published": prefill_wm_ok,
+    }
+
+
 def scenario_8(size: str = "tiny") -> dict:
     """Streaming CTR: DLRM-style recommender trained from a Kafka event
     stream — label + dense features + hashed categorical ids per record,
@@ -2854,6 +3010,7 @@ SCENARIOS = {
     18: scenario_18,
     19: scenario_19,
     20: scenario_20,
+    21: scenario_21,
 }
 
 
@@ -2902,7 +3059,7 @@ def run_scenario(
         )
     sample_kw = dict(temperature=temperature, top_k=top_k, top_p=top_p)
     spec_kw = dict(spec=spec, spec_k=spec_k, spec_draft_layers=spec_draft_layers)
-    if num in (10, 11, 12, 13, 15, 16, 17, 18, 19, 20):
+    if num in (10, 11, 12, 13, 15, 16, 17, 18, 19, 20, 21):
         return SCENARIOS[num](size, replicas=replicas)
     if model_scale is not None:
         if num not in (5, 7):

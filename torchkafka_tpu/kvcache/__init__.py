@@ -23,13 +23,16 @@ from torchkafka_tpu.kvcache.blocks import (
     PagedKVConfig,
 )
 from torchkafka_tpu.kvcache.radix import RadixCache
+from torchkafka_tpu.kvcache.tier import HostTier, TierConfig
 
 __all__ = [
     "BlockAllocator",
+    "HostTier",
     "KVBackend",
     "KV_KERNEL_AUTO_MIN_POOL",
     "PagedKVConfig",
     "RadixCache",
     "SINK_BLOCK",
+    "TierConfig",
     "resolve_kv_backend",
 ]

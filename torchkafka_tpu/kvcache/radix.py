@@ -52,14 +52,39 @@ class RadixCache:
     leaves whose blocks carry no reference beyond the tree's own.
     """
 
-    def __init__(self, allocator: BlockAllocator, block_size: int) -> None:
+    def __init__(self, allocator: BlockAllocator, block_size: int, *,
+                 tier=None, read_block=None, write_block=None) -> None:
+        """``tier``/``read_block``/``write_block``: the host-RAM tier
+        (kvcache/tier.py ``HostTier``) plus the pool I/O the server
+        supplies — ``read_block(block_id) -> payload`` fetches a block's
+        KV bytes to host (demotion source), ``write_block(block_id,
+        payload)`` scatters them back (promotion sink). With a tier,
+        ``evict`` DEMOTES unreferenced leaves instead of just freeing
+        them, and ``match`` PROMOTES tier entries that extend a prefix
+        walk into freshly allocated blocks — so a "miss" against the
+        in-HBM tree can still be a hit against host memory. Both sides
+        stay advisory: a tier miss (or a promotion that finds no free
+        block) simply re-prefills, exactly like eviction always did."""
         if block_size < 1:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
+        if tier is not None and (read_block is None or write_block is None):
+            raise ValueError(
+                "a tier needs read_block and write_block (the pool I/O "
+                "that moves payloads between HBM and the tier)"
+            )
         self._alloc = allocator
         self._bs = block_size
+        self._tier = tier
+        self._read_block = read_block
+        self._write_block = write_block
         self._root = _Node((), -1, None)
         self._clock = 0
         self.cached_blocks = 0
+        # Tier traffic counters (the server mirrors them onto
+        # ServeMetrics after each admission/eviction sweep).
+        self.demotions = 0
+        self.promotions = 0
+        self.tier_hits = 0
 
     # ------------------------------------------------------------- helpers
 
@@ -82,22 +107,65 @@ class RadixCache:
 
     # ----------------------------------------------------------------- api
 
+    @staticmethod
+    def _prefix_key(chunks: list[tuple]) -> bytes:
+        """Tier key for the prefix spelled by ``chunks`` (root→node token
+        path as int32 bytes) — physical ids are meaningless across
+        demote/promote cycles, token prefixes are not."""
+        import numpy as np
+
+        return np.asarray(
+            [t for c in chunks for t in c], np.int32
+        ).tobytes()
+
+    def _node_key(self, node: _Node) -> bytes:
+        chunks = []
+        while node is not self._root:
+            chunks.append(node.chunk)
+            node = node.parent
+        return self._prefix_key(chunks[::-1])
+
     def match(self, tokens) -> list[int]:
         """Longest cached whole-block prefix of ``tokens`` (capped at
         ``matchable_blocks``) → physical block ids in logical order.
         Takes one SLOT reference per returned block (caller decrefs when
-        the slot retires) and refreshes the path's LRU stamps."""
+        the slot retires) and refreshes the path's LRU stamps.
+
+        With a tier attached, a walk that falls off the in-HBM tree
+        keeps going against the tier: each matching tier entry is
+        PROMOTED — a fresh block allocated (never evicting: promotion
+        under pool pressure just stops, the prefix re-prefills), the
+        payload scattered back via ``write_block``, and a node inserted
+        holding the tree's reference — before the walk continues. The
+        promoted bytes are exactly the demoted bytes, which are exactly
+        what a re-prefill would compute, so serving stays token-exact
+        whether this returns a block or not."""
         stamp = self._tick()
         cap = self.matchable_blocks(len(tokens), self._bs)
         node = self._root
         out: list[int] = []
+        path: list[tuple] = []
         for chunk in self._chunks(tokens, cap):
             child = node.children.get(chunk)
+            if child is None and self._tier is not None:
+                key = self._prefix_key(path + [chunk])
+                if self._tier.contains(key):
+                    blk = self._alloc.alloc(1)
+                    if blk is None:
+                        break  # pool pressure: stop promoting, re-prefill
+                    payload = self._tier.take(key)
+                    self._write_block(blk[0], payload)
+                    child = _Node(chunk, blk[0], node)
+                    node.children[chunk] = child
+                    self.cached_blocks += 1
+                    self.promotions += 1
+                    self.tier_hits += 1
             if child is None:
                 break
             child.stamp = stamp
             out.append(child.block)
             node = child
+            path.append(chunk)
         if out:
             self._alloc.incref(out)
         return out
@@ -159,6 +227,16 @@ class RadixCache:
             while victim is not None and freed < n_blocks:
                 parent = victim.parent
                 assert parent is not None
+                if self._tier is not None:
+                    # DEMOTE before freeing: the block's bytes are valid
+                    # until a later alloc rewrites them, so the host copy
+                    # taken here is exact. The tier's own LRU/spill
+                    # policy decides how long the prefix survives.
+                    self._tier.put(
+                        self._node_key(victim),
+                        self._read_block(victim.block),
+                    )
+                    self.demotions += 1
                 del parent.children[victim.chunk]
                 self._alloc.decref([victim.block])
                 self.cached_blocks -= 1

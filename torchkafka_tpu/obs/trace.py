@@ -67,6 +67,13 @@ DROPPED = "dropped"
 # the trace stream and /metrics instead of stderr only). Not part of
 # the happy lifecycle: the record stays open/unresolved.
 DLQ_FAILED = "dlq_failed"
+# Disaggregated prefill (fleet/prefill.py + serve.py adoption): a
+# PREFILL worker published the record's filled-KV handoff onto the
+# transfer plane, and a DECODE replica adopted it into a slot without
+# running a prompt pass. Together with PREFILL_QUEUED these spell the
+# disaggregated admission lifecycle: prefill_queued → handoff → adopted.
+PREFILL_HANDOFF = "handoff"
+SLOT_ADOPTED = "adopted"
 # Not a record stage: a BurnRateMonitor state transition, riding the
 # same event stream (topic "slo", offset = transition sequence) so
 # overload state changes land in the trace, ordered against the record
@@ -89,8 +96,8 @@ BROKER_RESTARTED = "broker_restarted"
 STAGES = (
     POLLED, QOS_ADMITTED, DEFERRED, PREFILL_QUEUED, CHUNK_SCHEDULED,
     WARM_RESUMED, SLOT_ACTIVE, TOKENS, FINISHED, JOURNAL_SERVED, COMMITTED,
-    QUARANTINED, DROPPED, DLQ_FAILED, BURN_STATE, REPLICA_JOINED,
-    REPLICA_FENCED, JOURNAL_HANDOFF,
+    QUARANTINED, DROPPED, DLQ_FAILED, PREFILL_HANDOFF, SLOT_ADOPTED,
+    BURN_STATE, REPLICA_JOINED, REPLICA_FENCED, JOURNAL_HANDOFF,
 )
 
 
@@ -359,6 +366,22 @@ class RecordTracer:
     def chunk_scheduled(self, rec: Record, replica=None) -> None:
         with self._lock:
             self._emit(CHUNK_SCHEDULED, rec.topic, rec.partition, rec.offset,
+                       (("replica", replica),))
+
+    def prefill_handoff(self, rec: Record, blocks: int, replica=None) -> None:
+        """A PREFILL worker published this record's filled-KV handoff on
+        the transfer plane (``blocks`` prompt blocks of payload)."""
+        with self._lock:
+            self._emit(PREFILL_HANDOFF, rec.topic, rec.partition, rec.offset, (
+                ("blocks", blocks), ("replica", replica),
+            ))
+
+    def adopted(self, rec: Record, replica=None) -> None:
+        """A DECODE replica adopted this record's handoff into a slot —
+        no prompt pass ran here; the follow-up ``slot_active`` closes
+        TTFT as usual (the first token genuinely exists now)."""
+        with self._lock:
+            self._emit(SLOT_ADOPTED, rec.topic, rec.partition, rec.offset,
                        (("replica", replica),))
 
     def warm_resumed(self, rec: Record, tokens_restored: int,

@@ -79,6 +79,18 @@ recovery_mid_replay        the recovering broker dies mid-way through its
                            own WAL replay — replay is read-only until it
                            completes, so a second recovery must reproduce
                            the identical state
+prefill_handoff_pre_publish a disaggregated PREFILL worker filled a
+                           prompt's KV blocks but dies before publishing
+                           the handoff — no decode replica ever sees it;
+                           the prompt must fall back to a local prefill
+                           (at-least-once; exactly-once mode: committed
+                           duplicates stay 0) and the prefill group's
+                           offset must re-deliver to the next incarnation
+decode_adopt_pre_activate  a decode replica uploaded an adopted handoff's
+                           KV payload into its pool but dies before
+                           activating the slot — the record was never
+                           emitted to the ledger, so it re-delivers and
+                           re-adopts (or re-prefills) byte-identically
 ========================== =================================================
 
 Sites call ``crash_hook("<name>")``; production cost is one global ``is
@@ -125,6 +137,8 @@ REGISTERED_CRASH_POINTS: tuple[str, ...] = (
     "txn_marker_pre_append",
     "txn_marker_post_append_pre_ack",
     "recovery_mid_replay",
+    "prefill_handoff_pre_publish",
+    "decode_adopt_pre_activate",
 )
 
 ENV_VAR = "TORCHKAFKA_CRASHPOINT"

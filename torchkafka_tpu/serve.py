@@ -32,6 +32,7 @@ generalised to out-of-order completions via the OffsetLedger.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import logging
 import time
@@ -55,9 +56,11 @@ from torchkafka_tpu.journal import DecodeJournal, JournalEntry, value_crc
 from torchkafka_tpu.kvcache import (
     SINK_BLOCK,
     BlockAllocator,
+    HostTier,
     KVBackend,
     PagedKVConfig,
     RadixCache,
+    TierConfig,
     resolve_kv_backend,
 )
 from torchkafka_tpu.resilience.crashpoint import crash_hook
@@ -289,6 +292,22 @@ class ServeMetrics:
         # pressure (records re-offered FIFO once blocks free)
         self.cache_fallbacks = RateMeter()  # paged → dense cache-off fallbacks
         self.cache_pool_occupancy = Gauge()  # allocated / usable blocks
+        # Tiered radix cache (kv_tier=, kvcache/tier.py): cold prefix
+        # blocks demoted to host RAM instead of freed, promoted back on
+        # radix hit. All zero without a tier.
+        self.radix_demotions = RateMeter()  # blocks demoted HBM → host tier
+        self.radix_promotions = RateMeter()  # blocks promoted tier → HBM
+        self.tier_hits = RateMeter()  # prefix walks extended by the tier
+        self.tier_occupancy_bytes = Gauge()  # host-RAM tier payload bytes
+        # Disaggregated prefill (fleet/prefill.py): records held for a
+        # prefill-worker handoff and slots admitted by adopting one
+        # (decode never ran the prompt pass). All zero in monolithic
+        # serving.
+        self.prefill_routed = RateMeter()  # records first held awaiting a
+        # handoff (the admission-queue routing decision)
+        self.adopted_slots = RateMeter()  # slots filled by handoff adoption
+        self.handoffs_published = RateMeter()  # prefill-role only: filled-KV
+        # handoffs published onto the transfer plane
         # Chunked prefill (kv_pages with prefill_chunk != 0): admission
         # enqueues uncached suffixes and every tick carries a bounded
         # chunk of them alongside decode. All zero in legacy/dense modes.
@@ -399,6 +418,7 @@ class ServeMetrics:
             "output_capped": self.output_capped.count,
             "prefix_cache": self.cache_summary(),
             "tenant_cache": self.tenant_cache_summary(),
+            "disagg": self.disagg_summary(),
             "chunked_prefill": self.chunk_summary(),
             "journal": self.journal_summary(),
             "kv_backend": {
@@ -443,6 +463,19 @@ class ServeMetrics:
             "deferrals": self.admission_deferrals.count,
             "fallbacks": self.cache_fallbacks.count,
             "pool_occupancy": round(self.cache_pool_occupancy.value, 3),
+            "tier": {
+                "demotions": self.radix_demotions.count,
+                "promotions": self.radix_promotions.count,
+                "hits": self.tier_hits.count,
+                "occupancy_bytes": int(self.tier_occupancy_bytes.value),
+            },
+        }
+
+    def disagg_summary(self) -> dict:
+        return {
+            "prefill_routed": self.prefill_routed.count,
+            "adopted_slots": self.adopted_slots.count,
+            "handoffs_published": self.handoffs_published.count,
         }
 
     def render_prometheus(self, prefix: str = "torchkafka_serve") -> str:
@@ -528,6 +561,14 @@ class ServeMetrics:
             ("kvcache_fallbacks_total", "counter", pc["fallbacks"]),
             ("prefix_cache_hit_rate", "gauge", pc["hit_rate"] or 0.0),
             ("kvcache_pool_occupancy", "gauge", pc["pool_occupancy"]),
+            ("radix_demotions_total", "counter", pc["tier"]["demotions"]),
+            ("radix_promotions_total", "counter", pc["tier"]["promotions"]),
+            ("tier_hits_total", "counter", pc["tier"]["hits"]),
+            ("tier_occupancy_bytes", "gauge", pc["tier"]["occupancy_bytes"]),
+            ("prefill_routed_total", "counter", s["disagg"]["prefill_routed"]),
+            ("adopted_slots_total", "counter", s["disagg"]["adopted_slots"]),
+            ("prefill_handoffs_published_total", "counter",
+             s["disagg"]["handoffs_published"]),
         ])
 
 
@@ -579,6 +620,52 @@ class _PendingPrefill:
         self.key_np = key_np
         self.resume = resume
         self.enq_tick = enq_tick
+
+
+@dataclasses.dataclass
+class PrefillHandoff:
+    """One prompt's filled-KV transfer unit (disaggregated prefill).
+
+    A PREFILL worker (``prefill_role=True``) runs the normal chunked-
+    prefill machinery to fill a slot's prompt blocks, samples token 0
+    in-dispatch with the standard per-record key discipline, then
+    extracts this — record identity + payload CRC (so a handoff can
+    never adopt onto a different record), the sampling contract, the
+    per-record RNG key, token 0, and the raw per-pool payload bytes of
+    the ``prompt_blocks`` blocks covering positions [0, prompt_len) —
+    and publishes it on the transfer plane (a broker topic;
+    fleet/prefill.py owns the wire encoding). A DECODE replica ADOPTS
+    it: payloads scattered into freshly linked pool blocks (radix-
+    matched prefix blocks skip the upload — they already hold the
+    identical bytes), state merged exactly like a 1-token journal warm
+    resume — no prompt pass ever runs on the decode replica, and the
+    continuation is bitwise the run a monolithic server would produce
+    (the chunk machinery's chunk-width invariance is what makes the
+    worker's KV bytes equal the local prefill's).
+
+    ``pools``: one host array per device pool tensor, each sliced to
+    the prompt's blocks on axis 1 — 2 arrays on compute-dtype pools,
+    4 (payload+scale ×2) on int8 pools. The tier/journal sibling of a
+    ``JournalEntry``, generalized from crash recovery to routing."""
+
+    topic: str
+    partition: int
+    offset: int
+    crc: int
+    key_data: tuple
+    temperature: float
+    top_k: int | None
+    top_p: float | None
+    token0: int
+    prompt_blocks: int
+    pools: tuple
+
+    @property
+    def key(self) -> tuple[str, int, int]:
+        return (self.topic, self.partition, self.offset)
+
+    def payload_bytes(self) -> int:
+        return sum(a.nbytes for a in self.pools)
 
 
 class _TxnOutboxProducer:
@@ -678,6 +765,8 @@ class StreamingGenerator:
         kv_dtype: str | None = None,
         kv_kernel: bool | str = "auto",
         kv_pages: PagedKVConfig | dict | None = None,
+        kv_tier: TierConfig | dict | None = None,
+        prefill_role: bool = False,
         journal: DecodeJournal | None = None,
         tracer=None,
         trace_replica: int | None = None,
@@ -996,6 +1085,44 @@ class StreamingGenerator:
             raise ValueError("max_send_failure_streak must be >= 1")
         if kv_pages is not None and isinstance(kv_pages, dict):
             kv_pages = PagedKVConfig(**kv_pages)
+        # ``kv_tier``: demote cold radix blocks to a bounded host-RAM
+        # store (kvcache/tier.py) instead of freeing them, promote on
+        # radix hit — the effective prefix-cache capacity becomes host
+        # memory (plus optional disk spill), not pool blocks. Advisory
+        # like eviction itself: token-exactness never depends on it.
+        if kv_tier is not None and isinstance(kv_tier, dict):
+            kv_tier = TierConfig(**kv_tier)
+        if kv_tier is not None and kv_pages is None:
+            raise ValueError("kv_tier requires kv_pages (it tiers the "
+                             "paged radix cache)")
+        self._kv_tier_cfg = kv_tier
+        self._kv_tier: HostTier | None = None
+        # ``prefill_role``: this server is a disaggregated PREFILL
+        # worker — it admits prompts through the normal chunked
+        # machinery, but the moment a slot's suffix completes (token 0
+        # sampled in-dispatch) the slot is HARVESTED into a
+        # ``PrefillHandoff`` instead of decoding: the filled prompt
+        # blocks' payloads + resume state, for a decode replica to
+        # adopt. The record retires in this server's own ledger only
+        # when the caller confirms the handoff published
+        # (``note_handoff_published``), so a death mid-transfer
+        # re-delivers and re-prefills (at-least-once on the handoff
+        # plane; the DECODE group's exactly-once story is untouched —
+        # it never depends on handoffs existing).
+        if prefill_role:
+            if kv_pages is None or kv_pages.prefill_chunk == 0:
+                raise ValueError(
+                    "prefill_role requires kv_pages in chunked mode "
+                    "(the handoff is cut from the chunked-prefill "
+                    "machinery)"
+                )
+        self._prefill_role = prefill_role
+        self._prefilled_ready: list[tuple[Record, PrefillHandoff]] = []
+        # Decode-side handoff shelf: installed via add_prefill_handoffs
+        # (the fleet's handoff-topic poller), consumed at admission.
+        self._prefill_handoffs: dict[tuple[str, int, int], PrefillHandoff] = {}
+        self._adopt_upload_jits: dict[int, Callable] = {}
+        self._tier_seen = [0, 0, 0]  # demotions/promotions/hits mirrored
         # ONE capability probe for the whole (pages × dtype × kernel ×
         # mesh) space: validates the genuine exclusions eagerly (bad
         # dtype/kernel values, MoE + pages, legacy per-record admission
@@ -1076,6 +1203,11 @@ class StreamingGenerator:
         if self._kv_pages is not None and self._paged_setup():
             self._build_paged()
             return
+        if self._prefill_role:
+            raise ValueError(
+                "prefill_role cannot fall back to dense serving — size "
+                "kv_pages to hold at least one slot"
+            )
         cfg = self._cfg
         B, P, M = self._slots, self._prompt_len, self._max_len
         nl, kh, dh = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
@@ -1376,7 +1508,15 @@ class StreamingGenerator:
             return False
         self._blocks_per_slot = nblk
         self._kv_alloc = BlockAllocator(pages.num_blocks)
-        self._kv_radix = RadixCache(self._kv_alloc, pages.block_size)
+        if self._kv_tier_cfg is not None:
+            self._kv_tier = HostTier(self._kv_tier_cfg)
+            self._kv_radix = RadixCache(
+                self._kv_alloc, pages.block_size, tier=self._kv_tier,
+                read_block=self._tier_read_block,
+                write_block=self._tier_write_block,
+            )
+        else:
+            self._kv_radix = RadixCache(self._kv_alloc, pages.block_size)
         self._table_np = np.zeros((self._slots, nblk), np.int32)  # all sink
         self._paged_prefill_jits: dict[tuple[int, int], Callable] = {}
         # Chunked admission (the default; prefill_chunk=0 keeps the
@@ -1874,6 +2014,204 @@ class StreamingGenerator:
             self._kv_alloc.decref(row)
         self._table_np[i, :] = SINK_BLOCK
 
+    # ------------------------------------------------ tiered radix cache
+    #
+    # The host-RAM tier's pool I/O (kv_tier=): RadixCache calls these to
+    # DEMOTE an evicted block's payload to host memory and to PROMOTE a
+    # tier hit back into a fresh block. One payload = the per-pool
+    # tensors at one block index (2 on compute-dtype pools, 4 on int8);
+    # the bytes round-trip exactly, so a promotion is bitwise the
+    # re-prefill it replaces.
+
+    def _tier_read_block(self, block: int) -> tuple:
+        ti = self._paged_table_idx
+        return tuple(
+            np.asarray(jax.device_get(p[:, block]))
+            for p in self._caches[:ti]
+        )
+
+    def _tier_write_block(self, block: int, payload) -> None:
+        fn = getattr(self, "_tier_write_jit", None)
+        if fn is None:
+            def write(pools, b, pay):
+                return tuple(
+                    p.at[:, b].set(q.astype(p.dtype))
+                    for p, q in zip(pools, pay)
+                )
+
+            fn = jax.jit(write, donate_argnums=(0,))
+            self._tier_write_jit = fn
+        ti = self._paged_table_idx
+        pools = fn(
+            self._caches[:ti], jnp.int32(block),
+            tuple(jnp.asarray(a) for a in payload),
+        )
+        self._caches = tuple(pools) + self._caches[ti:]
+
+    def _sync_tier_metrics(self) -> None:
+        """Mirror the radix/tier counters onto ServeMetrics (the radix
+        owns the source of truth; deltas keep re-syncs idempotent)."""
+        if self._kv_tier is None:
+            return
+        r = self._kv_radix
+        sd, sp, sh = self._tier_seen
+        if r.demotions > sd:
+            self.metrics.radix_demotions.add(r.demotions - sd)
+        if r.promotions > sp:
+            self.metrics.radix_promotions.add(r.promotions - sp)
+        if r.tier_hits > sh:
+            self.metrics.tier_hits.add(r.tier_hits - sh)
+        self._tier_seen = [r.demotions, r.promotions, r.tier_hits]
+        self.metrics.tier_occupancy_bytes.set(
+            float(self._kv_tier.occupancy_bytes)
+        )
+
+    # --------------------------------------------- disaggregated prefill
+    #
+    # Prefill side (prefill_role=True): completed suffix prefills are
+    # harvested into PrefillHandoff units instead of decoding — the
+    # slot's prompt-block payloads + resume state, for the fleet's
+    # transfer plane (fleet/prefill.py). Decode side: handoffs installed
+    # via add_prefill_handoffs are adopted at admission — payload
+    # scattered into fresh blocks, token 0 merged like a 1-token warm
+    # resume, no prompt pass.
+
+    def _prompt_block_count(self) -> int:
+        """Blocks covering positions [0, prompt_len): the straddling
+        final block included (its tail past prompt_len is garbage the
+        write-before-attend discipline never reads)."""
+        return (self._prompt_len - 1) // self._kv_pages.block_size + 1
+
+    def _extract_prompt_blocks(self, slot: int) -> tuple[int, tuple]:
+        nb_p = self._prompt_block_count()
+        ids = jnp.asarray(self._table_np[slot, :nb_p].astype(np.int32))
+        ti = self._paged_table_idx
+        return nb_p, tuple(
+            np.asarray(jax.device_get(p[:, ids]))
+            for p in self._caches[:ti]
+        )
+
+    def _harvest_prefilled(self, finishers) -> None:
+        """Prefill-role epilogue of a chunk tick: every slot whose
+        suffix completed this tick (token 0 already sampled in-dispatch
+        by the fin merge — the standard per-record key draw) is cut
+        into a handoff and released; nothing ever decodes here."""
+        last = np.asarray(jax.device_get(self._last_tok))
+        released = False
+        for e, _row_idx in finishers:
+            i = e.slot
+            rec = self._slot_rec[i]
+            if rec is None or not self._active[i]:
+                continue
+            nb_p, pools = self._extract_prompt_blocks(i)
+            hand = PrefillHandoff(
+                rec.topic, rec.partition, rec.offset, value_crc(rec.value),
+                tuple(int(x) for x in np.asarray(e.key_np).ravel()),
+                self._temperature, self._top_k, self._top_p,
+                int(last[i]), nb_p, pools,
+            )
+            self._prefilled_ready.append((rec, hand))
+            self._active[i] = False
+            self._slot_rec[i] = None
+            self._slot_emitted[i] = 0
+            self._slot_journaled[i] = 0
+            self._release_slot_blocks(i)
+            released = True
+        if released:
+            self._caches = self._paged_set_table(
+                self._caches, self._device_table()
+            )
+            self.metrics.cache_pool_occupancy.set(self._kv_alloc.occupancy())
+
+    def take_prefilled(self) -> list[tuple[Record, PrefillHandoff]]:
+        """Pop the harvested handoffs (prefill role). The caller
+        publishes each onto the transfer plane and then confirms with
+        ``note_handoff_published`` — only that retires the record in
+        this worker's ledger, so a death between harvest and publish
+        re-delivers the prompt to the next prefill incarnation."""
+        ready, self._prefilled_ready = self._prefilled_ready, []
+        return ready
+
+    def note_handoff_published(self, rec: Record, blocks: int = 0) -> None:
+        """The handoff for ``rec`` is durably on the transfer plane:
+        retire the record in the prefill group's ledger."""
+        self.metrics.handoffs_published.add(1)
+        if self._tracer is not None:
+            self._tracer.prefill_handoff(
+                rec, blocks, replica=self._trace_replica
+            )
+        self._ledger.emitted(rec)
+        self._uncommitted += 1
+
+    def add_prefill_handoffs(self, entries: dict) -> None:
+        """Install decoded ``PrefillHandoff`` units keyed by (topic,
+        partition, offset). Consumed when the record is next offered for
+        admission; CRC/contract-gated at adoption, so a stale or foreign
+        handoff can never corrupt a slot (it just falls back to a local
+        prefill)."""
+        self._prefill_handoffs.update(entries)
+
+    def has_prefill_handoff(self, key: tuple[str, int, int]) -> bool:
+        """Routing probe (fleet/prefill.py's PrefillRouter): is a
+        handoff ready for this record identity?"""
+        return key in self._prefill_handoffs
+
+    def _take_handoff(self, rec: Record) -> "PrefillHandoff | None":
+        """Pop and validate ``rec``'s handoff; None = prefill locally
+        (the at-least-once fallback every disaggregated path keeps)."""
+        if self._kv_pages is None or not self._chunked:
+            return None
+        hand = self._prefill_handoffs.pop(
+            (rec.topic, rec.partition, rec.offset), None
+        )
+        if hand is None:
+            return None
+        ti = self._paged_table_idx
+        nb_p = self._prompt_block_count()
+        ok = (
+            hand.crc == value_crc(rec.value)
+            and hand.temperature == self._temperature
+            and hand.top_k == self._top_k
+            and hand.top_p == self._top_p
+            and hand.prompt_blocks == nb_p
+            and len(hand.pools) == ti
+        )
+        if ok:
+            for a, p in zip(hand.pools, self._caches[:ti]):
+                if (
+                    tuple(a.shape) != (p.shape[0], nb_p) + tuple(p.shape[2:])
+                    or a.dtype != np.dtype(p.dtype)
+                ):
+                    ok = False
+                    break
+        if not ok:
+            self.metrics.resume_rejected.add(1)
+            return None
+        return hand
+
+    def _adopt_upload(self, block_ids: list[int], payloads: tuple) -> None:
+        """Scatter an adopted handoff's payload blocks into the pool
+        (one jit specialisation per upload width, bounded by the prompt
+        block count)."""
+        n = len(block_ids)
+        fn = self._adopt_upload_jits.get(n)
+        if fn is None:
+            def write(pools, ids, pay):
+                return tuple(
+                    p.at[:, ids].set(q.astype(p.dtype))
+                    for p, q in zip(pools, pay)
+                )
+
+            fn = jax.jit(write, donate_argnums=(0,))
+            self._adopt_upload_jits[n] = fn
+        ti = self._paged_table_idx
+        pools = fn(
+            self._caches[:ti],
+            jnp.asarray(np.asarray(block_ids, np.int32)),
+            tuple(jnp.asarray(a) for a in payloads),
+        )
+        self._caches = tuple(pools) + self._caches[ti:]
+
     def _pack_chunk(self):
         """Fill the static chunk operands from the FIFO prefill queue:
         up to ``prefill_chunk`` suffix tokens, taken strictly in queue
@@ -2026,9 +2364,13 @@ class StreamingGenerator:
         slot_ids: list[int] = []
         logits_rows: list = []
         resumed: list[tuple[int, np.ndarray]] = []
+        adopted: list[tuple[int, np.ndarray]] = []
         reserved = 0  # chunked-mode reservations (prefill enqueued)
         journal_dirty = False
-        caches = self._caches
+        # NOTE: no local alias of self._caches here — tier demotions/
+        # promotions inside radix.match/evict rebind self._caches
+        # mid-loop, and an alias taken before the loop would clobber
+        # them at the end.
         slot_iter = iter(phys_free)
         while True:
             nxt = self._next_decodable(queue)
@@ -2038,6 +2380,7 @@ class StreamingGenerator:
             toks = np.asarray(toks, np.int32)
             kd = self._record_key_data(rec)
             hint = self._take_hint(rec)
+            hand = self._take_handoff(rec) if hint is None else None
             if hint is not None and hint.finished:
                 out = np.asarray(hint.tokens, np.int32)
                 self._journal_ready.append((rec, out))
@@ -2086,6 +2429,11 @@ class StreamingGenerator:
                     self._resume_hints[
                         (rec.topic, rec.partition, rec.offset)
                     ] = hint
+                if hand is not None:
+                    # Back on the shelf: the deferred re-offer re-adopts.
+                    self._prefill_handoffs[
+                        (rec.topic, rec.partition, rec.offset)
+                    ] = hand
                 if self._tracer is not None:
                     self._tracer.deferred(rec, replica=self._trace_replica)
                 self._paged_deferred.append(rec)
@@ -2094,6 +2442,43 @@ class StreamingGenerator:
                 break
             row = matched + priv
             self._table_np[i, :] = row
+            if hand is not None:
+                # ADOPTION: the prefill worker already computed this
+                # prompt's KV — scatter the uncached blocks' payloads in
+                # (radix-matched blocks already hold the identical
+                # bytes) and activate with the handoff's token 0, merged
+                # exactly like a 1-token journal warm resume. No prompt
+                # pass runs on this replica, at any chunk width.
+                nb_p = hand.prompt_blocks
+                up = row[len(matched):nb_p]
+                if up:
+                    self._adopt_upload(up, tuple(
+                        a[:, len(matched):nb_p] for a in hand.pools
+                    ))
+                # Payload uploaded, slot not yet active, record not yet
+                # in any ledger snapshot: death here re-delivers and
+                # re-adopts (or re-prefills) byte-identically.
+                crash_hook("decode_adopt_pre_activate")
+                cacheable = RadixCache.matchable_blocks(len(toks), bs)
+                self._kv_radix.insert(toks, row[:cacheable])
+                self._slot_rec[i] = rec
+                key_np = (
+                    np.asarray(hand.key_data, np.uint32)
+                    if hand.key_data else kd
+                )
+                keys_np[i] = key_np
+                key_mask[i] = True
+                self._active[i] = True
+                self._slot_emitted[i] = 1
+                self._slot_journaled[i] = 1
+                adopted.append((i, np.asarray([hand.token0], np.int32)))
+                self.metrics.adopted_slots.add(1)
+                if self._tracer is not None:
+                    self._tracer.adopted(rec, replica=self._trace_replica)
+                if self._journal is not None:
+                    self._journal_record(rec, key_np, (hand.token0,), False)
+                    journal_dirty = True
+                continue
             start = len(matched) * bs
             # Register the PROMPT's matchable whole blocks for reuse
             # (existing nodes are the ones we just matched; new nodes
@@ -2157,8 +2542,8 @@ class StreamingGenerator:
             self.metrics.prefill_tokens.add(len(seq) - start)
             self._active[i] = True
             table_row = jnp.asarray(self._table_np[i][None, :].copy())
-            logits, caches = self._paged_prefill_call(
-                caches, table_row, jnp.asarray(seq[None, start:]),
+            logits, self._caches = self._paged_prefill_call(
+                self._caches, table_row, jnp.asarray(seq[None, start:]),
                 total_len=len(seq),
             )
             if hint is None:
@@ -2176,16 +2561,20 @@ class StreamingGenerator:
         if newly_deferred > 0:
             self.metrics.admission_deferrals.add(newly_deferred)
         self.metrics.cache_pool_occupancy.set(self._kv_alloc.occupancy())
+        self._sync_tier_metrics()
         admitted = int(admit_mask.sum())
-        filled = admitted + len(resumed) + reserved
+        filled = admitted + len(resumed) + len(adopted) + reserved
         if filled:
             if in_flight > 0:
                 self.metrics.readmissions.add(filled)
-            if not self._chunked:
+            if not self._chunked or adopted:
                 # Chunked reservations push nothing: the device table
                 # keeps prefilling rows masked to the sink until
-                # activation (_device_table).
-                caches = self._paged_set_table(caches, self._device_table())
+                # activation (_device_table). Adopted slots activate NOW
+                # — their rows must unmask this push.
+                self._caches = self._paged_set_table(
+                    self._caches, self._device_table()
+                )
             self._slot_keys = jnp.where(
                 jnp.asarray(key_mask)[:, None], jnp.asarray(keys_np),
                 self._slot_keys,
@@ -2205,14 +2594,16 @@ class StreamingGenerator:
                     self._tracer.slot_active(
                         self._slot_rec[i], replica=self._trace_replica
                     )
-        if resumed:
+        if resumed or adopted:
             res_mask = np.zeros((B,), bool)
             res_last = np.zeros((B,), np.int32)
             res_pos = np.zeros((B,), np.int32)
             res_gen = np.zeros((B, self._max_new), np.int32)
-            for i, emitted in resumed:
+            for i, emitted in resumed + adopted:
                 res_mask[i] = True
                 res_last[i] = emitted[-1]
+                # An adoption restores exactly one emitted token (the
+                # handoff's admit draw) — the g=1 warm-resume state.
                 res_pos[i] = self._prompt_len + len(emitted) - 1
                 res_gen[i, : len(emitted)] = emitted
             m = jnp.asarray(res_mask)
@@ -2229,7 +2620,12 @@ class StreamingGenerator:
                         self._slot_rec[i], replica=self._trace_replica,
                         warm=True,
                     )
-        self._caches = caches
+                for i, _emitted in adopted:
+                    # Adoption's first token genuinely exists now: TTFT
+                    # closes here (not warm — nothing predates the poll).
+                    self._tracer.slot_active(
+                        self._slot_rec[i], replica=self._trace_replica,
+                    )
         if journal_dirty:
             self._journal.flush()
         return filled
@@ -3030,6 +3426,11 @@ class StreamingGenerator:
                 # pre-activation active set and its fetched state):
                 # completed prefills activate for the NEXT tick.
                 self._activate_chunk_finishers(finishers)
+                if self._prefill_role:
+                    # Disaggregated prefill: nothing decodes here — cut
+                    # the freshly activated slots into handoffs and free
+                    # them before any decode tick could run.
+                    self._harvest_prefilled(finishers)
             if run_chunk:
                 self.metrics.admission_queue_tokens.set(float(sum(
                     len(e.seq) - e.off for e in self._prefill_queue
