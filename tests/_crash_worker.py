@@ -34,6 +34,15 @@ Modes:
           joined and never heartbeated: observes the expired lease via
           membership(), then fences. Covers lease_expired_pre_fence
           (the kill lands between observation and the fence).
+  scaleup / scaledown — the SUPERVISOR is the victim: this process
+          hosts a WAL-backed ``ProcessFleet`` (real worker
+          grandchildren over its socket broker) and is SIGKILLed INSIDE
+          ``scale()`` — between choosing a scale-up replacement's
+          member-id slot and spawning it (``scale_up_pre_spawn``), or
+          after SIGTERMing a scale-down victim but before recording the
+          drain (``scale_down_mid_drain``). The parent audits by
+          recovering the WAL and converging a fresh supervisor to the
+          controller's target over the same workdir.
   broker — the BROKER is the victim: this process hosts a WAL-backed
           ``InMemoryBroker`` behind a ``BrokerServer`` (port published
           via an atomic port file) while the PARENT drives a
@@ -277,6 +286,83 @@ def run_sweep(broker) -> None:
             raise RuntimeError("zombie lease never expired")
         time.sleep(0.02)
     sweep_expired(broker, SWEEP_GROUP)
+
+
+SC_TOPIC, SC_OUT = "sct", "scout"
+SC_GROUP = "scg"
+SC_PARTS = 2
+SC_PROMPTS = 8
+
+
+def sc_prompts():
+    import numpy as np
+
+    rng = np.random.default_rng(31)
+    return rng.integers(0, VOCAB, (SC_PROMPTS, P), dtype=np.int32)
+
+
+def sc_model_spec() -> dict:
+    """The fleet model spec (fleet.proc.build_model input) matching
+    ``build_model`` — greedy decode over it is the scale matrix's
+    byte-truth."""
+    return {
+        "seed": 0, "vocab_size": VOCAB, "d_model": 32, "n_layers": 2,
+        "n_heads": 2, "n_kv_heads": 1, "d_ff": 64,
+        "max_seq_len": P + MAX_NEW,
+    }
+
+
+def run_scale(workdir: str, direction: str) -> int:
+    """The SUPERVISOR is the victim: this process hosts a WAL-backed
+    ``ProcessFleet`` (its broker's truth survives the supervisor's
+    death on disk), spawns real worker grandchildren, produces a prompt
+    storm, waits for mid-stream progress, then issues the controller's
+    scale order — the armed ``scale_up_pre_spawn`` /
+    ``scale_down_mid_drain`` point SIGKILLs the supervisor INSIDE
+    ``scale()``. The parent audits by recovering the WAL and running a
+    fresh supervisor to the same target over the same workdir (the
+    startup journal scan is the cross-incarnation handoff)."""
+    import time as _time
+
+    from torchkafka_tpu.fleet import ProcessFleet
+
+    fleet = ProcessFleet(
+        sc_model_spec(), topic=SC_TOPIC, prompt_len=P, max_new=MAX_NEW,
+        workdir=os.path.join(workdir, "fleet"),
+        replicas=1 if direction == "up" else 2,
+        partitions=SC_PARTS, slots=SLOTS, commit_every=2,
+        journal_cadence=1, session_timeout_s=2.0,
+        heartbeat_interval_s=0.2, respawn=False, group=SC_GROUP,
+        out_topic=SC_OUT, wal_dir=os.path.join(workdir, "wal"),
+        wal_durability="commit",
+    )
+    try:
+        fleet.start()
+        fleet.wait_ready(timeout_s=300)
+        prompts = sc_prompts()
+        for i in range(SC_PROMPTS):
+            fleet.broker.produce(
+                SC_TOPIC, prompts[i].tobytes(), partition=i % SC_PARTS,
+                key=str(i).encode(),
+            )
+        deadline = _time.monotonic() + 240
+        while len(fleet.results()) < 2:  # mid-stream: output durable
+            if _time.monotonic() > deadline:
+                raise TimeoutError(
+                    "fleet never made progress\n" + fleet.diagnose()
+                )
+            _time.sleep(0.01)
+        fleet.scale(2 if direction == "up" else 1)  # ← armed kill fires
+        # Unarmed path (the mode's no-kill sanity shape): serve out.
+        fleet.wait(lambda f: f.fully_committed(), timeout_s=240)
+        fleet.drain()
+        fleet.wait(
+            lambda f: all(not i.running for i in f.incarnations),
+            timeout_s=120,
+        )
+    finally:
+        fleet.close()
+    return 0
 
 
 BW_TOPIC, BW_OUT = "bt", "bout"
@@ -534,6 +620,13 @@ def main() -> int:
         arm_from_env()
         run_broker_host(workdir)
         return 0
+    if mode in ("scaleup", "scaledown"):
+        # The supervisor child is jax-free too (its worker GRANDCHILDREN
+        # decode); arm and supervise directly.
+        from torchkafka_tpu.resilience.crashpoint import arm_from_env
+
+        arm_from_env()
+        return run_scale(workdir, "up" if mode == "scaleup" else "down")
     import jax
 
     jax.config.update("jax_platforms", "cpu")

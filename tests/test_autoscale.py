@@ -1,0 +1,725 @@
+"""The autoscaling loop (fleet/autoscale.py).
+
+Four tiers, cheapest first:
+
+1. CONTROLLER UNITS — the pure decision core: hysteresis (cooldowns,
+   step limits, dead-band, down-confirm streaks), burn gating, clamps,
+   and byte-identical decision replay under a ManualClock.
+2. SCALE-DURING-REBALANCE RACE PIN — a ``scale(n)`` call landing while a
+   lease sweep is fencing a victim (injected through the existing
+   ``sweep_expired(on_fence=...)`` hook) must neither drain a healthy
+   survivor in the victim's place (an orphaned member-id range slot:
+   the fleet converges BELOW target forever) nor double-spawn; and the
+   scale-up replacement deliberately reuses the victim's replica index
+   so it sorts into the victim's member-id range (journal + radix
+   locality). Hermetic: stub processes, real broker membership, manual
+   clock.
+3. IN-PROCESS ELASTICITY — ``ServingFleet.scale_to`` joins a member
+   mid-serve (it serves rebalanced partitions) and drains one warm
+   (zero lost; drain commits its work).
+4. FULL LOOP (slow) — per-role decode+prefill autoscaling under a
+   step-load storm, byte-identical same-seed replay of the whole
+   control loop; and the real-process ``SupervisorAutoscaler`` closing
+   the loop against a ``ProcessFleet``.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import torchkafka_tpu as tk
+from torchkafka_tpu.fleet import (
+    AutoscaleController,
+    FleetAutoscaler,
+    ProcessFleet,
+    QoSConfig,
+    RolePolicy,
+    RoleSignals,
+    ServingFleet,
+    SupervisorAutoscaler,
+    sweep_expired,
+)
+from torchkafka_tpu.fleet.autoscale import (
+    DOWN,
+    PREFILL,
+    REASON_BURN,
+    REASON_IDLE,
+    REASON_QUEUE,
+    UP,
+)
+from torchkafka_tpu.fleet.metrics import FleetMetrics
+from torchkafka_tpu.fleet.supervisor import DRAINING, LIVE, _Incarnation
+from torchkafka_tpu.obs import ObsConfig, RecordTracer
+from torchkafka_tpu.obs.burn import BURNING, OK, SHEDDING, WARNING
+from torchkafka_tpu.resilience import ManualClock
+
+P, MAX_NEW, VOCAB = 16, 8, 64
+MODEL = dict(seed=0, vocab_size=VOCAB, d_model=32, n_layers=2, n_heads=2,
+             n_kv_heads=1, d_ff=64, max_seq_len=P + MAX_NEW)
+
+
+@pytest.fixture(scope="module")
+def model():
+    import jax
+    import jax.numpy as jnp
+
+    from torchkafka_tpu.models.transformer import (
+        TransformerConfig, init_params,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=VOCAB, d_model=32, n_layers=2, n_heads=2, n_kv_heads=1,
+        d_ff=64, max_seq_len=P + MAX_NEW, dtype=jnp.float32,
+    )
+    return cfg, init_params(jax.random.key(0), cfg)
+
+
+# --------------------------------------------------------------------------
+# 1. Controller units
+# --------------------------------------------------------------------------
+
+
+def _ctrl(mc, *, tracer=None, metrics=None, **pol):
+    base = dict(min_replicas=1, max_replicas=4, queue_high=4.0,
+                queue_low=1.0, up_cooldown_s=1.0, down_cooldown_s=2.0,
+                down_confirm=2)
+    base.update(pol)
+    return AutoscaleController(
+        {"decode": RolePolicy(**base)}, clock=mc.now, tracer=tracer,
+        metrics=metrics,
+    )
+
+
+class TestPolicyValidation:
+    def test_bounds(self):
+        with pytest.raises(ValueError, match="min_replicas"):
+            RolePolicy(min_replicas=3, max_replicas=2)
+        with pytest.raises(ValueError, match="queue_low"):
+            RolePolicy(queue_low=5.0, queue_high=4.0)
+        with pytest.raises(ValueError, match="down_confirm"):
+            RolePolicy(down_confirm=0)
+        with pytest.raises(ValueError, match="up_step"):
+            RolePolicy(up_step=0)
+        with pytest.raises(ValueError, match="occupancy_low"):
+            RolePolicy(occupancy_low=1.5)
+        with pytest.raises(ValueError, match="unknown burn state"):
+            RoleSignals(live=1, burn_state="meltdown")
+        with pytest.raises(ValueError, match="at least one role"):
+            AutoscaleController({})
+
+    def test_unknown_signal_roles_are_ignored(self):
+        mc = ManualClock()
+        c = _ctrl(mc)
+        assert c.evaluate({"gpu": RoleSignals(live=1, queue_depth=99)}) == []
+
+
+class TestControllerUnits:
+    def test_adopts_observed_live_then_scales_on_queue(self):
+        mc = ManualClock()
+        c = _ctrl(mc)
+        assert c.target("decode") is None
+        d = c.evaluate({"decode": RoleSignals(live=2, queue_depth=20)})
+        assert [tuple(x)[1:] for x in d] == [
+            ("decode", UP, REASON_QUEUE, 2, 3)
+        ]
+        assert c.target("decode") == 3
+
+    def test_up_cooldown_spaces_decisions(self):
+        mc = ManualClock()
+        c = _ctrl(mc, up_cooldown_s=1.0)
+        sig = {"decode": RoleSignals(live=1, queue_depth=100)}
+        assert len(c.evaluate(sig)) == 1
+        mc.advance(0.5)
+        assert c.evaluate(sig) == []  # cooling down
+        mc.advance(0.5)
+        d = c.evaluate(sig)
+        assert len(d) == 1 and d[0].to == 3
+
+    def test_dead_band_holds_and_resets_idle_streak(self):
+        mc = ManualClock()
+        c = _ctrl(mc, down_confirm=2, up_cooldown_s=0.0,
+                  down_cooldown_s=0.0)
+        c.evaluate({"decode": RoleSignals(live=3, queue_depth=100)})
+        assert c.target("decode") == 4
+        # One idle sweep, then a dead-band sweep, then idle again: the
+        # confirm streak must have been reset by the dead-band — no
+        # scale-down until two CONSECUTIVE idle sweeps.
+        idle = {"decode": RoleSignals(live=4, queue_depth=0)}
+        band = {"decode": RoleSignals(live=4, queue_depth=10)}
+        assert c.evaluate(idle) == []
+        assert c.evaluate(band) == []
+        assert c.evaluate(idle) == []
+        d = c.evaluate(idle)
+        assert [tuple(x)[1:] for x in d] == [
+            ("decode", DOWN, REASON_IDLE, 4, 3)
+        ]
+
+    def test_down_dwells_out_the_up_cooldown(self):
+        """A burst that just scaled up cannot immediately give the
+        replica back — no up→down thrash inside one cooldown."""
+        mc = ManualClock()
+        c = _ctrl(mc, up_cooldown_s=5.0, down_cooldown_s=0.0,
+                  down_confirm=1)
+        c.evaluate({"decode": RoleSignals(live=1, queue_depth=100)})
+        idle = {"decode": RoleSignals(live=2, queue_depth=0)}
+        mc.advance(1.0)
+        assert c.evaluate(idle) == []  # inside the up dwell
+        mc.advance(4.0)
+        assert len(c.evaluate(idle)) == 1
+
+    def test_step_limits_and_clamps(self):
+        mc = ManualClock()
+        c = _ctrl(mc, up_step=2, max_replicas=3, up_cooldown_s=0.0)
+        sig = {"decode": RoleSignals(live=1, queue_depth=1000)}
+        assert c.evaluate(sig)[0].to == 3  # 1 + 2, clamped at max
+        assert c.evaluate(sig) == []       # already at max: hold
+        c2 = _ctrl(mc, down_confirm=1, down_cooldown_s=0.0,
+                   up_cooldown_s=0.0, min_replicas=2)
+        c2.evaluate({"decode": RoleSignals(live=2, queue_depth=0)})
+        # target adopted at 2 == min: never goes below.
+        assert c2.target("decode") == 2
+        assert c2.evaluate({"decode": RoleSignals(live=2, queue_depth=0)}) \
+            == []
+
+    def test_burn_state_forces_up_and_blocks_down(self):
+        mc = ManualClock()
+        c = _ctrl(mc, up_cooldown_s=0.0, down_confirm=1,
+                  down_cooldown_s=0.0)
+        d = c.evaluate({"decode": RoleSignals(
+            live=2, queue_depth=0, burn_state=SHEDDING,
+        )})
+        assert d[0].reason == REASON_BURN
+        # warning alone neither scales up nor lets an idle queue scale
+        # down (the SLO is not provably safe).
+        assert c.evaluate({"decode": RoleSignals(
+            live=3, queue_depth=0, burn_state=WARNING,
+        )}) == []
+        c2 = _ctrl(mc, burn_up=False, up_cooldown_s=0.0)
+        assert c2.evaluate({"decode": RoleSignals(
+            live=1, queue_depth=0, burn_state=BURNING,
+        )}) == []
+
+    def test_occupancy_guards_scale_down(self):
+        mc = ManualClock()
+        c = _ctrl(mc, down_confirm=1, down_cooldown_s=0.0,
+                  up_cooldown_s=0.0, occupancy_low=0.5)
+        c.evaluate({"decode": RoleSignals(live=3, queue_depth=100)})
+        busy = {"decode": RoleSignals(live=4, queue_depth=0, occupancy=0.9)}
+        assert c.evaluate(busy) == []  # drained queue but busy slots
+        quiet = {"decode": RoleSignals(live=4, queue_depth=0, occupancy=0.1)}
+        assert len(c.evaluate(quiet)) == 1
+
+    def test_decision_replay_is_byte_identical(self):
+        def run():
+            mc = ManualClock()
+            c = _ctrl(mc, up_cooldown_s=0.1, down_cooldown_s=0.3,
+                      down_confirm=3)
+            rng = np.random.default_rng(9)
+            for _ in range(200):
+                mc.advance(0.01)
+                c.evaluate({"decode": RoleSignals(
+                    live=c.target("decode") or 1,
+                    queue_depth=int(rng.integers(0, 30)),
+                )})
+            return c.decision_digest(), c.summary()
+
+        a, sa = run()
+        b, sb = run()
+        assert a == b
+        assert sa == sb
+        assert sa["decisions"] > 0
+
+    def test_narration_metrics_and_trace(self):
+        mc = ManualClock()
+        m = FleetMetrics()
+        tr = RecordTracer(ObsConfig(clock=mc.now))
+        c = _ctrl(mc, tracer=tr, metrics=m, up_cooldown_s=0.0)
+        c.evaluate({"decode": RoleSignals(live=1, queue_depth=100)})
+        ev = [e for e in tr.events if e.stage == "scale_decision"]
+        assert len(ev) == 1 and ev[0].topic == "fleet"
+        attrs = dict(ev[0].attrs)
+        assert attrs == {"direction": UP, "from": 1, "reason": REASON_QUEUE,
+                         "role": "decode", "to": 2}
+        assert m.autoscale_decision("decode", UP, REASON_QUEUE).count == 1
+        assert m.autoscale_target("decode").value == 2
+        s = m.summary()["autoscale"]
+        assert s["decisions"] == {"decode/up/queue": 1}
+        assert s["targets"] == {"decode": 2}
+        text = m.render_prometheus()
+        for family in (
+            "autoscale_decisions_total", "autoscale_target_replicas",
+            "autoscale_phase", "autoscale_time_in_phase_seconds",
+        ):
+            assert f"torchkafka_fleet_{family}" in text, family
+
+    def test_worst_state_helper(self):
+        from torchkafka_tpu.obs import SLOTarget
+        from torchkafka_tpu.obs.burn import BurnRateMonitor
+
+        mc = ManualClock()
+        tr = RecordTracer(ObsConfig(clock=mc.now, window_s=0.5))
+        mon = BurnRateMonitor(tr.slo, [SLOTarget(
+            metric="ttft", threshold_s=0.01, objective=0.9,
+            fast_window_s=1.0, slow_window_s=2.0, min_samples=2,
+        )])
+        assert mon.worst_state() == OK
+        from torchkafka_tpu.source.records import Record
+
+        for i in range(8):
+            r = Record("t", 0, i, b"x", key=b"hog")
+            tr.polled(r)
+            mc.advance(0.05)
+            tr.slot_active(r)
+        mon.evaluate()
+        assert mon.worst_state() == SHEDDING
+
+
+# --------------------------------------------------------------------------
+# 2. The scale(n)-during-rebalance race (pinned via the sweeper hooks)
+# --------------------------------------------------------------------------
+
+
+class _FakeProc:
+    """A stand-in worker process for hermetic supervisor tests: alive
+    until told otherwise, records the signals the supervisor sends."""
+
+    def __init__(self) -> None:
+        self.signals: list[int] = []
+        self.returncode = None
+        self.pid = os.getpid()
+
+    def poll(self):
+        return self.returncode
+
+    def send_signal(self, sig) -> None:
+        self.signals.append(sig)
+        import signal as _signal
+
+        if sig == _signal.SIGTERM:
+            self.returncode = 0  # stubs drain instantly
+
+    def kill(self) -> None:
+        self.returncode = -9
+
+    def wait(self):
+        return self.returncode
+
+
+def _stub_spawn(self, idx, role="decode"):
+    """ProcessFleet._spawn without the subprocess: same member naming,
+    same ordering bias, a REAL broker join (membership/fencing is what
+    the race is about), a fake process handle."""
+    prefix = "r" if role == "decode" else "q"
+    member = f"{prefix}{idx:03d}i{self._seq:03d}"
+    self._seq += 1
+    group = self.group if role == "decode" else f"{self.group}-prefill"
+    self.broker.join(group, member, frozenset({self.topic}))
+    inc = _Incarnation(
+        idx=idx, member=member, proc=_FakeProc(), spec_path="",
+        journal_path=os.path.join(self.journal_dir, f"{member}.json"),
+        log_path="", metrics_path="", role=role,
+    )
+    self.incarnations.append(inc)
+    self.metrics.replica_joins.add(1)
+    return inc
+
+
+@pytest.fixture
+def stub_fleet(tmp_path, monkeypatch):
+    """A ProcessFleet over a ManualClock broker whose 'processes' are
+    stubs: leases, fencing, and scale bookkeeping are all real."""
+    monkeypatch.setattr(ProcessFleet, "_spawn", _stub_spawn)
+    mc = ManualClock()
+    broker = tk.InMemoryBroker(session_timeout_s=1.0, clock=mc.now)
+
+    def build(replicas):
+        fleet = ProcessFleet(
+            MODEL, topic="t", prompt_len=P, max_new=MAX_NEW,
+            workdir=tmp_path, replicas=replicas, partitions=4,
+            respawn=True, group="g", broker=broker,
+        )
+        fleet.start()
+        return fleet
+
+    yield mc, broker, build
+
+
+def _expire(mc, broker, victim_member, survivors):
+    """Advance past the session timeout renewing only ``survivors`` —
+    the victim's lease lapses exactly as a dead process's would."""
+    mc.advance(0.6)
+    for m in survivors:
+        broker.heartbeat("g", m)
+    mc.advance(0.6)
+
+
+class TestScaleDuringRebalanceRace:
+    def test_scale_down_mid_sweep_never_drains_a_survivor_slot(
+        self, stub_fleet,
+    ):
+        """THE orphaned-slot race: r0's lease expired (real death); a
+        scale(2) lands through the sweeper's on_fence hook — i.e. after
+        the broker fenced r0 but before the supervisor's bookkeeping
+        caught up. Counting the fenced victim as live would drain a
+        HEALTHY member in its place and converge the fleet to 1 < 2
+        forever. Pinned: no survivor is drained, and after the
+        supervisor's next poll the fleet serves exactly the target."""
+        mc, broker, build = stub_fleet
+        fleet = build(replicas=3)
+        try:
+            r0, r1, r2 = fleet.incarnations
+            _expire(mc, broker, r0.member, [r1.member, r2.member])
+            calls = []
+            swept = sweep_expired(
+                broker, "g",
+                on_fence=lambda m, age: calls.append(fleet.scale(2)),
+            )
+            assert swept == [r0.member]
+            assert len(calls) == 1
+            # The fix: neither healthy member was SIGTERMed or marked
+            # draining — the fenced victim was never counted as
+            # drainable capacity.
+            for inc in (r1, r2):
+                assert inc.state == LIVE
+                assert inc.proc.signals == []
+            fleet.poll_once()
+            live = [i for i in fleet.incarnations if i.state == LIVE]
+            assert len(live) == 2 and {i.member for i in live} == {
+                r1.member, r2.member,
+            }
+            # And the broker agrees: exactly the two survivors hold the
+            # group.
+            assert sorted(broker.membership("g")["members"]) == sorted(
+                [r1.member, r2.member]
+            )
+        finally:
+            fleet.close()
+
+    def test_scale_up_mid_sweep_no_double_spawn_and_range_inherited(
+        self, stub_fleet,
+    ):
+        """Scale-UP through the same window: the fenced victim's index
+        slot must be REUSED by exactly one replacement (it sorts into
+        the victim's member-id range and inherits journal + radix
+        locality), and the later poll_once must not respawn on top of
+        it (double-spawn)."""
+        mc, broker, build = stub_fleet
+        fleet = build(replicas=2)
+        try:
+            r0, r1 = fleet.incarnations
+            _expire(mc, broker, r0.member, [r1.member])
+            sweep_expired(
+                broker, "g",
+                on_fence=lambda m, age: fleet.scale(3),
+            )
+            live = [i for i in fleet.incarnations if i.state == LIVE
+                    and i.member != r0.member]
+            # Two spawns: the victim's slot 0 (range inheritance) and
+            # the fresh slot 2 — never two members in one slot.
+            assert sorted(i.idx for i in live) == [0, 1, 2]
+            replacement = [i for i in live if i.idx == 0][0]
+            assert replacement.member != r0.member
+            assert replacement.member.startswith("r000i")
+            fleet.poll_once()  # observes the fenced victim
+            live = [i for i in fleet.incarnations if i.state == LIVE]
+            assert len(live) == 3, [
+                (i.member, i.state) for i in fleet.incarnations
+            ]
+            assert sorted(i.idx for i in live) == [0, 1, 2]
+            # One more supervision round stays converged (idempotence).
+            for m in [i.member for i in live]:
+                broker.heartbeat("g", m)
+            fleet.poll_once()
+            assert len([
+                i for i in fleet.incarnations if i.state == LIVE
+            ]) == 3
+        finally:
+            fleet.close()
+
+    def test_scale_validations(self, stub_fleet):
+        mc, broker, build = stub_fleet
+        fleet = build(replicas=1)
+        try:
+            with pytest.raises(ValueError, match="must be >= 1"):
+                fleet.scale(0)
+            with pytest.raises(ValueError, match="prefill"):
+                fleet.scale(1, role="prefill")
+        finally:
+            fleet.close()
+
+
+# --------------------------------------------------------------------------
+# 3. In-process elasticity: ServingFleet.scale_to mid-serve
+# --------------------------------------------------------------------------
+
+
+class TestServingFleetScaleTo:
+    def test_scale_up_serves_and_scale_down_drains_warm(self, model):
+        cfg, params = model
+        broker = tk.InMemoryBroker()
+        broker.create_topic("t", partitions=4)
+        rng = np.random.default_rng(3)
+        n = 16
+        for i in range(n):
+            broker.produce(
+                "t", rng.integers(0, VOCAB, P, dtype=np.int32).tobytes(),
+                partition=i % 4, key=str(i).encode(),
+            )
+        fleet = ServingFleet(
+            lambda rid: tk.MemoryConsumer(
+                broker, "t", group_id="g", member_id=f"g-r{rid:03d}",
+            ),
+            params, cfg, replicas=1, prompt_len=P, max_new=MAX_NEW,
+            slots=2, commit_every=2, qos=QoSConfig(), obs=True,
+        )
+        fleet.warmup()
+        assert fleet.live_count() == 1
+        seen_live = []
+        phase = {"n": 0}
+
+        def on_round(f, served):
+            phase["n"] += 1
+            if phase["n"] == 2:
+                f.scale_to(3)
+            if served >= n - 2 and f.live_count() == 3:
+                f.scale_to(1)
+            seen_live.append(f.live_count())
+
+        served = fleet.serve_all(idle_timeout_ms=600, on_round=on_round)
+        assert max(seen_live) == 3
+        keys = {(r.partition, r.offset) for _rid, r, _t in served}
+        assert len(keys) == n  # zero lost
+        by_rid = {rid for rid, _r, _t in served}
+        assert len(by_rid) >= 2, "scaled-up members never served"
+        # The scale-up landed on the trace as membership events.
+        joins = [e for e in fleet.tracer.events
+                 if e.stage == "replica_joined"]
+        assert len(joins) == 3
+        # Warm drains: drained members committed before leaving (the
+        # fleet-level drains counter), nothing re-served after.
+        assert fleet.metrics.drains.count >= 2
+        from torchkafka_tpu.source.records import TopicPartition
+
+        for p in range(4):
+            tp = TopicPartition("t", p)
+            assert (broker.committed("g", tp) or 0) \
+                == broker.end_offset(tp)
+        fleet.close()
+
+    def test_scale_to_validation(self, model):
+        cfg, params = model
+        broker = tk.InMemoryBroker()
+        broker.create_topic("t", partitions=2)
+        fleet = ServingFleet(
+            lambda rid: tk.MemoryConsumer(broker, "t", group_id="g"),
+            params, cfg, replicas=1, prompt_len=P, max_new=MAX_NEW,
+            slots=2,
+        )
+        with pytest.raises(ValueError, match=">= 1"):
+            fleet.scale_to(0)
+        fleet.close()
+
+
+# --------------------------------------------------------------------------
+# 4. The full loop (slow): per-role in-process + real-process supervisor
+# --------------------------------------------------------------------------
+
+
+def _autoscaled_run(cfg, params, *, seed=5):
+    from torchkafka_tpu.obs import SLOTarget
+    from torchkafka_tpu.workload import (
+        WorkloadConfig, WorkloadGenerator, header_max_new, step_load,
+    )
+    from torchkafka_tpu.fleet import PrefillPool
+
+    TICK = 0.002
+    wcfg = WorkloadConfig(
+        tenants=3, total_records=36, arrival_rate=300.0, seed=seed,
+        rate_schedule=step_load(0.04, 6.0, 0.14),
+    )
+    gen = WorkloadGenerator(
+        wcfg, prompt_len=P, max_new=MAX_NEW, vocab_size=VOCAB,
+    )
+    mc = ManualClock()
+    broker = tk.InMemoryBroker()
+    broker.create_topic("t", partitions=4)
+    broker.create_topic("ho", partitions=1)
+    pages = {"block_size": 4, "num_blocks": 2 * -(-(P + MAX_NEW) // 4) + 16}
+    fleet = ServingFleet(
+        gen.consumer_factory(broker, "t", "g", clock=mc), params, cfg,
+        replicas=1, prompt_len=P, max_new=MAX_NEW, slots=2, commit_every=4,
+        clock=mc.now, qos=QoSConfig(),
+        gen_kwargs={"kv_pages": pages, "max_new_of": header_max_new},
+        obs=True,
+        slo_targets=[SLOTarget(
+            metric="ttft", threshold_s=TICK * 12, objective=0.75,
+            fast_window_s=TICK * 32, slow_window_s=TICK * 128,
+            min_samples=4,
+        )],
+        handoff_consumer_factory=lambda rid: tk.MemoryConsumer(
+            broker, "ho", group_id=f"ho-{rid}",
+        ),
+        route_patience=4,
+    )
+    pool = PrefillPool(
+        broker, "t", "g-prefill", "ho", params, cfg, workers=1, slots=2,
+        prompt_len=P, max_new=MAX_NEW, kv_pages=pages, commit_every=2,
+    )
+    ctrl = AutoscaleController({
+        "decode": RolePolicy(
+            min_replicas=1, max_replicas=4, queue_high=4, queue_low=1,
+            up_cooldown_s=TICK * 8, down_cooldown_s=TICK * 24,
+            down_confirm=6,
+        ),
+        "prefill": RolePolicy(
+            min_replicas=1, max_replicas=2, queue_high=6, queue_low=1,
+            up_cooldown_s=TICK * 8, down_cooldown_s=TICK * 24,
+            down_confirm=6, burn_up=False,
+        ),
+    }, clock=mc.now, tracer=fleet.tracer, metrics=fleet.metrics)
+    scaler = FleetAutoscaler(fleet, ctrl, prefill=pool)
+    fleet.warmup()
+    pool.warmup()
+    report = gen.drive(
+        fleet, broker, "t", clock=mc, tick_dt=TICK, settle_rounds=200,
+        on_round=lambda f, s: (pool.pump_once(), scaler.step()),
+    )
+    order = [
+        (rid, rec.partition, rec.offset, tuple(np.asarray(t).tolist()))
+        for rid, rec, t in report["completions"]
+    ]
+    from torchkafka_tpu.source.records import TopicPartition
+
+    committed = {
+        p: broker.committed("g", TopicPartition("t", p)) for p in range(4)
+    }
+    produced = {
+        (p, o) for p in range(4)
+        for o in range(broker.end_offset(TopicPartition("t", p)))
+    }
+    out = {
+        "order": order,
+        "events": list(fleet.tracer.events),
+        "committed": committed,
+        "produced": produced,
+        "report": report,
+        "ctrl": ctrl.summary(),
+        "digest": ctrl.decision_digest(),
+        "adopted": fleet.metrics.summary(
+            fleet.replicas
+        )["disagg"]["adopted_slots"],
+        "pool_drained": pool.drained,
+    }
+    fleet.close()
+    pool.close()
+    fleet.tracer.close()
+    return out
+
+
+@pytest.mark.slow
+class TestAutoscaledLoop:
+    def test_per_role_loop_replays_byte_identically(self, model):
+        cfg, params = model
+        a = _autoscaled_run(cfg, params)
+        b = _autoscaled_run(cfg, params)
+        # The WHOLE control loop: completion order (duplicates
+        # included), the trace stream INCLUDING timestamps (burn
+        # transitions + scale decisions + joins/drains), the ledger,
+        # and the decision digest.
+        assert a["order"] == b["order"]
+        assert a["events"] == b["events"]
+        assert a["committed"] == b["committed"]
+        assert a["digest"] == b["digest"]
+        # Zero lost, everything arrived and committed.
+        served = {(p, o) for _rid, p, o, _t in a["order"]}
+        assert served == a["produced"]
+        assert a["report"]["all_arrived"]
+        # Both roles scaled, both directions (the step ends: capacity
+        # returns), with adoption proving the prefill plane carried.
+        br = a["ctrl"]["by_reason"]
+        assert br.get("decode/up/queue", 0) >= 1
+        assert br.get("decode/down/idle", 0) >= 1
+        assert br.get("prefill/up/queue", 0) >= 1
+        assert br.get("prefill/down/idle", 0) >= 1
+        assert a["adopted"] > 0
+        assert a["pool_drained"] >= 1
+        # Hysteresis bounded the decision count under the bursty step.
+        assert a["ctrl"]["decisions"] <= 12
+
+    def test_supervisor_autoscaler_scales_real_processes(self, tmp_path):
+        """The real-process loop: a 1-replica ProcessFleet under a
+        prompt backlog scales up through SupervisorAutoscaler (broker
+        lag signal → scale(2)), serves everything with zero lost, then
+        scales down warm once the lag drains."""
+        import time
+
+        n = 12
+        rng = np.random.default_rng(7)
+        prompts = rng.integers(0, VOCAB, (n, P), dtype=np.int32)
+        fleet = ProcessFleet(
+            MODEL, topic="t", prompt_len=P, max_new=MAX_NEW,
+            workdir=tmp_path, replicas=1, partitions=4, slots=2,
+            commit_every=2, session_timeout_s=3.0,
+            heartbeat_interval_s=0.2, respawn=True, group="g",
+        )
+        # The up-cooldown doubles as the scale-down dwell: longer than a
+        # worker's startup, so a drain order can never hit a joiner
+        # that is still warming up (it would die un-warm, rc=-15,
+        # instead of drain-exiting 0).
+        ctrl = AutoscaleController({
+            "decode": RolePolicy(
+                min_replicas=1, max_replicas=2, queue_high=3.0,
+                queue_low=0.5, up_cooldown_s=30.0, down_cooldown_s=1.0,
+                down_confirm=3,
+            ),
+        })
+        scaler = SupervisorAutoscaler(fleet, ctrl)
+        try:
+            fleet.start()
+            fleet.wait_ready(timeout_s=300)
+            for i in range(n):
+                fleet.broker.produce(
+                    "t", prompts[i].tobytes(), partition=i % 4,
+                    key=str(i).encode(),
+                )
+            deadline = time.monotonic() + 240
+            scaled_up = False
+            while time.monotonic() < deadline:
+                for d in scaler.step():
+                    if d.direction == UP:
+                        scaled_up = True
+                if scaled_up and fleet.fully_committed():
+                    break
+                time.sleep(0.05)
+            assert scaled_up, "the lag never drove a scale-up"
+            assert fleet.fully_committed(), fleet.diagnose()
+            assert len(fleet.live()) == 2
+            # The joiner finishes warming BEFORE the dwell lets a drain
+            # order through — then the drained lag hands it back.
+            fleet.wait_ready(timeout_s=300)
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                if any(d.direction == DOWN for d in scaler.step()):
+                    break
+                time.sleep(0.05)
+            assert any(d.direction == DOWN for d in ctrl.decisions)
+            # The drain victim actually exits (cooperative SIGTERM
+            # drain), and the supervisor reaps it.
+            fleet.wait(
+                lambda f: sum(1 for i in f.incarnations if i.running) <= 1,
+                timeout_s=120,
+            )
+            fleet.poll_once()
+            drained = [
+                i for i in fleet.incarnations
+                if i.state not in (LIVE, DRAINING) and i.role == "decode"
+            ]
+            assert any(i.exit_code == 0 for i in drained), (
+                "scale-down did not drain-exit cleanly: "
+                + fleet.diagnose()
+            )
+            res = fleet.results()
+            assert set(res) == {str(i).encode() for i in range(n)}
+        finally:
+            fleet.close()

@@ -101,6 +101,14 @@ MATRIX: dict[str, tuple[str, int]] = {
     # adopted payload's upload and the slot's activation.
     "prefill_handoff_pre_publish": ("dgpre", 2),
     "decode_adopt_pre_activate": ("dgdec", 2),
+    # Autoscale supervisor windows (fleet/supervisor.py scale()): the
+    # SUPERVISOR is SIGKILLed mid-scale-event — at the first scale-up
+    # spawn decision and at the first scale-down drain order. The child
+    # hosts a WAL-backed fleet, so the broker truth the death leaves
+    # behind is recoverable and a fresh supervisor converges to the
+    # controller's target.
+    "scale_up_pre_spawn": ("scaleup", 1),
+    "scale_down_mid_drain": ("scaledown", 1),
 }
 
 # The tier-1 representative subset: one mid-serve death (commit path) and
@@ -830,6 +838,175 @@ def _run_dgdec_case(tmp_path, dg_reference, point: str, at: int):
     _dg_audit_complete(broker, dg_reference)
 
 
+def _sc_outputs(broker):
+    tp = TopicPartition(W.SC_OUT, 0)
+    out: dict[bytes, list] = {}
+    for rec in broker.fetch(tp, 0, 100000):
+        out.setdefault(rec.key, []).append(
+            np.frombuffer(rec.value, dtype=np.int32)
+        )
+    return out
+
+
+@pytest.fixture(scope="module")
+def sc_reference(tmp_path_factory):
+    """No-kill byte-truth for the scale matrix: greedy decode is a pure
+    function of (params, prompt), shared by every fleet process."""
+    import torchkafka_tpu as _tk
+    from torchkafka_tpu.serve import StreamingGenerator
+
+    cfg, params = W.build_model()
+    prompts = W.sc_prompts()
+    broker = _tk.InMemoryBroker()
+    broker.create_topic("ref", partitions=W.SC_PARTS)
+    for i in range(W.SC_PROMPTS):
+        broker.produce("ref", prompts[i].tobytes(),
+                       partition=i % W.SC_PARTS, key=str(i).encode())
+    c = _tk.MemoryConsumer(broker, "ref", group_id="ref")
+    gen = StreamingGenerator(
+        c, params, cfg, slots=W.SLOTS, prompt_len=W.P, max_new=W.MAX_NEW,
+        commit_every=2, ticks_per_sync=1,
+    )
+    ref = {rec.key: toks for rec, toks in gen.run(idle_timeout_ms=400)}
+    c.close()
+    return ref
+
+
+def _reap_orphan_workers(fleet_dir: str, timeout_s: float = 60.0) -> None:
+    """The SIGKILLed supervisor's worker grandchildren deliberately RIDE
+    broker outages (the broker-restart drill's contract: retry forever,
+    the broker comes back on the same port) — but this broker died WITH
+    the supervisor, so the parent plays init: SIGKILL the orphans
+    (their uncommitted work re-delivers to the recovery fleet; exactly
+    the at-least-once contract this matrix audits) and wait for the
+    journal locks they hold to go stale so the recovery workers steal
+    them instead of refusing."""
+    journal_dir = os.path.join(fleet_dir, "journals")
+    deadline = time.monotonic() + timeout_s
+    live: list[int] = []
+    while time.monotonic() < deadline:
+        live = []
+        if os.path.isdir(journal_dir):
+            for name in os.listdir(journal_dir):
+                if not name.endswith(".lock"):
+                    continue
+                try:
+                    with open(os.path.join(journal_dir, name)) as f:
+                        pid = int(f.read().strip() or 0)
+                    os.kill(pid, 0)
+                except (OSError, ValueError):
+                    continue  # gone or unreadable: stale
+                live.append(pid)
+        if not live:
+            return
+        for pid in live:
+            try:  # only ever a fleet worker of THIS case's fleet dir
+                with open(f"/proc/{pid}/cmdline", "rb") as f:
+                    cmd = f.read()
+                if b"torchkafka_tpu.fleet.proc" in cmd \
+                        and fleet_dir.encode() in cmd:
+                    os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass
+        time.sleep(0.1)
+    raise TimeoutError(f"orphan workers still alive: {live}")
+
+
+def _run_scale_case(tmp_path, sc_reference, point: str, at: int):
+    """The SUPERVISOR SIGKILLed mid-scale-event. At death: the group
+    never saw a half-born member (scale-up) / the fleet's committed
+    watermark covers only durable outputs (both). Recovery: a fresh
+    supervisor over the recovered WAL broker and the SAME workdir
+    converges to the controller's target with zero lost records,
+    byte-identical completions."""
+    mode, direction = MATRIX[point][0], (
+        "up" if point == "scale_up_pre_spawn" else "down"
+    )
+    target = 2 if direction == "up" else 1
+    workdir = str(tmp_path / point)
+    os.makedirs(workdir, exist_ok=True)
+    proc, marker = _spawn(mode, 0, workdir, point, at)
+    proc.wait(timeout=420)
+    with open(os.path.join(workdir, "child.log"), "rb") as f:
+        log = f.read().decode(errors="replace")
+    assert proc.returncode == -signal.SIGKILL, (
+        f"supervisor exited {proc.returncode}, not SIGKILL — point "
+        f"{point!r} never reached?\n{log}"
+    )
+    with open(marker) as f:
+        assert f.read().strip() == f"{point}:{at}"
+    fleet_dir = os.path.join(workdir, "fleet")
+    _reap_orphan_workers(fleet_dir)
+
+    # ---- invariants at the moment of death (recover the corpse's WAL;
+    # the child's session timeout, so memberships restore instead of the
+    # lease-less drop-and-rejoin path) ----
+    recovered = tk.InMemoryBroker(
+        wal_dir=os.path.join(workdir, "wal"), wal_durability="commit",
+        session_timeout_s=2.0,
+    )
+    members = recovered.membership(W.SC_GROUP)["members"]
+    if point == "scale_up_pre_spawn":
+        # The window: target decided, slot chosen, replacement NOT yet
+        # spawned — no half-born member may exist.
+        assert members == ["r000i000"], members
+    else:
+        # The SIGTERM was in flight when the supervisor died; whether
+        # the victim's drain-leave raced the broker's death, no member
+        # beyond the two originals ever existed.
+        assert set(members) <= {"r000i000", "r001i001"}, members
+    outs = _sc_outputs(recovered)
+    for p in range(W.SC_PARTS):
+        tp = TopicPartition(W.SC_TOPIC, p)
+        wm = recovered.committed(W.SC_GROUP, tp) or 0
+        assert wm <= recovered.end_offset(tp)
+        for off in range(wm):
+            key = str(off * W.SC_PARTS + p).encode()
+            assert key in outs, (
+                f"committed {p}:{off} (prompt {key}) has no durable output"
+            )
+    for key, copies in outs.items():
+        for c in copies:  # duplicates allowed, divergence not
+            np.testing.assert_array_equal(c, sc_reference[key], err_msg=str(key))
+
+    # ---- recovery: a fresh supervisor converges to the target -----------
+    from torchkafka_tpu.fleet import ProcessFleet
+
+    for member in list(members):
+        recovered.leave(W.SC_GROUP, member)  # reap the corpse's workers
+    fleet = ProcessFleet(
+        W.sc_model_spec(), topic=W.SC_TOPIC, prompt_len=W.P,
+        max_new=W.MAX_NEW, workdir=fleet_dir, replicas=target,
+        partitions=W.SC_PARTS, slots=W.SLOTS, commit_every=2,
+        journal_cadence=1, session_timeout_s=2.0,
+        heartbeat_interval_s=0.2, respawn=True, group=W.SC_GROUP,
+        out_topic=W.SC_OUT, broker=recovered,
+    )
+    try:
+        fleet.start()
+        fleet.wait(lambda f: f.fully_committed(), timeout_s=300)
+        # The controller's target, reached and held.
+        assert len(fleet.live()) == target, fleet.diagnose()
+        fleet.drain()
+        fleet.wait(
+            lambda f: all(not i.running for i in f.incarnations),
+            timeout_s=120,
+        )
+        fleet.poll_once()
+        assert fleet.fully_committed()
+        res = fleet.results()
+        assert set(res) == set(sc_reference), (
+            f"lost completions: {set(sc_reference) ^ set(res)}"
+        )
+        for key, copies in res.items():
+            for _member, toks in copies:
+                np.testing.assert_array_equal(
+                    toks, sc_reference[key], err_msg=str(key)
+                )
+    finally:
+        fleet.close()
+
+
 FULL_POINTS = [p for p in MATRIX if p not in TIER1]
 
 
@@ -893,6 +1070,10 @@ def _dispatch_case(tmp_path, request, point: str) -> None:
     elif mode == "dgdec":
         _run_dgdec_case(
             tmp_path, request.getfixturevalue("dg_reference"), point, at
+        )
+    elif mode in ("scaleup", "scaledown"):
+        _run_scale_case(
+            tmp_path, request.getfixturevalue("sc_reference"), point, at
         )
     else:  # pragma: no cover - matrix typo guard
         raise ValueError(f"unknown matrix mode {mode!r}")

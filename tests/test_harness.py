@@ -456,3 +456,39 @@ def test_scenario_21_disaggregated_prefill_kill_storm():
     # routing hold keeps records QUEUED, not slots idle-blocked).
     assert out["decode_step_p99_ms"] is not None
     assert out["decode_step_p99_ms"] < 1000.0
+
+
+def test_scenario_22_autoscaled_step_storm():
+    """The tier-1 closed-loop autoscaling smoke (fleet/autoscale): a
+    step-load storm against a ManualClock fleet with the burn-rate +
+    queue-depth controller driving ``scale_to``. Asserts the acceptance
+    contract: scale-up observed under the step, SLO recovery on record
+    (burn state back to ok), warm scale-down strictly AFTER the step
+    ends, zero lost records, hysteresis bounding the decision count,
+    and the whole control loop byte-identical on same-seed replay."""
+    out = run_scenario(22, "tiny")
+    assert out["scenario"] == "22:autoscaled-step-storm"
+    assert out["replay_identical"] is True
+    assert out["zero_lost"] is True
+    # The controller reacted to the step: capacity grew past the single
+    # starting replica...
+    assert out["scale_ups"] >= 1
+    assert out["peak_live"] >= 2
+    assert out["first_up_t"] is not None
+    # ...the SLO provably burned and recovered under the added capacity
+    # (recovery instant on record, end state clean)...
+    assert out["burn_transitions"] >= 2
+    assert out["burn_recovered_t"] is not None
+    assert out["burn_recovered_t"] > out["first_up_t"]
+    assert out["end_burn_state"] == "ok"
+    assert out["within_slo"] > 0
+    # ...and handed it back WARM strictly after the step ended: every
+    # down decision post-t_off, drained members committed before
+    # leaving, the fleet back at its floor.
+    assert out["scale_downs"] >= 1
+    assert out["downs_after_step_end"] is True
+    assert out["final_target"] == 1
+    assert out["drained_members"] >= out["scale_downs"]
+    # Hysteresis: bounded decisions under seeded Poisson burst noise
+    # (cooldowns + dead-band + down-confirm — no flapping).
+    assert out["decisions"] <= 8

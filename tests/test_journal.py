@@ -358,6 +358,11 @@ class TestCrashPoints:
             # the handoff, and a decode replica dying between uploading
             # an adopted payload and activating the slot.
             "prefill_handoff_pre_publish", "decode_adopt_pre_activate",
+            # The autoscale supervisor windows (ISSUE 15): the
+            # supervisor dying between choosing a scale-up target's
+            # member-id slot and spawning it, and after SIGTERMing a
+            # scale-down victim but before recording the drain.
+            "scale_up_pre_spawn", "scale_down_mid_drain",
         }
 
 

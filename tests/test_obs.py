@@ -653,7 +653,24 @@ def _fleet_metrics():
     m.replica_fences.add(1)
     m.member_lease_age("r0i0").set(0.4)
     m.member_lease_age(EVIL_TENANT).set(1.25)
-    return m.render_prometheus(replicas=None)
+    # ISSUE-15 autoscale families: decision counters labeled
+    # {role, direction, reason}, per-role target + phase gauges and the
+    # time-in-phase clock (fleet/autoscale.py's controller narration).
+    m.autoscale_decision("decode", "up", "burn").add(2)
+    m.autoscale_decision("decode", "down", "idle").add(1)
+    m.autoscale_decision("prefill", "up", "queue").add(1)
+    m.autoscale_target("decode").set(3)
+    m.autoscale_target("prefill").set(1)
+    m.autoscale_phase("decode").set(1)
+    m.autoscale_time_in_phase("decode").set(4.5)
+    text = m.render_prometheus(replicas=None)
+    for family in (
+        "autoscale_decisions_total", "autoscale_target_replicas",
+        "autoscale_phase", "autoscale_time_in_phase_seconds",
+    ):
+        assert f"torchkafka_fleet_{family}" in text, family
+    assert 'role="decode",direction="up",reason="burn"' in text
+    return text
 
 
 def _burn_monitor():

@@ -101,7 +101,7 @@ from torchkafka_tpu.transform import (
     raw_bytes,
 )
 
-__version__ = "0.17.0"
+__version__ = "0.18.0"
 
 __all__ = [
     "BarrierError",

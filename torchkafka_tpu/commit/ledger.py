@@ -179,6 +179,21 @@ class OffsetLedger:
             for off in offsets.tolist():
                 part.done(int(off))
 
+    def drop(self, tps) -> None:
+        """Forget every tracked offset of the given partitions — the
+        REVOCATION reset. A rebalance that takes a partition away leaves
+        its fetched-but-unretired records stranded here (their queued
+        copies were pruned; the new owner serves them); if the partition
+        later RETURNS, those stale pending entries would hold the
+        snapshot below the broker's committed watermark and the next
+        commit would REGRESS it (last-write-wins, like Kafka). Dropping
+        on revocation makes a comeback start from the fresh fetch
+        position; completions of already-in-flight work for a dropped
+        partition resolve as tolerated no-ops (see ``_done``)."""
+        with self._lock:
+            for tp in tps:
+                self._parts.pop(tp, None)
+
     def snapshot(self) -> dict[TopicPartition, int]:
         """Committable next-read offsets right now.
 

@@ -5,9 +5,18 @@ the same group: one OS process per replica over the socket broker, with
 heartbeat leases, zombie fencing, and cross-process warm failover.
 """
 
+from torchkafka_tpu.fleet.autoscale import (
+    AutoscaleController,
+    FleetAutoscaler,
+    RolePolicy,
+    RoleSignals,
+    ScaleDecision,
+    SupervisorAutoscaler,
+)
 from torchkafka_tpu.fleet.fleet import ReplicaChaos, ServingFleet
 from torchkafka_tpu.fleet.metrics import FleetMetrics
 from torchkafka_tpu.fleet.prefill import (
+    PrefillPool,
     PrefillRouter,
     PrefillWorker,
     decode_handoff,
@@ -28,13 +37,20 @@ from torchkafka_tpu.fleet.replica import Replica
 
 __all__ = [
     "AdmissionQueue",
+    "AutoscaleController",
     "BATCH",
+    "FleetAutoscaler",
     "FleetMetrics",
     "INTERACTIVE",
+    "PrefillPool",
     "PrefillRouter",
     "PrefillWorker",
     "ProcessFleet",
     "QoSConfig",
+    "RolePolicy",
+    "RoleSignals",
+    "ScaleDecision",
+    "SupervisorAutoscaler",
     "decode_handoff",
     "encode_handoff",
     "Replica",

@@ -57,8 +57,15 @@ import numpy as np
 
 from torchkafka_tpu.commit.ledger import merged_watermarks
 from torchkafka_tpu.fleet.metrics import FleetMetrics
+from torchkafka_tpu.fleet.prefill import PrefillRouter, drain_handoffs
 from torchkafka_tpu.fleet.qos import AdmissionQueue, QoSConfig, TenantBuckets
-from torchkafka_tpu.fleet.replica import DEAD, DONE, DRAINING, Replica
+from torchkafka_tpu.fleet.replica import (
+    DEAD,
+    DONE,
+    DRAINING,
+    SERVING,
+    Replica,
+)
 from torchkafka_tpu.journal import DecodeJournal
 from torchkafka_tpu.serve import StreamingGenerator
 from torchkafka_tpu.source.records import Record
@@ -180,6 +187,8 @@ class ServingFleet:
         drain_timeout_s: float | None = None,
         obs=None,
         slo_targets=None,
+        handoff_consumer_factory: Callable[[int], object] | None = None,
+        route_patience: int = 256,
     ) -> None:
         """``obs``: record-lifecycle tracing + SLO histograms for the
         whole fleet (torchkafka_tpu/obs). ``True`` builds a tracer on
@@ -190,6 +199,15 @@ class ServingFleet:
         replica — events tag the replica id, the SLO histograms label by
         lane/tenant/replica, and ``metrics.summary()`` gains an ``slo``
         section. None (default): zero tracing, guard-only cost.
+
+        ``handoff_consumer_factory``: disaggregated-prefill adoption for
+        an in-process fleet — ``(rid) -> Consumer`` tailing the handoff
+        topic (one PRIVATE group per replica: handoffs broadcast).
+        Each replica then routes admission through a ``PrefillRouter``
+        (``route_patience`` hold decisions before the local-prefill
+        fallback) and the serve loop drains arrived handoffs onto the
+        generator's shelf every round. Requires paged generators
+        (``gen_kwargs={"kv_pages": ...}``).
 
         ``slo_targets``: a list of ``obs.SLOTarget`` — builds a
         ``BurnRateMonitor`` over the tracer's windowed SLO view
@@ -242,14 +260,36 @@ class ServingFleet:
             self.tracer.attach_monitor(self.monitor)
             self.metrics.attach_burn(self.monitor)
         self._buckets = TenantBuckets(self._qos, clock)
+        # Everything _build_replica needs, kept so ``scale_to`` can join
+        # fresh group members MID-SERVE with the exact construction the
+        # initial replicas got (same jit cache, same QoS plumbing).
+        self._factory = consumer_factory
+        self._params = params
+        self._cfg = cfg
+        self._slots = slots
+        self._prompt_len = prompt_len
+        self._max_new = max_new
+        self._eos_id = eos_id
+        self._commit_every = commit_every
+        self._generator_cls = generator_cls
+        self._max_poll_records = max_poll_records
+        self._gen_kwargs = dict(gen_kwargs or {})
+        self._journal_cadence = journal_cadence
+        self._handoff_factory = handoff_consumer_factory
+        self._route_patience = route_patience
+        self._handoff_tails: dict[int, object] = {}
+        self._warmed = False
         self._journal_paths: dict[int, str] = {}
+        self._journal_dir = (
+            None if journal_dir is None else os.fspath(journal_dir)
+        )
         carried_hints: dict = {}
-        if journal_dir is not None:
-            journal_dir = os.fspath(journal_dir)
-            os.makedirs(journal_dir, exist_ok=True)
+        if self._journal_dir is not None:
+            os.makedirs(self._journal_dir, exist_ok=True)
             for rid in range(replicas):
-                path = os.path.join(journal_dir, f"replica_{rid}.json")
-                self._journal_paths[rid] = path
+                path = os.path.join(
+                    self._journal_dir, f"replica_{rid}.json"
+                )
                 # A journal left by a previous incarnation = that
                 # replica's in-flight state at the whole-fleet crash;
                 # its prompts redeliver to THIS incarnation's members.
@@ -260,48 +300,10 @@ class ServingFleet:
                     "warm resume", len(carried_hints),
                 )
         self.replicas: list[Replica] = []
-        for rid in range(replicas):
-            consumer = consumer_factory(rid)
-            kw = dict(gen_kwargs or {})
-            if journal_dir is not None:
-                kw["journal"] = DecodeJournal(
-                    self._journal_paths[rid], cadence=journal_cadence
-                )
-            if self.tracer is not None:
-                kw.setdefault("tracer", self.tracer)
-                kw.setdefault("trace_replica", rid)
-            gen = generator_cls(
-                consumer, params, cfg,
-                slots=slots, prompt_len=prompt_len, max_new=max_new,
-                eos_id=eos_id,
-                # The fleet loop owns the cadence (commit-follows-
-                # completion ordering); the generator must never
-                # self-commit mid-step.
-                commit_every=2**31 - 1,
-                **kw,
-            )
+        for _ in range(replicas):
+            gen = self._build_replica().gen
             if carried_hints:
                 gen.add_resume_hints(carried_hints)
-            queue = AdmissionQueue(
-                self._qos, self._buckets, self.metrics, clock,
-                tracer=self.tracer, replica=rid,
-                overload=(
-                    self.monitor.should_defer
-                    if self.monitor is not None else None
-                ),
-                on_overload_defer=(
-                    self.monitor.note_deferred
-                    if self.monitor is not None else None
-                ),
-            )
-            self.replicas.append(Replica(
-                rid, gen, consumer, queue, self._qos, self.metrics,
-                commit_every=commit_every,
-                max_poll_records=max_poll_records, clock=clock,
-            ))
-            self.metrics.replica_joins.add(1)
-            if self.tracer is not None:
-                self.tracer.replica_joined(f"replica-{rid}", replica=rid)
         self._draining = False
         self._drain_timeout_s = drain_timeout_s
         self._drain_started: float | None = None
@@ -311,6 +313,95 @@ class ServingFleet:
         # assert "committed ⊆ completed" at every commit point.
         self.completed: set[tuple[str, int, int]] = set()
 
+    # ---------------------------------------------------------- elasticity
+
+    def _build_replica(self) -> Replica:
+        """Construct and register one replica (the next free id): its
+        group-managed consumer, generator, admission queue — and, for a
+        disaggregated fleet, its private handoff tail + PrefillRouter.
+        Used by the constructor AND by ``scale_to`` mid-serve (the new
+        consumer's join triggers the rebalance that hands it
+        partitions)."""
+        rid = len(self.replicas)
+        consumer = self._factory(rid)
+        kw = dict(self._gen_kwargs)
+        if self._journal_dir is not None:
+            path = os.path.join(self._journal_dir, f"replica_{rid}.json")
+            self._journal_paths[rid] = path
+            kw["journal"] = DecodeJournal(
+                path, cadence=self._journal_cadence
+            )
+        if self.tracer is not None:
+            kw.setdefault("tracer", self.tracer)
+            kw.setdefault("trace_replica", rid)
+        gen = self._generator_cls(
+            consumer, self._params, self._cfg,
+            slots=self._slots, prompt_len=self._prompt_len,
+            max_new=self._max_new, eos_id=self._eos_id,
+            # The fleet loop owns the cadence (commit-follows-
+            # completion ordering); the generator must never
+            # self-commit mid-step.
+            commit_every=2**31 - 1,
+            **kw,
+        )
+        prefill_router = None
+        if self._handoff_factory is not None:
+            self._handoff_tails[rid] = self._handoff_factory(rid)
+            prefill_router = PrefillRouter(
+                gen, patience=self._route_patience
+            ).should_hold
+        queue = AdmissionQueue(
+            self._qos, self._buckets, self.metrics, self._clock,
+            tracer=self.tracer, replica=rid,
+            overload=(
+                self.monitor.should_defer
+                if self.monitor is not None else None
+            ),
+            on_overload_defer=(
+                self.monitor.note_deferred
+                if self.monitor is not None else None
+            ),
+            prefill_router=prefill_router,
+        )
+        rep = Replica(
+            rid, gen, consumer, queue, self._qos, self.metrics,
+            commit_every=self._commit_every,
+            max_poll_records=self._max_poll_records, clock=self._clock,
+        )
+        self.replicas.append(rep)
+        self.metrics.replica_joins.add(1)
+        if self.tracer is not None:
+            self.tracer.replica_joined(f"replica-{rid}", replica=rid)
+        if self._warmed:
+            gen.warmup()  # shared jit cache: scale-up joins compile-free
+        return rep
+
+    def live_count(self) -> int:
+        """Replicas currently SERVING (draining members are winding down
+        and no longer count as capacity — the autoscaler's view)."""
+        return sum(1 for r in self.replicas if r.state == SERVING)
+
+    def scale_to(self, n: int) -> None:
+        """Elastic membership mid-serve, in-process: the ServingFleet
+        twin of ``ProcessFleet.scale``. Scale-UP builds fresh replicas
+        (their consumers join the group — the rebalance hands them
+        partitions; the shared jit cache makes the join compile-free
+        after warmup). Scale-DOWN drains the NEWEST serving replicas
+        WARM (stop admitting, finish in-flight generations, commit,
+        leave — zero lost, zero replay at quiesced transitions); the
+        serve loop completes the drain."""
+        if n < 1:
+            raise ValueError(f"scale target must be >= 1, got {n}")
+        serving = [r for r in self.replicas if r.state == SERVING]
+        if n > len(serving):
+            for _ in range(n - len(serving)):
+                self._build_replica()
+        elif n < len(serving):
+            # LIFO: the longest-lived replicas keep their partition and
+            # radix-cache locality.
+            for rep in serving[n:]:
+                rep.start_drain()
+
     # ------------------------------------------------------------- control
 
     def warmup(self) -> None:
@@ -318,6 +409,7 @@ class ServingFleet:
         replica 0 pays, the rest hit)."""
         for rep in self.replicas:
             rep.gen.warmup()
+        self._warmed = True
 
     def drain(self) -> None:
         """Fleet-wide graceful drain: stop admitting everywhere; serve()
@@ -364,6 +456,7 @@ class ServingFleet:
         partitions; the hint is consumed there (CRC-checked), and stale
         copies on the other survivors sit harmlessly."""
         self.replicas[rid].kill()
+        self._close_handoff_tail(rid)
         self.metrics.replica_deaths.add(1)
         self.metrics.replica_fences.add(1)
         if self.tracer is not None:
@@ -392,10 +485,21 @@ class ServingFleet:
             "survivor(s) for warm resume", rid, len(hints), len(survivors),
         )
 
+    def _close_handoff_tail(self, rid: int) -> None:
+        tail = self._handoff_tails.pop(rid, None)
+        if tail is None:
+            return
+        try:
+            tail.close()
+        except Exception:  # noqa: BLE001 - teardown best-effort
+            _logger.exception("handoff tail close failed for replica %d", rid)
+
     def close(self) -> None:
         """Graceful stop outside serve(): commit completed work, leave."""
         for rep in self.replicas:
             rep.close()
+        for rid in list(self._handoff_tails):
+            self._close_handoff_tail(rid)
 
     def __enter__(self) -> "ServingFleet":
         return self
@@ -460,6 +564,13 @@ class ServingFleet:
             for rep in self.replicas:
                 if not rep.runnable:
                     continue
+                tail = self._handoff_tails.get(rep.id)
+                if tail is not None:
+                    # Disaggregated adoption: arrived handoffs land on
+                    # the generator's shelf BEFORE this round's
+                    # admission sweep, so the router releases their
+                    # records the same round.
+                    drain_handoffs(tail, rep.gen)
                 completions = rep.pump()
                 # Register BEFORE the flush below: every commit must only
                 # ever cover completions already in self.completed.
@@ -472,6 +583,7 @@ class ServingFleet:
                 rep.maybe_flush()
                 if rep.drain_idle:
                     rep.finish_drain()
+                    self._close_handoff_tail(rep.id)
                     self.metrics.drains.add(1)
                 if completions:
                     progressed = True

@@ -48,6 +48,14 @@ class FleetMetrics:
         self._member_lease_age: dict[str, Gauge] = {}  # seconds since the
         # member's last successful lease renewal (age = session timeout
         # minus observed remaining; 0 right after a heartbeat)
+        # Autoscale controller families (fleet/autoscale.py): decision
+        # counters labeled {role, direction, reason}, the controller's
+        # current per-role target, and which phase (steady / scaling_up /
+        # scaling_down) it sits in + for how long.
+        self._autoscale_decisions: dict[tuple[str, str, str], RateMeter] = {}
+        self._autoscale_target: dict[str, Gauge] = {}
+        self._autoscale_phase: dict[str, Gauge] = {}
+        self._autoscale_phase_s: dict[str, Gauge] = {}
         self._tenant_admitted: dict[str, RateMeter] = {}
         self._tenant_throttled: dict[str, RateMeter] = {}
         self._tenant_deferred: dict[str, RateMeter] = {}  # burn-rate
@@ -98,6 +106,21 @@ class FleetMetrics:
 
     def member_lease_age(self, member: str) -> Gauge:
         return self._member_lease_age.setdefault(member, Gauge())
+
+    def autoscale_decision(self, role: str, direction: str,
+                           reason: str) -> RateMeter:
+        return self._autoscale_decisions.setdefault(
+            (role, direction, reason), RateMeter()
+        )
+
+    def autoscale_target(self, role: str) -> Gauge:
+        return self._autoscale_target.setdefault(role, Gauge())
+
+    def autoscale_phase(self, role: str) -> Gauge:
+        return self._autoscale_phase.setdefault(role, Gauge())
+
+    def autoscale_time_in_phase(self, role: str) -> Gauge:
+        return self._autoscale_phase_s.setdefault(role, Gauge())
 
     # ----------------------------------------------------------- reporting
 
@@ -187,6 +210,26 @@ class FleetMetrics:
             ),
             "output_capped": sum(m.output_capped.count for m in gens),
         }
+        autoscale = {
+            "targets": {
+                role: int(g.value)
+                for role, g in sorted(self._autoscale_target.items())
+            },
+            "phase": {
+                role: int(g.value)
+                for role, g in sorted(self._autoscale_phase.items())
+            },
+            "time_in_phase_s": {
+                role: round(g.value, 4)
+                for role, g in sorted(self._autoscale_phase_s.items())
+            },
+            "decisions": {
+                f"{role}/{direction}/{reason}": m.count
+                for (role, direction, reason), m in sorted(
+                    self._autoscale_decisions.items()
+                )
+            },
+        }
         membership = {
             "joins": self.replica_joins.count,
             "fences": self.replica_fences.count,
@@ -198,6 +241,7 @@ class FleetMetrics:
         }
         return {
             "membership": membership,
+            "autoscale": autoscale,
             "slo": self._slo.summary() if self._slo is not None else None,
             "burn": (
                 self._burn.summary() if self._burn is not None else None
@@ -280,6 +324,29 @@ class FleetMetrics:
             ("member_lease_age_seconds", "gauge", [
                 (format_labels(member=m), age)
                 for m, age in s["membership"]["lease_age_s"].items()
+            ] or 0),
+            ("autoscale_decisions_total", "counter", [
+                (
+                    format_labels(
+                        role=role, direction=direction, reason=reason
+                    ),
+                    m.count,
+                )
+                for (role, direction, reason), m in sorted(
+                    self._autoscale_decisions.items()
+                )
+            ] or 0),
+            ("autoscale_target_replicas", "gauge", [
+                (format_labels(role=role), v)
+                for role, v in s["autoscale"]["targets"].items()
+            ] or 0),
+            ("autoscale_phase", "gauge", [
+                (format_labels(role=role), v)
+                for role, v in s["autoscale"]["phase"].items()
+            ] or 0),
+            ("autoscale_time_in_phase_seconds", "gauge", [
+                (format_labels(role=role), v)
+                for role, v in s["autoscale"]["time_in_phase_s"].items()
             ] or 0),
             ("journal_handoffs_total", "counter", s["journal"]["handoffs"]),
             ("drain_timeout_kills_total", "counter",

@@ -182,12 +182,18 @@ class PrefillWorker:
         self._max_poll = max_poll_records
         self._since_commit = 0
         self._retry_flush = False
+        # Warm drain (PrefillPool scale-down): stop polling new prompts,
+        # finish + publish + commit the in-flight ones, then leave.
+        self.draining = False
+
+    def start_drain(self) -> None:
+        self.draining = True
 
     def pump(self) -> int:
         """One quantum: poll → admit → chunk tick → publish harvested
         handoffs. Returns handoffs published."""
         free = self.gen.free_slots() - self.gen.pending_admissions
-        if free > 0:
+        if free > 0 and not self.draining:
             records = self.consumer.poll(
                 max_records=min(free, self._max_poll), timeout_ms=0,
             )
@@ -225,6 +231,173 @@ class PrefillWorker:
         except Exception:  # noqa: BLE001 - teardown best-effort
             pass
         self.gen.flush_commits()
+
+
+class PrefillPool:
+    """N in-process prefill workers over one broker — the prefill role's
+    twin of ``ServingFleet``'s decode replicas, elastic via ``scale_to``
+    (the autoscale controller's prefill actuation surface).
+
+    Every member is a ``PrefillWorker`` over its own group-managed
+    consumer (one consumer group for the whole pool: partitions of the
+    prompt topic range-assign across members) and a producer onto the
+    handoff topic. ``pump_once()`` runs one cooperative quantum across
+    live members — call it once per fleet scheduling round and the whole
+    disaggregated pipeline shares one deterministic timeline (same-seed
+    replays place every handoff identically).
+
+    Scale-up builds a fresh member (compile-free after the first
+    warmup: shared jit cache). Scale-down drains WARM: the member stops
+    polling, finishes + publishes + commits its in-flight prompts, then
+    leaves the group — unpublished work never commits, so nothing is
+    lost and the survivors (or the decode fallback path) pick up
+    whatever a slow drain leaves behind."""
+
+    def __init__(
+        self,
+        broker,
+        topic: str,
+        group: str,
+        handoff_topic: str,
+        params,
+        cfg,
+        *,
+        workers: int = 1,
+        slots: int = 2,
+        prompt_len: int,
+        max_new: int,
+        kv_pages: dict,
+        commit_every: int = 4,
+        max_poll_records: int = 64,
+        gen_kwargs: dict | None = None,
+    ) -> None:
+        if workers < 0:
+            raise ValueError(f"workers must be >= 0, got {workers}")
+        self.broker = broker
+        self.topic = topic
+        self.group = group
+        self.handoff_topic = handoff_topic
+        self._params = params
+        self._cfg = cfg
+        self._slots = slots
+        self._prompt_len = prompt_len
+        self._max_new = max_new
+        self._kv_pages = dict(kv_pages)
+        self._commit_every = commit_every
+        self._max_poll = max_poll_records
+        self._gen_kwargs = dict(gen_kwargs or {})
+        self._warmed = False
+        self._seq = 0
+        self.workers: list[PrefillWorker] = []
+        self.drained = 0  # members that completed a warm drain
+        for _ in range(workers):
+            self._spawn()
+
+    def _spawn(self) -> PrefillWorker:
+        from torchkafka_tpu.serve import StreamingGenerator
+        from torchkafka_tpu.source.memory import MemoryConsumer
+        from torchkafka_tpu.source.producer import MemoryProducer
+
+        member = f"pf{self._seq:03d}"
+        self._seq += 1
+        consumer = MemoryConsumer(
+            self.broker, self.topic, group_id=self.group, member_id=member,
+        )
+        gen = StreamingGenerator(
+            consumer, self._params, self._cfg,
+            slots=self._slots, prompt_len=self._prompt_len,
+            max_new=self._max_new, commit_every=2**31 - 1,
+            ticks_per_sync=1, max_poll_records=self._max_poll,
+            kv_pages=dict(self._kv_pages), prefill_role=True,
+            **self._gen_kwargs,
+        )
+        if self._warmed:
+            gen.warmup()
+        worker = PrefillWorker(
+            gen, consumer, MemoryProducer(self.broker), self.handoff_topic,
+            commit_every=self._commit_every,
+            max_poll_records=self._max_poll,
+        )
+        self.workers.append(worker)
+        return worker
+
+    def warmup(self) -> None:
+        for w in self.workers:
+            w.gen.warmup()
+        self._warmed = True
+
+    def live_count(self) -> int:
+        """Members still polling new work (draining members are winding
+        down and no longer count as capacity)."""
+        return sum(1 for w in self.workers if not w.draining)
+
+    def backlog(self) -> int:
+        """The prefill role's queue-depth signal: prompt-topic offsets
+        the pool's group has not committed yet (offered prefill work not
+        yet published-and-retired — the handoff-plane lag an autoscale
+        controller scales this role on)."""
+        from torchkafka_tpu.source.records import TopicPartition
+
+        total = 0
+        for p in range(self.broker.partitions_for(self.topic)):
+            tp = TopicPartition(self.topic, p)
+            total += self.broker.end_offset(tp) - (
+                self.broker.committed(self.group, tp) or 0
+            )
+        return total
+
+    def occupancy(self) -> float:
+        """Mean slot occupancy over live members (scale-down guard)."""
+        live = [w for w in self.workers if not w.draining]
+        if not live:
+            return 0.0
+        return sum(
+            1.0 - w.gen.free_slots() / max(1, w.gen.slots) for w in live
+        ) / len(live)
+
+    def scale_to(self, n: int) -> None:
+        """Elastic pool membership: up spawns fresh members (the group
+        rebalance hands them partitions), down warm-drains the NEWEST
+        members (LIFO — the longest-lived keep their partition
+        locality); ``pump_once`` completes the drain."""
+        if n < 0:
+            raise ValueError(f"scale target must be >= 0, got {n}")
+        live = [w for w in self.workers if not w.draining]
+        if n > len(live):
+            for _ in range(n - len(live)):
+                self._spawn()
+        elif n < len(live):
+            for w in live[n:]:
+                w.start_drain()
+
+    def pump_once(self) -> int:
+        """One cooperative quantum across every open member; completes
+        pending drains. Returns handoffs published this quantum."""
+        published = 0
+        still: list[PrefillWorker] = []
+        for w in self.workers:
+            published += w.pump()
+            if w.draining and w.idle():
+                # In-flight work finished, published, committed: leave.
+                w.close()
+                w.consumer.close()
+                self.drained += 1
+            else:
+                still.append(w)
+        self.workers = still
+        return published
+
+    def idle(self) -> bool:
+        return all(w.idle() for w in self.workers)
+
+    def close(self) -> None:
+        for w in self.workers:
+            w.close()
+            try:
+                w.consumer.close()
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+        self.workers = []
 
 
 def run_prefill_worker(spec: dict, broker=None, shutdown=None) -> int:

@@ -185,6 +185,14 @@ class Replica:
                     "replica %d rebalance: pruned %d queued records for "
                     "departed partitions", self.id, dropped,
                 )
+            departed = self._assigned - assigned
+            if departed:
+                # Revocation reset: without it, this replica's ledger
+                # keeps the pruned records 'pending', and if a departed
+                # partition ever RETURNS (a scale-up's range handed
+                # back at scale-down) the stale entries would regress
+                # the group's committed watermark at the next flush.
+                self.gen.note_partitions_revoked(departed)
             self._assigned = assigned
 
     def _poll_into_queue(self) -> None:

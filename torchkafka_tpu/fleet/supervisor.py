@@ -625,16 +625,46 @@ class ProcessFleet:
                      host, port, info)
         return info
 
-    def scale(self, n: int) -> None:
-        """Elastic membership mid-serve. Scale-UP spawns fresh members
-        (the rebalance hands them partitions — and their startup journal
-        scan makes them failover-capable immediately). Scale-DOWN
+    def scale(self, n: int, role: str = "decode") -> None:
+        """Elastic membership mid-serve, per role. Scale-UP spawns fresh
+        members (the rebalance hands them partitions — and their startup
+        journal scan makes them failover-capable immediately). Scale-DOWN
         SIGTERMs the newest live incarnations: each drains cooperatively
         (finish in-flight generations, commit, sync journal, leave), so
-        nothing is lost and nothing replays."""
-        if n < 1:
-            raise ValueError(f"scale target must be >= 1, got {n}")
-        cur = self.live()
+        nothing is lost and nothing replays.
+
+        Reconciled against BROKER truth first: a scale call can land
+        while a lease sweep is fencing a victim (the autoscale
+        controller reacts to the very fence events the sweep emits), and
+        the supervisor's own incarnation bookkeeping only catches up at
+        the next ``poll_once``. Counting such a victim as live would
+        make scale-down drain a healthy survivor in its place (the fleet
+        then converges BELOW target — an orphaned member-id range slot)
+        and scale-up under-provision. So capacity here is incarnations
+        that are broker-unfenced AND process-alive; a fenced victim's
+        replica index is deliberately free for reuse, so the scale-up
+        replacement sorts into the victim's member-id range and inherits
+        its journal + radix locality (the PR-9 range trick, made
+        deliberate)."""
+        floor = 1 if role == "decode" else 0
+        if n < floor:
+            raise ValueError(
+                f"scale target for {role!r} must be >= {floor}, got {n}"
+            )
+        if role == "prefill" and self.handoff_topic is None:
+            raise ValueError(
+                "cannot scale the prefill role of a fleet built without "
+                "prefill_replicas/kv_pages (no handoff plane exists)"
+            )
+        fenced = set(
+            self.broker.membership(
+                self.group if role == "decode" else f"{self.group}-prefill"
+            )["fenced"]
+        )
+        cur = [
+            i for i in self.live(role)
+            if i.member not in fenced and i.running
+        ]
         if n > len(cur):
             used = {i.idx for i in cur}
             idx = 0
@@ -642,7 +672,11 @@ class ProcessFleet:
                 while idx in used:
                     idx += 1
                 used.add(idx)
-                self._spawn(idx)
+                # Target decided, member-id range slot chosen, the
+                # replacement not yet alive: the supervisor-death window
+                # the crash matrix SIGKILLs at.
+                crash_hook("scale_up_pre_spawn")
+                self._spawn(idx, role=role)
         elif n < len(cur):
             # Drain the NEWEST incarnations first (LIFO): the longest-
             # lived members keep their partition/cache locality.
@@ -652,8 +686,15 @@ class ProcessFleet:
             for inc in to_drain:
                 if inc.running:
                     inc.proc.send_signal(signal.SIGTERM)
+                # Drain initiated (SIGTERM in flight), supervisor
+                # bookkeeping not yet updated: the mid-drain
+                # supervisor-death window.
+                crash_hook("scale_down_mid_drain")
                 inc.state = DRAINING
-        self._target = n
+        if role == "decode":
+            self._target = n
+        else:
+            self.prefill_replicas = n
 
     def drain(self) -> None:
         """SIGTERM every live worker (prefill included): fleet-wide

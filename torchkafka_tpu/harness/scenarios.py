@@ -2635,6 +2635,181 @@ def scenario_21(size: str = "tiny", replicas: int = 2) -> dict:
     }
 
 
+def scenario_22(size: str = "tiny", replicas: int = 1) -> dict:
+    """Closed-loop autoscaling under a step-load storm (fleet/autoscale,
+    ROADMAP item 2): a ManualClock in-process fleet starts at
+    ``replicas`` decode members with the burn-rate + queue-depth
+    controller ON; the workload steps to 6× offered load mid-run and
+    back. Asserted shape: the controller scales UP under the step
+    (hysteresis bounding the decision count under Poisson burst noise),
+    the SLO RECOVERS under the added capacity (burn state back to ok,
+    with the recovery instant on record), capacity is handed back warm
+    AFTER the step ends (scale-down decisions strictly later than
+    t_off; drains commit — zero lost), and the WHOLE control loop —
+    arrivals, burn transitions, controller decisions, scale events,
+    completions, ledger — replays byte-identically at the same seed
+    (the scenario runs twice and compares)."""
+    import torchkafka_tpu as tk
+    from torchkafka_tpu.fleet import (
+        AutoscaleController, FleetAutoscaler, QoSConfig, RolePolicy,
+        ServingFleet,
+    )
+    from torchkafka_tpu.obs import SLOTarget
+    from torchkafka_tpu.resilience import ManualClock
+    from torchkafka_tpu.source.records import TopicPartition
+    from torchkafka_tpu.workload import (
+        WorkloadConfig, WorkloadGenerator, header_max_new, step_load,
+    )
+
+    prompt_len, max_new = (16, 8) if size == "tiny" else (64, 32)
+    n = 32 if size == "tiny" else 96
+    parts, slots, commit_every = 4, 2, 4
+    tick_dt = 0.002
+    t_on, t_off, factor = 0.04, 0.14, 6.0
+    max_replicas = 3
+    cfg, params, label = _serving_model(size, None, prompt_len, max_new)
+
+    def run_once():
+        import time as _time
+
+        wcfg = WorkloadConfig(
+            tenants=3, zipf_s=1.2, total_records=n, arrival_rate=260.0,
+            burst_mean=3.0, interactive_fraction=0.5,
+            mean_suffix=max(4.0, prompt_len / 3),
+            mean_output=max_new * 0.75, seed=22,
+            rate_schedule=step_load(t_on, factor, t_off),
+        )
+        gen = WorkloadGenerator(
+            wcfg, prompt_len=prompt_len, max_new=max_new,
+            vocab_size=cfg.vocab_size,
+        )
+        mc = ManualClock()
+        broker = tk.InMemoryBroker()
+        broker.create_topic("t22", partitions=parts)
+        pages = {
+            "block_size": 4,
+            "num_blocks": slots * -(-(prompt_len + max_new) // 4) + 16,
+        }
+        targets = [SLOTarget(
+            metric="ttft", threshold_s=tick_dt * 12, objective=0.75,
+            fast_window_s=tick_dt * 32, slow_window_s=tick_dt * 128,
+            min_samples=4,
+        )]
+        fleet = ServingFleet(
+            gen.consumer_factory(broker, "t22", "s22", clock=mc),
+            params, cfg, replicas=replicas, prompt_len=prompt_len,
+            max_new=max_new, slots=slots, commit_every=commit_every,
+            clock=mc.now, qos=QoSConfig(),
+            gen_kwargs={"kv_pages": pages, "max_new_of": header_max_new},
+            obs=True, slo_targets=targets,
+        )
+        ctrl = AutoscaleController({
+            "decode": RolePolicy(
+                min_replicas=replicas, max_replicas=max_replicas,
+                queue_high=4.0, queue_low=1.0,
+                up_cooldown_s=tick_dt * 8, down_cooldown_s=tick_dt * 24,
+                down_confirm=6,
+            ),
+        }, clock=mc.now, tracer=fleet.tracer, metrics=fleet.metrics)
+        scaler = FleetAutoscaler(fleet, ctrl)
+        peak = {"live": replicas}
+
+        def on_round(f, _served):
+            scaler.step()
+            peak["live"] = max(peak["live"], f.live_count())
+
+        fleet.warmup()
+        t0 = _time.perf_counter()
+        report = gen.drive(
+            fleet, broker, "t22", clock=mc, tick_dt=tick_dt,
+            settle_rounds=200, on_round=on_round,
+        )
+        wall_s = _time.perf_counter() - t0
+        order = [
+            (rid, rec.partition, rec.offset,
+             tuple(np.asarray(t).tolist()))
+            for rid, rec, t in report["completions"]
+        ]
+        committed = {
+            p: broker.committed("s22", TopicPartition("t22", p)) or 0
+            for p in range(parts)
+        }
+        produced = {
+            (p, o) for p in range(parts)
+            for o in range(broker.end_offset(TopicPartition("t22", p)))
+        }
+        # Burn recovery instant: the last transition back to "ok" on
+        # the global ttft scope, read off the typed event stream.
+        burn_ok_t = None
+        for e in fleet.tracer.events:
+            if e.stage == "burn_state":
+                attrs = dict(e.attrs)
+                if attrs["dim"] == "" and attrs["to"] == "ok":
+                    burn_ok_t = e.t
+        out = {
+            "order": order,
+            "events": list(fleet.tracer.events),
+            "committed": committed,
+            "produced": produced,
+            "report": report,
+            "decisions": list(ctrl.decisions),
+            "digest": ctrl.decision_digest(),
+            "ctrl": ctrl.summary(),
+            "goodput": fleet.monitor.goodput_summary(),
+            "end_burn": fleet.monitor.worst_state(),
+            "burn_ok_t": burn_ok_t,
+            "transitions": fleet.monitor.transitions,
+            "drains": fleet.metrics.drains.count,
+            "peak_live": peak["live"],
+            "wall_s": wall_s,
+        }
+        fleet.close()
+        fleet.tracer.close()
+        return out
+
+    a = run_once()
+    b = run_once()
+    replay_identical = (
+        a["order"] == b["order"]
+        and a["events"] == b["events"]
+        and a["committed"] == b["committed"]
+        and a["digest"] == b["digest"]
+    )
+    served = {(p, o) for _rid, p, o, _t in a["order"]}
+    ups = [d for d in a["decisions"] if d.direction == "up"]
+    downs = [d for d in a["decisions"] if d.direction == "down"]
+    g = a["goodput"]
+    return {
+        "scenario": "22:autoscaled-step-storm",
+        "model_scale": label,
+        "records": n,
+        "step": {"t_on": t_on, "t_off": t_off, "factor": factor},
+        "replay_identical": replay_identical,
+        "zero_lost": served == a["produced"] and a["report"]["all_arrived"],
+        "duplicates": a["report"]["duplicates"],
+        "peak_live": a["peak_live"],
+        "scale_ups": len(ups),
+        "scale_downs": len(downs),
+        "decisions": a["ctrl"]["decisions"],
+        "by_reason": a["ctrl"]["by_reason"],
+        "first_up_t": round(ups[0].t_s, 4) if ups else None,
+        "first_down_t": round(downs[0].t_s, 4) if downs else None,
+        "downs_after_step_end": all(d.t_s > t_off for d in downs),
+        "final_target": a["ctrl"]["targets"]["decode"],
+        "burn_transitions": a["transitions"],
+        "burn_recovered_t": (
+            round(a["burn_ok_t"], 4) if a["burn_ok_t"] is not None
+            else None
+        ),
+        "end_burn_state": a["end_burn"],
+        "drained_members": a["drains"],
+        "goodput_ratio": g["goodput_ratio"],
+        "within_slo": g["within_slo"],
+        "completed": g["completed"],
+        "wall_s": round(a["wall_s"] + b["wall_s"], 2),
+    }
+
+
 def scenario_8(size: str = "tiny") -> dict:
     """Streaming CTR: DLRM-style recommender trained from a Kafka event
     stream — label + dense features + hashed categorical ids per record,
@@ -3011,6 +3186,7 @@ SCENARIOS = {
     19: scenario_19,
     20: scenario_20,
     21: scenario_21,
+    22: scenario_22,
 }
 
 
@@ -3061,6 +3237,8 @@ def run_scenario(
     spec_kw = dict(spec=spec, spec_k=spec_k, spec_draft_layers=spec_draft_layers)
     if num in (10, 11, 12, 13, 15, 16, 17, 18, 19, 20, 21):
         return SCENARIOS[num](size, replicas=replicas)
+    if num == 22:
+        return SCENARIOS[22](size, replicas=1)
     if model_scale is not None:
         if num not in (5, 7):
             raise ValueError("model_scale applies to scenarios 5 and 7 only")

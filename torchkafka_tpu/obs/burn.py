@@ -225,6 +225,15 @@ class BurnRateMonitor:
         with self._lock:
             return self._state.get((metric, dim, label), OK)
 
+    def worst_state(self) -> str:
+        """The highest-severity state over every monitored scope — the
+        single-signal view an autoscale controller consumes (OK when no
+        scope has been evaluated yet)."""
+        with self._lock:
+            if not self._state:
+                return OK
+            return max(self._state.values(), key=STATE_LEVEL.__getitem__)
+
     def should_defer(self, lane: str, tenant: str) -> bool:
         """The AdmissionQueue overload hook: defer this (lane, tenant)
         pop? True only for sheddable lanes (batch by default — the

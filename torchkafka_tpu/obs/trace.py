@@ -92,12 +92,19 @@ JOURNAL_HANDOFF = "journal_handoff"
 # record lifecycle at once, so it rides the same "fleet" stream ordered
 # against them.
 BROKER_RESTARTED = "broker_restarted"
+# An autoscale controller decision (fleet/autoscale.py): the control
+# plane's actuation orders ride the "fleet" stream ordered against the
+# joins/drains/fences they cause — under a ManualClock the whole control
+# loop (load → burn transitions → decisions → scale events) replays
+# byte-identically.
+SCALE_DECISION = "scale_decision"
 
 STAGES = (
     POLLED, QOS_ADMITTED, DEFERRED, PREFILL_QUEUED, CHUNK_SCHEDULED,
     WARM_RESUMED, SLOT_ACTIVE, TOKENS, FINISHED, JOURNAL_SERVED, COMMITTED,
     QUARANTINED, DROPPED, DLQ_FAILED, PREFILL_HANDOFF, SLOT_ADOPTED,
     BURN_STATE, REPLICA_JOINED, REPLICA_FENCED, JOURNAL_HANDOFF,
+    SCALE_DECISION,
 )
 
 
@@ -564,6 +571,20 @@ class RecordTracer:
                 ("aborted_txns", aborted_txns),
                 ("recovery_ms", round(recovery_ms, 3)),
                 ("replayed_records", replayed_records),
+            ))
+
+    def scale_decision(self, role: str, direction: str, reason: str,
+                       frm: int, to: int) -> None:
+        """An autoscale controller moved ``role``'s target replica count
+        ``frm`` → ``to`` (``direction`` up/down) because ``reason``
+        (burn / queue / idle). Topic ``fleet``; offset = membership
+        sequence — ordered against the joins and drains it causes."""
+        with self._lock:
+            seq = self._membership_seq
+            self._membership_seq += 1
+            self._emit(SCALE_DECISION, "fleet", 0, seq, (
+                ("direction", direction), ("from", frm),
+                ("reason", reason), ("role", role), ("to", to),
             ))
 
     def burn_state(self, seq: int, metric: str, dim: str, label: str,

@@ -91,6 +91,18 @@ decode_adopt_pre_activate  a decode replica uploaded an adopted handoff's
                            activating the slot — the record was never
                            emitted to the ledger, so it re-delivers and
                            re-adopts (or re-prefills) byte-identically
+scale_up_pre_spawn         the SUPERVISOR decided a scale-up target and
+                           chose the new member's replica-index slot but
+                           dies before spawning it — no half-born member
+                           exists, the group is untouched; a recovery
+                           supervisor re-applies the controller target and
+                           the fleet converges with zero lost
+scale_down_mid_drain       the SUPERVISOR SIGTERMed a scale-down victim
+                           but dies before recording the drain — the
+                           victim's own drain discipline (finish, commit,
+                           leave) still holds whatever the broker's fate
+                           allows; nothing uncommitted is lost, and a
+                           recovery supervisor converges to the target
 ========================== =================================================
 
 Sites call ``crash_hook("<name>")``; production cost is one global ``is
@@ -139,6 +151,8 @@ REGISTERED_CRASH_POINTS: tuple[str, ...] = (
     "recovery_mid_replay",
     "prefill_handoff_pre_publish",
     "decode_adopt_pre_activate",
+    "scale_up_pre_spawn",
+    "scale_down_mid_drain",
 )
 
 ENV_VAR = "TORCHKAFKA_CRASHPOINT"

@@ -2910,6 +2910,32 @@ class StreamingGenerator:
             for r in records:
                 tr.polled(r, replica=self._trace_replica)
 
+    def note_partitions_revoked(self, tps) -> None:
+        """A rebalance took these partitions away: reset their ledger
+        state and drop their internally-deferred admissions. Without
+        the reset, records fetched here but served by the NEW owner
+        stay 'pending' forever — and if the partition later comes BACK
+        (scale-down returning a scale-up's range), the stale entries
+        hold the snapshot below the broker's committed watermark and
+        the next commit REGRESSES it group-wide (last-write-wins).
+        Records already decoding in slots are left alone: their
+        completions resolve against the dropped partition as tolerated
+        no-ops, and any copy the new owner serves is the ordinary
+        at-least-once duplicate."""
+        tps = set(tps)
+        if not tps:
+            return
+        self._ledger.drop(tps)
+        if self._paged_deferred:
+            kept = [r for r in self._paged_deferred if r.tp not in tps]
+            dropped = len(self._paged_deferred) - len(kept)
+            if dropped:
+                self._paged_deferred = kept
+                _logger.info(
+                    "dropped %d deferred admission(s) for revoked "
+                    "partitions", dropped,
+                )
+
     def _next_decodable(self, queue: list[Record]):
         """Pop ``queue`` until a record decodes; returns (record, tokens)
         or None when exhausted. Failures follow the poison policy: with a

@@ -15,7 +15,10 @@ from torchkafka_tpu.workload.generator import (
     ChaosSchedule,
     WorkloadConfig,
     WorkloadGenerator,
+    diurnal_load,
     header_max_new,
+    rate_multiplier_at,
+    step_load,
     zipf_weights,
 )
 
@@ -24,6 +27,9 @@ __all__ = [
     "ChaosSchedule",
     "WorkloadConfig",
     "WorkloadGenerator",
+    "diurnal_load",
     "header_max_new",
+    "rate_multiplier_at",
+    "step_load",
     "zipf_weights",
 ]

@@ -52,6 +52,61 @@ INTERACTIVE = "interactive"
 BATCH = "batch"
 
 
+def step_load(t_on: float, factor: float,
+              t_off: float | None = None) -> tuple:
+    """A step load schedule for ``WorkloadConfig.rate_schedule``: 1× base
+    rate until ``t_on``, ``factor``× from then on (back to 1× at
+    ``t_off`` when given) — the storm-arrives/storm-ends shape an
+    autoscale controller must ride without flapping."""
+    if t_on < 0 or factor <= 0:
+        raise ValueError(f"need t_on >= 0, factor > 0, got {(t_on, factor)}")
+    sched = [(0.0, 1.0), (float(t_on), float(factor))]
+    if t_off is not None:
+        if t_off <= t_on:
+            raise ValueError(f"need t_off > t_on, got {(t_on, t_off)}")
+        sched.append((float(t_off), 1.0))
+    return tuple(sched)
+
+
+def diurnal_load(period_s: float, peak: float, trough: float = 1.0,
+                 phases: int = 8, cycles: int = 2) -> tuple:
+    """A piecewise-constant diurnal curve: ``phases`` segments per
+    period tracing trough → peak → trough (half-cosine), repeated for
+    ``cycles`` periods — the day/night swell that rewards scale-down as
+    much as scale-up."""
+    if period_s <= 0 or peak < trough or trough <= 0:
+        raise ValueError(
+            f"need period_s > 0, peak >= trough > 0, got "
+            f"{(period_s, peak, trough)}"
+        )
+    if phases < 2 or cycles < 1:
+        raise ValueError(f"need phases >= 2, cycles >= 1, got "
+                         f"{(phases, cycles)}")
+    sched = []
+    for c in range(cycles):
+        for i in range(phases):
+            frac = i / phases
+            mult = trough + (peak - trough) * 0.5 * (
+                1.0 - np.cos(2.0 * np.pi * frac)
+            )
+            sched.append((
+                round((c + frac) * period_s, 9), round(float(mult), 6),
+            ))
+    return tuple(sched)
+
+
+def rate_multiplier_at(schedule: tuple, t: float) -> float:
+    """The offered-load multiplier at synthetic time ``t`` under a
+    piecewise-constant ``rate_schedule`` (1.0 before the first entry or
+    with no schedule at all)."""
+    mult = 1.0
+    for t0, m in schedule:
+        if t0 > t:
+            break
+        mult = m
+    return mult
+
+
 @dataclasses.dataclass(frozen=True)
 class ChaosSchedule:
     """Mid-run chaos, on the workload's synthetic timeline.
@@ -101,6 +156,13 @@ class WorkloadConfig:
     pareto_alpha: float = 1.5   # pareto shape (tail exponent)
     seed: int = 0
     chaos: ChaosSchedule = dataclasses.field(default_factory=ChaosSchedule)
+    # Piecewise-constant offered-load multipliers ((t_s, factor), ...),
+    # sorted by t_s: the effective rate at synthetic time t is
+    # arrival_rate × the last factor whose t_s <= t. Build with
+    # ``step_load`` / ``diurnal_load``. Only arrival INSTANTS change —
+    # tenants, lanes, lengths, and payloads ride their own independent
+    # draw streams, so scheduled and unscheduled runs stay comparable.
+    rate_schedule: tuple = ()
 
     def __post_init__(self) -> None:
         if self.tenants < 1:
@@ -134,6 +196,19 @@ class WorkloadConfig:
                 f"pareto_alpha must be > 1 (finite mean), got "
                 f"{self.pareto_alpha}"
             )
+        last_t = -1.0
+        for entry in self.rate_schedule:
+            if len(entry) != 2:
+                raise ValueError(
+                    f"rate_schedule entries are (t_s, factor), got {entry!r}"
+                )
+            t_s, factor = entry
+            if t_s < 0 or t_s <= last_t or factor <= 0:
+                raise ValueError(
+                    "rate_schedule needs strictly increasing t_s >= 0 and "
+                    f"factors > 0, got {self.rate_schedule!r}"
+                )
+            last_t = t_s
 
 
 class ArrivalEvent(NamedTuple):
@@ -247,7 +322,12 @@ class WorkloadGenerator:
         burst_rate = cfg.arrival_rate / cfg.burst_mean
         t = 0.0
         while len(events) < cfg.total_records:
-            t += float(self._rng_arrival.exponential(1.0 / burst_rate))
+            # Inhomogeneous arrivals by gap scaling: the unit draw stream
+            # is consumed identically whatever the schedule, so a step or
+            # diurnal curve changes arrival INSTANTS only (the same
+            # stream-independence contract as scaling arrival_rate).
+            gap = float(self._rng_arrival.exponential(1.0 / burst_rate))
+            t += gap / rate_multiplier_at(cfg.rate_schedule, t)
             size = 1 + int(self._rng_arrival.poisson(cfg.burst_mean - 1.0))
             for _ in range(min(size, cfg.total_records - len(events))):
                 seq = len(events)
@@ -320,7 +400,17 @@ class WorkloadGenerator:
             resilient = bool(outages)
 
         def factory(rid: int):
-            consumer = MemoryConsumer(broker, topic, group_id=group_id)
+            # Explicit zero-padded member ids: the broker range-assigns
+            # over SORTED member ids, and the auto-generated fallback
+            # ("member-<global counter>") sorts by digit count — two
+            # same-seed runs in one process would draw different counter
+            # values and could land different partition splits. A pure
+            # function of (group, rid) keeps placement replayable, scale
+            # events included.
+            consumer = MemoryConsumer(
+                broker, topic, group_id=group_id,
+                member_id=f"{group_id}-r{rid:03d}",
+            )
             if outages:
                 consumer = ChaosConsumer(
                     consumer, seed=self.config.seed * 1009 + rid,
@@ -378,6 +468,8 @@ class WorkloadGenerator:
         tick_dt: float = 0.002,
         idle_timeout_ms: int = 4000,
         settle_s: float = 10.0,
+        on_round: "Callable | None" = None,
+        settle_rounds: int | None = None,
     ) -> dict:
         """Run the schedule through ``fleet`` on the synthetic timeline.
 
@@ -389,19 +481,46 @@ class WorkloadGenerator:
         survivable commit failures left by outage windows are retried
         until the ledger settles (bounded by ``settle_s`` wall seconds).
 
+        ``on_round(fleet, served)``: an extra per-round callback run
+        AFTER the driver's own work (arrivals produced, chaos fired) —
+        the autoscale controller's injection point: it samples the round
+        the load change already hit and actuates before the next round
+        serves. Anything it does rides the same synthetic timeline, so
+        the whole control loop replays with the rest.
+
+        ``settle_rounds``: DETERMINISTIC termination for control-loop
+        replays. Without it, serve() ends on a wall-clock idle timeout —
+        the number of trailing idle rounds (each advancing the synthetic
+        clock) varies run to run, which is invisible when nothing
+        happens in them but breaks byte-identity the moment a controller
+        acts there. With it, once the schedule has fully arrived and the
+        fleet has quiesced (nothing active, queued, or commit-pending),
+        exactly ``settle_rounds`` more rounds run — room for the
+        controller's post-storm scale-downs to fire on the synthetic
+        clock — and then the fleet DRAINS (warm: finish, commit, leave),
+        so the run ends at the same round on every replay.
+
         Returns completions (fleet order, duplicates included), the
-        kills that fired/skipped, and whether the schedule fully arrived
-        and served."""
+        kills that fired/skipped, rounds driven, and whether the
+        schedule fully arrived and served."""
         import time as _time
 
         sched = self.schedule()
         cursor = 0
+        rounds = 0
+        settled = 0
         kills = sorted(self.config.chaos.replica_kills)
         fired: list[tuple[float, int]] = []
         skipped: list[tuple[float, int]] = []
 
-        def on_round(f, _served):
-            nonlocal cursor
+        class _Stop:
+            requested = False
+
+        stop = _Stop()
+
+        def _on_round(f, _served):
+            nonlocal cursor, rounds, settled
+            rounds += 1
             clock.advance(tick_dt)
             now = clock.now()
             cursor = self.produce_due(broker, topic, now, cursor)
@@ -429,9 +548,22 @@ class WorkloadGenerator:
                 ):
                     for r in live:
                         r.maybe_flush(force=True)
+            if on_round is not None:
+                on_round(f, _served)
+            if settle_rounds is not None and not stop.requested:
+                live = [r for r in f.replicas if r.runnable]
+                quiesced = cursor == len(sched) and live and not any(
+                    r.gen.has_active() or r.queue.depth()
+                    or r.gen.pending_commit
+                    for r in live
+                )
+                settled = settled + 1 if quiesced else 0
+                if settled >= settle_rounds:
+                    stop.requested = True
 
         completions = fleet.serve_all(
-            idle_timeout_ms=idle_timeout_ms, on_round=on_round,
+            idle_timeout_ms=idle_timeout_ms, on_round=_on_round,
+            shutdown=stop,
         )
         # Outage-window commit failures are survivable: completions stay
         # commit-pending; retry against the healed broker.
@@ -455,5 +587,6 @@ class WorkloadGenerator:
             "all_arrived": cursor == len(sched),
             "kills_fired": fired,
             "kills_skipped": skipped,
+            "rounds": rounds,
             "end_time_s": clock.now(),
         }
