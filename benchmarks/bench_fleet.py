@@ -42,6 +42,18 @@ every slice) plus a recovery-time vs WAL-size curve with recovered
 state asserted equal to the pre-death broker at every point. Appends
 rows to FAILOVER_BENCH.json via --json-out.
 
+``--quorum``: the replicated-cell differential — (a) the commit-latency
+micro re-run with the broker being a 3-replica ``BrokerCell`` leader
+(``wal_durability="quorum"``: every frame ships to 2 followers over real
+sockets before the ack), paired in the same window against the
+at-least-once and exactly-once in-memory floors the --txn table
+recorded; (b) ``kill_leader()`` failover-to-goodput — the time from the
+kill instant to the first COMMITTED transaction through a wire client on
+the same advertised port — vs scenario 19's 2.5 s single-broker
+ride-through. Zero committed-record loss + an exactly-once committed
+view asserted inside every slice. Appends a "quorum" key to
+FAILOVER_BENCH.json via --json-out.
+
 ``--procs-failover``: the CROSS-PROCESS warm-failover differential — a
 real SIGKILL of one worker process mid-storm, journals shared (warm:
 the survivor loads the victim's file across the process boundary) vs
@@ -776,6 +788,271 @@ def run_wal(tk, cfg, params, args, prompt_len, max_new) -> None:
         print(f"appended wal rows to {args.json_out}", file=sys.stderr)
 
 
+def run_quorum(tk, cfg, params, args, prompt_len, max_new) -> None:
+    """The quorum-replication tax and the failover-to-goodput time.
+
+    (a) Commit-latency micro: the SAME transactional serving run, paired
+    and interleaved per slice across four broker shapes — in-memory
+    at-least-once (the 0.075 ms floor's shape), in-memory exactly-once
+    (the 0.217 ms floor), and a 3-replica ``BrokerCell`` leader at
+    per-replica durability None and "batch" (``wal_durability="quorum"``:
+    every acked frame is locally logged AND shipped over real sockets to
+    2 followers, majority before the ack). The quorum tax is quoted
+    against the SAME-WINDOW exactly-once row (pairing discipline; the
+    recorded floors are context, not the denominator). Byte-exactness +
+    an exactly-once committed view asserted inside every slice.
+
+    (b) Failover-to-goodput: a cell serves the full transactional storm,
+    then ``kill_leader()`` — timed from the kill instant to the first
+    COMMITTED transaction a wire client lands on the same advertised
+    port. Zero committed-record loss asserted: end offsets AND the
+    committed output view on the promoted leader must equal the
+    pre-kill snapshot byte-for-byte, still one-copy-per-prompt. The
+    drill excludes silent-death DETECTION (bounded by
+    ``lease_timeout_s``; scenario 23 measures the supervised fleet path
+    end to end) — reported next to scenario 19's 2.5 s single-broker
+    restart outage, which every worker rides."""
+    import tempfile
+
+    import numpy as np
+
+    from torchkafka_tpu.serve import StreamingGenerator
+    from torchkafka_tpu.source.records import TopicPartition
+    from torchkafka_tpu.source.replication import ReplicationConfig
+
+    n, parts, replicas = args.prompts, 4, 3
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(0, cfg.vocab_size, (n, prompt_len),
+                           dtype=np.int32)
+    # The floors the --txn table recorded (FAILOVER_BENCH.json -> txn),
+    # quoted as context; the paired denominator is this window's own row.
+    FLOOR_ALO_P99_MS, FLOOR_TXN_P99_MS = 0.075, 0.217
+    RIDE_THROUGH_BASELINE_MS = 2500.0  # scenario 19's single-broker outage
+
+    def fill(broker):
+        broker.create_topic("in", partitions=parts)
+        broker.create_topic("out", partitions=1)
+        broker.create_topic("probe", partitions=1)  # goodput probe lane
+        for i in range(n):
+            broker.produce("in", prompts[i].tobytes(), partition=i % parts,
+                           key=str(i).encode())
+
+    def serve(broker, txn):
+        consumer = tk.MemoryConsumer(broker, "in", group_id="b")
+        producer = (
+            tk.TransactionalProducer(broker, "bench-q")
+            if txn else tk.MemoryProducer(broker)
+        )
+        gen = StreamingGenerator(
+            consumer, params, cfg, slots=4, prompt_len=prompt_len,
+            max_new=max_new, commit_every=8, ticks_per_sync=1,
+            output_producer=producer, output_topic="out",
+            exactly_once=txn,
+        )
+        res = {rec.key: toks for rec, toks in gen.run(idle_timeout_ms=300)}
+        assert len(res) == n
+        commit = gen.metrics.commit_latency.summary()
+        consumer.close()
+        return res, commit
+
+    def committed_view(broker):
+        recs, _ = broker.fetch_stable(TopicPartition("out", 0), 0, 10**6)
+        keys = [r.key for r in recs]
+        assert sorted(keys) == sorted(set(keys)), "committed duplicates"
+        assert len(keys) == n, "committed view incomplete"
+        return [(r.key, r.value) for r in recs]
+
+    MODES = ("at_least_once", "exactly_once", "quorum_none", "quorum_batch")
+
+    def serve_mode(mode):
+        if mode.startswith("quorum"):
+            durability = None if mode.endswith("none") else "batch"
+            with tempfile.TemporaryDirectory() as td:
+                cell = tk.BrokerCell(
+                    os.path.join(td, "cell"),
+                    config=ReplicationConfig(
+                        replicas=replicas, durability=durability
+                    ),
+                )
+                try:
+                    fill(cell.broker)
+                    res, commit = serve(cell.broker, txn=True)
+                    committed_view(cell.broker)
+                    s = cell.broker.metrics.summary()
+                    repl = {
+                        "frames_shipped": s["repl_frames_shipped"],
+                        "quorum_commits": s["repl_quorum_commits"],
+                    }
+                finally:
+                    cell.close()
+            return res, commit, repl
+        broker = tk.InMemoryBroker()
+        fill(broker)
+        res, commit = serve(broker, txn=(mode == "exactly_once"))
+        if mode == "exactly_once":
+            committed_view(broker)
+        return res, commit, None
+
+    # ---------------------------------------------- (a) commit-tax micro
+    ref, _, _ = serve_mode("at_least_once")  # jit warm + byte-truth
+    rows = {m: [] for m in MODES}
+    repl_stats: dict | None = None
+    for s in range(args.slices):
+        for mode in MODES:
+            res, commit, repl = serve_mode(mode)
+            assert set(res) == set(ref)
+            for k in ref:
+                np.testing.assert_array_equal(res[k], ref[k], err_msg=str(k))
+            rows[mode].append(commit)
+            if repl is not None:
+                repl_stats = repl
+            print(f"slice {s} {mode}: commit p50 {commit['p50_ms']:.4f} ms "
+                  f"p99 {commit['p99_ms']:.4f} ms", file=sys.stderr)
+    micro = {}
+    for mode, commits in rows.items():
+        micro[mode] = {
+            "commit_p50_ms": float(np.median([c["p50_ms"] for c in commits])),
+            "commit_p99_ms": float(np.median([c["p99_ms"] for c in commits])),
+            "commits_per_run": commits[0]["count"],
+        }
+    txn_base = micro["exactly_once"]["commit_p99_ms"]
+    print("| commit path (3-replica cell for quorum rows) | p50 ms | "
+          "p99 ms | vs same-window exactly-once p99 |")
+    print("|---|---|---|---|")
+    for mode in MODES:
+        m = micro[mode]
+        ratio = m["commit_p99_ms"] / txn_base if txn_base else float("nan")
+        print(f"| {mode.replace('_', '-')} | {m['commit_p50_ms']:.4f} | "
+              f"{m['commit_p99_ms']:.4f} | {ratio:.2f}x |")
+
+    # ------------------------------------------ (b) failover-to-goodput
+    def failover_once():
+        with tempfile.TemporaryDirectory() as td:
+            cell = tk.BrokerCell(
+                os.path.join(td, "cell"),
+                config=ReplicationConfig(replicas=replicas,
+                                         durability="batch"),
+            )
+            try:
+                fill(cell.broker)
+                res, _ = serve(cell.broker, txn=True)
+                assert set(res) == set(ref)
+                for k in ref:
+                    np.testing.assert_array_equal(res[k], ref[k],
+                                                  err_msg=str(k))
+                before_view = committed_view(cell.broker)
+                before_ends = {
+                    p: cell.broker.end_offset(TopicPartition("in", p))
+                    for p in range(parts)
+                }
+                port = cell.port
+                t0 = time.perf_counter()
+                fx = cell.kill_leader()
+                # Goodput = a COMMITTED transaction through the wire on
+                # the same advertised port, not merely a reconnect.
+                deadline = time.monotonic() + 60
+                while True:
+                    try:
+                        with cell.client(timeout_s=5) as cli:
+                            pid, ep = cli.init_producer_id("probe")
+                            cli.begin_txn(pid, ep)
+                            cli.txn_produce(pid, ep, "probe", b"alive",
+                                            partition=0)
+                            cli.commit_txn(pid, ep)
+                        break
+                    except (tk.BrokerUnavailableError, ConnectionError,
+                            OSError):
+                        if time.monotonic() > deadline:
+                            raise
+                        time.sleep(0.005)
+                goodput_ms = (time.perf_counter() - t0) * 1e3
+                assert cell.port == port  # same-port takeover
+                # Zero committed-record loss, still exactly-once.
+                after_ends = {
+                    p: cell.broker.end_offset(TopicPartition("in", p))
+                    for p in range(parts)
+                }
+                assert after_ends == before_ends, "input records lost"
+                assert committed_view(cell.broker) == before_view, (
+                    "committed output view changed across failover"
+                )
+                row = {
+                    "goodput_ms": round(goodput_ms, 3),
+                    "election_ms": round(fx["election_ms"], 3),
+                    "failover_ms": round(fx["failover_ms"], 3),
+                    "recovery_ms": fx["recovery"]["recovery_ms"],
+                    "replayed_events": fx["recovery"]["replayed_events"],
+                    "winner_idx": fx["winner_idx"],
+                    "epoch": fx["epoch"],
+                }
+            finally:
+                cell.close()
+        return row
+
+    fail_rows = []
+    for s in range(args.slices):
+        row = failover_once()
+        fail_rows.append(row)
+        print(f"slice {s}: failover-to-goodput {row['goodput_ms']:.1f} ms "
+              f"(election {row['election_ms']:.1f}, recovery "
+              f"{row['recovery_ms']} ms, {row['replayed_events']} events)",
+              file=sys.stderr)
+    med_goodput = float(np.median([r["goodput_ms"] for r in fail_rows]))
+    print("| failover | to first committed txn (median) | vs 2.5 s "
+          "ride-through |")
+    print("|---|---|---|")
+    print(f"| single broker restart (scenario 19, ridden by workers) | "
+          f"{RIDE_THROUGH_BASELINE_MS:,.0f} ms | 1.00x |")
+    print(f"| quorum cell kill_leader -> promoted leader, same port | "
+          f"{med_goodput:,.1f} ms | "
+          f"{med_goodput / RIDE_THROUGH_BASELINE_MS:.4f}x |")
+
+    doc = {
+        "mode": "quorum",
+        "prompts": n,
+        "max_new": max_new,
+        "replicas": replicas,
+        "commit_tax": micro,
+        "quorum_p99_vs_same_window_exactly_once": {
+            m: micro[m]["commit_p99_ms"] / txn_base if txn_base else None
+            for m in ("quorum_none", "quorum_batch")
+        },
+        "recorded_floors_ms": {
+            "at_least_once_p99": FLOOR_ALO_P99_MS,
+            "exactly_once_p99": FLOOR_TXN_P99_MS,
+        },
+        "repl": repl_stats,
+        "failover": {
+            "slices": fail_rows,
+            "median_goodput_ms": med_goodput,
+            "ride_through_baseline_ms": RIDE_THROUGH_BASELINE_MS,
+            "vs_baseline": med_goodput / RIDE_THROUGH_BASELINE_MS,
+            "note": (
+                "drill excludes silent-death detection (bounded by "
+                "lease_timeout_s); scenario 23 measures the supervised "
+                "fleet path end to end"
+            ),
+        },
+        "exactness": (
+            "every slice byte-identical to the reference with an "
+            "exactly-once committed view; failover slices additionally "
+            "assert end offsets and the committed output view unchanged "
+            "across promotion"
+        ),
+    }
+    print(json.dumps(doc), file=sys.stderr)
+    if args.json_out:
+        try:
+            with open(args.json_out, encoding="utf-8") as f:
+                existing = json.load(f)
+        except (OSError, ValueError):
+            existing = {}
+        existing["quorum"] = doc
+        with open(args.json_out, "w", encoding="utf-8") as f:
+            json.dump(existing, f, indent=1)
+        print(f"appended quorum rows to {args.json_out}", file=sys.stderr)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--replicas", default="1,2,4")
@@ -794,13 +1071,19 @@ def main() -> None:
                     "latency micro (at-least-once vs transactional) + "
                     "cross-process SIGKILL failover with committed-view "
                     "duplicates asserted == 0")
+    ap.add_argument("--quorum", action="store_true",
+                    help="replicated-cell differential: quorum commit-"
+                    "latency tax (3-replica BrokerCell vs the in-memory "
+                    "at-least-once/exactly-once floors, paired) + "
+                    "kill_leader failover-to-goodput vs the 2.5 s "
+                    "single-broker ride-through, zero-loss asserted")
     ap.add_argument("--wal", action="store_true",
                     help="durable-broker WAL tax: paired transactional "
                     "commit-latency micro across durability "
                     "memory/None/batch/commit + recovery-time vs "
                     "WAL-size curve, exactness asserted every slice")
     ap.add_argument("--json-out", default=None,
-                    help="--procs-failover/--txn/--wal: "
+                    help="--procs-failover/--txn/--wal/--quorum: "
                     "FAILOVER_BENCH.json to append")
     args = ap.parse_args()
     counts = [int(x) for x in args.replicas.split(",")]
@@ -824,6 +1107,9 @@ def main() -> None:
     )
     params = init_params(jax.random.key(0), cfg)
 
+    if args.quorum:
+        run_quorum(tk, cfg, params, args, prompt_len, max_new=16)
+        return
     if args.wal:
         run_wal(tk, cfg, params, args, prompt_len, max_new=16)
         return

@@ -54,6 +54,17 @@ Modes:
           (``recovery_mid_replay`` — the child dies before the port file
           ever appears). The parent audits by RECOVERING the corpse's
           wal dir in-process and asserting the exactly-once invariants.
+  cell —  a replicated broker CELL is the victim: this process hosts a
+          1-leader + 2-follower quorum cell (advertised port published
+          via the atomic port file) while the parent drives the same
+          transactional workload; the armed point fires in the leader's
+          ship path (``repl_frame_pre_ship``,
+          ``repl_frame_post_majority_pre_ack``) or inside the election
+          the child runs against itself when the parent drops a
+          ``kill_leader`` trigger file (``election_pre_promote``). The
+          parent audits by running the election OFFLINE over the
+          follower WALs — promote the longest prefix, re-drive,
+          assert the exactly-once committed view.
 
 Importable from test_crash_matrix.py: the mode functions double as the
 parent's no-kill reference and recovery runners (identical logic, same
@@ -461,6 +472,40 @@ def run_broker_host(workdir: str) -> None:
         _time.sleep(0.05)
 
 
+CELL_REPLICAS = 3
+
+
+def run_cell_host(workdir: str) -> None:
+    """The cell-victim child: host a full 1-leader + 2-follower broker
+    CELL (quorum acks, real netbroker wire between leader and followers)
+    and publish the ADVERTISED port atomically. The replication crash
+    points (``repl_frame_pre_ship``, ``repl_frame_post_majority_pre_ack``)
+    fire inside the leader's ship path as the parent's workload drives
+    it; ``election_pre_promote`` fires inside the election this child
+    runs against ITSELF when the parent drops a ``kill_leader`` trigger
+    file into the workdir. Either way the whole cell dies by SIGKILL and
+    the parent audits by electing offline over the follower WALs."""
+    import time as _time
+
+    from torchkafka_tpu.source.cluster import BrokerCell
+    from torchkafka_tpu.source.replication import ReplicationConfig
+
+    cell = BrokerCell(
+        os.path.join(workdir, "cell"),
+        config=ReplicationConfig(replicas=CELL_REPLICAS, durability="commit"),
+    )
+    tmp = os.path.join(workdir, "port.tmp")
+    with open(tmp, "w") as f:
+        f.write(str(cell.port))
+    os.replace(tmp, os.path.join(workdir, "port"))
+    trigger = os.path.join(workdir, "kill_leader")
+    while True:
+        if os.path.exists(trigger):
+            os.unlink(trigger)
+            cell.kill_leader()  # ← election_pre_promote fires inside
+        _time.sleep(0.05)
+
+
 DG_TOPIC, DG_HANDOFF, DG_OUT, DG_DLQ = "dgt", "dgho", "dgout", "dgdlq"
 DG_GROUP = "dgg"
 DG_PREFILL_GROUP = "dgg-prefill"
@@ -619,6 +664,15 @@ def main() -> int:
 
         arm_from_env()
         run_broker_host(workdir)
+        return 0
+    if mode == "cell":
+        # The cell child is jax-free like the broker child; SIGKILL is
+        # its only exit too (the armed point fires in the leader's ship
+        # path or inside its own kill_leader election).
+        from torchkafka_tpu.resilience.crashpoint import arm_from_env
+
+        arm_from_env()
+        run_cell_host(workdir)
         return 0
     if mode in ("scaleup", "scaledown"):
         # The supervisor child is jax-free too (its worker GRANDCHILDREN

@@ -109,6 +109,23 @@ MATRIX: dict[str, tuple[str, int]] = {
     # controller's target.
     "scale_up_pre_spawn": ("scaleup", 1),
     "scale_down_mid_drain": ("scaledown", 1),
+    # Replicated-cell windows (source/replication.py + source/cluster.py):
+    # the CHILD hosts a whole 1-leader + 2-follower quorum cell and the
+    # armed kill takes the entire cell process. Ship arrivals track the
+    # leader's WAL appends one-for-one (the replicator ships every
+    # appended frame), so the broker-mode schedule carries over: 24 dies
+    # after the leader appended batch 2's second produce but before any
+    # follower saw it (unacked — promotion must not surface it as
+    # committed), 26 dies after a MAJORITY holds batch 2's commit marker
+    # but before the client's ack (promotion must replay it and answer
+    # the retry idempotently). election_pre_promote fires inside the
+    # election the child runs against itself (kill_leader trigger file),
+    # AFTER the epoch bump fenced the old leader but BEFORE the winner
+    # promoted — the parent's offline re-election must converge on the
+    # same durable prefix.
+    "repl_frame_pre_ship": ("cell", 24),
+    "repl_frame_post_majority_pre_ack": ("cell", 26),
+    "election_pre_promote": ("cell", 1),
 }
 
 # The tier-1 representative subset: one mid-serve death (commit path) and
@@ -688,6 +705,124 @@ def _run_broker_case(tmp_path, point: str, at: int):
     again.close()
 
 
+def _elect_offline(workdir: str) -> str:
+    """The parent's stand-in for the election a dead cell never finished:
+    scan the FOLLOWER WALs (the leader's disk is the casualty — that is
+    the drill) and return the member dir holding the longest clean frame
+    prefix, exactly the candidate the in-process election would promote.
+    Majority-acked frames are on >= quorum replicas, so the longest
+    follower prefix holds every frame any client was ever acked."""
+    from torchkafka_tpu.source import wal as walmod
+
+    cell_dir = os.path.join(workdir, "cell")
+    best, best_n = None, -1
+    for i in range(1, W.CELL_REPLICAS):
+        d = os.path.join(cell_dir, f"member-{i:02d}")
+        events, _ = walmod.replay(d, repair=False)
+        if len(events) > best_n:
+            best, best_n = d, len(events)
+    assert best is not None, "no follower WAL to promote"
+    return best
+
+
+def _run_cell_case(tmp_path, point: str, at: int):
+    """The whole CELL is the corpse: a subprocess hosting a 1-leader +
+    2-follower quorum cell is SIGKILLed inside the leader's ship path
+    (mid-replication windows) or inside its own kill_leader election
+    (``election_pre_promote``), while the parent drives the same
+    transactional workload as the broker matrix. The parent audits by
+    running the election OFFLINE — promote the longest follower WAL
+    through broker recovery — and asserting the exactly-once invariants,
+    a full re-drive, and promotion idempotence."""
+    from torchkafka_tpu.errors import BrokerUnavailableError
+
+    workdir = str(tmp_path / point)
+    os.makedirs(workdir, exist_ok=True)
+    proc, marker = _spawn("cell", 0, workdir, point, at)
+    port_path = os.path.join(workdir, "port")
+    deadline = time.monotonic() + 60
+    while not os.path.exists(port_path):
+        if proc.poll() is not None:
+            break
+        if time.monotonic() > deadline:
+            raise TimeoutError("cell child never published a port")
+        time.sleep(0.01)
+    assert proc.poll() is None, "cell died before serving"
+    with open(port_path) as f:
+        port = int(f.read())
+    client = tk.BrokerClient("localhost", port, timeout_s=10)
+    drove = False
+    try:
+        W.prime_bw_topics(client)
+        drove = W.drive_bw_txn(client)
+    except BrokerUnavailableError:
+        pass
+    finally:
+        client.close()
+    if point == "election_pre_promote":
+        # The armed point is NOT on the serve path: the workload must
+        # complete first, then the parent orders the leader-kill drill
+        # and the child dies inside its own election.
+        assert drove is True, "workload should complete before the drill"
+        trigger = os.path.join(workdir, "kill_leader")
+        with open(trigger + ".tmp", "w") as f:
+            f.write("now\n")
+        os.replace(trigger + ".tmp", trigger)
+        proc.wait(timeout=120)
+    else:
+        proc.wait(timeout=120)
+        assert drove is False, (
+            f"workload completed without the cell dying — arrival "
+            f"count {at} for {point!r} is past the schedule"
+        )
+    with open(os.path.join(workdir, "child.log"), "rb") as f:
+        log = f.read().decode(errors="replace")
+    assert proc.returncode == -signal.SIGKILL, (
+        f"cell exited {proc.returncode}, not SIGKILL — point {point!r} "
+        f"never reached?\n{log}"
+    )
+    with open(marker) as f:
+        assert f.read().strip() == f"{point}:{at}"
+
+    # ---- promotion: elect the longest follower prefix, recover it ------
+    winner_dir = _elect_offline(workdir)
+    if point == "repl_frame_pre_ship":
+        # The leader's own WAL holds the frame that never shipped; the
+        # promoted follower must NOT — the mutation was never acked.
+        # (Checked BEFORE promotion: recovery may legitimately append a
+        # txn_abort repair marker to the winner's WAL.)
+        from torchkafka_tpu.source import wal as walmod
+
+        leader_dir = os.path.join(workdir, "cell", "member-00")
+        leader_events, _ = walmod.replay(leader_dir, repair=False)
+        winner_events, _ = walmod.replay(winner_dir, repair=False)
+        assert len(leader_events) > len(winner_events), (
+            "pre-ship death should leave the leader ahead of every "
+            "follower"
+        )
+        # And the follower log is a strict PREFIX of the leader's.
+        assert leader_events[: len(winner_events)] == winner_events
+    promoted = tk.InMemoryBroker(wal_dir=winner_dir, wal_durability="commit")
+    info = promoted.recovery_info
+    assert info is not None and info["replayed_events"] > 0
+    _bw_audit(promoted, complete=point == "election_pre_promote")
+
+    # ---- recovery: re-drive the same workload to completion -----------
+    _reap_group(promoted, W.BW_GROUP)
+    assert W.drive_bw_txn(promoted, member="drv-promoted") is True
+    _bw_audit(promoted, complete=True)
+    promoted.close()
+
+    # ---- promotion is idempotent: a second recovery reproduces it ------
+    again = tk.InMemoryBroker(wal_dir=winner_dir, wal_durability="commit")
+    assert again.recovery_info["truncated_bytes"] == 0
+    _bw_audit(again, complete=True)
+    for p in range(W.BW_PARTS):
+        tp = TopicPartition(W.BW_TOPIC, p)
+        assert again.committed(W.BW_GROUP, tp) is not None
+    again.close()
+
+
 @pytest.fixture(scope="module")
 def dg_reference(tmp_path_factory):
     """The no-kill disaggregated reference: one prefill pass fills the
@@ -1063,6 +1198,8 @@ def _dispatch_case(tmp_path, request, point: str) -> None:
         _run_sweep_case(tmp_path, point, at)
     elif mode == "broker":
         _run_broker_case(tmp_path, point, at)
+    elif mode == "cell":
+        _run_cell_case(tmp_path, point, at)
     elif mode == "dgpre":
         _run_dgpre_case(
             tmp_path, request.getfixturevalue("dg_reference"), point, at

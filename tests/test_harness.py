@@ -374,6 +374,35 @@ def test_scenario_19_broker_crash_recovery():
     assert sorted(out["exit_codes"].values()) == [0, 0]
 
 
+def test_scenario_23_quorum_leader_failover():
+    """The tier-1 quorum-cell smoke (ISSUE 17): a 2-process exactly-once
+    fleet over a 3-replica broker cell; the LEADER dies mid-storm with
+    journal-proven uncommitted transactional work in flight, the cell
+    elects and promotes the longest-prefix follower onto the same
+    advertised port, and the workers reconnect unfenced. The acceptance
+    contract is the ISSUE's: zero lost records, committed-view
+    duplicates exactly zero, byte-identical completions, and the
+    deposed leader's forged late append rejected by the bumped epoch."""
+    out = run_scenario(23, "tiny")
+    assert out["scenario"] == "23:quorum-leader-failover-storm"
+    assert out["replicas"] == 2 and out["broker_replicas"] == 3
+    assert out["leader_elections"] == 1
+    fx = out["failover"]
+    assert fx["victim_idx"] == 0 and fx["winner_idx"] in (1, 2)
+    assert fx["epoch"] == fx["old_epoch"] + 1 == out["cell_epoch"]
+    # Promotion really replayed a follower WAL through recovery.
+    assert fx["recovery"]["replayed_records"] > 0
+    assert fx["recovery"]["replayed_events"] > fx["recovery"]["replayed_records"]
+    assert out["zero_lost"] is True
+    assert out["identical_to_no_kill"] is True
+    assert out["committed_duplicates"] == 0
+    # The zombie leader is fenced at the cell level: its forged
+    # old-epoch frame was rejected, never applied.
+    assert out["deposed_append_rejected"] is True
+    assert out["workers_survived_unfenced"] is True
+    assert sorted(out["exit_codes"].values()) == [0, 0]
+
+
 def test_scenario_20_sharded_paged_fleet():
     """The tier-1 sharded-paged smoke (PR 13): a 2-replica fleet whose
     generators compose paged block tables + int8 payloads + the kernel

@@ -363,6 +363,13 @@ class TestCrashPoints:
             # member-id slot and spawning it, and after SIGTERMing a
             # scale-down victim but before recording the drain.
             "scale_up_pre_spawn", "scale_down_mid_drain",
+            # The quorum-replication windows (ISSUE 17): a leader dying
+            # after its local WAL append but before shipping the frame,
+            # after the frame is majority-held but before the client is
+            # acked, and an elected winner dying after the epoch bump
+            # but before promotion.
+            "repl_frame_pre_ship", "repl_frame_post_majority_pre_ack",
+            "election_pre_promote",
         }
 
 

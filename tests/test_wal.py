@@ -177,6 +177,13 @@ def build_workload(seed: int, wal_dir: str, durability="commit") -> None:
     rng = random.Random(seed)
     b = tk.InMemoryBroker(wal_dir=wal_dir, wal_durability=durability,
                           wal_segment_bytes=1024)
+    _drive_workload(b, rng, seed)
+
+
+def _drive_workload(b, rng, seed: int) -> None:
+    """The seeded life itself, against ANY broker exposing the
+    InMemoryBroker surface — a bare WAL'd broker or a quorum cell's
+    leader (whose every append ships to the follower replicas)."""
     b.create_topic(TOPIC, partitions=2)
     b.create_topic(OUT, partitions=1)
     gen = b.join(GROUP, "m0", frozenset({TOPIC}))
@@ -360,6 +367,101 @@ def test_torn_tail_fuzz_fast(tmp_path, seed):
 def test_torn_tail_fuzz_full(tmp_path, seed):
     """The full ~20-seed sweep (slow tier): seeds 0-1 run in tier-1."""
     _sweep_final_segment(tmp_path, seed)
+
+
+# ------------------------------------------- the follower torn-tail fuzz
+
+
+def _build_cell_workload(tmp_path, seed: int) -> str:
+    """The same seeded life, but against a 3-replica quorum cell: every
+    acked frame was majority-held, and each follower WAL is a byte-exact
+    prefix of the leader's one total order. Returns the cell workdir."""
+    cell_dir = str(tmp_path / f"cell-{seed}")
+    cell = tk.BrokerCell(
+        cell_dir,
+        config=tk.ReplicationConfig(
+            replicas=3, durability="commit", segment_bytes=1024
+        ),
+    )
+    try:
+        _drive_workload(cell.broker, random.Random(seed), seed)
+    finally:
+        # WAL writes are unbuffered os.write: close() loses nothing, it
+        # just tears down the follower sockets.
+        cell.close()
+    return cell_dir
+
+
+def _sweep_follower_final_segment(tmp_path, seed: int) -> int:
+    """Promotion fuzz: tear ONE follower's final WAL segment at every
+    byte boundary and promote the torn replica through broker recovery.
+    At each cut the promoted state must equal the brute-force reference
+    replay of the clean prefix (no resurrected aborts, no double-applied
+    offsets), and the torn replica can never outrank its intact peer in
+    an election — which is why a majority-acked record is never lost to
+    one replica's torn tail. Returns the number of cuts swept."""
+    cell_dir = _build_cell_workload(tmp_path, seed)
+    leader_dir = os.path.join(cell_dir, "member-00")
+    torn_src = os.path.join(cell_dir, "member-01")
+    intact = os.path.join(cell_dir, "member-02")
+
+    leader_events, lt = W.replay(leader_dir, repair=False)
+    assert lt == 0
+    for d in (torn_src, intact):
+        ev, t = W.replay(d, repair=False)
+        assert t == 0
+        # Replication preserves the one total order: each follower WAL
+        # is a strict prefix of the leader's frame log, frame-for-frame.
+        assert ev == leader_events[: len(ev)], d
+    intact_events, _ = W.replay(intact, repair=False)
+    # The intact peer holds the full acked history: promotion of the
+    # longest prefix (the election rule) recovers every acked record.
+    full_ref = reference_state(intact_events)
+    anchor = tk.InMemoryBroker(wal_dir=intact)
+    assert_recovery_matches_reference(anchor, full_ref)
+    anchor.close()
+
+    segs = sorted(
+        n for n in os.listdir(torn_src)
+        if n.startswith("wal-") and n.endswith(".log")
+    )
+    assert len(segs) >= 2, "workload too small to roll segments"
+    final = os.path.join(torn_src, segs[-1])
+    final_bytes = open(final, "rb").read()
+    work = str(tmp_path / f"work-{seed}")
+    shutil.copytree(torn_src, work)
+    wfinal = os.path.join(work, segs[-1])
+    for cut in range(len(final_bytes) + 1):
+        with open(wfinal, "wb") as f:
+            f.write(final_bytes[:cut])
+        events, _ = W.replay(work, repair=False)
+        assert events == leader_events[: len(events)]  # still a prefix
+        # Election safety: the torn replica never holds MORE frames than
+        # its intact peer, so the longest-prefix rule never promotes it
+        # past a replica holding majority-acked records it lacks.
+        assert len(events) <= len(intact_events)
+        ref = reference_state(events)
+        b = tk.InMemoryBroker(wal_dir=work)
+        assert_recovery_matches_reference(b, ref)
+        assert b.recovery_info["aborted_txns"] == ref["aborted_dangling"]
+        b.close()
+    return len(final_bytes) + 1
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_follower_torn_tail_fuzz_fast(tmp_path, seed):
+    """Tier-1 slice: every byte boundary of a replicated follower's
+    final segment, two seeds."""
+    cuts = _sweep_follower_final_segment(tmp_path, seed)
+    assert cuts > 100
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", list(range(2, 20)))
+def test_follower_torn_tail_fuzz_full(tmp_path, seed):
+    """The full ~20-seed follower sweep (slow tier) — the quorum-broker
+    re-run of the transactional fuzz the acceptance gate names."""
+    _sweep_follower_final_segment(tmp_path, seed)
 
 
 @pytest.mark.parametrize("durability", [None, "batch", "commit"])

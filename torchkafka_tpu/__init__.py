@@ -30,6 +30,8 @@ from torchkafka_tpu.errors import (
     PoisonRecordError,
     ProducerClosedError,
     ProducerFencedError,
+    QuorumLostError,
+    StaleEpochError,
     TpuKafkaError,
     TransactionStateError,
 )
@@ -63,9 +65,13 @@ from torchkafka_tpu.source import (
     ChaosProducer,
     ChaosTransport,
     Consumer,
+    BrokerCell,
     BrokerClient,
     BrokerServer,
+    FollowerReplica,
     InMemoryBroker,
+    ReplicationConfig,
+    Replicator,
     KafkaConsumer,
     KafkaProducer,
     KafkaTransactionalProducer,
@@ -101,7 +107,7 @@ from torchkafka_tpu.transform import (
     raw_bytes,
 )
 
-__version__ = "0.18.0"
+__version__ = "0.19.0"
 
 __all__ = [
     "BarrierError",
@@ -126,8 +132,10 @@ __all__ = [
     "PagedKVConfig",
     "TierConfig",
     "resolve_kv_backend",
+    "BrokerCell",
     "BrokerClient",
     "BrokerServer",
+    "FollowerReplica",
     "InMemoryBroker",
     "KafkaConsumer",
     "KafkaProducer",
@@ -145,6 +153,10 @@ __all__ = [
     "Producer",
     "ProducerClosedError",
     "ProducerFencedError",
+    "QuorumLostError",
+    "ReplicationConfig",
+    "Replicator",
+    "StaleEpochError",
     "BurnRateMonitor",
     "ChaosSchedule",
     "RecordMetadata",

@@ -99,6 +99,29 @@ class ProducerFencedError(TpuKafkaError):
     same replica identity."""
 
 
+class StaleEpochError(TpuKafkaError):
+    """A replicated WAL frame (or election probe) carried a LEADER epoch
+    older than one this replica has already accepted: the sender is a
+    DEPOSED leader — an election it never saw bumped the cell epoch — and
+    its frame must be rejected, never applied. TERMINAL for the sender:
+    the cell moved on, so retrying the identical append cannot help; the
+    only valid responses are to step down (rejoin as a follower of the
+    new epoch) or to exit. The cell-level twin of ``ProducerFencedError``:
+    the producer epoch fences a zombie transaction, the cell epoch fences
+    a zombie leader's entire replication stream."""
+
+
+class QuorumLostError(BrokerUnavailableError):
+    """The leader could not place a WAL frame on a MAJORITY of replicas
+    (followers unreachable, or a majority stale-fenced this leader's
+    epoch), so the mutation was never acknowledged. RETRYABLE — it
+    subclasses ``BrokerUnavailableError`` because the client-side story
+    is identical to a broker outage: the operation is idempotent, the
+    cell is (re-)electing, and repeating the call after a backoff reaches
+    whichever leader the new epoch crowned. Nothing un-acked ever
+    surfaces in the committed view, so the retry can never double-apply."""
+
+
 class TransactionStateError(TpuKafkaError):
     """A transactional operation was issued in the wrong state — produce
     or commit with no open transaction, begin-inside-begin with a

@@ -45,6 +45,9 @@ class FleetMetrics:
         # kill, or drain-timeout escalation
         self.broker_restarts = RateMeter()  # broker deaths recovered from
         # the write-ahead log (ProcessFleet.restart_broker)
+        self.leader_elections = RateMeter()  # broker-cell failovers: a
+        # leader death absorbed by an epoch-bumped election + follower
+        # promotion (ProcessFleet.kill_leader or a lapsed leader lease)
         self._member_lease_age: dict[str, Gauge] = {}  # seconds since the
         # member's last successful lease renewal (age = session timeout
         # minus observed remaining; 0 right after a heartbeat)
@@ -234,6 +237,7 @@ class FleetMetrics:
             "joins": self.replica_joins.count,
             "fences": self.replica_fences.count,
             "broker_restarts": self.broker_restarts.count,
+            "leader_elections": self.leader_elections.count,
             "lease_age_s": {
                 m: round(g.value, 3)
                 for m, g in sorted(self._member_lease_age.items())
@@ -321,6 +325,8 @@ class FleetMetrics:
             ("replica_fences_total", "counter", s["membership"]["fences"]),
             ("broker_restarts_total", "counter",
              s["membership"]["broker_restarts"]),
+            ("leader_elections_total", "counter",
+             s["membership"]["leader_elections"]),
             ("member_lease_age_seconds", "gauge", [
                 (format_labels(member=m), age)
                 for m, age in s["membership"]["lease_age_s"].items()

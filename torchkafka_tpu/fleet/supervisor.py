@@ -153,6 +153,7 @@ class ProcessFleet:
         route_patience: int = 256,
         wal_dir: str | os.PathLike | None = None,
         wal_durability: str | None = "batch",
+        broker_replicas: int = 1,
         resilient: bool = False,
         reconnect_attempts: int = 6,
         reconnect_deadline_s: float = 15.0,
@@ -202,10 +203,41 @@ class ProcessFleet:
                 "prefill_replicas requires kv_pages (the handoff carries "
                 "paged KV blocks)"
             )
-        self.broker = broker if broker is not None else InMemoryBroker(
-            session_timeout_s=session_timeout_s,
-            wal_dir=self.wal_dir, wal_durability=wal_durability,
-        )
+        # Replicated broker cell: ``broker_replicas >= 2`` hosts the
+        # broker as a 1-leader + N-follower quorum cell (source/cluster)
+        # instead of a lone InMemoryBroker — every acked mutation is on a
+        # majority of WAL replicas, and ``kill_leader()`` fails over to a
+        # promoted follower on the SAME advertised port with zero
+        # committed-record loss (workers ride it exactly like
+        # ``restart_broker``'s outage, reconnect-unfenced).
+        self._cell = None
+        if broker is None and broker_replicas > 1:
+            if self.wal_dir is None:
+                raise ValueError(
+                    "broker_replicas > 1 requires ProcessFleet(wal_dir=...):"
+                    " a quorum cell is made of WAL replicas"
+                )
+            from torchkafka_tpu.source.cluster import BrokerCell
+            from torchkafka_tpu.source.replication import ReplicationConfig
+            self._cell = BrokerCell(
+                self.wal_dir,
+                config=ReplicationConfig(
+                    replicas=broker_replicas,
+                    durability=(
+                        "batch" if wal_durability == "quorum"
+                        else wal_durability
+                    ),
+                    lease_timeout_s=session_timeout_s,
+                    heartbeat_interval_s=heartbeat_interval_s,
+                ),
+                session_timeout_s=session_timeout_s,
+            )
+            self.broker = self._cell.broker
+        else:
+            self.broker = broker if broker is not None else InMemoryBroker(
+                session_timeout_s=session_timeout_s,
+                wal_dir=self.wal_dir, wal_durability=wal_durability,
+            )
         for t, p in ((topic, partitions), (out_topic, 1),
                      (ready_topic, 1), (self.handoff_topic, 1)):
             if t is None or p is None:
@@ -214,7 +246,10 @@ class ProcessFleet:
                 self.broker.create_topic(t, partitions=p)
             except ValueError:
                 pass  # caller already created (and maybe filled) it
-        self.server = BrokerServer(self.broker)
+        self.server = (
+            self._cell.server if self._cell is not None
+            else BrokerServer(self.broker)
+        )
         self.metrics = metrics if metrics is not None else FleetMetrics()
         self.tracer = tracer
         self._target = replicas
@@ -570,6 +605,40 @@ class ProcessFleet:
         self.victims.append(forensics)
         return forensics
 
+    def kill_leader(self) -> dict:
+        """Leader-death drill for a replicated broker cell
+        (``broker_replicas >= 2``): drop the leader the way SIGKILL
+        would (its server vanishes mid-conversation, its WAL is
+        abandoned un-flushed), run the epoch-bumped election, and
+        promote the longest follower onto the SAME advertised port —
+        the ``restart_broker`` takeover discipline, minus the outage
+        window a lone broker has to ride. Workers reconnect through
+        their retry stacks, unfenced; the deposed leader's late ships
+        stale-epoch-fence like any zombie's commits. Returns forensics
+        (victim/winner indices, epochs, candidate positions, the
+        promotion's PR-11 recovery summary, failover wall-clock),
+        appended to ``self.victims`` like every other kill drill."""
+        if self._cell is None:
+            raise ValueError(
+                "kill_leader requires ProcessFleet(broker_replicas >= 2): "
+                "a lone broker has no follower to promote"
+            )
+        fx = self._cell.kill_leader()
+        self.broker = self._cell.broker
+        self.server = self._cell.server
+        self.metrics.leader_elections.add(1)
+        if self.tracer is not None:
+            rec = fx.get("recovery", {})
+            self.tracer.broker_restarted(
+                replayed_records=rec.get("replayed_records", 0),
+                aborted_txns=rec.get("aborted_txns", 0),
+                recovery_ms=rec.get("recovery_ms", 0.0),
+            )
+        forensics = {"kind": "leader", **fx}
+        self.victims.append(forensics)
+        _logger.info("broker leader failed over: %s", forensics)
+        return forensics
+
     def restart_broker(self, crash: bool = True, down_s: float = 0.0) -> dict:
         """Kill and recover the hosted broker — the broker-death drill.
 
@@ -592,6 +661,12 @@ class ProcessFleet:
             raise ValueError(
                 "restart_broker requires ProcessFleet(wal_dir=...): "
                 "without a WAL there is no state to recover"
+            )
+        if self._cell is not None:
+            raise ValueError(
+                "a replicated cell fails over via kill_leader(), not "
+                "restart_broker(): promotion, not restart, is its "
+                "recovery path"
             )
         from torchkafka_tpu.source.memory import InMemoryBroker
         from torchkafka_tpu.source.netbroker import BrokerServer
@@ -803,8 +878,11 @@ class ProcessFleet:
             if inc.proc.poll() is None:
                 inc.proc.kill()
                 inc.proc.wait()
-        self.server.close()
-        self.broker.close()  # flush + close the WAL, when one exists
+        if self._cell is not None:
+            self._cell.close()  # leader, followers, servers, WALs
+        else:
+            self.server.close()
+            self.broker.close()  # flush + close the WAL, when one exists
 
     def __enter__(self) -> "ProcessFleet":
         return self

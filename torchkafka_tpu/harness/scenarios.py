@@ -3164,6 +3164,210 @@ def scenario_9(size: str = "tiny") -> dict:
     return _result("9:ragged-bucketed-train", rows, elapsed, stream, extra)
 
 
+def scenario_23(size: str = "tiny", replicas: int = 2) -> dict:
+    """Quorum-cell leader death mid-storm (ISSUE 17): the broker itself
+    becomes highly available. A 2-process ``exactly_once`` fleet serves
+    over a 3-REPLICA broker cell (``ProcessFleet(broker_replicas=3,
+    wal_durability="quorum")`` — every acked mutation majority-held
+    across WAL replicas before the client hears back). Once a worker's
+    journal proves served-but-uncommitted transactional work exists, the
+    LEADER is dropped the way SIGKILL would drop it (listener gone
+    mid-conversation, WAL abandoned un-flushed) and the cell runs its
+    epoch-bumped election: the longest-prefix follower replays through
+    PR-11 recovery (dangling transactions aborted, LSO recomputed) and
+    takes over the SAME advertised port. Workers reconnect through their
+    retry stacks, unfenced — promotion, not restart, so there is no
+    ride-through window to hold open. Audited: zero lost records,
+    committed-view duplicates EXACTLY zero, every committed completion
+    byte-identical to a no-kill reference, and the deposed leader's
+    forged late append REJECTED by the bumped epoch
+    (``StaleEpochError``) — the cell-level twin of scenario 18's fenced
+    zombie commit."""
+    import tempfile
+    import time as _time
+
+    import torchkafka_tpu as tk
+    from torchkafka_tpu.errors import StaleEpochError
+    from torchkafka_tpu.fleet import ProcessFleet
+    from torchkafka_tpu.journal import DecodeJournal
+    from torchkafka_tpu.serve import StreamingGenerator
+    from torchkafka_tpu.source.records import TopicPartition
+
+    prompt_len, max_new = (8, 16) if size == "tiny" else (32, 32)
+    n = 12 if size == "tiny" else 48
+    parts, slots, commit_every = 4, 2, 4
+    cfg, params, label = _serving_model(size, None, prompt_len, max_new)
+    model_spec = dict(
+        seed=0, vocab_size=cfg.vocab_size, d_model=cfg.d_model,
+        n_layers=cfg.n_layers, n_heads=cfg.n_heads,
+        n_kv_heads=cfg.n_kv_heads, d_ff=cfg.d_ff,
+        max_seq_len=cfg.max_seq_len,
+    )
+    rng = np.random.default_rng(23)
+    prompts = rng.integers(0, cfg.vocab_size, (n, prompt_len),
+                           dtype=np.int32)
+    all_keys = {str(i).encode() for i in range(n)}
+
+    # In-process no-kill reference (greedy decode is a pure function of
+    # (params, prompt)).
+    rb = tk.InMemoryBroker()
+    rb.create_topic("t23", partitions=parts)
+    for i in range(n):
+        rb.produce("t23", prompts[i].tobytes(), partition=i % parts,
+                   key=str(i).encode())
+    rc = tk.MemoryConsumer(rb, "t23", group_id="ref23")
+    ref_gen = StreamingGenerator(
+        rc, params, cfg, slots=slots, prompt_len=prompt_len,
+        max_new=max_new, commit_every=commit_every, ticks_per_sync=1,
+    )
+    ref = {rec.key: toks for rec, toks in ref_gen.run(idle_timeout_ms=400)}
+    rc.close()
+
+    t0 = _time.perf_counter()
+    with tempfile.TemporaryDirectory() as td:
+        import os as _os
+
+        fleet = ProcessFleet(
+            model_spec, topic="t23", prompt_len=prompt_len,
+            max_new=max_new, workdir=td, replicas=replicas,
+            partitions=parts, slots=slots, commit_every=commit_every,
+            session_timeout_s=8.0, heartbeat_interval_s=0.2,
+            journal_cadence=1, respawn=False, group="s23",
+            exactly_once=True,
+            wal_dir=_os.path.join(td, "cell"), wal_durability="quorum",
+            broker_replicas=3,
+            # Short client retries so the failover gap is FELT by the
+            # resilience stack (and provably ridden), not absorbed.
+            resilient=True, reconnect_attempts=2,
+            reconnect_deadline_s=0.4,
+        )
+        try:
+            fleet.start()
+            fleet.wait_ready(timeout_s=300)
+            ready_s = _time.perf_counter() - t0
+            for i in range(n):
+                fleet.broker.produce(
+                    "t23", prompts[i].tobytes(), partition=i % parts,
+                    key=str(i).encode(),
+                )
+
+            def uncommitted_served_work(inc) -> bool:
+                """Scenario 19's kill criterion, re-aimed at the leader:
+                a FINISHED journal entry past the committed watermark
+                proves in-flight transactional work exists for the
+                election to strand — the committed view must not move."""
+                try:
+                    entries = DecodeJournal.load(inc.journal_path)
+                except Exception:  # noqa: BLE001 - mid-write race
+                    return False
+                for (topic, p, off), e in entries.items():
+                    if not e.finished or topic != "t23":
+                        continue
+                    wm = fleet.broker.committed(
+                        "s23", TopicPartition("t23", p)
+                    ) or 0
+                    if off >= wm:
+                        return True
+                return False
+
+            deadline = _time.monotonic() + 240
+            while not any(
+                uncommitted_served_work(i) for i in fleet.live()
+            ):
+                if _time.monotonic() > deadline:
+                    raise TimeoutError(
+                        "no kill opportunity arose\n" + fleet.diagnose()
+                    )
+                if len(fleet.results("read_committed")) >= n:
+                    raise RuntimeError(
+                        "storm finished before any worker held "
+                        "uncommitted served work — shrink commit_every"
+                    )
+                _time.sleep(0.01)
+
+            failover = fleet.kill_leader()
+
+            # The deposed leader's late write: a forged frame carrying
+            # the OLD epoch must be rejected by every follower, never
+            # applied — zombie fencing at the cell level.
+            forged_rejected = False
+            try:
+                fleet._cell.forge_deposed_frame()
+            except StaleEpochError:
+                forged_rejected = True
+
+            def covered(f) -> bool:
+                committed = set(f.results("read_committed"))
+                if committed >= all_keys:
+                    return True
+                pending = set()
+                for inc in f.live():
+                    try:
+                        entries = DecodeJournal.load(inc.journal_path)
+                    except Exception:  # noqa: BLE001 - mid-write race
+                        continue
+                    for (topic, p, off), e in entries.items():
+                        if e.finished and topic == "t23":
+                            pending.add(str(off * parts + p).encode())
+                return committed | pending >= all_keys
+
+            fleet.wait(covered, timeout_s=240)
+            fleet.drain()
+            fleet.wait(
+                lambda f: all(not i.running for i in f.incarnations),
+                timeout_s=120,
+            )
+            fleet.poll_once()
+            zero_lost = fleet.fully_committed()
+
+            committed_res = fleet.results("read_committed")
+            committed_dups = sum(
+                len(v) - 1 for v in committed_res.values()
+            )
+            identical = set(committed_res) == set(ref) and all(
+                np.array_equal(toks, ref[k])
+                for k, copies in committed_res.items()
+                for _m, toks in copies
+            )
+            cell_status = fleet._cell.status()
+            worker_m = fleet.worker_metrics()
+            elapsed = _time.perf_counter() - t0
+        finally:
+            fleet.close()
+    return {
+        "scenario": "23:quorum-leader-failover-storm",
+        "model_scale": label,
+        "replicas": replicas,
+        "broker_replicas": 3,
+        "records": n,
+        "ready_s": round(ready_s, 2),
+        "elapsed_s": round(elapsed, 2),
+        "leader_elections": fleet.metrics.leader_elections.count,
+        "failover": {
+            "victim_idx": failover["victim_idx"],
+            "winner_idx": failover["winner_idx"],
+            "old_epoch": failover["old_epoch"],
+            "epoch": failover["epoch"],
+            "candidates": failover["candidates"],
+            "election_ms": round(failover["election_ms"], 2),
+            "failover_ms": round(failover["failover_ms"], 2),
+            "recovery": failover["recovery"],
+        },
+        "cell_epoch": cell_status["epoch"],
+        "zero_lost": zero_lost,
+        "identical_to_no_kill": identical,
+        "committed_duplicates": committed_dups,
+        "deposed_append_rejected": forged_rejected,
+        "workers_survived_unfenced": all(
+            m["exit"] == 0 for m in worker_m
+        ) and len(worker_m) == replicas,
+        "exit_codes": {
+            i.member: (None if i.proc is None else i.proc.returncode)
+            for i in fleet.incarnations
+        },
+    }
+
+
 SCENARIOS = {
     1: scenario_1,
     2: scenario_2,
@@ -3187,6 +3391,7 @@ SCENARIOS = {
     20: scenario_20,
     21: scenario_21,
     22: scenario_22,
+    23: scenario_23,
 }
 
 
@@ -3235,7 +3440,7 @@ def run_scenario(
         )
     sample_kw = dict(temperature=temperature, top_k=top_k, top_p=top_p)
     spec_kw = dict(spec=spec, spec_k=spec_k, spec_draft_layers=spec_draft_layers)
-    if num in (10, 11, 12, 13, 15, 16, 17, 18, 19, 20, 21):
+    if num in (10, 11, 12, 13, 15, 16, 17, 18, 19, 20, 21, 23):
         return SCENARIOS[num](size, replicas=replicas)
     if num == 22:
         return SCENARIOS[22](size, replicas=1)

@@ -103,6 +103,23 @@ scale_down_mid_drain       the SUPERVISOR SIGTERMed a scale-down victim
                            leave) still holds whatever the broker's fate
                            allows; nothing uncommitted is lost, and a
                            recovery supervisor converges to the target
+repl_frame_pre_ship        the LEADER appended a frame to its own WAL but
+                           dies before shipping it to any follower — the
+                           mutation was never quorum-acked, so the client
+                           retries against the promoted follower; the
+                           leader-local-only frame must never surface in
+                           the cell's committed view as a duplicate
+repl_frame_post_majority_pre_ack  a majority of replicas hold the frame
+                           but the leader dies before acking the client —
+                           the mutation IS durable cell-wide; promotion
+                           replays it and the client's retry is answered
+                           idempotently (the exactly-once twin of
+                           txn_marker_post_append_pre_ack, one layer up)
+election_pre_promote       an election chose the winning follower but the
+                           process dies before the promotion replay /
+                           port takeover — the cell stays leaderless; a
+                           re-run election (epoch bumped again) must
+                           converge on the same durable prefix
 ========================== =================================================
 
 Sites call ``crash_hook("<name>")``; production cost is one global ``is
@@ -153,6 +170,9 @@ REGISTERED_CRASH_POINTS: tuple[str, ...] = (
     "decode_adopt_pre_activate",
     "scale_up_pre_spawn",
     "scale_down_mid_drain",
+    "repl_frame_pre_ship",
+    "repl_frame_post_majority_pre_ack",
+    "election_pre_promote",
 )
 
 ENV_VAR = "TORCHKAFKA_CRASHPOINT"

@@ -9,12 +9,18 @@ from torchkafka_tpu.source.kafka import (
     KafkaProducer,
     KafkaTransactionalProducer,
 )
+from torchkafka_tpu.source.cluster import BrokerCell
 from torchkafka_tpu.source.memory import InMemoryBroker, MemoryConsumer
 from torchkafka_tpu.source.netbroker import (
     BrokerClient,
     BrokerServer,
     ChaosTransport,
     WireFaults,
+)
+from torchkafka_tpu.source.replication import (
+    FollowerReplica,
+    ReplicationConfig,
+    Replicator,
 )
 from torchkafka_tpu.source.wal import WriteAheadLog
 from torchkafka_tpu.source.producer import (
@@ -27,6 +33,7 @@ from torchkafka_tpu.source.producer import (
 from torchkafka_tpu.source.records import Record, TopicPartition
 
 __all__ = [
+    "BrokerCell",
     "BrokerClient",
     "BrokerServer",
     "ChaosConsumer",
@@ -43,6 +50,9 @@ __all__ = [
     "Producer",
     "TransactionalProducer",
     "RecordMetadata",
+    "FollowerReplica",
+    "ReplicationConfig",
+    "Replicator",
     "dead_letter_to_topic",
     "seek_to_timestamp",
     "Record",

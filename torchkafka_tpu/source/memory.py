@@ -183,6 +183,16 @@ class InMemoryBroker:
         self.metrics = BrokerMetrics()
         self.recovery_info: dict | None = None
         self._wal: _wal.WriteAheadLog | None = None
+        # "quorum" is a CELL-level ack discipline, not a new fsync mode:
+        # each replica keeps the default batch fsync locally and the ack
+        # gate moves to the replicator (a mutation returns only once a
+        # majority of replicas appended its frame). A bare broker with
+        # no replicator attached just runs the local half — the cell
+        # (source/cluster.py) attaches the quorum gate after recovery.
+        self.wal_durability = wal_durability
+        if wal_durability == "quorum":
+            wal_durability = "batch"
+        self.replicator = None
         if wal_dir is not None:
             self._recover_from_wal(
                 wal_dir, wal_durability, wal_segment_bytes
@@ -200,6 +210,23 @@ class InMemoryBroker:
         # durability is over — the broker is already being discarded.
         if self._wal is not None and not self._wal.closed:
             self._wal.append(kind, event)
+        # Quorum gate: with a replicator attached, the local append is
+        # only half the ack — ship() returns on majority and RAISES
+        # otherwise, aborting the in-memory apply before the caller could
+        # observe a mutation the cell cannot durably prove.
+        rep = self.replicator
+        if rep is not None:
+            rep.ship(kind, event)
+
+    def repl_ping(self) -> dict:
+        """Leader-liveness probe for the cell's heartbeat loop: answers
+        iff this broker's server is reachable, and reports the epoch it
+        is serving under (0 for a bare, cell-less broker)."""
+        rep = self.replicator
+        return {
+            "epoch": rep.epoch if rep is not None else 0,
+            "frames": len(rep.log) if rep is not None else 0,
+        }
 
     def close(self) -> None:
         """Flush + close the write-ahead log (clean shutdown; a crash

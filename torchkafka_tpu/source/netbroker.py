@@ -69,6 +69,14 @@ _METHODS = frozenset(
         "abort_txn",
         "fetch_stable",
         "last_stable_offset",
+        # Replication (broker-cell surface): the leader ships WAL frames
+        # to FollowerReplica objects served by this same BrokerServer, and
+        # the cell probes liveness/position over the same wire. Stale-
+        # epoch fencing crosses as the marshalled terminal StaleEpochError;
+        # transport faults stay the retryable BrokerUnavailableError.
+        "repl_append",
+        "repl_status",
+        "repl_ping",
     }
 )
 
@@ -549,3 +557,14 @@ class BrokerClient:
 
     def last_stable_offset(self, tp):
         return self._call("last_stable_offset", tp)
+
+    # ---- replication (broker-cell surface over the socket) ----
+
+    def repl_append(self, epoch, base, frames):
+        return self._call("repl_append", epoch, base, frames)
+
+    def repl_status(self, epoch=None):
+        return self._call("repl_status", epoch)
+
+    def repl_ping(self):
+        return self._call("repl_ping")

@@ -354,12 +354,15 @@ class StreamMetrics:
 
 class BrokerMetrics:
     """The metric set a durable ``InMemoryBroker`` maintains: WAL write
-    cost (appends, bytes, fsyncs) and recovery outcome (events/records
+    cost (appends, bytes, fsyncs), recovery outcome (events/records
     replayed, dangling transactions aborted, torn-tail bytes truncated,
-    wall-clock to recover) — the operator's answer to "what did that
-    broker restart cost and what did it salvage". Rendered on the same
-    shared exposition grammar as every other metrics class so the fleet
-    endpoint serves it from the same scrape."""
+    wall-clock to recover), and — when the broker leads a replicated
+    cell — the replication plane (frames shipped/applied, quorum
+    commits, stale-epoch rejections, elections won) — the operator's
+    answer to "what did that broker restart cost and what did it
+    salvage". Rendered on the same shared exposition grammar as every
+    other metrics class so the fleet endpoint serves it from the same
+    scrape."""
 
     def __init__(self) -> None:
         self.wal_appends = RateMeter()
@@ -371,6 +374,12 @@ class BrokerMetrics:
         self.recovery_aborted_txns = RateMeter()
         self.recovery_truncated_bytes = RateMeter()
         self.recovery_ms = Gauge()  # last recovery's replay wall-clock
+        # Replication plane (zero for a bare, cell-less broker).
+        self.repl_frames_shipped = RateMeter()
+        self.repl_frames_applied = RateMeter()
+        self.repl_quorum_commits = RateMeter()
+        self.repl_stale_rejections = RateMeter()
+        self.elections = RateMeter()
 
     def summary(self) -> dict:
         return {
@@ -383,6 +392,11 @@ class BrokerMetrics:
             "recovery_aborted_txns": self.recovery_aborted_txns.count,
             "recovery_truncated_bytes": self.recovery_truncated_bytes.count,
             "recovery_ms": round(self.recovery_ms.value, 3),
+            "repl_frames_shipped": self.repl_frames_shipped.count,
+            "repl_frames_applied": self.repl_frames_applied.count,
+            "repl_quorum_commits": self.repl_quorum_commits.count,
+            "repl_stale_rejections": self.repl_stale_rejections.count,
+            "elections": self.elections.count,
         }
 
     def render_prometheus(self, prefix: str = "torchkafka_broker") -> str:
@@ -401,4 +415,13 @@ class BrokerMetrics:
             ("recovery_truncated_bytes_total", "counter",
              s["recovery_truncated_bytes"]),
             ("recovery_ms", "gauge", s["recovery_ms"]),
+            ("repl_frames_shipped_total", "counter",
+             s["repl_frames_shipped"]),
+            ("repl_frames_applied_total", "counter",
+             s["repl_frames_applied"]),
+            ("repl_quorum_commits_total", "counter",
+             s["repl_quorum_commits"]),
+            ("repl_stale_rejections_total", "counter",
+             s["repl_stale_rejections"]),
+            ("elections_total", "counter", s["elections"]),
         ])
