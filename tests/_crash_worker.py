@@ -30,6 +30,12 @@ Modes:
           count pump progress): group membership + per-pump lease
           renewal + peer-journal scans. Covers heartbeat_pre_send and
           journal_handoff_pre_load.
+  rollout — one exactly-once fleet replica with the ROLLOUT plane
+          wired: pre-primed checkpoint (v1, different weights) and
+          scripted canary→swap directives on the control topic, no
+          controller process. Covers canary_pre_verdict,
+          rollout_pre_swap and swap_mid_apply — the journal's durable
+          model_version is the recovery authority at each.
   sweep — a supervisor's lease sweep against a zombie member that
           joined and never heartbeated: observes the expired lease via
           membership(), then fences. Covers lease_expired_pre_fence
@@ -619,6 +625,104 @@ def run_dg_decode(broker, workdir: str, *, patience: int = 8) -> None:
     producer.close()
 
 
+RO_TOPIC, RO_OUT = "rot", "roout"
+RO_CTL, RO_CKPT = "roctl", "rockpt"
+RO_GROUP = "rog"
+RO_PARTS = 2
+RO_PROMPTS = 8
+RO_CANARY_N = 2  # == SLOTS: the first retiring batch completes the slice
+
+
+def ro_model_spec(seed: int = 0) -> dict:
+    """fleet.proc.build_model spec; seed 0 is the boot (v0) weights,
+    seed 1 the published v1 checkpoint — DIFFERENT weights, so the two
+    references genuinely disagree and a mis-tagged output cannot pass
+    both."""
+    return {
+        "seed": seed, "vocab_size": VOCAB, "d_model": 32, "n_layers": 2,
+        "n_heads": 2, "n_kv_heads": 1, "d_ff": 64,
+        "max_seq_len": P + MAX_NEW,
+    }
+
+
+def ro_prompts():
+    import numpy as np
+
+    rng = np.random.default_rng(13)
+    return rng.integers(0, VOCAB, (RO_PROMPTS, P), dtype=np.int32)
+
+
+def prime_rollout_topics(broker):
+    """Prompt/output/control/checkpoint topics for the rollout-mode
+    matrix: v1 (seed-1) weights on the checkpoint topic, and the
+    SCRIPTED directives — canary then swap, both addressed to m0 — on
+    the control plane. No controller process exists in this mode: the
+    worker executes the pre-primed script, dies at the armed point, and
+    the recovery incarnation re-reads the same topic from offset 0."""
+    import json
+
+    import numpy as np
+
+    from torchkafka_tpu.fleet.proc import build_model
+    from torchkafka_tpu.source.checkpoint_wire import publish_checkpoint
+
+    broker.create_topic(RO_TOPIC, partitions=RO_PARTS)
+    broker.create_topic(RO_OUT, partitions=1)
+    broker.create_topic(RO_CTL, partitions=1)
+    broker.create_topic(RO_CKPT, partitions=1)
+    prompts = ro_prompts()
+    for i in range(RO_PROMPTS):
+        broker.produce(
+            RO_TOPIC, prompts[i].tobytes(), partition=i % RO_PARTS,
+            key=str(i).encode(),
+        )
+    _, v1_params = build_model(ro_model_spec(seed=1))
+    publish_checkpoint(broker, RO_CKPT, 1, v1_params)
+    for msg in (
+        {"t": "canary", "member": "m0", "version": 1, "n": RO_CANARY_N},
+        {"t": "swap", "member": "m0", "version": 1},
+    ):
+        broker.produce(RO_CTL, json.dumps(msg).encode(), partition=0)
+    return prompts
+
+
+def run_rollout(broker, workdir: str, member: str = "m0") -> int:
+    """One EXACTLY-ONCE process-fleet replica with the rollout plane
+    wired (fleet/proc.py spawns a RolloutWorker when rollout_topic +
+    ckpt_topic are set). The member id stays "m0" across incarnations:
+    journals/m0.json is the version-restore authority — a recovery
+    under a fresh name would neither see the journaled version nor
+    match the scripted directives' address. Covers canary_pre_verdict,
+    rollout_pre_swap and swap_mid_apply."""
+    from torchkafka_tpu.fleet.proc import run_replica_worker
+
+    spec = {
+        "member_id": member,
+        "replica_index": 0,
+        "topic": RO_TOPIC,
+        "group": RO_GROUP,
+        "out_topic": RO_OUT,
+        "ready_topic": None,
+        "journal_dir": os.path.join(workdir, "journals"),
+        "journal_cadence": 2,
+        "model": ro_model_spec(),
+        "model_version": 0,
+        "rollout_topic": RO_CTL,
+        "ckpt_topic": RO_CKPT,
+        "exactly_once": True,
+        "prompt_len": P,
+        "max_new": MAX_NEW,
+        "slots": SLOTS,
+        "commit_every": COMMIT_EVERY,
+        "ticks_per_sync": 1,
+        "max_poll_records": SLOTS,
+        "heartbeat_interval_s": 0.0,
+        "heartbeat_mode": "loop",
+        "idle_exit_ms": 600,
+    }
+    return run_replica_worker(spec, broker=broker)
+
+
 def run_ckpt(broker, workdir: str) -> None:
     """One training-shaped incarnation: resume from the newest complete
     checkpoint, then chunks of poll → commit → save. The commit-then-
@@ -699,6 +803,8 @@ def main() -> int:
             run_ckpt(client, workdir)
         elif mode == "fleet":
             run_fleet(client, workdir)
+        elif mode == "rollout":
+            run_rollout(client, workdir)
         elif mode == "sweep":
             run_sweep(client)
         elif mode == "dgpre":

@@ -66,11 +66,19 @@ def pytest_collection_modifyitems(config, items):
     newest_tests = ("test_scenario_22_autoscaled_step_storm",)
     newest_module = "test_autoscale.py"
     # ISSUE-17 coverage is newer still: the quorum failover storm runs
-    # dead last so a budget overrun truncates it before anything older.
+    # near-last so a budget overrun truncates it before anything older.
     quorum_tests = ("test_scenario_23_quorum_leader_failover",)
+    # ISSUE-18 coverage is the newest of all: the rollout differential
+    # suite and the hot-swap canary scenario run dead last.
+    rollout_module = "test_rollout.py"
+    rollout_tests = ("test_scenario_24_rolling_hot_swap",)
 
     def tail_rank(item):
         path = str(getattr(item, "fspath", ""))
+        if item.name in rollout_tests:
+            return 8
+        if path.endswith(rollout_module):
+            return 7
         if item.name in quorum_tests:
             return 6
         if item.name in newest_tests:

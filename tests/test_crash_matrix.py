@@ -123,6 +123,17 @@ MATRIX: dict[str, tuple[str, int]] = {
     # AFTER the epoch bump fenced the old leader but BEFORE the winner
     # promoted — the parent's offline re-election must converge on the
     # same durable prefix.
+    # Rolling weight hot-swap windows (fleet/rollout.py + serve.py
+    # swap_params): one exactly-once replica executing a scripted
+    # canary→swap rollout. Arrival 1 everywhere — each window is
+    # reached exactly once per rollout: the canary's verdict fires the
+    # pump the first completion batch retires (the slice == slots, so
+    # compared jumps 0→n in one sweep, BEFORE any swap attempt);
+    # pre_swap/mid_apply fire inside the quiesced swap_params call,
+    # either side of the journal's durable version flip.
+    "canary_pre_verdict": ("rollout", 1),
+    "rollout_pre_swap": ("rollout", 1),
+    "swap_mid_apply": ("rollout", 1),
     "repl_frame_pre_ship": ("cell", 24),
     "repl_frame_post_majority_pre_ack": ("cell", 26),
     "election_pre_promote": ("cell", 1),
@@ -1142,6 +1153,165 @@ def _run_scale_case(tmp_path, sc_reference, point: str, at: int):
         fleet.close()
 
 
+@pytest.fixture(scope="module")
+def ro_reference(tmp_path_factory):
+    """Byte-truth PER MODEL VERSION for the rollout matrix: greedy
+    decode of every rollout prompt under the v0 (boot, seed-0) and v1
+    (checkpoint, seed-1) weights. The two references disagree, so an
+    output can only pass the audit under the version its "mv" tag
+    claims — the never-half-old/half-new check is exact."""
+    from torchkafka_tpu.fleet.proc import build_model
+    from torchkafka_tpu.serve import StreamingGenerator
+
+    prompts = W.ro_prompts()
+    refs: dict[int, dict] = {}
+    for version, seed in ((0, 0), (1, 1)):
+        cfg, params = build_model(W.ro_model_spec(seed=seed))
+        broker = tk.InMemoryBroker()
+        broker.create_topic("ref", partitions=W.RO_PARTS)
+        for i in range(W.RO_PROMPTS):
+            broker.produce("ref", prompts[i].tobytes(),
+                           partition=i % W.RO_PARTS, key=str(i).encode())
+        c = tk.MemoryConsumer(broker, "ref", group_id="ref")
+        gen = StreamingGenerator(
+            c, params, cfg, slots=W.SLOTS, prompt_len=W.P,
+            max_new=W.MAX_NEW, commit_every=2, ticks_per_sync=1,
+        )
+        refs[version] = {
+            rec.key: toks for rec, toks in gen.run(idle_timeout_ms=400)
+        }
+        c.close()
+    assert any(
+        not np.array_equal(refs[0][k], refs[1][k]) for k in refs[0]
+    ), "v0 and v1 references coincide — the version audit would be vacuous"
+    return refs
+
+
+def _ro_committed(broker):
+    """Committed-view rollout outputs by key → list of (mv tag, tokens):
+    the downstream consumer's truth, version tags included."""
+    out: dict[bytes, list] = {}
+    recs, _ = broker.fetch_stable(TopicPartition(W.RO_OUT, 0), 0, 100000)
+    for rec in recs:
+        mv = dict(rec.headers or ()).get("mv", b"?")
+        out.setdefault(rec.key, []).append(
+            (mv, np.frombuffer(rec.value, dtype=np.int32))
+        )
+    return out
+
+
+def _ro_audit(broker, ro_reference, *, complete: bool):
+    """Exactly-once + version-integrity invariants: each key committed
+    at most (``complete``: exactly) once; every output's tokens are
+    byte-identical to the reference OF THE VERSION ITS TAG CLAIMS —
+    a half-swapped tree would match neither; every committed offset is
+    covered by a committed output."""
+    outs = _ro_committed(broker)
+    for key, copies in outs.items():
+        assert len(copies) == 1, (
+            f"{len(copies)} committed copies of {key!r}"
+        )
+        mv, toks = copies[0]
+        assert mv in (b"0", b"1"), (key, mv)
+        np.testing.assert_array_equal(
+            toks, ro_reference[int(mv)][key],
+            err_msg=f"{key!r} tagged mv={mv!r} but tokens do not match "
+            "that version's reference — half-old/half-new params",
+        )
+    for p in range(W.RO_PARTS):
+        tp = TopicPartition(W.RO_TOPIC, p)
+        wm = broker.committed(W.RO_GROUP, tp) or 0
+        assert wm <= broker.end_offset(tp)
+        for off in range(wm):
+            key = str(off * W.RO_PARTS + p).encode()
+            assert key in outs, (
+                f"committed {p}:{off} (prompt {key}) has no committed output"
+            )
+        if complete:
+            assert wm == broker.end_offset(tp), (
+                f"partition {p} not fully committed"
+            )
+    if complete:
+        assert set(outs) == {
+            str(i).encode() for i in range(W.RO_PROMPTS)
+        }, "lost completions"
+    return outs
+
+
+def _run_rollout_case(tmp_path, ro_reference, point: str, at: int):
+    """An exactly-once replica SIGKILLed inside the rollout plane. The
+    journal's durable model_version — flipped BEFORE the in-memory
+    rebind — is the single restart authority: at death the committed
+    view and the journal are consistent with exactly one side of each
+    window, and the recovery incarnation (same member id, same journal)
+    restores the journaled version, re-reads the scripted directives
+    from offset 0, completes the swap, and serves the remainder under
+    v1 — zero lost, zero committed duplicates, every version tag true."""
+    import json
+
+    broker = tk.InMemoryBroker()
+    W.prime_rollout_topics(broker)
+    workdir = str(tmp_path / point)
+    os.makedirs(workdir, exist_ok=True)
+    with tk.BrokerServer(broker) as server:
+        proc, marker = _spawn("rollout", server.port, workdir, point, at)
+        proc.wait(timeout=180)
+    with open(os.path.join(workdir, "child.log"), "rb") as f:
+        log = f.read().decode(errors="replace")
+    assert proc.returncode == -signal.SIGKILL, (
+        f"worker exited {proc.returncode}, not SIGKILL — point {point!r} "
+        f"never reached?\n{log}"
+    )
+    with open(marker) as f:
+        assert f.read().strip() == f"{point}:{at}"
+    _reap_group(broker, W.RO_GROUP)
+
+    # ---- invariants at the moment of death ------------------------------
+    jpath = os.path.join(workdir, "journals", "m0.json")
+    meta_v = DecodeJournal.load_meta(jpath).get("model_version")
+    outs = _ro_audit(broker, ro_reference, complete=False)
+    # Whichever side of the flip the death landed on, the corpse never
+    # emitted a v1 output: the rebind either never happened (pre_swap,
+    # pre_verdict) or died before the first post-swap admission
+    # (mid_apply kills between flip and rebind).
+    assert all(c[0][0] == b"0" for c in outs.values()), (
+        "a v1-tagged output committed before the swap completed"
+    )
+    if point == "swap_mid_apply":
+        # The defining window: version 1 DURABLE, rebind never reached.
+        assert meta_v is not None and int(meta_v) == 1, meta_v
+    else:
+        # The flip was never reached: journal meta absent or still 0.
+        assert meta_v in (None, 0), meta_v
+    if point == "canary_pre_verdict":
+        # Died holding the verdict: neither the canary report nor any
+        # swap ack ever made the control topic — the incumbent was
+        # still serving and the (scripted) controller saw nothing.
+        ctl = broker.fetch(TopicPartition(W.RO_CTL, 0), 0, 1000)
+        kinds = [
+            (json.loads(r.value) or {}).get("t") for r in ctl
+        ]
+        assert "canary_report" not in kinds, kinds
+        assert "ack" not in kinds, kinds
+
+    # ---- recovery: same member id, same journal, in-process -------------
+    # Constructing the recovery TransactionalProducer re-inits the
+    # replica-indexed transactional id (epoch bump: the corpse's open
+    # transaction aborts); the journal meta restore rebuilds the
+    # journaled version's weights from the checkpoint topic BEFORE the
+    # first token; the control topic replays the scripted directives.
+    rc = W.run_rollout(broker, workdir, member="m0")
+    assert rc == 0
+    outs = _ro_audit(broker, ro_reference, complete=True)
+    final_v = DecodeJournal.load_meta(jpath).get("model_version")
+    assert final_v is not None and int(final_v) == 1, (
+        f"journal version {final_v!r} after recovery — swap never landed"
+    )
+    assert any(c[0][0] == b"1" for c in outs.values()), (
+        "no v1 output after recovery — the rollout never completed"
+    )
+
+
 FULL_POINTS = [p for p in MATRIX if p not in TIER1]
 
 
@@ -1193,6 +1363,10 @@ def _dispatch_case(tmp_path, request, point: str) -> None:
     elif mode == "fleet":
         _run_fleet_case(
             tmp_path, request.getfixturevalue("fleet_reference"), point, at
+        )
+    elif mode == "rollout":
+        _run_rollout_case(
+            tmp_path, request.getfixturevalue("ro_reference"), point, at
         )
     elif mode == "sweep":
         _run_sweep_case(tmp_path, point, at)

@@ -505,3 +505,43 @@ class TestGracefulDrain:
             for p in range(2)
         )
         assert committed == len(got) >= 4
+
+    def test_finish_drain_retries_survivable_flush_failure(self):
+        """The fleet-wide-drain generation race (caught by scenario 24):
+        a peer's clean leave bumps the group generation mid-drain and the
+        last replica's final flush gets CommitFailedError. finish_drain
+        must RETRY — flush_commits keeps the outbox/cadence intact and
+        the next attempt re-syncs the group — not exit rc=0 with
+        finished completions stranded uncommitted."""
+        from torchkafka_tpu.fleet.replica import DRAINING, Replica
+
+        class _Gen:
+            def __init__(self):
+                self.flush_calls = 0
+                self.synced = False
+
+            def flush_commits(self):
+                self.flush_calls += 1
+                # Two survivable failures (rebalanced-generation commit
+                # rejections), then the re-synced attempt lands.
+                return self.flush_calls >= 3
+
+            def sync_journal(self):
+                self.synced = True
+
+            def has_active(self):
+                return False
+
+        class _Consumer:
+            closed = False
+
+            def close(self):
+                self.closed = True
+
+        gen, consumer = _Gen(), _Consumer()
+        rep = Replica(0, gen, consumer, None, None, None)
+        rep.state = DRAINING
+        rep.finish_drain()
+        assert gen.flush_calls == 3  # retried past both failures
+        assert gen.synced and consumer.closed
+        assert rep.state == "done"

@@ -403,6 +403,42 @@ def test_scenario_23_quorum_leader_failover():
     assert sorted(out["exit_codes"].values()) == [0, 0]
 
 
+def test_scenario_24_rolling_hot_swap():
+    """The tier-1 live-lifecycle smoke (ISSUE 18): a 2-process
+    exactly-once fleet serves a storm while a DIVERGENT checkpoint rolls
+    out — the canary's token diff triggers an AUTOMATIC rollback before
+    any replica serves it into the committed view — then a CLEAN
+    checkpoint rolls out to completion one drain-swap at a time. The
+    acceptance contract is the ISSUE's: zero lost records, committed
+    duplicates exactly zero, byte-identical to a no-rollout reference,
+    and every committed output version-tagged v0 or v2 — never the
+    divergent v1."""
+    out = run_scenario(24, "tiny")
+    assert out["scenario"] == "24:rolling-hot-swap-canary-rollback"
+    assert out["replicas"] == 2
+    # Rollout 1: divergence detected on the canary, rolled back, every
+    # member back on (still on) the incumbent.
+    div = out["divergent_rollout"]
+    assert div["phase"] == "rolled_back"
+    assert div["rollback_reason"] == "canary_divergence"
+    assert all(v == 0 for v in div["member_versions"].values())
+    # Rollout 2: clean walk to completion; the fleet's incumbent
+    # advanced to v2 on every member.
+    clean = out["clean_rollout"]
+    assert clean["phase"] == "complete"
+    assert all(v == 2 for v in clean["member_versions"].values())
+    assert out["fleet_model_version"] == 2
+    # The committed view: exactly-once, byte-identical, version tags
+    # consistent — the divergent version left no committed trace.
+    assert out["zero_lost"] is True
+    assert out["committed_duplicates"] == 0
+    assert out["identical_to_no_rollout"] is True
+    assert out["divergent_version_leaked"] is False
+    assert out["version_tags_consistent"] is True
+    assert "0" in out["version_tags"] and "2" in out["version_tags"]
+    assert out["workers_survived"] is True
+
+
 def test_scenario_20_sharded_paged_fleet():
     """The tier-1 sharded-paged smoke (PR 13): a 2-replica fleet whose
     generators compose paged block tables + int8 payloads + the kernel

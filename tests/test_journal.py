@@ -370,6 +370,12 @@ class TestCrashPoints:
             # but before promotion.
             "repl_frame_pre_ship", "repl_frame_post_majority_pre_ack",
             "election_pre_promote",
+            # The rolling hot-swap windows (ISSUE 18): a worker dying
+            # after the swap directive lands but before the drain-swap
+            # starts, mid-way through rebinding the new weights, and a
+            # canary dying after shadow-serving its slice but before
+            # publishing the verdict.
+            "rollout_pre_swap", "swap_mid_apply", "canary_pre_verdict",
         }
 
 

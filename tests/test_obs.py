@@ -44,8 +44,9 @@ from torchkafka_tpu.obs import (
 )
 from torchkafka_tpu.obs.burn import BURNING, OK, SHEDDING, WARNING
 from torchkafka_tpu.obs.trace import (
-    BURN_STATE, COMMITTED, FINISHED, JOURNAL_HANDOFF, POLLED, QOS_ADMITTED,
-    REPLICA_FENCED, REPLICA_JOINED, SLOT_ACTIVE,
+    BURN_STATE, CANARY_STARTED, COMMITTED, FINISHED, JOURNAL_HANDOFF,
+    POLLED, QOS_ADMITTED, REPLICA_FENCED, REPLICA_JOINED, ROLLED_BACK,
+    ROLLOUT_PHASE, SLOT_ACTIVE, SWAPPED,
 )
 from torchkafka_tpu.resilience import ManualClock
 from torchkafka_tpu.serve import ServeMetrics, StreamingGenerator
@@ -663,13 +664,29 @@ def _fleet_metrics():
     m.autoscale_target("prefill").set(1)
     m.autoscale_phase("decode").set(1)
     m.autoscale_time_in_phase("decode").set(4.5)
+    # ISSUE-18 rollout families: controller phase + target gauges,
+    # per-member served-version gauges (member ids escape like tenant
+    # keys), canary diff / rollback / checkpoint-reject counters with
+    # reason labels.
+    m.rollout_phase.set(1)
+    m.rollout_target_version.set(3)
+    m.canary_token_diffs.add(2)
+    m.replica_model_version("r0i0").set(3)
+    m.replica_model_version(EVIL_TENANT).set(2)
+    m.rollback("canary_divergence").add(1)
+    m.checkpoint_reject("wire").add(2)
     text = m.render_prometheus(replicas=None)
     for family in (
         "autoscale_decisions_total", "autoscale_target_replicas",
         "autoscale_phase", "autoscale_time_in_phase_seconds",
+        "rollout_phase", "rollout_target_version",
+        "canary_token_diffs_total", "replica_model_version",
+        "rollbacks_total", "checkpoint_rejects_total",
     ):
         assert f"torchkafka_fleet_{family}" in text, family
     assert 'role="decode",direction="up",reason="burn"' in text
+    assert 'reason="canary_divergence"' in text
+    assert 'member="r0i0"' in text
     return text
 
 
@@ -818,6 +835,47 @@ def test_membership_events_ride_the_trace_stream():
     tr2.replica_fenced("r0i0", reason="lease_expired", lease_age_s=2.5,
                        replica=0)
     tr2.journal_handoff("r0i0", entries=3, replica=0)
+    assert tr2.signature() == tr.signature()
+
+
+def test_rollout_events_ride_the_trace_stream():
+    """ISSUE-18 lifecycle observability: rollout_phase / canary_started
+    / swapped / rolled_back are typed events on the SAME stream as
+    record lifecycles (topic "fleet", sequential offsets) with the
+    phase, member, version, slice and reason in the attrs — they open
+    no record lifecycle, and a same-input replay emits identical
+    signatures (the byte-auditable narration contract)."""
+    mc = ManualClock()
+    tr = RecordTracer(ObsConfig(clock=mc.now))
+    tr.rollout_phase("canary", 3)
+    tr.canary_started("r0i0", 3, slice_n=4)
+    mc.advance(0.5)
+    tr.swapped(3, member="r0i0", replica=0)
+    tr.rollout_phase("rolling", 3)
+    tr.rolled_back("canary_divergence", 3)
+    evs = list(tr.events)
+    assert [e.stage for e in evs] == [
+        ROLLOUT_PHASE, CANARY_STARTED, SWAPPED, ROLLOUT_PHASE, ROLLED_BACK,
+    ]
+    assert [e.key for e in evs] == [
+        ("fleet", 0, i) for i in range(5)
+    ]
+    assert dict(evs[0].attrs) == {"phase": "canary", "version": 3}
+    canary = dict(evs[1].attrs)
+    assert canary == {"member": "r0i0", "version": 3, "slice_n": 4}
+    swapped = dict(evs[2].attrs)
+    assert swapped == {"member": "r0i0", "replica": 0, "version": 3}
+    assert dict(evs[4].attrs) == {
+        "reason": "canary_divergence", "version": 3,
+    }
+    assert tr.summary()["open_records"] == 0
+    # Same-seed determinism: a replay emits identical signatures.
+    tr2 = RecordTracer(ObsConfig(clock=ManualClock().now))
+    tr2.rollout_phase("canary", 3)
+    tr2.canary_started("r0i0", 3, slice_n=4)
+    tr2.swapped(3, member="r0i0", replica=0)
+    tr2.rollout_phase("rolling", 3)
+    tr2.rolled_back("canary_divergence", 3)
     assert tr2.signature() == tr.signature()
 
 

@@ -173,3 +173,13 @@ class BarrierError(TpuKafkaError):
     The commit path fails *closed* on this: no offsets are committed, so Kafka
     re-delivers the batch — zero uncommitted-batch loss on host preemption.
     """
+
+
+class CheckpointWireError(TpuKafkaError):
+    """A checkpoint frame on the rollout plane failed validation —
+    truncated manifest/chunk, CRC mismatch, dtype/shape drift against the
+    incumbent tree, or a missing chunk. TERMINAL PER FETCH, never per
+    process: the replica rejects the candidate, keeps serving the
+    incumbent version, counts the rejection, and a re-published (or
+    re-fetched) checkpoint converges — a torn rollout artifact degrades
+    the rollout, never the serving path."""

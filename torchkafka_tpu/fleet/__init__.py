@@ -22,6 +22,12 @@ from torchkafka_tpu.fleet.prefill import (
     decode_handoff,
     encode_handoff,
 )
+from torchkafka_tpu.fleet.rollout import (
+    BrokerRolloutDriver,
+    InProcessRolloutDriver,
+    RolloutController,
+    RolloutWorker,
+)
 from torchkafka_tpu.fleet.supervisor import ProcessFleet, sweep_expired
 from torchkafka_tpu.fleet.qos import (
     BATCH,
@@ -39,6 +45,10 @@ __all__ = [
     "AdmissionQueue",
     "AutoscaleController",
     "BATCH",
+    "BrokerRolloutDriver",
+    "InProcessRolloutDriver",
+    "RolloutController",
+    "RolloutWorker",
     "FleetAutoscaler",
     "FleetMetrics",
     "INTERACTIVE",

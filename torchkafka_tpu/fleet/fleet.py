@@ -446,6 +446,43 @@ class ServingFleet:
                 self.kill_replica(rep.id)
                 self.metrics.drain_timeout_kills.add(1)
 
+    def start_rollout(
+        self,
+        version: int,
+        params_by_version: dict,
+        *,
+        canary_replica: int = 0,
+        canary_slice: int = 8,
+        max_canary_diffs: int = 0,
+        incumbent_version: int = 0,
+    ):
+        """Begin a rolling hot-swap to ``version`` on this in-process
+        fleet (fleet/rollout.py). ``params_by_version`` maps version
+        ints to params trees — the in-process twin of the checkpoint
+        topic; it must hold the target AND the incumbent (rollback swaps
+        back to it). Returns an ``InProcessRolloutDriver``: plug its
+        ``on_round`` into ``serve(on_round=...)`` and feed every yielded
+        completion to ``observe(rid, rec, tokens)`` — the canary's
+        token-diff stream. ``trace_acks`` is off because each
+        generator's own ``swap_params`` already types the ``swapped``
+        event with its replica id."""
+        from torchkafka_tpu.fleet.rollout import (
+            InProcessRolloutDriver,
+            RolloutController,
+        )
+
+        ctl = RolloutController(
+            [r.id for r in self.replicas if r.state == SERVING],
+            int(version),
+            canary_member=canary_replica,
+            canary_slice=canary_slice,
+            max_canary_diffs=max_canary_diffs,
+            incumbent_version=incumbent_version,
+            tracer=self.tracer, metrics=self.metrics,
+            trace_acks=False,
+        )
+        return InProcessRolloutDriver(self, ctl, params_by_version)
+
     def kill_replica(self, rid: int) -> None:
         """Simulate a replica crash (see Replica.kill), then consult the
         victim's decode journal for warm failover: its entries — read

@@ -51,6 +51,18 @@ class FleetMetrics:
         self._member_lease_age: dict[str, Gauge] = {}  # seconds since the
         # member's last successful lease renewal (age = session timeout
         # minus observed remaining; 0 right after a heartbeat)
+        # Live model lifecycle (fleet/rollout.py): the controller's
+        # current phase (0 pending / 1 canary / 2 rolling / 3 complete /
+        # 4 rolled_back), each member's serving version, canary shadow
+        # token diffs, automatic rollbacks by reason, and checkpoint
+        # frames rejected by the wire's CRC/shape gates (graceful
+        # degradation — the replica keeps serving the incumbent).
+        self.rollout_phase = Gauge()
+        self.rollout_target_version = Gauge()
+        self.canary_token_diffs = RateMeter()
+        self._replica_model_version: dict[str, Gauge] = {}
+        self._rollbacks: dict[str, RateMeter] = {}
+        self._ckpt_rejects: dict[str, RateMeter] = {}
         # Autoscale controller families (fleet/autoscale.py): decision
         # counters labeled {role, direction, reason}, the controller's
         # current per-role target, and which phase (steady / scaling_up /
@@ -124,6 +136,15 @@ class FleetMetrics:
 
     def autoscale_time_in_phase(self, role: str) -> Gauge:
         return self._autoscale_phase_s.setdefault(role, Gauge())
+
+    def replica_model_version(self, member: str) -> Gauge:
+        return self._replica_model_version.setdefault(member, Gauge())
+
+    def rollback(self, reason: str) -> RateMeter:
+        return self._rollbacks.setdefault(reason, RateMeter())
+
+    def checkpoint_reject(self, reason: str) -> RateMeter:
+        return self._ckpt_rejects.setdefault(reason, RateMeter())
 
     # ----------------------------------------------------------- reporting
 
@@ -233,6 +254,23 @@ class FleetMetrics:
                 )
             },
         }
+        rollout = {
+            "phase": int(self.rollout_phase.value),
+            "target_version": int(self.rollout_target_version.value),
+            "canary_token_diffs": self.canary_token_diffs.count,
+            "member_versions": {
+                m: int(g.value)
+                for m, g in sorted(self._replica_model_version.items())
+            },
+            "rollbacks": {
+                reason: m.count
+                for reason, m in sorted(self._rollbacks.items())
+            },
+            "checkpoint_rejects": {
+                reason: m.count
+                for reason, m in sorted(self._ckpt_rejects.items())
+            },
+        }
         membership = {
             "joins": self.replica_joins.count,
             "fences": self.replica_fences.count,
@@ -245,6 +283,7 @@ class FleetMetrics:
         }
         return {
             "membership": membership,
+            "rollout": rollout,
             "autoscale": autoscale,
             "slo": self._slo.summary() if self._slo is not None else None,
             "burn": (
@@ -353,6 +392,23 @@ class FleetMetrics:
             ("autoscale_time_in_phase_seconds", "gauge", [
                 (format_labels(role=role), v)
                 for role, v in s["autoscale"]["time_in_phase_s"].items()
+            ] or 0),
+            ("rollout_phase", "gauge", s["rollout"]["phase"]),
+            ("rollout_target_version", "gauge",
+             s["rollout"]["target_version"]),
+            ("canary_token_diffs_total", "counter",
+             s["rollout"]["canary_token_diffs"]),
+            ("replica_model_version", "gauge", [
+                (format_labels(member=m), v)
+                for m, v in s["rollout"]["member_versions"].items()
+            ] or 0),
+            ("rollbacks_total", "counter", [
+                (format_labels(reason=reason), v)
+                for reason, v in s["rollout"]["rollbacks"].items()
+            ] or 0),
+            ("checkpoint_rejects_total", "counter", [
+                (format_labels(reason=reason), v)
+                for reason, v in s["rollout"]["checkpoint_rejects"].items()
             ] or 0),
             ("journal_handoffs_total", "counter", s["journal"]["handoffs"]),
             ("drain_timeout_kills_total", "counter",

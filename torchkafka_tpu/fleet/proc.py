@@ -174,6 +174,9 @@ def _dump_metrics(
         "resume_rejected": m.resume_rejected.count,
         "completions": fleet_metrics.completions.count,
         "commit_failures": m.commit_failures.count,
+        # Which weights this incarnation EXITED on — the rollout audit's
+        # per-worker version attribution (journal meta is the durable twin).
+        "model_version": gen.model_version,
         # Disaggregated decode: slots admitted by handoff adoption (no
         # prompt pass here) vs locally prefilled tokens, plus the tick
         # p50/p99 the "decode ITL never stalls" audit reads.
@@ -245,6 +248,38 @@ def run_replica_worker(spec: dict, broker=None, shutdown=None) -> int:
         # run against compile time we have not even joined for yet.
         cfg, params = build_model(spec["model"])
         import jax
+
+        # Version restore: the journal's durable meta — flipped BEFORE the
+        # in-memory rebind at every swap — is the restart authority. A
+        # worker SIGKILL'd mid-rollout comes back here, reads the version
+        # its previous life committed to, and rebuilds THOSE weights from
+        # the checkpoint topic byte-identically before serving a single
+        # token. A torn/unfetchable checkpoint falls back to the boot
+        # weights: the version-tagged resume hints then reject (cold
+        # replay — slower, still exactly-once), never a crash.
+        boot_version = int(spec.get("model_version", 0))
+        boot_params = params
+        model_version = boot_version
+        ckpt_topic = spec.get("ckpt_topic")
+        if ckpt_topic:
+            from torchkafka_tpu.journal import DecodeJournal as _DJ
+
+            journaled = _DJ.load_meta(jpath).get("model_version")
+            if journaled is not None and int(journaled) != boot_version:
+                from torchkafka_tpu.errors import CheckpointWireError
+                from torchkafka_tpu.source.checkpoint_wire import (
+                    fetch_checkpoint,
+                    rebuild_tree,
+                )
+
+                try:
+                    flat, _mf = fetch_checkpoint(
+                        broker, ckpt_topic, int(journaled),
+                    )
+                    params = rebuild_tree(boot_params, flat)
+                    model_version = int(journaled)
+                except CheckpointWireError:
+                    metrics.checkpoint_reject("restore").add(1)
 
         consumer = MemoryConsumer(
             broker, spec["topic"], group_id=spec["group"], member_id=member,
@@ -329,6 +364,7 @@ def run_replica_worker(spec: dict, broker=None, shutdown=None) -> int:
             kv_pages=spec.get("kv_pages"),
             kv_tier=spec.get("kv_tier"),
             journal=journal,
+            model_version=model_version,
         )
         # Disaggregated decode: tail the handoff topic (broadcast — one
         # private group per replica) into the generator's shelf, and
@@ -377,6 +413,19 @@ def run_replica_worker(spec: dict, broker=None, shutdown=None) -> int:
             commit_every=int(spec.get("commit_every", 8)),
             max_poll_records=int(spec.get("max_poll_records", 64)),
         )
+        rollout = None
+        if spec.get("rollout_topic") and ckpt_topic:
+            from torchkafka_tpu.fleet.rollout import RolloutWorker
+
+            rollout = RolloutWorker(
+                broker, spec["rollout_topic"], ckpt_topic, member, rep,
+                boot_params=boot_params, boot_version=boot_version,
+                metrics=metrics,
+            )
+            if model_version != boot_version:
+                # The restored tree is this incarnation's incumbent —
+                # a later rollback to it must not need the wire.
+                rollout.cache(model_version, params)
 
         idle_exit_ms = spec.get("idle_exit_ms")
         last_assign: frozenset = frozenset()
@@ -420,6 +469,12 @@ def run_replica_worker(spec: dict, broker=None, shutdown=None) -> int:
                     last_assign = assigned
                 completions = rep.pump()
                 rep.maybe_flush()
+                if rollout is not None:
+                    # One rollout-plane sweep per pump: control-topic
+                    # directives in, canary comparisons over this pump's
+                    # completions, a pending drain-swap completed the
+                    # moment the replica quiesces.
+                    rollout.pump(completions)
             except BrokerUnavailableError:
                 # The broker is DOWN past the client's retry budget (a
                 # broker-process death; the supervisor is restarting it
@@ -439,7 +494,9 @@ def run_replica_worker(spec: dict, broker=None, shutdown=None) -> int:
                 return EXIT_CLEAN
             if completions or gen.has_active() or queue.depth():
                 idle_since = None
-            elif rep.state == SERVING:
+            elif rep.state == SERVING and not rep.admission_paused:
+                # A quiesced-for-swap replica is WORKING (the swap lands
+                # on the next pump), not idle — never idle-exit it.
                 if idle_since is None:
                     idle_since = now
                 elif (

@@ -73,6 +73,13 @@ class Replica:
         self._since_commit = 0
         self._retry_flush = False  # a survivably-failed flush awaits retry
         self._assigned: frozenset = frozenset()
+        # Admission pause (the rollout drain-swap's quiesce): while set,
+        # pump() neither polls nor admits — in-flight slots finish
+        # through further ticks — but the member STAYS in the group
+        # (assignment sync and heartbeats continue), unlike a drain,
+        # which leaves. That difference is the whole point: a weight
+        # swap must not cost a rebalance.
+        self._admission_paused = False
 
     # ----------------------------------------------------------- lifecycle
 
@@ -100,11 +107,63 @@ class Replica:
         journal is synced (flush + fsync) before the consumer leaves:
         a clean drain retires everything so the journal is empty-pruned,
         but a SECOND signal racing this path must still find the disk
-        state current."""
-        self.gen.flush_commits()
+        state current.
+
+        The final flush RETRIES on survivable failure: in a fleet-wide
+        drain a peer's clean leave bumps the group generation, and a
+        replica whose last commit races that rebalance gets
+        CommitFailedError — one-shot flushing here would exit rc=0 with
+        finished completions stranded uncommitted (replayed on restart,
+        LOST if the fleet is retiring for good). flush_commits keeps the
+        outbox/cadence intact on failure and the next attempt re-syncs
+        the group (assignment() adopts the post-rebalance generation),
+        so a bounded retry loop converges; past the budget we fall back
+        to the loss-free half of the contract (re-delivery)."""
+        deadline = time.monotonic() + 15.0
+        while not self.gen.flush_commits():
+            if time.monotonic() > deadline:
+                _logger.warning(
+                    "replica %d drain flush still failing at deadline; "
+                    "leaving the tail to re-delivery", self.id,
+                )
+                break
+            time.sleep(0.05)
         self.gen.sync_journal()
         self.consumer.close()
         self.state = DONE
+
+    def pause_admission(self) -> None:
+        """Quiesce for an in-place operation (weight hot-swap): stop
+        POLLING new work without leaving the group. Pumps keep ticking —
+        and keep admitting already-fetched (queued) records — so
+        everything the ledger holds pending retires; ``quiesced`` turns
+        True once it all has. Queued records must DRAIN rather than
+        wait: a fetched-but-unadmitted record is ledger-pending, so any
+        completion ordered after it is HELD from the committed view
+        (exactly-once outbox) — abandoning the queue would leave those
+        outputs uncommittable and the swap's closed-commit-window
+        precondition unsatisfiable forever."""
+        self._admission_paused = True
+
+    def resume_admission(self) -> None:
+        self._admission_paused = False
+
+    @property
+    def admission_paused(self) -> bool:
+        return self._admission_paused
+
+    @property
+    def quiesced(self) -> bool:
+        """Paused, queue drained, and no generation in flight — the
+        state a hot-swap requires (the commit window is the caller's to
+        close via ``maybe_flush(force=True)``): nothing fetched is
+        unretired, so one forced flush commits an EMPTY pending set and
+        the swap sits exactly between commit windows."""
+        return (
+            self._admission_paused
+            and self.queue.depth() == 0
+            and not self.gen.has_active()
+        )
 
     def kill(self) -> None:
         """Crash simulation: leave the group with NOTHING committed beyond
@@ -135,8 +194,9 @@ class Replica:
             return []
         self._sync_assignment()
         if self.state == SERVING:
-            self._poll_into_queue()
-            self._backpressure()
+            if not self._admission_paused:
+                self._poll_into_queue()
+                self._backpressure()
             free = self.gen.free_slots()
             # Paged-pool pressure defers admissions inside the generator
             # (StreamingGenerator.pending_admissions); deferred records
@@ -169,7 +229,13 @@ class Replica:
         exactly_once mode, its outputs invisible) — found by the
         broker crash-restart drill."""
         if force or self._retry_flush or self._since_commit >= self._commit_every:
-            if self._since_commit or self._retry_flush:
+            # ``force`` flushes even at zero counted completions: the
+            # exactly-once outbox can hold outputs ORDERED AFTER records
+            # that completed in an earlier window (flush_commits'
+            # outbox-forces-flush contract) — the hot-swap's
+            # close-the-window call must reach it, and flush_commits
+            # itself is a no-op when truly nothing is pending.
+            if force or self._since_commit or self._retry_flush:
                 ok = self.gen.flush_commits()
                 self._since_commit = 0
                 self._retry_flush = ok is False

@@ -151,6 +151,10 @@ class ProcessFleet:
         kv_pages: dict | None = None,
         kv_tier: dict | None = None,
         route_patience: int = 256,
+        rollout: bool = False,
+        rollout_topic: str = "fleet-rollout",
+        ckpt_topic: str = "fleet-ckpt",
+        model_version: int = 0,
         wal_dir: str | os.PathLike | None = None,
         wal_durability: str | None = "batch",
         broker_replicas: int = 1,
@@ -238,8 +242,19 @@ class ProcessFleet:
                 session_timeout_s=session_timeout_s,
                 wal_dir=self.wal_dir, wal_durability=wal_durability,
             )
+        # Live model lifecycle (fleet/rollout.py): with ``rollout`` on,
+        # workers tail a 1-partition control topic for canary/swap
+        # directives and fetch versioned checkpoints from ``ckpt_topic``
+        # (CRC'd chunked frames, source/checkpoint_wire.py).
+        # ``model_version`` tags the boot weights; every committed output
+        # window carries the serving version in its "mv" header.
+        self.rollout_topic = rollout_topic if rollout else None
+        self.ckpt_topic = ckpt_topic if rollout else None
+        self.model_version = int(model_version)
+        self._rollout_driver = None
         for t, p in ((topic, partitions), (out_topic, 1),
-                     (ready_topic, 1), (self.handoff_topic, 1)):
+                     (ready_topic, 1), (self.handoff_topic, 1),
+                     (self.rollout_topic, 1), (self.ckpt_topic, 1)):
             if t is None or p is None:
                 continue
             try:
@@ -283,6 +298,9 @@ class ProcessFleet:
             "kv_tier": kv_tier,
             "handoff_topic": self.handoff_topic,
             "route_patience": route_patience,
+            "rollout_topic": self.rollout_topic,
+            "ckpt_topic": self.ckpt_topic,
+            "model_version": self.model_version,
         }
         self.incarnations: list[_Incarnation] = []
         self.victims: list[dict] = []  # kill_replica forensics
@@ -475,6 +493,16 @@ class ProcessFleet:
                 self._abort_victim_txn(inc)
                 self._handoff(inc)
                 self._maybe_respawn(inc)
+        if self._rollout_driver is not None and not self._rollout_driver.done:
+            # The rollout control plane rides the supervision cadence:
+            # worker acks/reports in, next directive out, stale-version
+            # zombies fenced after completion.
+            self._rollout_driver.pump()
+            if self._rollout_driver.controller.phase == "complete":
+                # The fleet's incumbent advances ONLY on completion (a
+                # rollback leaves it untouched) — the next rollout's
+                # controller needs the true incumbent to swap back to.
+                self.model_version = self._rollout_driver.controller.version
 
     def _note_fence(self, member: str, reason: str,
                     lease_age_s: float | None) -> None:
@@ -553,6 +581,68 @@ class ProcessFleet:
             self._spawn(dead.idx, role=dead.role)
 
     # ----------------------------------------------------------- control
+
+    def publish_checkpoint(self, version: int, params,
+                           kind: str = "serving") -> int:
+        """Publish a versioned checkpoint onto the checkpoint topic
+        (manifest + CRC'd chunks). Returns the frame count."""
+        if self.ckpt_topic is None:
+            raise ValueError("fleet was built without rollout=True")
+        from torchkafka_tpu.source.checkpoint_wire import publish_checkpoint
+
+        return publish_checkpoint(
+            self.broker, self.ckpt_topic, int(version), params, kind=kind,
+        )
+
+    def start_rollout(
+        self,
+        version: int,
+        *,
+        canary_member: str | None = None,
+        canary_slice: int = 8,
+        max_canary_diffs: int = 0,
+    ):
+        """Begin a rolling hot-swap to ``version`` (already published via
+        ``publish_checkpoint``): canary shadow-serve on one member,
+        token-diff gate, then drain-swap one member at a time; any
+        divergence or checkpoint rejection rolls every swapped member
+        back automatically. Driven from ``poll_once`` — ``wait(lambda f:
+        f.rollout_done)`` rides the normal supervision loop. Returns the
+        ``BrokerRolloutDriver`` (its ``.controller`` is the state
+        machine)."""
+        if self.rollout_topic is None:
+            raise ValueError("fleet was built without rollout=True")
+        if self._rollout_driver is not None and not self._rollout_driver.done:
+            raise RuntimeError("a rollout is already in flight")
+        from torchkafka_tpu.fleet.rollout import (
+            BrokerRolloutDriver,
+            RolloutController,
+        )
+
+        members = sorted(
+            self.broker.membership(self.group)["members"]
+        ) or sorted(i.member for i in self.live())
+        ctl = RolloutController(
+            members, int(version),
+            canary_member=canary_member,
+            canary_slice=canary_slice,
+            max_canary_diffs=max_canary_diffs,
+            incumbent_version=self.model_version,
+            tracer=self.tracer, metrics=self.metrics,
+        )
+        self._rollout_driver = BrokerRolloutDriver(
+            self.broker, self.rollout_topic, ctl, group=self.group,
+        )
+        self._rollout_driver.start()
+        return self._rollout_driver
+
+    @property
+    def rollout_done(self) -> bool:
+        return self._rollout_driver is not None and self._rollout_driver.done
+
+    @property
+    def rollout(self):
+        return self._rollout_driver
 
     def kill_replica(self, idx: int) -> dict:
         """SIGKILL the newest live incarnation of replica ``idx`` — a

@@ -3368,6 +3368,229 @@ def scenario_23(size: str = "tiny", replicas: int = 2) -> dict:
     }
 
 
+def scenario_24(size: str = "tiny", replicas: int = 2) -> dict:
+    """Rolling weight hot-swap with canary auto-rollback (ISSUE 18): the
+    model itself becomes a live, versioned resource. A 2-process
+    ``exactly_once`` fleet serves a storm while the supervisor drives
+    TWO rollouts over the broker control plane. First a DIVERGENT v1
+    (different weights) is published to the checkpoint topic and rolled
+    out: the canary replica shadow-serves a deterministic slice under
+    v1, token-diffs against its own live incumbent output, and the
+    controller AUTOMATICALLY rolls back on divergence — no replica ever
+    serves v1 into the committed view. Then a CLEAN v2 (byte-identical
+    weights, new version) rolls out to completion: canary passes,
+    replicas drain-swap one at a time (quiesce → close the commit
+    window → journal the version → rebind, zero recompile), and the
+    fleet's incumbent advances. Audited: zero lost records,
+    committed-view duplicates EXACTLY zero, every committed completion
+    byte-identical to a no-rollout reference, and every output's "mv"
+    version tag ∈ {0, 2} — the divergent version left no trace."""
+    import tempfile
+    import time as _time
+
+    import torchkafka_tpu as tk
+    from torchkafka_tpu.fleet import ProcessFleet
+    from torchkafka_tpu.fleet.proc import build_model
+    from torchkafka_tpu.journal import DecodeJournal
+    from torchkafka_tpu.serve import StreamingGenerator
+    from torchkafka_tpu.source.records import TopicPartition
+
+    prompt_len, max_new = (8, 16) if size == "tiny" else (32, 32)
+    parts, slots, commit_every = 4, 2, 4
+    pool = 400  # prompt pool upper bound; the storm produces on demand
+    cfg, params, label = _serving_model(size, None, prompt_len, max_new)
+    model_spec = dict(
+        seed=0, vocab_size=cfg.vocab_size, d_model=cfg.d_model,
+        n_layers=cfg.n_layers, n_heads=cfg.n_heads,
+        n_kv_heads=cfg.n_kv_heads, d_ff=cfg.d_ff,
+        max_seq_len=cfg.max_seq_len,
+    )
+    rng = np.random.default_rng(24)
+    prompts = rng.integers(0, cfg.vocab_size, (pool, prompt_len),
+                           dtype=np.int32)
+
+    t0 = _time.perf_counter()
+    with tempfile.TemporaryDirectory() as td:
+        fleet = ProcessFleet(
+            model_spec, topic="t24", prompt_len=prompt_len,
+            max_new=max_new, workdir=td, replicas=replicas,
+            partitions=parts, slots=slots, commit_every=commit_every,
+            session_timeout_s=8.0, heartbeat_interval_s=0.2,
+            journal_cadence=1, respawn=False, group="s24",
+            out_topic="out24", exactly_once=True, rollout=True,
+            rollout_topic="roll24", ckpt_topic="ckpt24",
+            idle_exit_ms=None,
+        )
+        nkeys = 0
+
+        def produce(n: int) -> None:
+            nonlocal nkeys
+            for _ in range(n):
+                if nkeys >= pool:
+                    raise RuntimeError("prompt pool exhausted")
+                fleet.broker.produce(
+                    "t24", prompts[nkeys].tobytes(),
+                    partition=nkeys % parts, key=str(nkeys).encode(),
+                )
+                nkeys += 1
+
+        def feed() -> None:
+            """Keep the storm alive WITHOUT flooding: the canary needs
+            live completions to compare, but an unthrottled producer
+            outruns tiny-model decode and bloats the reference replay —
+            top the uncommitted backlog back up to a small constant."""
+            backlog = nkeys - len(fleet.results("read_committed"))
+            if backlog < 12:
+                produce(2)
+
+        try:
+            fleet.start()
+            fleet.wait_ready(timeout_s=300)
+            ready_s = _time.perf_counter() - t0
+            produce(8)
+
+            # --- rollout 1: DIVERGENT weights → canary auto-rollback --
+            _, divergent = build_model(dict(model_spec, seed=1))
+            fleet.publish_checkpoint(1, divergent)
+            drv1 = fleet.start_rollout(1, canary_slice=3)
+            deadline = _time.monotonic() + 180
+            while not fleet.rollout_done:
+                if _time.monotonic() > deadline:
+                    raise TimeoutError(
+                        "divergent rollout never resolved\n"
+                        + fleet.diagnose()
+                    )
+                fleet.poll_once()
+                feed()  # the canary compares LIVE traffic
+                _time.sleep(0.05)
+            phase1 = drv1.controller.phase
+            reason1 = drv1.controller.rollback_reason
+            versions1 = dict(drv1.controller.member_versions)
+            rollback_s = _time.perf_counter() - t0 - ready_s
+
+            # --- rollout 2: CLEAN weights (same bytes, new version) →
+            # canary passes, every replica drain-swaps, incumbent
+            # advances ---------------------------------------------------
+            _, clean = build_model(model_spec)
+            fleet.publish_checkpoint(2, clean)
+            drv2 = fleet.start_rollout(2, canary_slice=3)
+            deadline = _time.monotonic() + 180
+            while not fleet.rollout_done:
+                if _time.monotonic() > deadline:
+                    raise TimeoutError(
+                        "clean rollout never completed\n" + fleet.diagnose()
+                    )
+                fleet.poll_once()
+                feed()
+                _time.sleep(0.05)
+            phase2 = drv2.controller.phase
+            versions2 = dict(drv2.controller.member_versions)
+            fleet_version = fleet.model_version
+
+            # Serve out the tail BEFORE draining: drain abandons
+            # queued-but-unadmitted records (loss-free by re-delivery,
+            # but this fleet is about to exit for good), so wait until
+            # every produced key is either committed or finished in a
+            # live worker's journal — then the drain only has to flush.
+            tail_keys = {str(i).encode() for i in range(nkeys)}
+
+            def covered(f) -> bool:
+                done = set(f.results("read_committed"))
+                if done >= tail_keys:
+                    return True
+                for inc in f.live():
+                    try:
+                        entries = DecodeJournal.load(inc.journal_path)
+                    except Exception:  # noqa: BLE001 - mid-write race
+                        continue
+                    for (topic, p, off), e in entries.items():
+                        if e.finished and topic == "t24":
+                            done.add(str(off * parts + p).encode())
+                return done >= tail_keys
+
+            fleet.wait(covered, timeout_s=240)
+            fleet.drain()
+            fleet.wait(lambda f: not f.live(), timeout_s=120)
+            fleet.poll_once()
+            zero_lost = fleet.fully_committed()
+
+            committed_res = fleet.results(isolation="read_committed")
+            committed_dups = sum(
+                len(v) - 1 for v in committed_res.values()
+            )
+            all_keys = {str(i).encode() for i in range(nkeys)}
+            none_lost = set(committed_res) == all_keys
+
+            # Version tags on the committed view: the divergent v1 must
+            # have left NO committed trace; everything is v0 or v2.
+            tags: dict = {}
+            for p in range(fleet.broker.partitions_for("out24")):
+                recs, _ = fleet.broker.fetch_stable(
+                    TopicPartition("out24", p), 0, 10**6,
+                )
+                for rec in recs:
+                    mv = dict(rec.headers or ()).get("mv", b"?")
+                    tags[mv.decode()] = tags.get(mv.decode(), 0) + 1
+            divergent_leaked = "1" in tags
+            tags_consistent = set(tags) <= {"0", "2"}
+
+            # No-rollout byte-truth: v2's weights ARE v0's, so one
+            # seed-0 greedy reference covers every committed output
+            # regardless of which side of the swap served it.
+            rb = tk.InMemoryBroker()
+            rb.create_topic("r24", partitions=parts)
+            for i in range(nkeys):
+                rb.produce("r24", prompts[i].tobytes(),
+                           partition=i % parts, key=str(i).encode())
+            rcons = tk.MemoryConsumer(rb, "r24", group_id="ref24")
+            ref_gen = StreamingGenerator(
+                rcons, params, cfg, slots=slots, prompt_len=prompt_len,
+                max_new=max_new, commit_every=commit_every,
+                ticks_per_sync=1,
+            )
+            ref = {
+                rec.key: toks
+                for rec, toks in ref_gen.run(idle_timeout_ms=400)
+            }
+            rcons.close()
+            identical = all(
+                np.array_equal(toks, ref[k])
+                for k, copies in committed_res.items()
+                for _m, toks in copies
+            )
+            worker_m = fleet.worker_metrics()
+            elapsed = _time.perf_counter() - t0
+        finally:
+            fleet.close()
+    return {
+        "scenario": "24:rolling-hot-swap-canary-rollback",
+        "model_scale": label,
+        "replicas": replicas,
+        "records": nkeys,
+        "ready_s": round(ready_s, 2),
+        "elapsed_s": round(elapsed, 2),
+        "divergent_rollout": {
+            "phase": phase1,
+            "rollback_reason": reason1,
+            "member_versions": versions1,
+            "resolved_s": round(rollback_s, 2),
+        },
+        "clean_rollout": {
+            "phase": phase2,
+            "member_versions": versions2,
+        },
+        "fleet_model_version": fleet_version,
+        "version_tags": tags,
+        "divergent_version_leaked": divergent_leaked,
+        "version_tags_consistent": tags_consistent,
+        "zero_lost": bool(zero_lost and none_lost),
+        "identical_to_no_rollout": identical,
+        "committed_duplicates": committed_dups,
+        "workers_survived": all(m["exit"] == 0 for m in worker_m)
+        and len(worker_m) == replicas,
+    }
+
+
 SCENARIOS = {
     1: scenario_1,
     2: scenario_2,
@@ -3392,6 +3615,7 @@ SCENARIOS = {
     21: scenario_21,
     22: scenario_22,
     23: scenario_23,
+    24: scenario_24,
 }
 
 
@@ -3440,7 +3664,7 @@ def run_scenario(
         )
     sample_kw = dict(temperature=temperature, top_k=top_k, top_p=top_p)
     spec_kw = dict(spec=spec, spec_k=spec_k, spec_draft_layers=spec_draft_layers)
-    if num in (10, 11, 12, 13, 15, 16, 17, 18, 19, 20, 21, 23):
+    if num in (10, 11, 12, 13, 15, 16, 17, 18, 19, 20, 21, 23, 24):
         return SCENARIOS[num](size, replicas=replicas)
     if num == 22:
         return SCENARIOS[22](size, replicas=1)

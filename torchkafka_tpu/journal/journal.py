@@ -68,6 +68,12 @@ class JournalEntry:
     top_p: float | None
     tokens: tuple[int, ...] = ()
     finished: bool = False
+    # Which model version produced the journaled tokens. A warm resume
+    # only applies a hint when the server's live version matches — a
+    # token prefix decoded under v0 continued under v1 would NOT be
+    # byte-identical to either reference, so version-mismatched hints
+    # fall back to a cold (still exactly-once) replay.
+    model_version: int = 0
 
     @property
     def key(self) -> tuple[str, int, int]:
@@ -85,6 +91,7 @@ class JournalEntry:
             "top_p": self.top_p,
             "toks": list(self.tokens),
             "fin": self.finished,
+            "mv": self.model_version,
         }
 
     @classmethod
@@ -103,6 +110,7 @@ class JournalEntry:
             top_p=None if d.get("top_p") is None else float(d["top_p"]),
             tokens=tuple(int(x) for x in d.get("toks", ())),
             finished=bool(d.get("fin", False)),
+            model_version=int(d.get("mv", 0)),
         )
 
 
@@ -132,6 +140,13 @@ class DecodeJournal:
         self._entries: dict[tuple[str, int, int], JournalEntry] = {}
         self._dirty = False
         self._closed = False
+        # The model version this incarnation serves — journal-level meta
+        # written in every flush. The swap protocol writes the NEW
+        # version (durably, while the entry set is empty) BEFORE the
+        # in-memory rebind, so a recovery after SIGKILL-mid-swap reads
+        # load_meta() and restores exactly the weights whose outputs the
+        # committed view already attributes to this member.
+        self.model_version = 0
         self.stats = _Stats()
         # Single-writer discipline across PROCESSES: a journal file is one
         # replica incarnation's private state; two live writers would
@@ -207,6 +222,7 @@ class DecodeJournal:
         temperature: float = 0.0,
         top_k: int | None = None,
         top_p: float | None = None,
+        model_version: int = 0,
     ) -> None:
         """Upsert the entry for ``record`` (admit / progress / adoption
         after a warm resume). Marks the journal dirty; the caller flushes
@@ -225,6 +241,7 @@ class DecodeJournal:
             top_p=top_p,
             tokens=tuple(int(t) for t in tokens),
             finished=finished,
+            model_version=int(model_version),
         )
         self._entries[entry.key] = entry
         self._dirty = True
@@ -268,6 +285,17 @@ class DecodeJournal:
             self.stats.pruned += len(drop)
         return len(drop)
 
+    def set_model_version(self, version: int) -> None:
+        """Record the serving model version as journal-level meta. The
+        swap protocol calls this (then ``sync()``) while the entry set is
+        empty and the commit window is closed — the durable version flip
+        IS the swap's commit point: recovery before it restarts on the
+        old weights, recovery after it restarts on the new."""
+        version = int(version)
+        if version != self.model_version:
+            self.model_version = version
+            self._dirty = True
+
     # ----------------------------------------------------------- persistence
 
     def flush(self) -> None:
@@ -280,6 +308,7 @@ class DecodeJournal:
         payload = json.dumps({
             "version": _VERSION,
             "cadence": self.cadence,
+            "model_version": self.model_version,
             "entries": [e.to_json() for e in self._entries.values()],
         }).encode()
         tmp = self._path + ".tmp"
@@ -369,6 +398,28 @@ class DecodeJournal:
                 ):
                     merged[key] = entry
         return merged
+
+    @staticmethod
+    def load_meta(path: str | os.PathLike) -> dict:
+        """Read a journal file's top-level metadata (notably
+        ``model_version``) without materializing entries — what a
+        restarting incarnation consults FIRST, so it rebuilds the weights
+        its previous life durably committed to before touching any hint.
+        Missing or corrupt file → ``{}`` (boot on the spec's version)."""
+        path = os.fspath(path)
+        try:
+            with open(path, "rb") as f:
+                doc = json.loads(f.read().decode())
+            if not isinstance(doc, dict):
+                return {}
+        except FileNotFoundError:
+            return {}
+        except (OSError, ValueError) as exc:
+            _logger.warning(
+                "ignoring unreadable decode journal meta %s (%s)", path, exc,
+            )
+            return {}
+        return {k: v for k, v in doc.items() if k != "entries"}
 
     @staticmethod
     def load(path: str | os.PathLike) -> dict[tuple[str, int, int], JournalEntry]:

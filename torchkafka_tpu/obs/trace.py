@@ -98,13 +98,25 @@ BROKER_RESTARTED = "broker_restarted"
 # loop (load → burn transitions → decisions → scale events) replays
 # byte-identically.
 SCALE_DECISION = "scale_decision"
+# The live model lifecycle (fleet/rollout.py): the rollout state machine
+# (pending → canary → rolling → complete | rolled_back) typed on the
+# "fleet" stream, ordered against the record lifecycles a swap pauses
+# and the fences a stale-version zombie earns. ``rollout_phase`` marks
+# every controller phase transition; ``canary_started`` opens the
+# shadow-serving slice; ``swapped`` is one replica's atomic weight
+# rebind landing (also emitted by the server itself at swap_params);
+# ``rolled_back`` is the automatic verdict on a divergent canary.
+ROLLOUT_PHASE = "rollout_phase"
+CANARY_STARTED = "canary_started"
+SWAPPED = "swapped"
+ROLLED_BACK = "rolled_back"
 
 STAGES = (
     POLLED, QOS_ADMITTED, DEFERRED, PREFILL_QUEUED, CHUNK_SCHEDULED,
     WARM_RESUMED, SLOT_ACTIVE, TOKENS, FINISHED, JOURNAL_SERVED, COMMITTED,
     QUARANTINED, DROPPED, DLQ_FAILED, PREFILL_HANDOFF, SLOT_ADOPTED,
     BURN_STATE, REPLICA_JOINED, REPLICA_FENCED, JOURNAL_HANDOFF,
-    SCALE_DECISION,
+    SCALE_DECISION, ROLLOUT_PHASE, CANARY_STARTED, SWAPPED, ROLLED_BACK,
 )
 
 
@@ -585,6 +597,57 @@ class RecordTracer:
             self._emit(SCALE_DECISION, "fleet", 0, seq, (
                 ("direction", direction), ("from", frm),
                 ("reason", reason), ("role", role), ("to", to),
+            ))
+
+    def rollout_phase(self, phase: str, version: int) -> None:
+        """The rollout controller entered ``phase`` for target
+        ``version``. Topic ``fleet``; offset = membership sequence —
+        ordered against the swaps, fences, and joins the phase drives."""
+        with self._lock:
+            seq = self._membership_seq
+            self._membership_seq += 1
+            self._emit(ROLLOUT_PHASE, "fleet", 0, seq, (
+                ("phase", phase), ("version", int(version)),
+            ))
+
+    def canary_started(self, member: str, version: int,
+                       slice_n: int | None = None) -> None:
+        """Member ``member`` began shadow-serving a deterministic slice
+        under candidate ``version`` — token-diffed against the incumbent
+        before any weight anywhere is swapped."""
+        with self._lock:
+            seq = self._membership_seq
+            self._membership_seq += 1
+            attrs = [("member", member), ("version", int(version))]
+            if slice_n is not None:
+                attrs.append(("slice_n", int(slice_n)))
+            self._emit(CANARY_STARTED, "fleet", 0, seq,
+                       tuple(sorted(attrs)))
+
+    def swapped(self, version: int, member: str | None = None,
+                replica=None) -> None:
+        """One replica's weights atomically rebound to ``version`` (the
+        drain-swap landed: in-flight finished, window committed, journal
+        meta flipped, params swapped without recompiling)."""
+        with self._lock:
+            seq = self._membership_seq
+            self._membership_seq += 1
+            attrs = [("version", int(version))]
+            if member is not None:
+                attrs.append(("member", member))
+            if replica is not None:
+                attrs.append(("replica", replica))
+            self._emit(SWAPPED, "fleet", 0, seq, tuple(sorted(attrs)))
+
+    def rolled_back(self, reason: str, version: int) -> None:
+        """The rollout of ``version`` was automatically halted and every
+        swapped replica ordered back to the incumbent (``reason``:
+        canary_divergence / checkpoint_rejected / ...)."""
+        with self._lock:
+            seq = self._membership_seq
+            self._membership_seq += 1
+            self._emit(ROLLED_BACK, "fleet", 0, seq, (
+                ("reason", reason), ("version", int(version)),
             ))
 
     def burn_state(self, seq: int, metric: str, dim: str, label: str,

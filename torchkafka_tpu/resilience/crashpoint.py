@@ -120,6 +120,22 @@ election_pre_promote       an election chose the winning follower but the
                            port takeover — the cell stays leaderless; a
                            re-run election (epoch bumped again) must
                            converge on the same durable prefix
+rollout_pre_swap           a replica quiesced for a weight swap (in-flight
+                           drained, window committed) but dies BEFORE the
+                           journal records the new version — recovery
+                           restarts on the OLD weights, the rollout
+                           directive still stands, and the re-swap
+                           converges to the controller target
+swap_mid_apply             the journal durably records the NEW version but
+                           the process dies before the in-memory param
+                           rebind — the journal is EMPTY here (quiesced),
+                           so recovery fetches and serves the new version;
+                           no output was ever produced by mixed weights
+canary_pre_verdict         the canary replica finished its shadow slice
+                           but dies before publishing the verdict — no
+                           swap happened anywhere; recovery re-runs the
+                           canary deterministically and the rollout
+                           proceeds (or rolls back) on the same evidence
 ========================== =================================================
 
 Sites call ``crash_hook("<name>")``; production cost is one global ``is
@@ -173,6 +189,9 @@ REGISTERED_CRASH_POINTS: tuple[str, ...] = (
     "repl_frame_pre_ship",
     "repl_frame_post_majority_pre_ack",
     "election_pre_promote",
+    "rollout_pre_swap",
+    "swap_mid_apply",
+    "canary_pre_verdict",
 )
 
 ENV_VAR = "TORCHKAFKA_CRASHPOINT"

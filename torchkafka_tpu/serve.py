@@ -718,6 +718,29 @@ def _record_tenant(record: Record) -> str:
         return record.key.hex()
 
 
+class _ShadowConsumer:
+    """The canary shadow generator's consumer-shaped null object: it is
+    never a group member, never polls, and owns no partitions — so the
+    shadow's commit path is structurally a no-op (an empty assignment
+    drops every ledger partition from the snapshot) and nothing a shadow
+    decodes can reach a broker. See ``StreamingGenerator.spawn_shadow``."""
+
+    def poll(self, max_records: int = 1, timeout_ms: int = 0) -> list:
+        return []
+
+    def assignment(self):
+        return frozenset()
+
+    def commit(self, offsets) -> None:
+        pass
+
+    def heartbeat(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
 def _default_decode_prompt(prompt_len: int) -> Callable[[Record], np.ndarray]:
     def decode(record: Record) -> np.ndarray:
         toks = np.frombuffer(record.value, dtype=np.int32)[:prompt_len]
@@ -771,6 +794,7 @@ class StreamingGenerator:
         tracer=None,
         trace_replica: int | None = None,
         max_new_of: Callable[[Record], int | None] | None = None,
+        model_version: int = 0,
     ) -> None:
         """``ticks_per_sync``: decode ticks chained per device dispatch
         (and per host sync of the done mask). Higher amortises dispatch
@@ -1174,6 +1198,15 @@ class StreamingGenerator:
         self._journal = journal
         self._tracer = tracer
         self._trace_replica = trace_replica
+        # The model version these weights serve as — stamped on every
+        # output ("mv" header), every journal entry, and the journal's
+        # own meta, so the exactly-once invariant survives a mid-rollout
+        # crash: recovery always knows WHICH weights produced what. 0 is
+        # the boot checkpoint; swap_params moves it (only between commit
+        # windows — see its preconditions).
+        self._model_version = int(model_version)
+        if journal is not None:
+            journal.set_model_version(self._model_version)
         # Per-record output budget: ``max_new_of(record) -> n`` bounds
         # that record's generation to n tokens (clamped to [1, max_new]).
         # Enforced host-side at sync granularity: when a slot's emitted
@@ -2867,6 +2900,108 @@ class StreamingGenerator:
         self._caches, self._last_tok, self._pos, self._gen = out[:4]
         jax.device_get(out[4])
 
+    # ---------------------------------------------- live model lifecycle
+
+    @property
+    def model_version(self) -> int:
+        """The version id of the weights currently serving."""
+        return self._model_version
+
+    def swap_params(self, params, version: int) -> None:
+        """Hot-swap the serving weights IN PLACE — no recompilation (the
+        jitted programs take params as an argument; rebinding the
+        closure's source is the whole swap) and no group churn (the
+        consumer, lease, and slots are untouched).
+
+        Preconditions make a mixed-version commit window impossible by
+        construction: the caller must have QUIESCED (no active or
+        prefilling slot — finish in-flight first) and CLOSED the commit
+        window (flush_commits) — so every output the old weights
+        produced is already committed under the old version tag, and
+        everything after this call is produced, journaled, and committed
+        under the new one. Durability order is version-journal-first:
+        the journal's model_version meta is fsynced BEFORE the in-memory
+        rebind, so a SIGKILL between the two restarts on weights that
+        match the (empty) journal either way — ``rollout_pre_swap`` dies
+        with the OLD version durable, ``swap_mid_apply`` with the NEW;
+        the crash matrix kills at both to prove half-old/half-new state
+        is unreachable."""
+        if self.has_active():
+            raise RuntimeError(
+                "swap_params requires a quiesced server (drain in-flight "
+                "generations first — the warm-drain discipline)"
+            )
+        if self._uncommitted or (self._txn_mode and self._txn_outbox):
+            raise RuntimeError(
+                "swap_params requires a closed commit window "
+                "(flush_commits first) — a window must never span model "
+                "versions"
+            )
+        version = int(version)
+        crash_hook("rollout_pre_swap")
+        if self._journal is not None:
+            self._journal.set_model_version(version)
+            self._journal.sync()
+        crash_hook("swap_mid_apply")
+        if self._mesh is not None:
+            params = jax.device_put(
+                params, serving_shardings(self._cfg, self._mesh, params)
+            )
+        # ONE rebind: the admit/tick lambdas read self._params at call
+        # time, so there is no instant where some program sees old and
+        # some new weights.
+        self._params = params
+        self._model_version = version
+        if self._tracer is not None:
+            self._tracer.swapped(
+                version, replica=self._trace_replica
+            )
+
+    def spawn_shadow(self, params, version: int) -> "StreamingGenerator":
+        """A scratch single-slot generator over CANDIDATE weights for
+        canary shadow-serving: same config, prompt decoding, sampling
+        contract, and per-record RNG base as this server — so for any
+        record its output is byte-for-byte what the candidate version
+        WOULD commit — but no consumer group, no producer, no journal:
+        nothing a shadow decodes can ever reach the committed view (the
+        'divergent canary never publishes' invariant is structural).
+        Dense serving path regardless of the incumbent's KV mode (paged/
+        dense are differential-tested token-exact)."""
+        return StreamingGenerator(
+            _ShadowConsumer(), params, self._cfg,
+            slots=1,
+            prompt_len=self._prompt_len,
+            max_new=self._max_new,
+            eos_id=self._eos_id,
+            commit_every=2**31 - 1,
+            decode_prompt=self._decode_prompt,
+            ticks_per_sync=1,
+            temperature=self._temperature,
+            top_k=self._top_k,
+            top_p=self._top_p,
+            rng=self._rng,
+            mesh=self._mesh,
+            max_new_of=self._max_new_of,
+            model_version=int(version),
+        )
+
+    def shadow_decode(self, rec: Record) -> np.ndarray | None:
+        """Decode ``rec`` to completion on THIS generator as a shadow
+        pass (canary use: call on a ``spawn_shadow`` instance). Returns
+        the tokens, or None if the record is undecodable. The record is
+        ledger-registered locally but never committed anywhere."""
+        self.note_fetched([rec])
+        if self.admit_records([rec]) == 0 and not self._journal_ready:
+            return None
+        out: np.ndarray | None = None
+        while self.has_active() or self._journal_ready:
+            for done_rec, toks in self.step():
+                if done_rec.offset == rec.offset and \
+                        done_rec.topic == rec.topic and \
+                        done_rec.partition == rec.partition:
+                    out = toks
+        return out
+
     # ------------------------------------------- external admission surface
     #
     # run() is a thin loop over four primitives, each usable on its own by
@@ -3030,6 +3165,10 @@ class StreamingGenerator:
             and hint.temperature == self._temperature
             and hint.top_k == self._top_k
             and hint.top_p == self._top_p
+            # A prefix decoded under another model version continued
+            # under this one would match NEITHER reference — version-
+            # mismatched hints fall back to cold replay (still correct).
+            and hint.model_version == self._model_version
             and 1 <= g <= self._max_new
             and (hint.finished or g < self._max_new)
             # Partial-generation resume prefills through this server's
@@ -3068,7 +3207,7 @@ class StreamingGenerator:
         self._journal.record(
             rec, key_data, tokens=tokens, finished=finished,
             temperature=self._temperature, top_k=self._top_k,
-            top_p=self._top_p,
+            top_p=self._top_p, model_version=self._model_version,
         )
 
     def _resume_into_slot(self, i: int, rec: Record, prompt_toks,
@@ -3260,7 +3399,14 @@ class StreamingGenerator:
                     dict(
                         topic=self._output_topic,
                         value=self._encode_output(rec, out),
-                        key=rec.key, headers=(),
+                        key=rec.key,
+                        # The version tag: every committed output window
+                        # records which weights produced it (swap_params
+                        # only lands between windows, so a window is
+                        # never mixed-version).
+                        headers=(
+                            ("mv", str(self._model_version).encode()),
+                        ),
                     )
                 )
             else:
@@ -3270,6 +3416,9 @@ class StreamingGenerator:
                             self._output_topic,
                             self._encode_output(rec, out),
                             key=rec.key,
+                            headers=(
+                                ("mv", str(self._model_version).encode()),
+                            ),
                         )
                     )
                     self._send_failure_streak = 0

@@ -684,6 +684,42 @@ class SpecStreamingGenerator(StreamingGenerator):
             )
         return logits, (t_k, t_v, d_k, d_v) + caches[4:]
 
+    def swap_draft_params(self, draft_params, draft_cfg=None) -> None:
+        """Hot-swap the DRAFT weights in place — the rollout plane's
+        delivery path for continuously-distilled drafts (ROADMAP item 1).
+        Cheaper contract than ``swap_params``: the draft only PROPOSES —
+        verification against the target is what commits tokens — so a
+        draft refresh never changes committed output, only the realized
+        acceptance α. It can therefore land between ticks without
+        quiescing. The jitted programs close over ``self._draft_params``
+        at call time; same structure/shapes required (the compiled
+        programs are shape-specialized), which ``draft_cfg`` (when given)
+        and the tree check enforce."""
+        if draft_cfg is not None and (
+            draft_cfg.n_layers != self._draft_cfg.n_layers
+            or draft_cfg.vocab_size != self._draft_cfg.vocab_size
+            or draft_cfg.d_model != self._draft_cfg.d_model
+        ):
+            raise ValueError(
+                "swap_draft_params requires a structurally identical "
+                "draft (the compiled rounds are shape-specialized); "
+                "rebuild the generator for a different draft geometry"
+            )
+        old = jax.tree_util.tree_structure(self._draft_params)
+        new = jax.tree_util.tree_structure(draft_params)
+        if old != new:
+            raise ValueError(
+                f"draft tree structure mismatch: {new} != {old}"
+            )
+        if self._mesh is not None:
+            from torchkafka_tpu.models.generate import serving_shardings
+
+            draft_params = jax.device_put(
+                draft_params,
+                serving_shardings(self._draft_cfg, self._mesh, draft_params),
+            )
+        self._draft_params = draft_params
+
     def spec_stats(self) -> dict:
         """Measured speculation counters since construction (one device
         fetch). ``acceptance`` is the realized α — the workload-dependent
