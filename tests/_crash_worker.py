@@ -36,6 +36,14 @@ Modes:
           controller process. Covers canary_pre_verdict,
           rollout_pre_swap and swap_mid_apply — the journal's durable
           model_version is the recovery authority at each.
+  distill — the online-distillation closed loop in one incarnation:
+          speculative serving stages committed completions onto the
+          distill topic, a DistillTrainer trains the truncated draft
+          and publishes a versioned checkpoint, then the serving side
+          fetches it and live-swaps the draft before the post-swap
+          wave. Covers distill_pre_publish (trained state in memory,
+          checkpoint plane untouched) and draft_swap_pre_apply (v1
+          durable, incumbent draft still serving).
   sweep — a supervisor's lease sweep against a zombie member that
           joined and never heartbeated: observes the expired lease via
           membership(), then fences. Covers lease_expired_pre_fence
@@ -723,6 +731,120 @@ def run_rollout(broker, workdir: str, member: str = "m0") -> int:
     return run_replica_worker(spec, broker=broker)
 
 
+DL_TOPIC, DL_OUT = "dlt", "dlout"
+DL_DISTILL, DL_CKPT = "dldist", "dlckpt"
+DL_GROUP, DL_TRAIN_GROUP = "dlg", "dltr"
+DL_PARTS = 2
+DL_WAVE1, DL_WAVE2 = 8, 4  # pre-swap corpus wave, post-swap serving wave
+
+
+def dl_prompts():
+    import numpy as np
+
+    rng = np.random.default_rng(23)
+    return rng.integers(
+        0, VOCAB, (DL_WAVE1 + DL_WAVE2, P), dtype=np.int32
+    )
+
+
+def prime_distill_topics(broker):
+    """Prompt/output/distill/checkpoint topics for the distill-mode
+    matrix: wave-1 prompts only — wave 2 is produced by the runner
+    itself at the swap stage (guarded by end-offset, so a recovery
+    incarnation never double-produces it)."""
+    broker.create_topic(DL_TOPIC, partitions=DL_PARTS)
+    broker.create_topic(DL_OUT, partitions=1)
+    broker.create_topic(DL_DISTILL, partitions=1)
+    broker.create_topic(DL_CKPT, partitions=1)
+    prompts = dl_prompts()
+    for i in range(DL_WAVE1):
+        broker.produce(
+            DL_TOPIC, prompts[i].tobytes(), partition=i % DL_PARTS,
+            key=str(i).encode(),
+        )
+    return prompts
+
+
+def _dl_spec_gen(broker, producer):
+    from torchkafka_tpu.serve_spec import SpecStreamingGenerator
+    from torchkafka_tpu.source.memory import MemoryConsumer
+
+    cfg, params = build_model()
+    consumer = MemoryConsumer(broker, DL_TOPIC, group_id=DL_GROUP)
+    gen = SpecStreamingGenerator(
+        consumer, params, cfg, slots=SLOTS, prompt_len=P, max_new=MAX_NEW,
+        commit_every=COMMIT_EVERY, ticks_per_sync=1,
+        max_poll_records=SLOTS, decode_prompt=make_decode_prompt(),
+        output_producer=producer, output_topic=DL_OUT,
+        distill_topic=DL_DISTILL, k=3, draft_layers=1,
+    )
+    return gen, consumer, cfg, params
+
+
+def run_distill(broker, workdir: str) -> None:
+    """The online-distillation closed loop as one incarnation, three
+    stages: (A) speculative serving stages committed completions onto
+    the distill topic; (B) a DistillTrainer consumes them and publishes
+    a versioned draft checkpoint — ``distill_pre_publish`` fires inside
+    ``publish()``, between trained state and the checkpoint-plane
+    produce; (C) the serving side fetches v1 and live-swaps the draft —
+    ``draft_swap_pre_apply`` fires inside ``swap_draft_params``, after
+    validation, before any tree is applied — then serves the post-swap
+    wave. Re-entrant by construction: every stage resumes from group
+    offsets / the checkpoint plane, and the wave-2 produce is
+    end-offset-guarded, so the recovery incarnation IS this same
+    function. The committed-tokens invariant the parent audits: the
+    draft only PROPOSES — tokens are byte-identical whichever draft
+    (or kill) was live."""
+    import jax
+    import numpy as np
+
+    from torchkafka_tpu.distill import DistillTrainer
+    from torchkafka_tpu.source.checkpoint_wire import (
+        fetch_checkpoint,
+        rebuild_tree,
+    )
+    from torchkafka_tpu.source.memory import MemoryConsumer
+    from torchkafka_tpu.source.producer import MemoryProducer
+    from torchkafka_tpu.source.records import TopicPartition
+
+    producer = MemoryProducer(broker)
+    # ---- stage A: serve whatever is uncommitted, staging the corpus.
+    gen, consumer, cfg, params = _dl_spec_gen(broker, producer)
+    for _rec, _toks in gen.run(idle_timeout_ms=400):
+        pass
+    gen.close()
+    consumer.close()
+    # ---- stage B: train the draft on the fleet's own committed output.
+    tc = MemoryConsumer(broker, DL_DISTILL, group_id=DL_TRAIN_GROUP)
+    trainer = DistillTrainer(
+        tc, params, cfg, seq_len=P + MAX_NEW, batch_size=2,
+        draft_layers=1, broker=broker, ckpt_topic=DL_CKPT,
+        publish_every=2,
+    )
+    trainer.run(idle_timeout_ms=300)
+    tc.close()
+    # ---- stage C: wave-2 prompts, live draft refresh, post-swap serve.
+    prompts = dl_prompts()
+    tp0 = TopicPartition(DL_TOPIC, 0)
+    tp1 = TopicPartition(DL_TOPIC, 1)
+    if broker.end_offset(tp0) + broker.end_offset(tp1) < len(prompts):
+        for i in range(DL_WAVE1, len(prompts)):
+            broker.produce(
+                DL_TOPIC, prompts[i].tobytes(), partition=i % DL_PARTS,
+                key=str(i).encode(),
+            )
+    gen, consumer, _cfg, _params = _dl_spec_gen(broker, producer)
+    flat, _manifest = fetch_checkpoint(broker, DL_CKPT, 1)
+    schema = jax.tree_util.tree_map(np.asarray, gen._draft_params)
+    gen.swap_draft_params(rebuild_tree(schema, flat))
+    for _rec, _toks in gen.run(idle_timeout_ms=400):
+        pass
+    gen.close()
+    consumer.close()
+    producer.close()
+
+
 def run_ckpt(broker, workdir: str) -> None:
     """One training-shaped incarnation: resume from the newest complete
     checkpoint, then chunks of poll → commit → save. The commit-then-
@@ -805,6 +927,8 @@ def main() -> int:
             run_fleet(client, workdir)
         elif mode == "rollout":
             run_rollout(client, workdir)
+        elif mode == "distill":
+            run_distill(client, workdir)
         elif mode == "sweep":
             run_sweep(client)
         elif mode == "dgpre":

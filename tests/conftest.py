@@ -68,13 +68,27 @@ def pytest_collection_modifyitems(config, items):
     # ISSUE-17 coverage is newer still: the quorum failover storm runs
     # near-last so a budget overrun truncates it before anything older.
     quorum_tests = ("test_scenario_23_quorum_leader_failover",)
-    # ISSUE-18 coverage is the newest of all: the rollout differential
-    # suite and the hot-swap canary scenario run dead last.
+    # ISSUE-18 coverage: the rollout differential suite and the
+    # hot-swap canary scenario.
     rollout_module = "test_rollout.py"
     rollout_tests = ("test_scenario_24_rolling_hot_swap",)
+    # ISSUE-19 coverage is the newest of all: the online-distillation
+    # differential suite and the closed-loop scenario run dead last.
+    distill_module = "test_distill.py"
+    distill_tests = ("test_scenario_25_online_draft_distillation",)
 
     def tail_rank(item):
         path = str(getattr(item, "fspath", ""))
+        if item.name in distill_tests:
+            return 10
+        if path.endswith(distill_module):
+            # Wire/controller/policy units are host-only (no jit) —
+            # cheap; the trainer/fleet differentials compile — rank 9.
+            cheap = (
+                "TestDistillWire" in item.nodeid
+                or "TestDistillController" in item.nodeid
+            )
+            return 1 if cheap else 9
         if item.name in rollout_tests:
             return 8
         if path.endswith(rollout_module):

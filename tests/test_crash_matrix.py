@@ -134,6 +134,14 @@ MATRIX: dict[str, tuple[str, int]] = {
     "canary_pre_verdict": ("rollout", 1),
     "rollout_pre_swap": ("rollout", 1),
     "swap_mid_apply": ("rollout", 1),
+    # Online draft distillation windows (distill/trainer.py +
+    # serve_spec.py swap_draft_params): pre_publish arrival 1 = the
+    # trainer's FIRST checkpoint publish (draft trained, nothing on the
+    # checkpoint plane yet — the publish dies whole); pre_apply arrival
+    # 1 = the serving side's live draft swap, after validation, before
+    # any tree is applied (the incumbent draft must keep serving).
+    "distill_pre_publish": ("distill", 1),
+    "draft_swap_pre_apply": ("distill", 1),
     "repl_frame_pre_ship": ("cell", 24),
     "repl_frame_post_majority_pre_ack": ("cell", 26),
     "election_pre_promote": ("cell", 1),
@@ -1312,6 +1320,146 @@ def _run_rollout_case(tmp_path, ro_reference, point: str, at: int):
     )
 
 
+@pytest.fixture(scope="module")
+def dl_reference():
+    """Byte-truth for the distill matrix: PLAIN greedy decode of every
+    prompt (both waves) under the target weights. The draft — trained,
+    refreshed, or mid-kill — only proposes; the target's verification
+    commits, so every committed distill-mode output must match this
+    speculation-free reference bit for bit."""
+    from torchkafka_tpu.serve import StreamingGenerator
+
+    prompts = W.dl_prompts()
+    cfg, params = W.build_model()
+    broker = tk.InMemoryBroker()
+    broker.create_topic("ref", partitions=W.DL_PARTS)
+    for i in range(len(prompts)):
+        broker.produce("ref", prompts[i].tobytes(),
+                       partition=i % W.DL_PARTS, key=str(i).encode())
+    c = tk.MemoryConsumer(broker, "ref", group_id="ref")
+    gen = StreamingGenerator(
+        c, params, cfg, slots=W.SLOTS, prompt_len=W.P,
+        max_new=W.MAX_NEW, commit_every=2, ticks_per_sync=1,
+    )
+    ref = {rec.key: toks for rec, toks in gen.run(idle_timeout_ms=400)}
+    gen.close()
+    c.close()
+    assert len(ref) == len(prompts)
+    return ref
+
+
+def _dl_outputs(broker):
+    out: dict[bytes, list] = {}
+    for rec in broker.fetch(TopicPartition(W.DL_OUT, 0), 0, 100000):
+        out.setdefault(rec.key, []).append(
+            np.frombuffer(rec.value, dtype=np.int32)
+        )
+    return out
+
+
+def _dl_audit(broker, dl_reference, *, complete: bool):
+    """Committed-tokens invariants for the distill matrix: every output
+    copy byte-identical to the speculation-free reference (at-least-once
+    duplicates allowed, divergence never), committed watermarks covered
+    by outputs, and — the corpus-hygiene half — every frame on the
+    distill topic decodes and carries EXACTLY its key's committed
+    tokens (the trainer only ever learns the committed view)."""
+    from torchkafka_tpu.distill import decode_completion
+
+    outs = _dl_outputs(broker)
+    for key, copies in outs.items():
+        for toks in copies:
+            np.testing.assert_array_equal(
+                toks, dl_reference[key], err_msg=str(key)
+            )
+    prompts = W.dl_prompts()
+    by_prompt = {
+        prompts[i].tobytes(): str(i).encode() for i in range(len(prompts))
+    }
+    corpus_keys = set()
+    for rec in broker.fetch(TopicPartition(W.DL_DISTILL, 0), 0, 100000):
+        frame = decode_completion(rec.value)  # raises on any torn frame
+        key = by_prompt[np.asarray(frame["prompt"], np.int32).tobytes()]
+        np.testing.assert_array_equal(
+            np.asarray(frame["tokens"], np.int32), dl_reference[key],
+            err_msg=f"corpus frame for {key!r} diverges from committed",
+        )
+        corpus_keys.add(key)
+    assert corpus_keys <= set(outs), "corpus frame without an output"
+    if complete:
+        assert set(outs) == set(by_prompt.values()), "lost completions"
+        assert corpus_keys == set(outs), (
+            "committed completion missing from the training corpus"
+        )
+    return outs
+
+
+def _run_distill_case(tmp_path, dl_reference, point: str, at: int):
+    """The closed distillation loop SIGKILLed at its two windows. Either
+    death leaves the serving contract untouched — the draft is advisory:
+    pre_publish dies with the checkpoint plane still empty (the trained
+    state was process memory; nothing torn lands), pre_apply dies with
+    v1 published but never applied. The recovery incarnation is the SAME
+    three-stage runner: it re-serves what was uncommitted, re-trains
+    from the corpus group's offsets, (re)publishes, swaps, and finishes
+    the post-swap wave — with every committed token, both waves, both
+    lives, byte-identical to the speculation-free reference."""
+    from torchkafka_tpu.errors import CheckpointWireError
+    from torchkafka_tpu.source.checkpoint_wire import fetch_checkpoint
+
+    broker = tk.InMemoryBroker()
+    W.prime_distill_topics(broker)
+    workdir = str(tmp_path / point)
+    os.makedirs(workdir, exist_ok=True)
+    with tk.BrokerServer(broker) as server:
+        proc, marker = _spawn("distill", server.port, workdir, point, at)
+        proc.wait(timeout=180)
+    with open(os.path.join(workdir, "child.log"), "rb") as f:
+        log = f.read().decode(errors="replace")
+    assert proc.returncode == -signal.SIGKILL, (
+        f"worker exited {proc.returncode}, not SIGKILL — point {point!r} "
+        f"never reached?\n{log}"
+    )
+    with open(marker) as f:
+        assert f.read().strip() == f"{point}:{at}"
+    _reap_group(broker, W.DL_GROUP)
+    _reap_group(broker, W.DL_TRAIN_GROUP)
+
+    # ---- invariants at the moment of death ------------------------------
+    outs = _dl_audit(broker, dl_reference, complete=False)
+    wave1 = {str(i).encode() for i in range(W.DL_WAVE1)}
+    assert set(outs) == wave1, "stage-A serving incomplete at death"
+    n_prompts = sum(
+        broker.end_offset(TopicPartition(W.DL_TOPIC, p))
+        for p in range(W.DL_PARTS)
+    )
+    if point == "distill_pre_publish":
+        # The first publish died whole: the checkpoint plane is EMPTY —
+        # no manifest, no torn chunk — and the swap stage never ran.
+        assert broker.end_offset(TopicPartition(W.DL_CKPT, 0)) == 0
+        with pytest.raises(CheckpointWireError):
+            fetch_checkpoint(broker, W.DL_CKPT, 1)
+        assert n_prompts == W.DL_WAVE1
+        # The steps BEFORE the doomed publish committed their corpus
+        # offsets (commit-after-step): progress durable, publish lost.
+        committed = broker.committed(
+            W.DL_TRAIN_GROUP, TopicPartition(W.DL_DISTILL, 0)
+        ) or 0
+        assert committed >= 2, committed
+    else:  # draft_swap_pre_apply
+        # v1 made the plane intact; the swap died before applying it —
+        # and before any wave-2 admission, so no post-swap serving.
+        _flat, manifest = fetch_checkpoint(broker, W.DL_CKPT, 1)
+        assert manifest["kind"] == "draft"
+        assert n_prompts == W.DL_WAVE1 + W.DL_WAVE2
+
+    # ---- recovery: the same three-stage runner, in-process --------------
+    W.run_distill(broker, workdir)
+    _dl_audit(broker, dl_reference, complete=True)
+    _flat, manifest = fetch_checkpoint(broker, W.DL_CKPT, 1)
+    assert manifest["kind"] == "draft"
+
+
 FULL_POINTS = [p for p in MATRIX if p not in TIER1]
 
 
@@ -1367,6 +1515,10 @@ def _dispatch_case(tmp_path, request, point: str) -> None:
     elif mode == "rollout":
         _run_rollout_case(
             tmp_path, request.getfixturevalue("ro_reference"), point, at
+        )
+    elif mode == "distill":
+        _run_distill_case(
+            tmp_path, request.getfixturevalue("dl_reference"), point, at
         )
     elif mode == "sweep":
         _run_sweep_case(tmp_path, point, at)

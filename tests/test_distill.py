@@ -1,0 +1,568 @@
+"""Online draft distillation (torchkafka_tpu/distill).
+
+Four load-bearing contracts:
+
+1. WIRE SAFETY: a distill frame round-trips losslessly; every torn
+   prefix, corrupted payload, or forged header is REJECTED (and the
+   stream processor turns rejection into a silent drop — one bad corpus
+   record never stalls the trainer).
+2. TRAINER DETERMINISM: same seed + same topic contents ⇒ byte-identical
+   draft params, step for step (prefetch=0, jitted pure optimizer math)
+   — and the trainer's deep-copy at init severs the weight sharing with
+   the serving target, so training NEVER deletes the target's buffers
+   out from under a live server (the donation bug this pins).
+3. CONTROLLER HYSTERESIS: windowed α tracking + refresh gating replayed
+   under a ManualClock — cooldown, drop_frac, min_proposed,
+   refresh_on_publish, permanent CRC-reject skip.
+4. REFRESH UNDER CHAOS: a mid-serve draft swap on a speculative fleet
+   WHILE a replica is killed changes α only — committed tokens stay
+   byte-identical to a never-refreshed reference (swap_draft_params
+   refreshes the proposer; the target's verification commits tokens).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import torchkafka_tpu as tk
+from torchkafka_tpu.distill import (
+    DistillController,
+    DistillPolicy,
+    DistillTrainer,
+    decode_completion,
+    distill_processor,
+    encode_completion,
+)
+from torchkafka_tpu.errors import DistillWireError
+from torchkafka_tpu.models.spec_decode import truncated_draft
+from torchkafka_tpu.models.transformer import TransformerConfig, init_params
+from torchkafka_tpu.resilience import ManualClock
+from torchkafka_tpu.serve_spec import SpecStreamingGenerator
+from torchkafka_tpu.source.records import Record
+
+P, MAX_NEW, VOCAB = 8, 8, 64
+SEQ = P + MAX_NEW
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = TransformerConfig(
+        vocab_size=VOCAB, d_model=32, n_layers=2, n_heads=2, n_kv_heads=1,
+        d_ff=64, max_seq_len=SEQ, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+def _frames(n, seed=5, model_version=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        prompt = rng.integers(0, VOCAB, P, dtype=np.int32)
+        toks = rng.integers(0, VOCAB, MAX_NEW, dtype=np.int32)
+        out.append(encode_completion(
+            prompt, toks, tenant=f"t{i % 3}".encode(),
+            model_version=model_version,
+        ))
+    return out
+
+
+def _corpus_broker(frames, topic="d"):
+    broker = tk.InMemoryBroker()
+    broker.create_topic(topic, partitions=1)
+    for f in frames:
+        broker.produce(topic, f)
+    return broker
+
+
+def _leaves(tree):
+    return jax.tree_util.tree_leaves(
+        jax.tree_util.tree_map(np.asarray, tree)
+    )
+
+
+class TestDistillWire:
+    def test_round_trip(self):
+        prompt = np.arange(P, dtype=np.int32)
+        toks = np.arange(100, 100 + MAX_NEW, dtype=np.int32)
+        buf = encode_completion(prompt, toks, tenant=b"acme", model_version=7)
+        rec = decode_completion(buf)
+        np.testing.assert_array_equal(rec["prompt"], prompt)
+        np.testing.assert_array_equal(rec["tokens"], toks)
+        assert rec["tenant"] == b"acme"
+        assert rec["model_version"] == 7
+
+    def test_tenant_none_and_arbitrary_bytes(self):
+        buf = encode_completion([1, 2], [3], tenant=None, model_version=0)
+        assert decode_completion(buf)["tenant"] == b""
+        evil = bytes(range(256))
+        buf = encode_completion([1], [2], tenant=evil, model_version=1)
+        assert decode_completion(buf)["tenant"] == evil
+
+    def test_every_truncation_rejected(self):
+        buf = encode_completion(
+            np.arange(4, dtype=np.int32), np.arange(3, dtype=np.int32),
+            tenant=b"k", model_version=2,
+        )
+        for cut in range(len(buf)):
+            with pytest.raises(DistillWireError):
+                decode_completion(buf[:cut])
+
+    def test_payload_corruption_rejected(self):
+        buf = bytearray(encode_completion(
+            [1, 2, 3], [4, 5], tenant=b"k", model_version=0
+        ))
+        buf[-1] ^= 0xFF  # flip a payload byte: CRC must catch it
+        with pytest.raises(DistillWireError, match="CRC"):
+            decode_completion(bytes(buf))
+
+    def test_forged_headers_rejected(self):
+        with pytest.raises(DistillWireError, match="magic"):
+            decode_completion(b"NOPE" + b"\x00" * 16)
+        # A corrupt length field asking for gigabytes is bounded out.
+        huge = b"DSTL" + (1 << 30).to_bytes(4, "big") + b"{}"
+        with pytest.raises(DistillWireError, match="bound"):
+            decode_completion(huge)
+        import json as _json
+
+        hdr = _json.dumps({"v": 99}).encode()
+        forged = b"DSTL" + len(hdr).to_bytes(4, "big") + hdr
+        with pytest.raises(DistillWireError, match="version"):
+            decode_completion(forged)
+        with pytest.raises(DistillWireError):
+            decode_completion(12345)
+
+    def test_processor_shapes_truncation_and_drop(self):
+        proc = distill_processor(10)
+        buf = encode_completion(
+            np.arange(P, dtype=np.int32),
+            np.arange(MAX_NEW, dtype=np.int32),
+            tenant=b"t", model_version=0,
+        )
+        out = proc(Record("d", 0, 0, buf))
+        assert out["tokens"].shape == (10,) and out["mask"].shape == (10,)
+        assert out["tokens"].dtype == np.int32
+        assert out["mask"].sum() == 10  # P + MAX_NEW = 16 truncated to 10
+        # Short sequence: left-aligned, zero-padded, mask marks the reals.
+        short = encode_completion([1, 2], [3], tenant=b"t", model_version=0)
+        out = proc(Record("d", 0, 1, short))
+        np.testing.assert_array_equal(out["tokens"][:3], [1, 2, 3])
+        np.testing.assert_array_equal(
+            out["mask"], [1, 1, 1, 0, 0, 0, 0, 0, 0, 0]
+        )
+        # Malformed record -> None (the stream's drop signal), no raise.
+        assert proc(Record("d", 0, 2, b"garbage")) is None
+        assert proc(Record("d", 0, 3, buf[: len(buf) // 2])) is None
+        with pytest.raises(ValueError, match="seq_len"):
+            distill_processor(1)
+
+
+class TestDistillTrainer:
+    def test_same_seed_same_topic_byte_identical(self, model):
+        """The determinism differential: two trainers over identical
+        corpus bytes from the same target params converge byte-for-byte
+        — the property same-seed replay and the crash matrix's
+        recompute-after-death story both stand on."""
+        cfg, params = model
+        frames = _frames(12)
+        reports, trees = [], []
+        for _ in range(2):
+            broker = _corpus_broker(frames)
+            consumer = tk.MemoryConsumer(broker, "d", group_id="tr")
+            trainer = DistillTrainer(
+                consumer, params, cfg, seq_len=SEQ, batch_size=4,
+                draft_layers=1, learning_rate=5e-3,
+            )
+            reports.append(trainer.run(idle_timeout_ms=50))
+            trees.append(_leaves(trainer.draft_params))
+            consumer.close()
+        assert reports[0]["steps"] == 3 and reports[0]["records"] == 12
+        assert reports[0] == reports[1]
+        for a, b in zip(trees[0], trees[1]):
+            np.testing.assert_array_equal(a, b)
+
+    def test_training_never_deletes_the_serving_target(self, model):
+        """truncated_draft aliases embed/ln_f/lm_head BY REFERENCE and
+        the jitted step DONATES its params — without the trainer's
+        deep-copy at init, step 1 deletes the serving target's own
+        buffers. Pin it: after training, the target tree is alive and
+        bit-unchanged while the draft's shared leaves moved."""
+        cfg, params = model
+        before = _leaves(params)
+        broker = _corpus_broker(_frames(8))
+        consumer = tk.MemoryConsumer(broker, "d", group_id="tr")
+        trainer = DistillTrainer(
+            consumer, params, cfg, seq_len=SEQ, batch_size=4,
+            draft_layers=1, learning_rate=5e-3,
+        )
+        trainer.run(idle_timeout_ms=50)
+        consumer.close()
+        assert trainer.steps >= 1
+        after = _leaves(params)  # raises if any buffer was donated away
+        for a, b in zip(before, after):
+            np.testing.assert_array_equal(a, b)
+        # The draft genuinely trained: its embed diverged from the
+        # target's (they were one buffer before the copy).
+        assert not np.array_equal(
+            np.asarray(trainer.draft_params["embed"]),
+            np.asarray(params["embed"]),
+        )
+
+    def test_publish_versions_fetchable_and_monotonic(self, model):
+        """publish_every cadence: versioned draft checkpoints land on
+        the plane, fetch-side CRC + tree rebuild accept them, and the
+        last published tree equals the trainer's live params."""
+        from torchkafka_tpu.source.checkpoint_wire import (
+            fetch_checkpoint,
+            rebuild_tree,
+        )
+
+        cfg, params = model
+        broker = _corpus_broker(_frames(12))
+        broker.create_topic("ck", partitions=1)
+        consumer = tk.MemoryConsumer(broker, "d", group_id="tr")
+        trainer = DistillTrainer(
+            consumer, params, cfg, seq_len=SEQ, batch_size=4,
+            draft_layers=1, learning_rate=5e-3,
+            broker=broker, ckpt_topic="ck", publish_every=3,
+            base_version=5,
+        )
+        report = trainer.run(idle_timeout_ms=50)
+        consumer.close()
+        assert report["steps"] == 3 and report["published"] == 1
+        assert trainer.next_version == 7
+        flat, manifest = fetch_checkpoint(broker, "ck", 6)
+        assert manifest["kind"] == "draft"
+        host = jax.tree_util.tree_map(np.asarray, trainer.draft_params)
+        rebuilt = rebuild_tree(host, flat)
+        for a, b in zip(_leaves(rebuilt), _leaves(host)):
+            np.testing.assert_array_equal(a, b)
+
+    def test_torn_corpus_records_drop_not_stall(self, model):
+        """At-least-once corpus hygiene: garbage and torn frames on the
+        topic cost their own sample only — the trainer consumes past
+        them and still trains on every valid frame."""
+        cfg, params = model
+        frames = _frames(6)
+        broker = tk.InMemoryBroker()
+        broker.create_topic("d", partitions=1)
+        for i, f in enumerate(frames):
+            broker.produce("d", f)
+            if i % 2 == 0:
+                broker.produce("d", b"not-a-frame")
+                broker.produce("d", f[: len(f) // 2])
+        consumer = tk.MemoryConsumer(broker, "d", group_id="tr")
+        trainer = DistillTrainer(
+            consumer, params, cfg, seq_len=SEQ, batch_size=3,
+            draft_layers=1,
+        )
+        report = trainer.run(idle_timeout_ms=50)
+        consumer.close()
+        assert report["records"] == 6  # every valid frame, nothing else
+        assert report["steps"] >= 2
+
+    def test_validation(self, model):
+        cfg, params = model
+        broker = _corpus_broker([])
+        consumer = tk.MemoryConsumer(broker, "d", group_id="tr")
+        with pytest.raises(ValueError, match="publish_every"):
+            DistillTrainer(
+                consumer, params, cfg, seq_len=SEQ, publish_every=2,
+            )
+        with pytest.raises(ValueError, match="together"):
+            DistillTrainer(
+                consumer, params, cfg, seq_len=SEQ,
+                draft_params={"x": np.zeros(2)},
+            )
+        with pytest.raises(ValueError, match="max_seq_len"):
+            DistillTrainer(consumer, params, cfg, seq_len=10_000)
+        consumer.close()
+
+
+class TestDistillController:
+    def _ctl(self, clock, **kw):
+        kw.setdefault("window_rounds", 2)
+        kw.setdefault("min_proposed", 10)
+        kw.setdefault("drop_frac", 0.5)
+        kw.setdefault("cooldown_s", 5.0)
+        return DistillController(DistillPolicy(**kw), clock=clock.now)
+
+    def test_window_close_and_min_proposed(self):
+        mc = ManualClock()
+        c = self._ctl(mc)
+        c.note_round(4, 5)
+        assert c.alpha_window is None  # window still open
+        c.note_round(8, 10)
+        assert c.alpha_window == 0.8 and c.alpha_best == 0.8
+        # A sparse window (< min_proposed new proposals) is discarded.
+        c.note_round(8, 12)
+        c.note_round(9, 14)
+        assert c.alpha_window == 0.8
+
+    def test_alpha_drop_gating_and_cooldown(self):
+        mc = ManualClock()
+        c = self._ctl(mc)
+        c.note_round(4, 5)
+        c.note_round(8, 10)  # alpha 0.8
+        assert c.maybe_refresh() is None  # no version available
+        c.note_version(1)
+        assert c.maybe_refresh() is None  # no degradation yet
+        c.note_round(9, 20)
+        c.note_round(10, 30)  # window alpha 0.1 < 0.5 * 0.8
+        d = c.maybe_refresh()
+        assert d == {"version": 1, "reason": "alpha_drop", "alpha": 0.1}
+        c.note_applied(1, d["reason"])
+        assert c.applied_version == 1 and c.refreshes == 1
+        assert c.alpha_best is None  # baseline reset post-refresh
+        assert c.maybe_refresh() is None  # nothing newer
+        # A newer version inside the cooldown stays gated even after a
+        # fresh degraded window...
+        c.note_version(2)
+        c.note_round(40, 70)
+        c.note_round(70, 110)  # alpha 0.75 -> new best
+        c.note_round(72, 130)
+        c.note_round(74, 150)  # alpha 0.1 -> degraded again
+        assert c.maybe_refresh() is None  # cooldown (5s) not elapsed
+        mc.advance(5.0)
+        d = c.maybe_refresh()
+        assert d is not None and d["version"] == 2
+        assert d["reason"] == "alpha_drop"
+
+    def test_refresh_on_publish_mode(self):
+        mc = ManualClock()
+        c = self._ctl(mc, refresh_on_publish=True, cooldown_s=2.0)
+        c.note_version(1)
+        # No alpha windows needed in this mode — but cooldown still holds.
+        d = c.maybe_refresh()
+        assert d == {"version": 1, "reason": "published", "alpha": None}
+        c.note_applied(1, "published")
+        c.note_version(2)
+        assert c.maybe_refresh() is None  # inside the cooldown
+        mc.advance(2.0)
+        assert c.maybe_refresh()["version"] == 2
+
+    def test_rejected_version_skipped_forever(self):
+        mc = ManualClock()
+        c = self._ctl(mc, refresh_on_publish=True, cooldown_s=0.0)
+        c.note_version(3)
+        assert c.maybe_refresh()["version"] == 3
+        c.note_rejected(3)
+        assert c.maybe_refresh() is None
+        mc.advance(100.0)
+        assert c.maybe_refresh() is None  # 3 is poisoned, not cooling
+        c.note_version(4)  # the clean republish is a NEW version
+        assert c.maybe_refresh()["version"] == 4
+
+    def test_stale_version_never_fires(self):
+        mc = ManualClock()
+        c = DistillController(
+            DistillPolicy(refresh_on_publish=True, cooldown_s=0.0),
+            applied_version=7, clock=mc.now,
+        )
+        c.note_version(5)
+        assert c.available_version == 7  # never regresses
+        assert c.maybe_refresh() is None
+
+    def test_policy_validation(self):
+        for kw in (
+            {"window_rounds": 0}, {"min_proposed": 0},
+            {"drop_frac": 0.0}, {"drop_frac": 1.5}, {"cooldown_s": -1},
+        ):
+            with pytest.raises(ValueError):
+                DistillPolicy(**kw)
+
+
+class TestRefreshUnderChaos:
+    def test_swap_plus_replica_kill_committed_tokens_invariant(self, model):
+        """The closed loop's safety half, under chaos: a speculative
+        fleet serves a storm; mid-stream a NEW draft version (different
+        weights, same geometry) is published and the driver refreshes
+        every runnable replica between ticks WHILE a replica dies. Every
+        served completion — duplicates from the kill included — is
+        byte-identical to a no-refresh no-kill reference, because the
+        draft only proposes; the target's verification commits."""
+        from torchkafka_tpu.fleet import ReplicaChaos, ServingFleet
+
+        cfg, params = model
+        broker = tk.InMemoryBroker()
+        broker.create_topic("p", partitions=4)
+        rng = np.random.default_rng(17)
+        n = 24
+        prompts = rng.integers(0, VOCAB, (n, P), dtype=np.int32)
+        for i in range(n):
+            broker.produce("p", prompts[i].tobytes(), partition=i % 4)
+
+        def build(group):
+            return ServingFleet(
+                lambda rid: tk.MemoryConsumer(broker, "p", group_id=group),
+                params, cfg, replicas=2, prompt_len=P, max_new=MAX_NEW,
+                slots=2, commit_every=2,
+                generator_cls=SpecStreamingGenerator,
+                gen_kwargs={"k": 3, "draft_layers": 1},
+            )
+
+        ref_fleet = build("gref")
+        reference = {
+            (rec.partition, rec.offset): np.asarray(toks)
+            for _rid, rec, toks in ref_fleet.serve_all(idle_timeout_ms=500)
+        }
+        assert len(reference) == n
+
+        # A structurally identical draft with DIFFERENT weights: the
+        # refresh provably changes the proposer.
+        alt_draft, _ = truncated_draft(
+            init_params(jax.random.key(1), cfg), cfg, 1
+        )
+        fleet = build("gchaos")
+        driver = fleet.start_distill(
+            policy=DistillPolicy(
+                window_rounds=4, min_proposed=8, cooldown_s=0.0,
+                refresh_on_publish=True,
+            ),
+            versions={1: alt_draft},
+        )
+        chaos = ReplicaChaos(seed=3, min_completions=4, max_completions=8)
+
+        def hook(f, served):
+            if served >= 6:
+                driver.note_version(1)
+            driver.on_round(f, served)
+
+        served = list(fleet.serve(
+            idle_timeout_ms=500, chaos=chaos, on_round=hook,
+        ))
+        assert chaos.killed, "the kill never fired — chaos is vacuous"
+        assert driver.controller.applied_version == 1
+        assert driver.controller.refreshes == 1
+        got = {}
+        for _rid, rec, toks in served:
+            got.setdefault((rec.partition, rec.offset), []).append(
+                np.asarray(toks)
+            )
+        assert set(got) == set(reference), "lost completions under chaos"
+        for key, copies in got.items():
+            for c in copies:  # kill duplicates allowed, divergence never
+                np.testing.assert_array_equal(
+                    c, reference[key], err_msg=str(key)
+                )
+        # The refresh observably landed on the survivors' metrics.
+        versions = {
+            m: int(g.value)
+            for m, g in fleet.metrics._replica_draft_version.items()
+        }
+        assert versions and all(v == 1 for v in versions.values())
+        assert int(fleet.metrics.draft_version.value) == 1
+
+
+@pytest.mark.slow
+class TestProcessDistillRole:
+    def test_distill_worker_trains_publishes_respawns(self, tmp_path):
+        """The real-process flavor: a ProcessFleet with a distill role —
+        decode replicas stage committed completions onto the distill
+        topic, the trainer worker (own consumer group, heartbeat-leased)
+        trains the truncated draft and publishes versioned checkpoints;
+        kill_distill + the lease sweep respawn it like any worker, and
+        drain exits everyone clean with a distill metrics dump."""
+        from torchkafka_tpu.fleet import ProcessFleet
+        from torchkafka_tpu.source.checkpoint_wire import fetch_checkpoint
+        from torchkafka_tpu.source.records import TopicPartition
+
+        fleet = ProcessFleet(
+            {
+                "seed": 0, "vocab_size": VOCAB, "d_model": 32,
+                "n_layers": 2, "n_heads": 2, "n_kv_heads": 1, "d_ff": 64,
+                "max_seq_len": SEQ,
+            },
+            topic="dp", prompt_len=P, max_new=MAX_NEW,
+            workdir=tmp_path / "fleet", replicas=1, distill_replicas=1,
+            distill_topic="dd", publish_every=2, draft_layers=1,
+            distill_batch=2, partitions=2, slots=2, commit_every=2,
+            journal_cadence=1, session_timeout_s=2.0,
+            heartbeat_interval_s=0.2, respawn=True, group="dg",
+        )
+        try:
+            fleet.start()
+            fleet.wait_ready(timeout_s=300)
+            rng = np.random.default_rng(29)
+            for i in range(8):
+                fleet.broker.produce(
+                    "dp",
+                    rng.integers(0, VOCAB, P, dtype=np.int32).tobytes(),
+                    partition=i % 2, key=str(i).encode(),
+                )
+            fleet.wait(lambda f: f.fully_committed(), timeout_s=300)
+            # Commit-gated staging: the distill topic fills only as
+            # commits land; 8 completions / batch 2 / publish_every 2
+            # yields draft versions 1 and 2 on the checkpoint plane.
+            fleet.wait(
+                lambda f: f.broker.end_offset(
+                    TopicPartition("dd", 0)
+                ) >= 8,
+                timeout_s=120,
+            )
+
+            def published(f):
+                try:
+                    _, manifest = fetch_checkpoint(
+                        f.broker, "fleet-ckpt", 1
+                    )
+                    return manifest["kind"] == "draft"
+                except Exception:  # noqa: BLE001 - not yet published
+                    return False
+
+            fleet.wait(published, timeout_s=300)
+
+            forensics = fleet.kill_distill()
+            assert forensics["role"] == "distill"
+            fleet.wait(
+                lambda f: len(f.live("distill")) == 1
+                and f.live("distill")[0].member != forensics["member"],
+                timeout_s=120,
+            )
+            # Let the replacement finish booting (ready marker produced,
+            # SIGTERM handler installed) before the fleet-wide drain —
+            # a SIGTERM during interpreter startup dies -15, not clean.
+            fleet.wait_ready(timeout_s=300)
+            # Fresh traffic for the replacement: the victim's training
+            # progress died with it (SIGKILL leaves no metrics dump), so
+            # the respawn must observably train — it resumes from the
+            # group's committed offsets and commits after each step.
+            for i in range(8, 12):
+                fleet.broker.produce(
+                    "dp",
+                    rng.integers(0, VOCAB, P, dtype=np.int32).tobytes(),
+                    partition=i % 2, key=str(i).encode(),
+                )
+            fleet.wait(lambda f: f.fully_committed(), timeout_s=300)
+            dd0 = TopicPartition("dd", 0)
+            fleet.wait(
+                lambda f: f.broker.end_offset(dd0) >= 12
+                and (f.broker.committed("dg-distill", dd0) or 0) >= 12,
+                timeout_s=300,
+            )
+            fleet.drain()
+            fleet.wait(
+                lambda f: all(not i.running for i in f.incarnations),
+                timeout_s=120,
+            )
+            fleet.poll_once()
+            codes = {
+                i.member: i.exit_code for i in fleet.incarnations
+                if i.exit_code is not None
+            }
+            assert codes.pop(forensics["member"]) == -9
+            assert codes and all(c == 0 for c in codes.values()), codes
+            reports = [
+                m for m in fleet.worker_metrics()
+                if m.get("role") == "distill"
+            ]
+            assert reports, "no distill worker metrics dump"
+            total_steps = sum(r["steps"] for r in reports)
+            assert total_steps >= 2, reports
+            assert any(r["published"] >= 1 for r in reports), reports
+        finally:
+            fleet.close()

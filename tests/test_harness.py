@@ -439,6 +439,38 @@ def test_scenario_24_rolling_hot_swap():
     assert out["workers_survived"] is True
 
 
+def test_scenario_25_online_draft_distillation():
+    """The tier-1 closed-loop smoke (ISSUE 19): a speculative fleet
+    serves a Zipf workload whose hot set ROTATES mid-run (draft α
+    collapses on the unseen distribution) while a DistillTrainer
+    consumes the fleet's own committed completions and publishes fresher
+    drafts; the DistillController's windowed α gauge triggers live
+    swap_draft_params refreshes fleet-wide. The acceptance contract is
+    the ISSUE's: α visibly degrades at the drift and recovers after a
+    post-drift refresh, committed tokens stay byte-identical to a
+    NO-distillation reference fleet (draft proposes, target commits),
+    and the exactly-once discipline holds throughout."""
+    out = run_scenario(25, "tiny")
+    assert out["scenario"] == "25:online-draft-distillation"
+    assert out["replicas"] == 2
+    # The closed loop: degradation observed, refresh landed after the
+    # drift, acceptance recovered.
+    assert out["alpha_degraded_at_drift"] is True
+    assert out["refreshes_post_drift"] >= 1
+    assert out["alpha_recovered"] is True
+    # Every α phase window measured real speculation traffic.
+    assert all(n > 0 for n in out["alpha_windows_proposed"])
+    # The trainer genuinely trained and shipped versions.
+    assert out["trainer"]["steps"] >= 1
+    assert out["trainer"]["published"] >= 1
+    # The safety half: refreshes changed the PROPOSER only — the
+    # committed view is byte-identical to the reference fleet's, exactly
+    # once, nothing lost.
+    assert out["identical_to_no_distill"] is True
+    assert out["committed_duplicates"] == 0
+    assert out["all_arrived"] is True
+
+
 def test_scenario_20_sharded_paged_fleet():
     """The tier-1 sharded-paged smoke (PR 13): a 2-replica fleet whose
     generators compose paged block tables + int8 payloads + the kernel

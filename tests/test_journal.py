@@ -376,6 +376,12 @@ class TestCrashPoints:
             # canary dying after shadow-serving its slice but before
             # publishing the verdict.
             "rollout_pre_swap", "swap_mid_apply", "canary_pre_verdict",
+            # The online-distillation windows (ISSUE 19): a distill
+            # trainer dying after committing a step's corpus offsets but
+            # before publishing the draft checkpoint, and a serving
+            # worker dying after fetching a draft version but before the
+            # between-ticks swap applies.
+            "distill_pre_publish", "draft_swap_pre_apply",
         }
 
 

@@ -627,13 +627,27 @@ def _serve_metrics():
     m.prefill_routed.add(2)
     m.adopted_slots.add(2)
     m.handoffs_published.add(1)
+    # ISSUE-19 distill families: corpus/trainer counters, the windowed
+    # live-α gauge the controller gates on, the applied draft version,
+    # and refresh counters labeled by reason.
+    m.distill_published.add(4)
+    m.distill_steps.add(2)
+    m.distill_records.add(8)
+    m.spec_alpha_window.set(0.625)
+    m.draft_version.set(3)
+    m.draft_refreshes("published").add(1)
+    m.draft_refreshes("alpha_drop").add(2)
     text = m.render_prometheus()
     for family in (
         "radix_demotions_total", "radix_promotions_total",
         "tier_hits_total", "tier_occupancy_bytes", "prefill_routed_total",
         "adopted_slots_total", "prefill_handoffs_published_total",
+        "distill_published_total", "distill_steps_total",
+        "distill_records_total", "spec_alpha_window", "draft_version",
+        "draft_refreshes_total",
     ):
         assert f"torchkafka_serve_{family}" in text, family
+    assert 'reason="alpha_drop"' in text
     return text
 
 
@@ -675,6 +689,14 @@ def _fleet_metrics():
     m.replica_model_version(EVIL_TENANT).set(2)
     m.rollback("canary_divergence").add(1)
     m.checkpoint_reject("wire").add(2)
+    # ISSUE-19 distill families: the fleet-applied draft version, the
+    # per-replica draft versions (member ids escape like tenant keys),
+    # and refresh counters labeled by reason.
+    m.draft_version.set(2)
+    m.replica_draft_version("r0i0").set(2)
+    m.replica_draft_version(EVIL_TENANT).set(1)
+    m.draft_refreshes("alpha_drop").add(1)
+    m.draft_refreshes("checkpoint_rejected").add(1)
     text = m.render_prometheus(replicas=None)
     for family in (
         "autoscale_decisions_total", "autoscale_target_replicas",
@@ -682,10 +704,13 @@ def _fleet_metrics():
         "rollout_phase", "rollout_target_version",
         "canary_token_diffs_total", "replica_model_version",
         "rollbacks_total", "checkpoint_rejects_total",
+        "draft_applied_version", "draft_version",
+        "draft_refreshes_total",
     ):
         assert f"torchkafka_fleet_{family}" in text, family
     assert 'role="decode",direction="up",reason="burn"' in text
     assert 'reason="canary_divergence"' in text
+    assert 'reason="checkpoint_rejected"' in text
     assert 'member="r0i0"' in text
     return text
 

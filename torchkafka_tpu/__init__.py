@@ -107,7 +107,7 @@ from torchkafka_tpu.transform import (
     raw_bytes,
 )
 
-__version__ = "0.20.0"
+__version__ = "0.21.0"
 
 __all__ = [
     "BarrierError",

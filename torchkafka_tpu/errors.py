@@ -183,3 +183,12 @@ class CheckpointWireError(TpuKafkaError):
     incumbent version, counts the rejection, and a re-published (or
     re-fetched) checkpoint converges — a torn rollout artifact degrades
     the rollout, never the serving path."""
+
+
+class DistillWireError(TpuKafkaError):
+    """A completion frame on the distill topic failed validation — bad
+    magic, truncated header/payload, or CRC mismatch. PER RECORD, never
+    per trainer: the corpus is at-least-once and self-healing (the
+    publisher only ever frames committed tokens), so the trainer drops
+    the frame, counts it, and keeps consuming — a torn training record
+    costs one sample, never the training loop."""

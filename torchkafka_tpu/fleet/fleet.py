@@ -483,6 +483,45 @@ class ServingFleet:
         )
         return InProcessRolloutDriver(self, ctl, params_by_version)
 
+    def start_distill(
+        self,
+        *,
+        policy=None,
+        broker=None,
+        ckpt_topic: str | None = None,
+        versions: dict | None = None,
+        applied_version: int = 0,
+    ):
+        """Close the online-distillation loop on this in-process fleet
+        (torchkafka_tpu/distill): a ``DistillController`` tracking the
+        windowed live-α from every replica's ``spec_stats`` (requires
+        ``generator_cls=SpecStreamingGenerator``) and an
+        ``InProcessDistillDriver`` applying refresh directives via
+        ``swap_draft_params`` — between ticks, no quiesce, committed
+        tokens invariant. Plug the returned driver's ``on_round`` into
+        ``serve(on_round=...)`` (compose with a workload driver's hook
+        by calling both) and push published draft versions with
+        ``driver.note_version``. Delivery is ``broker``+``ckpt_topic``
+        (wire fetch, CRC-validated) or a ``versions`` dict (in-process
+        twin). The controller shares the fleet clock, so ManualClock
+        fleets replay the whole control loop byte-identically."""
+        from torchkafka_tpu.distill.controller import (
+            DistillController,
+            InProcessDistillDriver,
+        )
+
+        ctl = DistillController(
+            policy,
+            applied_version=applied_version,
+            clock=self._clock,
+            tracer=self.tracer,
+            metrics=self.metrics,
+        )
+        return InProcessDistillDriver(
+            self, ctl, broker=broker, ckpt_topic=ckpt_topic,
+            versions=versions,
+        )
+
     def kill_replica(self, rid: int) -> None:
         """Simulate a replica crash (see Replica.kill), then consult the
         victim's decode journal for warm failover: its entries — read

@@ -63,6 +63,17 @@ class FleetMetrics:
         self._replica_model_version: dict[str, Gauge] = {}
         self._rollbacks: dict[str, RateMeter] = {}
         self._ckpt_rejects: dict[str, RateMeter] = {}
+        # Online draft distillation (torchkafka_tpu/distill): the closed
+        # loop's fleet-level view — the controller's windowed live-α,
+        # the draft version it applied, each member's proposing draft,
+        # refresh verdicts by reason, and the trainer's progress
+        # (aggregated from trainer reports). All zero without a loop.
+        self.spec_alpha_window = Gauge()
+        self.draft_version = Gauge()  # fleet-APPLIED draft version
+        self.distill_steps = RateMeter()
+        self.distill_records = RateMeter()
+        self._draft_refreshes: dict[str, RateMeter] = {}
+        self._replica_draft_version: dict[str, Gauge] = {}
         # Autoscale controller families (fleet/autoscale.py): decision
         # counters labeled {role, direction, reason}, the controller's
         # current per-role target, and which phase (steady / scaling_up /
@@ -142,6 +153,12 @@ class FleetMetrics:
 
     def rollback(self, reason: str) -> RateMeter:
         return self._rollbacks.setdefault(reason, RateMeter())
+
+    def draft_refreshes(self, reason: str) -> RateMeter:
+        return self._draft_refreshes.setdefault(reason, RateMeter())
+
+    def replica_draft_version(self, member: str) -> Gauge:
+        return self._replica_draft_version.setdefault(member, Gauge())
 
     def checkpoint_reject(self, reason: str) -> RateMeter:
         return self._ckpt_rejects.setdefault(reason, RateMeter())
@@ -271,6 +288,20 @@ class FleetMetrics:
                 for reason, m in sorted(self._ckpt_rejects.items())
             },
         }
+        distill = {
+            "alpha_window": round(self.spec_alpha_window.value, 4),
+            "applied_version": int(self.draft_version.value),
+            "steps": self.distill_steps.count,
+            "records": self.distill_records.count,
+            "member_draft_versions": {
+                m: int(g.value)
+                for m, g in sorted(self._replica_draft_version.items())
+            },
+            "refreshes": {
+                reason: m.count
+                for reason, m in sorted(self._draft_refreshes.items())
+            },
+        }
         membership = {
             "joins": self.replica_joins.count,
             "fences": self.replica_fences.count,
@@ -284,6 +315,7 @@ class FleetMetrics:
         return {
             "membership": membership,
             "rollout": rollout,
+            "distill": distill,
             "autoscale": autoscale,
             "slo": self._slo.summary() if self._slo is not None else None,
             "burn": (
@@ -410,6 +442,19 @@ class FleetMetrics:
                 (format_labels(reason=reason), v)
                 for reason, v in s["rollout"]["checkpoint_rejects"].items()
             ] or 0),
+            ("spec_alpha_window", "gauge", s["distill"]["alpha_window"]),
+            ("draft_applied_version", "gauge",
+             s["distill"]["applied_version"]),
+            ("draft_version", "gauge", [
+                (format_labels(member=m), v)
+                for m, v in s["distill"]["member_draft_versions"].items()
+            ] or 0),
+            ("draft_refreshes_total", "counter", [
+                (format_labels(reason=reason), v)
+                for reason, v in s["distill"]["refreshes"].items()
+            ] or 0),
+            ("distill_steps_total", "counter", s["distill"]["steps"]),
+            ("distill_records_total", "counter", s["distill"]["records"]),
             ("journal_handoffs_total", "counter", s["journal"]["handoffs"]),
             ("drain_timeout_kills_total", "counter",
              s["journal"]["drain_timeout_kills"]),

@@ -365,6 +365,11 @@ def run_replica_worker(spec: dict, broker=None, shutdown=None) -> int:
             kv_tier=spec.get("kv_tier"),
             journal=journal,
             model_version=model_version,
+            # Online distillation corpus: committed completions ride the
+            # same commit window (exactly-once: same transaction) onto
+            # the distill topic, so the trainer only ever sees tokens the
+            # committed view holds.
+            distill_topic=spec.get("distill_topic"),
         )
         # Disaggregated decode: tail the handoff topic (broadcast — one
         # private group per replica) into the generator's shelf, and
@@ -568,6 +573,10 @@ def main(argv: list[str]) -> int:
             from torchkafka_tpu.fleet.prefill import run_prefill_worker
 
             return run_prefill_worker(spec, shutdown=stop)
+        if spec.get("role") == "distill":
+            from torchkafka_tpu.distill.worker import run_distill_worker
+
+            return run_distill_worker(spec, shutdown=stop)
         return run_replica_worker(spec, shutdown=stop)
 
 

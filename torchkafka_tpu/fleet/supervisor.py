@@ -155,6 +155,14 @@ class ProcessFleet:
         rollout_topic: str = "fleet-rollout",
         ckpt_topic: str = "fleet-ckpt",
         model_version: int = 0,
+        distill_replicas: int = 0,
+        distill_topic: str = "fleet-distill",
+        publish_every: int = 0,
+        draft_layers: int | None = None,
+        distill_batch: int = 8,
+        distill_lr: float = 1e-3,
+        distill_seq_len: int | None = None,
+        draft_base_version: int = 0,
         wal_dir: str | os.PathLike | None = None,
         wal_durability: str | None = "batch",
         broker_replicas: int = 1,
@@ -249,12 +257,25 @@ class ProcessFleet:
         # ``model_version`` tags the boot weights; every committed output
         # window carries the serving version in its "mv" header.
         self.rollout_topic = rollout_topic if rollout else None
-        self.ckpt_topic = ckpt_topic if rollout else None
+        # Online draft distillation (torchkafka_tpu/distill):
+        # ``distill_replicas`` DistillTrainer workers ("d" prefix) in
+        # their own consumer group train the layer-truncated draft on the
+        # committed-completion corpus decode replicas stage onto
+        # ``distill_topic`` inside their commit windows, and publish
+        # versioned draft checkpoints onto ``ckpt_topic`` — which is why
+        # the checkpoint plane exists for distill fleets even without
+        # ``rollout=True``.
+        self.distill_replicas = distill_replicas
+        self.distill_topic = distill_topic if distill_replicas else None
+        self.ckpt_topic = (
+            ckpt_topic if (rollout or distill_replicas) else None
+        )
         self.model_version = int(model_version)
         self._rollout_driver = None
         for t, p in ((topic, partitions), (out_topic, 1),
                      (ready_topic, 1), (self.handoff_topic, 1),
-                     (self.rollout_topic, 1), (self.ckpt_topic, 1)):
+                     (self.rollout_topic, 1), (self.ckpt_topic, 1),
+                     (self.distill_topic, 1)):
             if t is None or p is None:
                 continue
             try:
@@ -301,6 +322,13 @@ class ProcessFleet:
             "rollout_topic": self.rollout_topic,
             "ckpt_topic": self.ckpt_topic,
             "model_version": self.model_version,
+            "distill_topic": self.distill_topic,
+            "publish_every": publish_every,
+            "draft_layers": draft_layers,
+            "distill_batch": distill_batch,
+            "distill_lr": distill_lr,
+            "distill_seq_len": distill_seq_len,
+            "draft_base_version": draft_base_version,
         }
         self.incarnations: list[_Incarnation] = []
         self.victims: list[dict] = []  # kill_replica forensics
@@ -313,10 +341,10 @@ class ProcessFleet:
         # respawned incarnation slots into its predecessor's position and
         # inherits the same partition range. That bias is what makes the
         # victim's journal (and its radix prefix locality) land where the
-        # redelivered prompts do. Prefill workers ("q" prefix) live in
-        # their OWN consumer group, so the prefix only has to be
-        # distinct, not ordered against decode members.
-        prefix = "r" if role == "decode" else "q"
+        # redelivered prompts do. Prefill ("q") and distill ("d") workers
+        # live in their OWN consumer groups, so those prefixes only have
+        # to be distinct, not ordered against decode members.
+        prefix = {"decode": "r", "prefill": "q", "distill": "d"}[role]
         member = f"{prefix}{idx:03d}i{self._seq:03d}"  # zero-padded
         self._seq += 1                          # order == numeric order
         spec = dict(self._spec_base)
@@ -369,6 +397,8 @@ class ProcessFleet:
             self._spawn(idx)
         for idx in range(self.prefill_replicas):
             self._spawn(idx, role="prefill")
+        for idx in range(self.distill_replicas):
+            self._spawn(idx, role="distill")
         return self
 
     def wait_ready(self, timeout_s: float = 120.0) -> None:
@@ -417,7 +447,7 @@ class ProcessFleet:
     def _group_of(self, inc: _Incarnation) -> str:
         return (
             self.group if inc.role == "decode"
-            else f"{self.group}-prefill"
+            else f"{self.group}-{inc.role}"
         )
 
     def poll_once(self) -> None:
@@ -429,6 +459,8 @@ class ProcessFleet:
         groups = [self.group]
         if self.prefill_replicas:
             groups.append(f"{self.group}-prefill")
+        if self.distill_replicas:
+            groups.append(f"{self.group}-distill")
         infos: dict[str, dict] = {}
         for group in groups:
             info = self.broker.membership(group)
@@ -570,9 +602,11 @@ class ProcessFleet:
         if not self.respawn:
             return
         alive = len(self.live(dead.role))
-        target = (
-            self._target if dead.role == "decode" else self.prefill_replicas
-        )
+        target = {
+            "decode": self._target,
+            "prefill": self.prefill_replicas,
+            "distill": self.distill_replicas,
+        }[dead.role]
         if alive < target:
             _logger.info(
                 "respawning %s replica %d (member %s %s)",
@@ -587,7 +621,9 @@ class ProcessFleet:
         """Publish a versioned checkpoint onto the checkpoint topic
         (manifest + CRC'd chunks). Returns the frame count."""
         if self.ckpt_topic is None:
-            raise ValueError("fleet was built without rollout=True")
+            raise ValueError(
+                "fleet was built without rollout=True or distill_replicas"
+            )
         from torchkafka_tpu.source.checkpoint_wire import publish_checkpoint
 
         return publish_checkpoint(
@@ -690,6 +726,34 @@ class ProcessFleet:
         inc.proc.wait()
         forensics = {
             "member": inc.member, "idx": idx, "role": "prefill",
+            "log_path": inc.log_path,
+        }
+        self.victims.append(forensics)
+        return forensics
+
+    def kill_distill(self, idx: int = 0) -> dict:
+        """SIGKILL the newest live distill-trainer incarnation of index
+        ``idx`` — the trainer-death drill: unpublished draft progress
+        (at most ``publish_every`` steps past the last checkpoint)
+        vanishes with the process, the serving fleet keeps proposing
+        with its incumbent draft (serving never depended on the trainer
+        being alive), and (with ``respawn=True``) a fresh incarnation
+        resumes from the corpus group's committed offsets — at-least-
+        once, so a mid-step death re-delivers that step's records as
+        extra gradient samples. Zero committed-token impact by
+        construction."""
+        victims = [
+            i for i in self.incarnations
+            if i.idx == idx and i.state in (LIVE, DRAINING) and i.running
+            and i.role == "distill"
+        ]
+        if not victims:
+            raise ValueError(f"no live process for distill worker {idx}")
+        inc = victims[-1]
+        inc.proc.send_signal(signal.SIGKILL)
+        inc.proc.wait()
+        forensics = {
+            "member": inc.member, "idx": idx, "role": "distill",
             "log_path": inc.log_path,
         }
         self.victims.append(forensics)
@@ -821,9 +885,14 @@ class ProcessFleet:
                 "cannot scale the prefill role of a fleet built without "
                 "prefill_replicas/kv_pages (no handoff plane exists)"
             )
+        if role == "distill" and self.distill_topic is None:
+            raise ValueError(
+                "cannot scale the distill role of a fleet built without "
+                "distill_replicas (no distill corpus topic exists)"
+            )
         fenced = set(
             self.broker.membership(
-                self.group if role == "decode" else f"{self.group}-prefill"
+                self.group if role == "decode" else f"{self.group}-{role}"
             )["fenced"]
         )
         cur = [
@@ -858,18 +927,23 @@ class ProcessFleet:
                 inc.state = DRAINING
         if role == "decode":
             self._target = n
-        else:
+        elif role == "prefill":
             self.prefill_replicas = n
+        else:
+            self.distill_replicas = n
 
     def drain(self) -> None:
-        """SIGTERM every live worker (prefill included): fleet-wide
-        cooperative drain."""
-        for inc in self.live() + self.live("prefill"):
+        """SIGTERM every live worker (prefill and distill included):
+        fleet-wide cooperative drain."""
+        for inc in (
+            self.live() + self.live("prefill") + self.live("distill")
+        ):
             if inc.running:
                 inc.proc.send_signal(signal.SIGTERM)
             inc.state = DRAINING
         self._target = 0
         self.prefill_replicas = 0
+        self.distill_replicas = 0
 
     def wait(
         self,

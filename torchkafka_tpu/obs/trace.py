@@ -110,6 +110,15 @@ ROLLOUT_PHASE = "rollout_phase"
 CANARY_STARTED = "canary_started"
 SWAPPED = "swapped"
 ROLLED_BACK = "rolled_back"
+# Online draft distillation (torchkafka_tpu/distill): the closed loop's
+# control decisions on the same "fleet" stream. ``draft_refresh`` is the
+# DistillController's verdict (the windowed live-α crossed the refresh
+# gate, or a refresh was rejected — the reason attribute says which);
+# ``draft_swapped`` is one replica's draft rebinding landing between
+# ticks (no quiesce — the draft only proposes, verification commits).
+# Under a ManualClock the whole loop replays byte-identically.
+DRAFT_REFRESH = "draft_refresh"
+DRAFT_SWAPPED = "draft_swapped"
 
 STAGES = (
     POLLED, QOS_ADMITTED, DEFERRED, PREFILL_QUEUED, CHUNK_SCHEDULED,
@@ -117,6 +126,7 @@ STAGES = (
     QUARANTINED, DROPPED, DLQ_FAILED, PREFILL_HANDOFF, SLOT_ADOPTED,
     BURN_STATE, REPLICA_JOINED, REPLICA_FENCED, JOURNAL_HANDOFF,
     SCALE_DECISION, ROLLOUT_PHASE, CANARY_STARTED, SWAPPED, ROLLED_BACK,
+    DRAFT_REFRESH, DRAFT_SWAPPED,
 )
 
 
@@ -649,6 +659,36 @@ class RecordTracer:
             self._emit(ROLLED_BACK, "fleet", 0, seq, (
                 ("reason", reason), ("version", int(version)),
             ))
+
+    def draft_refresh(self, reason: str, version: int,
+                      alpha: float | None = None) -> None:
+        """The DistillController decided a draft refresh (``reason``:
+        alpha_drop / forced) or rejected one (checkpoint_rejected).
+        α rounded so the JSONL trace round-trips byte-exact."""
+        with self._lock:
+            seq = self._membership_seq
+            self._membership_seq += 1
+            attrs = [("reason", reason), ("version", int(version))]
+            if alpha is not None:
+                attrs.append(("alpha", round(float(alpha), 4)))
+            self._emit(DRAFT_REFRESH, "fleet", 0, seq,
+                       tuple(sorted(attrs)))
+
+    def draft_swapped(self, version: int, member: str | None = None,
+                      replica=None) -> None:
+        """One replica's DRAFT rebound to checkpoint ``version`` between
+        ticks — committed tokens unchanged by contract (the draft only
+        proposes; the target's verification commits)."""
+        with self._lock:
+            seq = self._membership_seq
+            self._membership_seq += 1
+            attrs = [("version", int(version))]
+            if member is not None:
+                attrs.append(("member", member))
+            if replica is not None:
+                attrs.append(("replica", replica))
+            self._emit(DRAFT_SWAPPED, "fleet", 0, seq,
+                       tuple(sorted(attrs)))
 
     def burn_state(self, seq: int, metric: str, dim: str, label: str,
                    old: str, new: str, fast: float, slow: float) -> None:

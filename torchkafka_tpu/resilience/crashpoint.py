@@ -136,6 +136,21 @@ canary_pre_verdict         the canary replica finished its shadow slice
                            swap happened anywhere; recovery re-runs the
                            canary deterministically and the rollout
                            proceeds (or rolls back) on the same evidence
+distill_pre_publish        the distill trainer finished a train step but
+                           dies before publishing the refreshed draft
+                           checkpoint — no complete version ever appears
+                           on the checkpoint topic (a torn frame set is
+                           rejected by the fetch-side CRC path), the
+                           trainer's own consumer offsets re-deliver its
+                           uncommitted corpus at-least-once, and the
+                           serving fleet's committed tokens are untouched
+                           (the trainer is off the serving path)
+draft_swap_pre_apply       a speculative server fetched and validated a
+                           refreshed draft but dies before rebinding it —
+                           the draft only PROPOSES and verification
+                           commits, so the committed view at death is a
+                           prefix of the no-refresh reference; recovery
+                           serves byte-identical tokens on either draft
 ========================== =================================================
 
 Sites call ``crash_hook("<name>")``; production cost is one global ``is
@@ -192,6 +207,8 @@ REGISTERED_CRASH_POINTS: tuple[str, ...] = (
     "rollout_pre_swap",
     "swap_mid_apply",
     "canary_pre_verdict",
+    "distill_pre_publish",
+    "draft_swap_pre_apply",
 )
 
 ENV_VAR = "TORCHKAFKA_CRASHPOINT"

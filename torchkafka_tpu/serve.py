@@ -308,6 +308,22 @@ class ServeMetrics:
         self.adopted_slots = RateMeter()  # slots filled by handoff adoption
         self.handoffs_published = RateMeter()  # prefill-role only: filled-KV
         # handoffs published onto the transfer plane
+        # Online draft distillation (torchkafka_tpu/distill): the serve →
+        # distill-topic → trainer → checkpoint-topic → swap loop. All
+        # zero without a distill topic / spec serving.
+        self.distill_published = RateMeter()  # committed completions
+        # framed onto the distill topic (txn: counted at commit)
+        self.distill_steps = RateMeter()  # trainer train steps (trainer
+        # role only)
+        self.distill_records = RateMeter()  # corpus records consumed into
+        # train batches (trainer role only)
+        self.spec_alpha_window = Gauge()  # windowed live acceptance α the
+        # DistillController gates refreshes on (NaN-free: 0 until the
+        # first window closes)
+        self.draft_version = Gauge()  # draft checkpoint version currently
+        # proposing (0 = the built-in / construction-time draft)
+        self._draft_refreshes: dict[str, RateMeter] = {}  # draft
+        # hot-swaps by reason ("alpha_drop", "forced", ...)
         # Chunked prefill (kv_pages with prefill_chunk != 0): admission
         # enqueues uncached suffixes and every tick carries a bounded
         # chunk of them alongside decode. All zero in legacy/dense modes.
@@ -361,6 +377,21 @@ class ServeMetrics:
 
     def tenant_prefix_hits(self, tenant: str) -> RateMeter:
         return self._tenant_prefix_hits.setdefault(tenant, RateMeter())
+
+    def draft_refreshes(self, reason: str) -> RateMeter:
+        return self._draft_refreshes.setdefault(reason, RateMeter())
+
+    def distill_summary(self) -> dict:
+        return {
+            "published": self.distill_published.count,
+            "steps": self.distill_steps.count,
+            "records": self.distill_records.count,
+            "alpha_window": round(self.spec_alpha_window.value, 4),
+            "draft_version": int(self.draft_version.value),
+            "refreshes": {
+                r: m.count for r, m in sorted(self._draft_refreshes.items())
+            },
+        }
 
     def tenant_prefix_misses(self, tenant: str) -> RateMeter:
         return self._tenant_prefix_misses.setdefault(tenant, RateMeter())
@@ -419,6 +450,7 @@ class ServeMetrics:
             "prefix_cache": self.cache_summary(),
             "tenant_cache": self.tenant_cache_summary(),
             "disagg": self.disagg_summary(),
+            "distill": self.distill_summary(),
             "chunked_prefill": self.chunk_summary(),
             "journal": self.journal_summary(),
             "kv_backend": {
@@ -569,6 +601,16 @@ class ServeMetrics:
             ("adopted_slots_total", "counter", s["disagg"]["adopted_slots"]),
             ("prefill_handoffs_published_total", "counter",
              s["disagg"]["handoffs_published"]),
+            ("distill_published_total", "counter",
+             s["distill"]["published"]),
+            ("distill_steps_total", "counter", s["distill"]["steps"]),
+            ("distill_records_total", "counter", s["distill"]["records"]),
+            ("spec_alpha_window", "gauge", s["distill"]["alpha_window"]),
+            ("draft_version", "gauge", s["distill"]["draft_version"]),
+            ("draft_refreshes_total", "counter", [
+                (format_labels(reason=r), v)
+                for r, v in s["distill"]["refreshes"].items()
+            ] or 0),
         ])
 
 
@@ -795,6 +837,8 @@ class StreamingGenerator:
         trace_replica: int | None = None,
         max_new_of: Callable[[Record], int | None] | None = None,
         model_version: int = 0,
+        distill_topic: str | None = None,
+        distill_producer=None,
     ) -> None:
         """``ticks_per_sync``: decode ticks chained per device dispatch
         (and per host sync of the done mask). Higher amortises dispatch
@@ -1008,7 +1052,27 @@ class StreamingGenerator:
         produce before its offset retires (``metrics.quarantined``); a
         failed DLQ produce raises ``OutputDeliveryError`` — fail-stop,
         crash-before-commit, so the committed watermark never covers a
-        record that is neither served nor durably quarantined."""
+        record that is neither served nor durably quarantined.
+
+        ``distill_topic``: publish each completion as a framed training
+        record (``distill.wire.encode_completion`` — prompt ids,
+        committed tokens, tenant key, model version) for the online
+        draft-distillation loop. The frames follow the SAME durability
+        discipline as outputs, commit-gated both ways so the training
+        corpus only ever contains COMMITTED tokens: under
+        ``exactly_once`` they are staged beside the output outbox and
+        produced inside the commit window's transaction (atomic with
+        outputs + offsets — an aborted window's frames are invisible,
+        a zombie's frames are fenced with its transaction); in
+        at-least-once mode they are held host-side and produced only
+        AFTER the offset commit that covers them succeeds (a crash
+        before commit publishes nothing for the re-delivered records —
+        the regenerated completions publish instead). A divergent
+        canary or a fenced zombie therefore never trains the draft.
+        ``distill_producer`` overrides the producer used for the
+        at-least-once publish (default: ``output_producer``); in
+        transactional mode the frames always ride the transactional
+        producer."""
         if prompt_len + max_new > cfg.max_seq_len:
             raise ValueError("prompt_len + max_new exceeds cfg.max_seq_len")
         if max_new < 2:
@@ -1105,6 +1169,26 @@ class StreamingGenerator:
         self._encode_output = encode_output or (
             lambda rec, toks: np.asarray(toks, np.int32).tobytes()
         )
+        if distill_topic is not None and not exactly_once:
+            if distill_producer is None and output_producer is None:
+                raise ValueError(
+                    "distill_topic requires a producer (distill_producer "
+                    "or output_producer) in at-least-once mode"
+                )
+        self._distill_topic = distill_topic
+        self._distill_producer = distill_producer
+        # Distill frames staged by record identity. Txn mode: sent inside
+        # the commit window's transaction (the outbox discipline). Non-txn
+        # mode: held until the offset commit that covers them SUCCEEDS,
+        # then produced — commit-gated either way, so the corpus never
+        # contains an uncommitted token.
+        self._distill_outbox: dict[tuple[str, int, int], bytes] = {}
+        if distill_topic is not None:
+            from torchkafka_tpu.distill.wire import encode_completion
+
+            self._encode_distill = encode_completion
+        else:
+            self._encode_distill = None
         if max_send_failure_streak < 1:
             raise ValueError("max_send_failure_streak must be >= 1")
         if kv_pages is not None and isinstance(kv_pages, dict):
@@ -3375,6 +3459,19 @@ class StreamingGenerator:
             self._tracer.finished(
                 rec, len(out), replica=self._trace_replica
             )
+        if self._distill_topic is not None:
+            # Frame the training-corpus record NOW (tokens in hand) but
+            # produce it only WITH the commit that covers its offset
+            # (txn: inside the transaction; at-least-once: after the
+            # commit succeeds) — the corpus holds committed tokens only.
+            # Keyed by record identity: a re-serve overwrites the
+            # identical frame (one committed copy, ever).
+            self._distill_outbox[(rec.topic, rec.partition, rec.offset)] = (
+                self._encode_distill(
+                    self._decode_prompt(rec), out,
+                    tenant=rec.key, model_version=self._model_version,
+                )
+            )
         sent_ok = True
         if self._output_producer is not None:
             # Async send; durability is settled in _commit (flush
@@ -3817,6 +3914,37 @@ class StreamingGenerator:
         if self._tracer is not None:
             # Durably committed: close every covered record's e2e span.
             self._tracer.note_commit(snapshot)
+        if self._distill_topic is not None and self._distill_outbox:
+            # Commit SUCCEEDED: the frames whose offsets it covers hold
+            # committed tokens — publish them now (never before; a crash
+            # pre-commit publishes nothing and the re-delivered records'
+            # regenerated completions frame the only copy). A send fault
+            # keeps the frame for the next commit's retry — losing it to
+            # a crash costs one corpus sample, never correctness.
+            prod = self._distill_producer or self._output_producer
+            if assigned is not None:
+                for ident in [
+                    i for i in self._distill_outbox
+                    if TopicPartition(i[0], i[1]) not in assigned
+                ]:
+                    del self._distill_outbox[ident]
+            covered = [
+                i for i in self._distill_outbox
+                if i[2] < snapshot.get(TopicPartition(i[0], i[1]), 0)
+            ]
+            for ident in covered:
+                try:
+                    prod.send(
+                        self._distill_topic, self._distill_outbox[ident]
+                    )
+                except Exception:  # noqa: BLE001 - retry next commit
+                    _logger.warning(
+                        "distill frame publish failed; retrying at the "
+                        "next commit", exc_info=True,
+                    )
+                    break
+                del self._distill_outbox[ident]
+                self.metrics.distill_published.add(1)
         if self._journal is not None:
             # Journal GC at commit flush: entries below the committed
             # watermark are durable history — pruning here is what bounds
@@ -3880,6 +4008,9 @@ class StreamingGenerator:
             ]
             for ident in stale:
                 del self._txn_outbox[ident]
+                # The departed record's distill frame is stale with it:
+                # its new owner frames the only committed copy.
+                self._distill_outbox.pop(ident, None)
         dup_serves = [
             ident for ident in self._txn_outbox
             if ident[2] < self._txn_committed_wm.get(
@@ -3891,6 +4022,7 @@ class StreamingGenerator:
             # (both copies of an eager-rebalance double delivery ran to
             # completion): the committed view has its single copy.
             del self._txn_outbox[ident]
+            self._distill_outbox.pop(ident, None)
         if dup_serves:
             _logger.info(
                 "dropped %d duplicate re-serve(s) already covered by "
@@ -3900,6 +4032,14 @@ class StreamingGenerator:
             ident for ident in self._txn_outbox
             if ident[2] < snapshot.get(TopicPartition(ident[0], ident[1]), 0)
         ]
+        # Distill frames covered by this window's snapshot ride the SAME
+        # transaction as the outputs + offsets: an aborted window's
+        # corpus records are invisible, a fenced zombie's are aborted
+        # with its transaction — only committed tokens ever train.
+        d_sendable = [
+            ident for ident in self._distill_outbox
+            if ident[2] < snapshot.get(TopicPartition(ident[0], ident[1]), 0)
+        ] if self._distill_topic is not None else []
         if not snapshot and not p.in_transaction:
             return True  # nothing resolved, nothing dangling: no-op
         try:
@@ -3912,6 +4052,10 @@ class StreamingGenerator:
                     kw["topic"], kw["value"], key=kw["key"],
                     headers=kw.get("headers", ()),
                 )
+            for ident in d_sendable:
+                # Tenant key rides inside the frame header; no record
+                # key needed for the corpus topic.
+                p.send(self._distill_topic, self._distill_outbox[ident])
             if snapshot:
                 p.send_offsets(
                     getattr(self._consumer, "group_id"), snapshot,
@@ -3947,6 +4091,10 @@ class StreamingGenerator:
             return False
         for ident in sendable:
             del self._txn_outbox[ident]
+        for ident in d_sendable:
+            self._distill_outbox.pop(ident, None)
+        if d_sendable:
+            self.metrics.distill_published.add(len(d_sendable))
         for tp, off in snapshot.items():
             if off > self._txn_committed_wm.get(tp, 0):
                 self._txn_committed_wm[tp] = off

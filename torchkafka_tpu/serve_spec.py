@@ -72,6 +72,7 @@ from torchkafka_tpu.models.generate import KVCache, _project_qkv, prefill
 from torchkafka_tpu.models.quant import embed_rows, load_weight
 from torchkafka_tpu.models.spec_decode import _multi_step, truncated_draft
 from torchkafka_tpu.models.transformer import _rms_norm, _rope
+from torchkafka_tpu.resilience.crashpoint import crash_hook
 from torchkafka_tpu.serve import StreamingGenerator
 from torchkafka_tpu.utils import tracing as xprof
 
@@ -718,6 +719,11 @@ class SpecStreamingGenerator(StreamingGenerator):
                 draft_params,
                 serving_shardings(self._draft_cfg, self._mesh, draft_params),
             )
+        # Death HERE (candidate fetched + validated, not yet bound) must
+        # be invisible in committed output: the incumbent draft still
+        # proposes on restart, and either draft yields the target's
+        # greedy tokens — the crash matrix pins exactly that.
+        crash_hook("draft_swap_pre_apply")
         self._draft_params = draft_params
 
     def spec_stats(self) -> dict:

@@ -17,6 +17,7 @@ from torchkafka_tpu.workload.generator import (
     WorkloadGenerator,
     diurnal_load,
     header_max_new,
+    hot_set_shift_at,
     rate_multiplier_at,
     step_load,
     zipf_weights,
@@ -29,6 +30,7 @@ __all__ = [
     "WorkloadGenerator",
     "diurnal_load",
     "header_max_new",
+    "hot_set_shift_at",
     "rate_multiplier_at",
     "step_load",
     "zipf_weights",

@@ -107,6 +107,18 @@ def rate_multiplier_at(schedule: tuple, t: float) -> float:
     return mult
 
 
+def hot_set_shift_at(rotation: tuple, t: float) -> int:
+    """The tenant-rank shift in force at synthetic time ``t`` under a
+    piecewise-constant ``hot_set_rotation`` (0 before the first entry or
+    with no rotation at all)."""
+    shift = 0
+    for t0, s in rotation:
+        if t0 > t:
+            break
+        shift = int(s)
+    return shift
+
+
 @dataclasses.dataclass(frozen=True)
 class ChaosSchedule:
     """Mid-run chaos, on the workload's synthetic timeline.
@@ -163,6 +175,16 @@ class WorkloadConfig:
     # tenants, lanes, lengths, and payloads ride their own independent
     # draw streams, so scheduled and unscheduled runs stay comparable.
     rate_schedule: tuple = ()
+    # Scheduled Zipf hot-set rotation ((t_s, shift), ...), strictly
+    # increasing t_s: from synthetic time t_s on, popularity rank r maps
+    # to tenant (r + shift) % tenants — the head of the Zipf curve
+    # MOVES. The rank draw stream is consumed identically whatever the
+    # rotation (the remap is pure arithmetic on the drawn rank), so a
+    # rotated run shares every arrival instant, lane, and length with
+    # its unrotated twin; only WHICH tenant (and hence which shared
+    # context prefix — real prompt-content drift, the thing that decays
+    # a distilled draft's α) changes at the configured instants.
+    hot_set_rotation: tuple = ()
 
     def __post_init__(self) -> None:
         if self.tenants < 1:
@@ -207,6 +229,20 @@ class WorkloadConfig:
                 raise ValueError(
                     "rate_schedule needs strictly increasing t_s >= 0 and "
                     f"factors > 0, got {self.rate_schedule!r}"
+                )
+            last_t = t_s
+        last_t = -1.0
+        for entry in self.hot_set_rotation:
+            if len(entry) != 2:
+                raise ValueError(
+                    "hot_set_rotation entries are (t_s, shift), got "
+                    f"{entry!r}"
+                )
+            t_s, shift = entry
+            if t_s < 0 or t_s <= last_t or int(shift) != shift:
+                raise ValueError(
+                    "hot_set_rotation needs strictly increasing t_s >= 0 "
+                    f"and integer shifts, got {self.hot_set_rotation!r}"
                 )
             last_t = t_s
 
@@ -331,9 +367,15 @@ class WorkloadGenerator:
             size = 1 + int(self._rng_arrival.poisson(cfg.burst_mean - 1.0))
             for _ in range(min(size, cfg.total_records - len(events))):
                 seq = len(events)
-                tenant = self.tenant_names[
-                    int(self._rng_tenant.choice(cfg.tenants, p=self._weights))
-                ]
+                # The Zipf draw picks a popularity RANK; the rotation in
+                # force at this instant maps rank → tenant. Pure
+                # arithmetic after the draw: zero extra RNG consumption,
+                # so rotated and unrotated runs share every stream.
+                rank = int(
+                    self._rng_tenant.choice(cfg.tenants, p=self._weights)
+                )
+                shift = hot_set_shift_at(cfg.hot_set_rotation, t)
+                tenant = self.tenant_names[(rank + shift) % cfg.tenants]
                 lane = (
                     INTERACTIVE
                     if self._rng_lane.random() < cfg.interactive_fraction
